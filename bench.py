@@ -1,0 +1,57 @@
+"""Benchmark: scheduling-session solve latency on TPU.
+
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The metric is the on-device batched allocate solve (gang + DRF + proportion
++ predicates + nodeorder scoring) on a synthetic kubemark-style snapshot.
+Baseline target (BASELINE.md): < 1000 ms per session at 50k pods x 10k nodes.
+
+Env overrides: BENCH_TASKS, BENCH_NODES, BENCH_JOBS, BENCH_QUEUES.
+"""
+
+import json
+import os
+import time
+
+
+def main():
+    import jax
+
+    n_tasks = int(os.environ.get("BENCH_TASKS", 50_000))
+    n_nodes = int(os.environ.get("BENCH_NODES", 10_000))
+    n_jobs = int(os.environ.get("BENCH_JOBS", 2_000))
+    n_queues = int(os.environ.get("BENCH_QUEUES", 4))
+
+    from kube_batch_tpu.models.synthetic import make_synthetic_inputs
+    from kube_batch_tpu.ops.solver import solve_allocate
+
+    inputs, config = make_synthetic_inputs(
+        n_tasks=n_tasks, n_nodes=n_nodes, n_jobs=n_jobs, n_queues=n_queues,
+        seed=0)
+
+    import numpy as np
+
+    # Warm-up: compile (cached for subsequent sessions of the same bucket).
+    # np.asarray forces device completion + transfer; block_until_ready is
+    # not reliable on the experimental axon TPU tunnel.
+    np.asarray(solve_allocate(inputs, config).assignment)
+
+    runs = []
+    for _ in range(3):
+        start = time.perf_counter()
+        result = solve_allocate(inputs, config)
+        np.asarray(result.assignment)
+        runs.append((time.perf_counter() - start) * 1e3)
+    value = min(runs)
+
+    baseline_ms = 1000.0  # north-star target per session
+    print(json.dumps({
+        "metric": f"sched-session solve latency @ {n_tasks} tasks x "
+                  f"{n_nodes} nodes (gang+DRF+proportion)",
+        "value": round(value, 2),
+        "unit": "ms",
+        "vs_baseline": round(baseline_ms / value, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

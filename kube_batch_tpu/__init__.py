@@ -1,0 +1,14 @@
+"""kube_batch_tpu: a TPU-native batch-scheduling framework.
+
+A standalone reimplementation of the capabilities of kube-batch
+(kubernetes-sigs/kube-batch, surveyed in /root/repo/SURVEY.md): gang
+scheduling over PodGroup/Queue resources, multi-queue weighted fairness,
+DRF, priority, preemption/reclaim/backfill, and pluggable predicates and
+node scoring — with the per-session decision kernel reformulated as batched
+tensor programs solved on TPU via JAX/XLA (see ``kube_batch_tpu.ops`` and the
+``tpu-allocate`` action).
+"""
+
+from .version import __version__
+
+__all__ = ["__version__"]

@@ -1,0 +1,5 @@
+"""Scheduling actions (L4): allocate, preempt, reclaim, backfill,
+tpu-allocate.
+
+TPU-native counterpart of /root/reference/pkg/scheduler/actions/.
+"""

@@ -1,0 +1,38 @@
+"""Backfill action: place BestEffort tasks on any predicate-passing node.
+
+Mirrors /root/reference/pkg/scheduler/actions/backfill/backfill.go:44-68.
+"""
+
+from __future__ import annotations
+
+from ..api import FitError, TaskStatus
+from ..framework import Action
+from ..utils import get_node_list
+
+
+class BackfillAction(Action):
+
+    def name(self) -> str:
+        return "backfill"
+
+    def execute(self, ssn) -> None:
+        for job in list(ssn.jobs.values()):
+            pending = list(job.task_status_index.get(TaskStatus.Pending,
+                                                     {}).values())
+            for task in pending:
+                if not task.init_resreq.is_empty():
+                    continue  # only BestEffort tasks backfill
+                for node in get_node_list(ssn.nodes):
+                    try:
+                        ssn.predicate_fn(task, node)
+                    except FitError:
+                        continue
+                    try:
+                        ssn.allocate(task, node.name)
+                    except Exception:
+                        continue
+                    break
+
+
+def new() -> BackfillAction:
+    return BackfillAction()

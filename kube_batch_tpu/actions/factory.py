@@ -1,0 +1,14 @@
+"""Action registration (reference actions/factory.go:28-33)."""
+
+from ..framework import register_action
+from . import allocate, backfill, preempt, reclaim
+
+
+def register_default_actions() -> None:
+    register_action(allocate.new())
+    register_action(preempt.new())
+    register_action(reclaim.new())
+    register_action(backfill.new())
+    # The TPU-batched allocate action (imports jax lazily).
+    from . import tpu_allocate
+    register_action(tpu_allocate.new())

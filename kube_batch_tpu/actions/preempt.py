@@ -1,0 +1,150 @@
+"""Preempt action: within-queue preemption under a Statement transaction.
+
+Mirrors /root/reference/pkg/scheduler/actions/preempt/preempt.go: inter-job
+preemption within each queue (commit only if the preemptor job reaches
+JobPipelined, else discard), then intra-job preemption.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..api import Resource, TaskInfo, TaskStatus
+from ..framework import Action
+from ..metrics import metrics
+from ..utils import (PriorityQueue, get_node_list, predicate_nodes,
+                     prioritize_nodes, sort_nodes)
+
+
+class PreemptAction(Action):
+
+    def name(self) -> str:
+        return "preempt"
+
+    def execute(self, ssn) -> None:
+        preemptors_map: Dict[str, PriorityQueue] = {}
+        preemptor_tasks: Dict[str, PriorityQueue] = {}
+        under_request: List = []
+        queues: Dict[str, object] = {}
+
+        for job in ssn.jobs.values():
+            queue = ssn.queues.get(job.queue)
+            if queue is None:
+                continue
+            queues.setdefault(queue.uid, queue)
+            if job.task_status_index.get(TaskStatus.Pending):
+                if job.queue not in preemptors_map:
+                    preemptors_map[job.queue] = PriorityQueue(ssn.job_order_fn)
+                preemptors_map[job.queue].push(job)
+                under_request.append(job)
+                preemptor_tasks[job.uid] = PriorityQueue(ssn.task_order_fn)
+                for task in job.task_status_index[TaskStatus.Pending].values():
+                    preemptor_tasks[job.uid].push(task)
+
+        # Preemption between jobs within a queue (preempt.go:76-134).
+        for queue in queues.values():
+            while True:
+                preemptors = preemptors_map.get(queue.uid)
+                if preemptors is None or preemptors.empty():
+                    break
+                preemptor_job = preemptors.pop()
+
+                stmt = ssn.statement()
+                assigned = False
+                while True:
+                    if preemptor_tasks[preemptor_job.uid].empty():
+                        break
+                    preemptor = preemptor_tasks[preemptor_job.uid].pop()
+
+                    def job_filter(task: TaskInfo) -> bool:
+                        if task.status != TaskStatus.Running:
+                            return False
+                        job = ssn.jobs.get(task.job)
+                        if job is None:
+                            return False
+                        return (job.queue == preemptor_job.queue
+                                and preemptor.job != task.job)
+
+                    if _preempt(ssn, stmt, preemptor, ssn.nodes, job_filter):
+                        assigned = True
+                    if ssn.job_pipelined(preemptor_job):
+                        stmt.commit()
+                        break
+
+                if not ssn.job_pipelined(preemptor_job):
+                    stmt.discard()
+                    continue
+                if assigned:
+                    preemptors.push(preemptor_job)
+
+            # Preemption between tasks within a job (preempt.go:136-165).
+            for job in under_request:
+                while True:
+                    tasks = preemptor_tasks.get(job.uid)
+                    if tasks is None or tasks.empty():
+                        break
+                    preemptor = tasks.pop()
+                    stmt = ssn.statement()
+                    assigned = _preempt(
+                        ssn, stmt, preemptor, ssn.nodes,
+                        lambda task: (task.status == TaskStatus.Running
+                                      and preemptor.job == task.job))
+                    stmt.commit()
+                    if not assigned:
+                        break
+
+
+def _preempt(ssn, stmt, preemptor: TaskInfo, nodes, filter_fn) -> bool:
+    """Try to free room for preemptor on some node (preempt.go:171-254)."""
+    all_nodes = get_node_list(nodes)
+    candidates = predicate_nodes(preemptor, all_nodes, ssn.predicate_fn)
+    priority_list = prioritize_nodes(preemptor, candidates,
+                                     ssn.node_prioritizers())
+    selected_nodes = sort_nodes(priority_list, ssn.nodes)
+
+    assigned = False
+    for node in selected_nodes:
+        preemptees = [task.clone() for task in node.tasks.values()
+                      if filter_fn is None or filter_fn(task)]
+        victims = ssn.preemptable(preemptor, preemptees)
+        metrics.update_preemption_victims_count(len(victims))
+
+        if not _validate_victims(victims, preemptor.init_resreq):
+            continue
+
+        # Lowest-priority victims evicted first: reversed task order
+        # (preempt.go:213-218).
+        victims_queue = PriorityQueue(lambda l, r: not ssn.task_order_fn(l, r))
+        for victim in victims:
+            victims_queue.push(victim)
+
+        preempted = Resource.empty()
+        resreq = preemptor.init_resreq.clone()
+        while not victims_queue.empty():
+            preemptee = victims_queue.pop()
+            stmt.evict(preemptee, "preempt")
+            preempted.add(preemptee.resreq)
+            if resreq.less_equal(preempted):
+                break
+
+        metrics.register_preemption_attempt()
+        if preemptor.init_resreq.less_equal(preempted):
+            stmt.pipeline(preemptor, node.name)
+            assigned = True
+            break
+
+    return assigned
+
+
+def _validate_victims(victims: List[TaskInfo], resreq: Resource) -> bool:
+    """Victims exist and cover the requested resources (preempt.go:256-271)."""
+    if not victims:
+        return False
+    total = Resource.empty()
+    for v in victims:
+        total.add(v.resreq)
+    return resreq.less_equal(total)
+
+
+def new() -> PreemptAction:
+    return PreemptAction()

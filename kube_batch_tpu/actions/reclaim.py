@@ -1,0 +1,107 @@
+"""Reclaim action: cross-queue eviction for starved queues.
+
+Mirrors /root/reference/pkg/scheduler/actions/reclaim/reclaim.go: per pending
+task of a non-overused queue, walk nodes, collect Running tasks of *other*
+queues, ask Reclaimable, evict until the request is covered, then Pipeline.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ..api import FitError, Resource, TaskStatus
+from ..framework import Action
+from ..utils import PriorityQueue, get_node_list
+
+
+class ReclaimAction(Action):
+
+    def name(self) -> str:
+        return "reclaim"
+
+    def execute(self, ssn) -> None:
+        queues = PriorityQueue(ssn.queue_order_fn)
+        queue_map: Dict[str, object] = {}
+        preemptors_map: Dict[str, PriorityQueue] = {}
+        preemptor_tasks: Dict[str, PriorityQueue] = {}
+
+        for job in ssn.jobs.values():
+            queue = ssn.queues.get(job.queue)
+            if queue is None:
+                continue
+            if queue.uid not in queue_map:
+                queue_map[queue.uid] = queue
+                queues.push(queue)
+            if job.task_status_index.get(TaskStatus.Pending):
+                if job.queue not in preemptors_map:
+                    preemptors_map[job.queue] = PriorityQueue(ssn.job_order_fn)
+                preemptors_map[job.queue].push(job)
+                preemptor_tasks[job.uid] = PriorityQueue(ssn.task_order_fn)
+                for task in job.task_status_index[TaskStatus.Pending].values():
+                    preemptor_tasks[job.uid].push(task)
+
+        while not queues.empty():
+            queue = queues.pop()
+            if ssn.overused(queue):
+                continue
+
+            jobs = preemptors_map.get(queue.uid)
+            if jobs is None or jobs.empty():
+                continue
+            job = jobs.pop()
+
+            tasks = preemptor_tasks.get(job.uid)
+            if tasks is None or tasks.empty():
+                continue
+            task = tasks.pop()
+
+            assigned = False
+            for node in get_node_list(ssn.nodes):
+                try:
+                    ssn.predicate_fn(task, node)
+                except FitError:
+                    continue
+
+                resreq = task.init_resreq.clone()
+                reclaimed = Resource.empty()
+
+                # Candidates: Running tasks of other queues (reclaim.go:126-138).
+                reclaimees: List = []
+                for t in node.tasks.values():
+                    if t.status != TaskStatus.Running:
+                        continue
+                    j = ssn.jobs.get(t.job)
+                    if j is None:
+                        continue
+                    if j.queue != job.queue:
+                        reclaimees.append(t.clone())
+                victims = ssn.reclaimable(task, reclaimees)
+                if not victims:
+                    continue
+
+                total = Resource.empty()
+                for v in victims:
+                    total.add(v.resreq)
+                if not resreq.less_equal(total):
+                    continue
+
+                for reclaimee in victims:
+                    try:
+                        ssn.evict(reclaimee, "reclaim")
+                    except Exception:
+                        continue
+                    reclaimed.add(reclaimee.resreq)
+                    if resreq.less_equal(reclaimed):
+                        break
+
+                if task.init_resreq.less_equal(reclaimed):
+                    ssn.pipeline(task, node.name)
+                    assigned = True
+                    break
+
+            if assigned:
+                queues.push(queue)
+
+
+def new() -> ReclaimAction:
+    return ReclaimAction()

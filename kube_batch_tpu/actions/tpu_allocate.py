@@ -1,0 +1,75 @@
+"""tpu-allocate: the allocate action solved as one device program.
+
+The action the north star (BASELINE.json) asks for: tensorize the session
+snapshot (models/tensor_snapshot.py), run the batched solver on TPU
+(ops/solver.py), then apply the placements back through the session so
+plugins, gang dispatch, and binders observe exactly the same sequence of
+events as the host allocate action.  Selectable from the YAML conf as
+``actions: "tpu-allocate, backfill"`` with zero CRD changes; sessions using
+features the device path doesn't express yet (host ports, inter-pod
+affinity) fall back to the host allocate action transparently.
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..framework import Action
+from ..metrics import metrics
+
+
+class TpuAllocateAction(Action):
+
+    def __init__(self):
+        self._fallback = None
+
+    def name(self) -> str:
+        return "tpu-allocate"
+
+    def execute(self, ssn) -> None:
+        from ..models.tensor_snapshot import tensorize_session
+
+        start = time.time()
+        snap = tensorize_session(ssn)
+        if snap.needs_fallback:
+            if self._fallback is None:
+                from .allocate import AllocateAction
+                self._fallback = AllocateAction()
+            self._fallback.execute(ssn)
+            return
+        metrics.observe_tpu_transfer_latency(time.time() - start)
+
+        if not snap.tasks:
+            return
+
+        from ..ops.solver import solve_allocate
+
+        import numpy as np
+        solve_start = time.time()
+        result = solve_allocate(snap.inputs, snap.config)
+        # np.asarray forces completion; block_until_ready is unreliable on
+        # the experimental axon TPU tunnel.
+        assignment = np.asarray(result.assignment)
+        metrics.observe_tpu_solve_latency(time.time() - solve_start)
+        kind = np.asarray(result.kind)
+        order = np.asarray(result.order)
+
+        # Apply placements in device-solve order so event handlers and the
+        # gang dispatch barrier fire in the same sequence as the host loop.
+        placed = np.nonzero(kind > 0)[0]
+        for idx in placed[np.argsort(order[placed], kind="stable")]:
+            task = snap.tasks[idx]
+            node_name = snap.node_names[int(assignment[idx])]
+            try:
+                if kind[idx] == 1:
+                    ssn.allocate(task, node_name)
+                else:
+                    ssn.pipeline(task, node_name)
+            except (KeyError, ValueError):
+                # Mirror the reference's log-and-continue on bind errors
+                # (allocate.go:162-166); cache resync repairs divergence.
+                continue
+
+
+def new() -> TpuAllocateAction:
+    return TpuAllocateAction()

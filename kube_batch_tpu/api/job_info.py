@@ -1,0 +1,210 @@
+"""TaskInfo and JobInfo: the scheduler's working units.
+
+Mirrors /root/reference/pkg/scheduler/api/job_info.go: TaskInfo construction
+from a pod (:69-93), the JobInfo TaskStatusIndex invariants (:233-295), and
+gang-readiness accounting (:383-434).
+"""
+
+from __future__ import annotations
+
+import copy
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+from ..apis.scheduling.v1alpha1 import GroupNameAnnotationKey
+from .objects import Pod, pod_key, get_pod_resource_request, \
+    get_pod_resource_without_init_containers
+from .pod_group_info import PodGroup
+from .resource import Resource
+from .types import TaskStatus, allocated_status, get_task_status
+
+
+def get_job_id(pod: Pod) -> str:
+    """namespace/group-name from the pod's group annotation (job_info.go:56-66)."""
+    group = pod.metadata.annotations.get(GroupNameAnnotationKey, "")
+    if group:
+        return f"{pod.metadata.namespace}/{group}"
+    return ""
+
+
+class TaskInfo:
+    """Scheduler view of one pod (job_info.go:36-54)."""
+
+    __slots__ = ("uid", "job", "name", "namespace", "resreq", "init_resreq",
+                 "node_name", "status", "priority", "volume_ready", "pod")
+
+    def __init__(self, pod: Pod):
+        self.uid: str = pod.metadata.uid
+        self.job: str = get_job_id(pod)
+        self.name: str = pod.metadata.name
+        self.namespace: str = pod.metadata.namespace
+        # Resreq: steady-state request; InitResreq: launch requirement
+        # including init containers (job_info.go:70-71).
+        self.resreq: Resource = get_pod_resource_without_init_containers(pod)
+        self.init_resreq: Resource = get_pod_resource_request(pod)
+        self.node_name: str = pod.spec.node_name
+        self.status: TaskStatus = get_task_status(pod)
+        self.priority: int = pod.spec.priority if pod.spec.priority is not None else 1
+        self.volume_ready: bool = False
+        self.pod: Pod = pod
+
+    def clone(self) -> "TaskInfo":
+        ti = TaskInfo.__new__(TaskInfo)
+        ti.uid = self.uid
+        ti.job = self.job
+        ti.name = self.name
+        ti.namespace = self.namespace
+        ti.resreq = self.resreq.clone()
+        ti.init_resreq = self.init_resreq.clone()
+        ti.node_name = self.node_name
+        ti.status = self.status
+        ti.priority = self.priority
+        ti.volume_ready = self.volume_ready
+        ti.pod = self.pod
+        return ti
+
+    def __repr__(self) -> str:
+        return (f"Task({self.namespace}/{self.name}: job {self.job}, "
+                f"status {self.status.name}, pri {self.priority})")
+
+
+class JobInfo:
+    """All tasks of one job plus gang/fairness accounting (job_info.go:127-154)."""
+
+    def __init__(self, uid: str, *tasks: TaskInfo):
+        self.uid: str = uid
+        self.name: str = ""
+        self.namespace: str = ""
+        self.queue: str = ""
+        self.priority: int = 0
+        self.min_available: int = 0
+        self.node_selector: Dict[str, str] = {}
+        # node name -> leftover-after-fit vector for fit-error reporting.
+        self.nodes_fit_delta: Dict[str, Resource] = {}
+        self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = defaultdict(dict)
+        self.tasks: Dict[str, TaskInfo] = {}
+        self.allocated: Resource = Resource.empty()
+        self.total_request: Resource = Resource.empty()
+        self.creation_timestamp: float = 0.0
+        self.pod_group: Optional[PodGroup] = None
+        for task in tasks:
+            self.add_task_info(task)
+
+    # -- podgroup wiring ----------------------------------------------------
+
+    def set_pod_group(self, pg: PodGroup) -> None:
+        self.name = pg.metadata.name
+        self.namespace = pg.metadata.namespace
+        self.min_available = pg.spec.min_member
+        self.queue = pg.spec.queue
+        self.creation_timestamp = pg.metadata.creation_timestamp
+        self.pod_group = pg
+
+    def unset_pod_group(self) -> None:
+        self.pod_group = None
+
+    # -- task bookkeeping (invariant-preserving) ----------------------------
+
+    def add_task_info(self, ti: TaskInfo) -> None:
+        self.tasks[ti.uid] = ti
+        self.task_status_index[ti.status][ti.uid] = ti
+        self.total_request.add(ti.resreq)
+        if allocated_status(ti.status):
+            self.allocated.add(ti.resreq)
+
+    def delete_task_info(self, ti: TaskInfo) -> None:
+        task = self.tasks.get(ti.uid)
+        if task is None:
+            raise KeyError(
+                f"failed to find task {ti.namespace}/{ti.name} in job "
+                f"{self.namespace}/{self.name}")
+        self.total_request.sub(task.resreq)
+        if allocated_status(task.status):
+            self.allocated.sub(task.resreq)
+        del self.tasks[task.uid]
+        index = self.task_status_index.get(task.status)
+        if index is not None:
+            index.pop(task.uid, None)
+            if not index:
+                del self.task_status_index[task.status]
+
+    def update_task_status(self, task: TaskInfo, status: TaskStatus) -> None:
+        """Move a task between status buckets (job_info.go:252-271)."""
+        if task.uid in self.tasks:
+            self.delete_task_info(task)
+        task.status = status
+        self.add_task_info(task)
+
+    def get_tasks(self, *statuses: TaskStatus) -> List[TaskInfo]:
+        out: List[TaskInfo] = []
+        for status in statuses:
+            out.extend(t.clone() for t in self.task_status_index.get(status, {}).values())
+        return out
+
+    # -- gang accounting (job_info.go:383-434) ------------------------------
+
+    def ready_task_num(self) -> int:
+        n = 0
+        for status, tasks in self.task_status_index.items():
+            if allocated_status(status) or status == TaskStatus.Succeeded:
+                n += len(tasks)
+        return n
+
+    def waiting_task_num(self) -> int:
+        return len(self.task_status_index.get(TaskStatus.Pipelined, {}))
+
+    def valid_task_num(self) -> int:
+        n = 0
+        for status, tasks in self.task_status_index.items():
+            if (allocated_status(status) or status in
+                    (TaskStatus.Succeeded, TaskStatus.Pipelined, TaskStatus.Pending)):
+                n += len(tasks)
+        return n
+
+    def ready(self) -> bool:
+        return self.ready_task_num() >= self.min_available
+
+    def pipelined(self) -> bool:
+        return self.waiting_task_num() + self.ready_task_num() >= self.min_available
+
+    # -- diagnostics --------------------------------------------------------
+
+    def fit_error(self) -> str:
+        """Histogram of insufficient resources across nodes (job_info.go:348-380)."""
+        if not self.nodes_fit_delta:
+            return "0 nodes are available"
+        reasons: Dict[str, int] = defaultdict(int)
+        for delta in self.nodes_fit_delta.values():
+            if delta.get("cpu") < 0:
+                reasons["cpu"] += 1
+            if delta.get("memory") < 0:
+                reasons["memory"] += 1
+            for name, q in delta.scalar_resources.items():
+                if q < 0:
+                    reasons[name] += 1
+        parts = sorted(f"{count} insufficient {name}" for name, count in reasons.items())
+        return (f"0/{len(self.nodes_fit_delta)} nodes are available, "
+                f"{', '.join(parts)}.")
+
+    def clone(self) -> "JobInfo":
+        info = JobInfo(self.uid)
+        info.name = self.name
+        info.namespace = self.namespace
+        info.queue = self.queue
+        info.priority = self.priority
+        info.min_available = self.min_available
+        info.node_selector = dict(self.node_selector)
+        info.creation_timestamp = self.creation_timestamp
+        info.pod_group = copy.deepcopy(self.pod_group)
+        for task in self.tasks.values():
+            info.add_task_info(task.clone())
+        return info
+
+    def __repr__(self) -> str:
+        return (f"Job({self.uid}: queue {self.queue}, minAvailable "
+                f"{self.min_available}, tasks {len(self.tasks)})")
+
+
+def job_terminated(job: JobInfo) -> bool:
+    """Job has no group and no tasks left (helpers.go:115-119)."""
+    return job.pod_group is None and not job.tasks

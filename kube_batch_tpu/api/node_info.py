@@ -1,0 +1,140 @@
+"""NodeInfo: per-node resource accounting.
+
+Mirrors /root/reference/pkg/scheduler/api/node_info.go, in particular the
+status-dependent accounting in AddTask/RemoveTask (:172-259): a Releasing task
+still holds Idle but contributes to Releasing; a Pipelined task consumes from
+Releasing; everything else consumes Idle.  OutOfSync detection (:107-131)
+excludes nodes whose Used exceeds allocatable.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .objects import Node, pod_key
+from .resource import Resource
+from .types import NodePhase, NodeState, TaskStatus
+from .job_info import TaskInfo
+
+
+class NodeInfo:
+
+    def __init__(self, node: Optional[Node] = None):
+        self.name: str = ""
+        self.node: Optional[Node] = None
+        self.state: NodeState = NodeState()
+        self.releasing: Resource = Resource.empty()
+        self.idle: Resource = Resource.empty()
+        self.used: Resource = Resource.empty()
+        self.allocatable: Resource = Resource.empty()
+        self.capability: Resource = Resource.empty()
+        self.tasks: Dict[str, TaskInfo] = {}
+        if node is not None:
+            self.name = node.name
+            self.node = node
+            self.idle = Resource.from_resource_list(node.status.allocatable)
+            self.allocatable = Resource.from_resource_list(node.status.allocatable)
+            self.capability = Resource.from_resource_list(node.status.capacity)
+        self._set_node_state(node)
+
+    # -- state --------------------------------------------------------------
+
+    def _set_node_state(self, node: Optional[Node]) -> None:
+        if node is None:
+            self.state = NodeState(NodePhase.NotReady, "UnInitialized")
+            return
+        if not self.used.less_equal(Resource.from_resource_list(node.status.allocatable)):
+            self.state = NodeState(NodePhase.NotReady, "OutOfSync")
+            return
+        self.state = NodeState(NodePhase.Ready, "")
+
+    def ready(self) -> bool:
+        return self.state.phase == NodePhase.Ready
+
+    def set_node(self, node: Node) -> None:
+        """Refresh from the cluster object, rebuilding accounting from the
+        resident tasks (node_info.go:134-158)."""
+        self._set_node_state(node)
+        if not self.ready():
+            return
+        self.name = node.name
+        self.node = node
+        self.allocatable = Resource.from_resource_list(node.status.allocatable)
+        self.capability = Resource.from_resource_list(node.status.capacity)
+        self.idle = Resource.from_resource_list(node.status.allocatable)
+        self.used = Resource.empty()
+        self.releasing = Resource.empty()
+        for task in self.tasks.values():
+            if task.status == TaskStatus.Releasing:
+                self.releasing.add(task.resreq)
+            self.idle.sub(task.resreq)
+            self.used.add(task.resreq)
+
+    # -- task accounting ----------------------------------------------------
+
+    def _allocate_idle(self, ti: TaskInfo) -> None:
+        if not ti.resreq.less_equal(self.idle):
+            raise ValueError("Selected node NotReady")
+        self.idle.sub(ti.resreq)
+
+    def add_task(self, task: TaskInfo) -> None:
+        """Account a task onto this node (node_info.go:172-220).  On error the
+        task and node are left untouched."""
+        if task.node_name and self.name and task.node_name != self.name:
+            raise ValueError(
+                f"task {task.namespace}/{task.name} already on different "
+                f"node {task.node_name}")
+        key = pod_key(task.pod)
+        if key in self.tasks:
+            raise ValueError(
+                f"task {task.namespace}/{task.name} already on node {self.name}")
+        # The node holds a clone so later task-status churn can't corrupt
+        # node accounting.
+        ti = task.clone()
+        if self.node is not None:
+            if ti.status == TaskStatus.Releasing:
+                self._allocate_idle(ti)
+                self.releasing.add(ti.resreq)
+            elif ti.status == TaskStatus.Pipelined:
+                self.releasing.sub(ti.resreq)
+            else:
+                self._allocate_idle(ti)
+            self.used.add(ti.resreq)
+        task.node_name = self.name
+        ti.node_name = self.name
+        self.tasks[key] = ti
+
+    def remove_task(self, ti: TaskInfo) -> None:
+        """Reverse of add_task (node_info.go:223-248)."""
+        key = pod_key(ti.pod)
+        task = self.tasks.get(key)
+        if task is None:
+            raise KeyError(
+                f"failed to find task {ti.namespace}/{ti.name} on host {self.name}")
+        if self.node is not None:
+            if task.status == TaskStatus.Releasing:
+                self.releasing.sub(task.resreq)
+                self.idle.add(task.resreq)
+            elif task.status == TaskStatus.Pipelined:
+                self.releasing.add(task.resreq)
+            else:
+                self.idle.add(task.resreq)
+            self.used.sub(task.resreq)
+        del self.tasks[key]
+
+    def update_task(self, ti: TaskInfo) -> None:
+        self.remove_task(ti)
+        self.add_task(ti)
+
+    def pods(self):
+        return [t.pod for t in self.tasks.values()]
+
+    def clone(self) -> "NodeInfo":
+        res = NodeInfo(self.node)
+        for task in self.tasks.values():
+            res.add_task(task.clone())
+        return res
+
+    def __repr__(self) -> str:
+        return (f"NodeInfo({self.name}: idle <{self.idle}>, used <{self.used}>, "
+                f"releasing <{self.releasing}>)")

@@ -1,0 +1,317 @@
+"""SchedulerCache: the cluster mirror and effector hub.
+
+Mirrors /root/reference/pkg/scheduler/cache/cache.go and event_handlers.go:
+informer callbacks mutate the in-memory model under one lock; ``snapshot()``
+deep-clones Ready nodes, queues, and jobs-with-podgroups and resolves job
+priority from PriorityClasses; ``bind``/``evict`` go through pluggable
+effectors with status revert + resync on failure; pods without a PodGroup get
+shadow groups.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ..api import (ClusterInfo, JobInfo, NodeInfo, Pod, PodGroup, QueueInfo,
+                   TaskInfo, TaskStatus, get_job_id, job_terminated,
+                   pod_key)
+from ..api.job_info import TaskInfo as _TaskInfo
+from ..api.queue_info import Queue, queue_from_versioned
+from ..api.pod_group_info import from_versioned
+from .interface import Binder, Cache, Evictor, StatusUpdater, VolumeBinder
+from .shadow import create_shadow_pod_group, shadow_group_key, shadow_pod_group
+
+
+class SchedulerCache(Cache):
+    """In-memory cluster mirror (cache.go:73-105)."""
+
+    def __init__(self, scheduler_name: str = "kube-batch",
+                 default_queue: str = "default",
+                 binder: Optional[Binder] = None,
+                 evictor: Optional[Evictor] = None,
+                 status_updater: Optional[StatusUpdater] = None,
+                 volume_binder: Optional[VolumeBinder] = None):
+        self.mutex = threading.RLock()
+        self.scheduler_name = scheduler_name
+        self.default_queue = default_queue
+
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[str, Queue] = {}
+        self.priority_classes: Dict[str, object] = {}
+        self.default_priority_class = None
+
+        self.binder = binder
+        self.evictor = evictor
+        self.status_updater = status_updater
+        self.volume_binder = volume_binder
+
+        # Failed-effect repair queue (cache.go:602-624): tasks whose async
+        # bind/evict failed are resynced against cluster ground truth.
+        self.err_tasks: List[TaskInfo] = []
+        self.deleted_jobs: List[JobInfo] = []
+        self.events: List[tuple] = []  # recorded cluster events
+
+    # ------------------------------------------------------------------
+    # lifecycle
+
+    def run(self) -> None:
+        pass  # informer wiring handled by the Cluster simulator / edge
+
+    def wait_for_cache_sync(self) -> bool:
+        return True
+
+    # ------------------------------------------------------------------
+    # pod / task ingestion (event_handlers.go:72-161)
+
+    def _get_or_create_job(self, ti: _TaskInfo) -> Optional[JobInfo]:
+        if not ti.job:
+            # No PodGroup annotation: only pods of our scheduler get shadow
+            # groups (event_handlers.go:45-70).
+            if ti.pod.spec.scheduler_name != self.scheduler_name:
+                return None
+            key = shadow_group_key(ti.pod)
+            ti.job = key
+            if key not in self.jobs:
+                job = JobInfo(key)
+                job.set_pod_group(create_shadow_pod_group(ti.pod))
+                job.queue = self.default_queue
+                self.jobs[key] = job
+            return self.jobs[key]
+        if ti.job not in self.jobs:
+            self.jobs[ti.job] = JobInfo(ti.job)
+        return self.jobs[ti.job]
+
+    def _add_task(self, ti: _TaskInfo) -> None:
+        job = self._get_or_create_job(ti)
+        if job is not None:
+            job.add_task_info(ti)
+        if ti.node_name:
+            if ti.node_name not in self.nodes:
+                self.nodes[ti.node_name] = NodeInfo(None)
+                self.nodes[ti.node_name].name = ti.node_name
+            self.nodes[ti.node_name].add_task(ti)
+
+    def _delete_task(self, ti: _TaskInfo) -> None:
+        job = self.jobs.get(ti.job)
+        if job is not None:
+            existing = job.tasks.get(ti.uid)
+            if existing is not None:
+                job.delete_task_info(existing)
+                ti = existing
+            if job_terminated(job):
+                del self.jobs[job.uid]
+        if ti.node_name and ti.node_name in self.nodes:
+            try:
+                self.nodes[ti.node_name].remove_task(ti)
+            except KeyError:
+                pass
+
+    def add_pod(self, pod: Pod) -> None:
+        with self.mutex:
+            self._add_task(_TaskInfo(pod))
+
+    def update_pod(self, old_pod: Pod, new_pod: Pod) -> None:
+        with self.mutex:
+            self._delete_task(_TaskInfo(old_pod))
+            self._add_task(_TaskInfo(new_pod))
+
+    def delete_pod(self, pod: Pod) -> None:
+        with self.mutex:
+            self._delete_task(_TaskInfo(pod))
+
+    def sync_task(self, old_task: TaskInfo, cluster_pod: Optional[Pod]) -> None:
+        """Refetch ground truth for a task whose effect failed
+        (event_handlers.go:101-119)."""
+        with self.mutex:
+            self._delete_task(old_task)
+            if cluster_pod is not None:
+                self._add_task(_TaskInfo(cluster_pod))
+
+    # ------------------------------------------------------------------
+    # node ingestion (event_handlers.go:296-365)
+
+    def add_node(self, node) -> None:
+        with self.mutex:
+            if node.name in self.nodes:
+                self.nodes[node.name].set_node(node)
+            else:
+                self.nodes[node.name] = NodeInfo(node)
+
+    def update_node(self, old_node, new_node) -> None:
+        with self.mutex:
+            if new_node.name in self.nodes:
+                self.nodes[new_node.name].set_node(new_node)
+            else:
+                self.nodes[new_node.name] = NodeInfo(new_node)
+
+    def delete_node(self, node) -> None:
+        with self.mutex:
+            self.nodes.pop(node.name, None)
+
+    # ------------------------------------------------------------------
+    # PodGroup / Queue / PriorityClass ingestion
+
+    def add_pod_group(self, pg) -> None:
+        """Accepts a v1alpha1 or v1alpha2 PodGroup (event_handlers.go
+        version-converting handlers)."""
+        internal = from_versioned(pg) if not isinstance(pg, PodGroup) else pg
+        key = f"{internal.metadata.namespace}/{internal.metadata.name}"
+        with self.mutex:
+            if key not in self.jobs:
+                self.jobs[key] = JobInfo(key)
+            job = self.jobs[key]
+            job.set_pod_group(internal)
+            if not job.queue:
+                job.queue = self.default_queue
+
+    def update_pod_group(self, old_pg, new_pg) -> None:
+        self.add_pod_group(new_pg)
+
+    def delete_pod_group(self, pg) -> None:
+        internal = from_versioned(pg) if not isinstance(pg, PodGroup) else pg
+        key = f"{internal.metadata.namespace}/{internal.metadata.name}"
+        with self.mutex:
+            job = self.jobs.get(key)
+            if job is None:
+                return
+            job.unset_pod_group()
+            if job_terminated(job):
+                del self.jobs[key]
+            else:
+                self.deleted_jobs.append(job)
+
+    def add_queue(self, queue) -> None:
+        q = queue if isinstance(queue, Queue) else queue_from_versioned(queue)
+        with self.mutex:
+            self.queues[q.metadata.name] = q
+
+    def update_queue(self, old_queue, new_queue) -> None:
+        self.add_queue(new_queue)
+
+    def delete_queue(self, queue) -> None:
+        name = queue.metadata.name if hasattr(queue, "metadata") else str(queue)
+        with self.mutex:
+            self.queues.pop(name, None)
+
+    def add_priority_class(self, pc) -> None:
+        with self.mutex:
+            self.priority_classes[pc.metadata.name] = pc
+            if pc.global_default:
+                self.default_priority_class = pc
+
+    def delete_priority_class(self, pc) -> None:
+        with self.mutex:
+            self.priority_classes.pop(pc.metadata.name, None)
+            if (self.default_priority_class is not None
+                    and self.default_priority_class.metadata.name
+                    == pc.metadata.name):
+                self.default_priority_class = None
+
+    # ------------------------------------------------------------------
+    # snapshot (cache.go:627-683)
+
+    def snapshot(self) -> ClusterInfo:
+        with self.mutex:
+            info = ClusterInfo()
+            for name, node in self.nodes.items():
+                if not node.ready():
+                    continue  # OutOfSync/NotReady nodes excluded (cache.go:638-643)
+                info.nodes[name] = node.clone()
+            for name, queue in self.queues.items():
+                info.queues[name] = QueueInfo(queue)
+            for uid, job in self.jobs.items():
+                # Jobs without PodGroup (or PDB analog) are skipped with an
+                # unschedulable event (cache.go:650-662).
+                if job.pod_group is None:
+                    self.events.append(
+                        ("FailedScheduling", uid, "job without PodGroup"))
+                    continue
+                clone = job.clone()
+                # Resolve job priority from PriorityClass (cache.go:664-674).
+                pc_name = clone.pod_group.spec.priority_class_name
+                if self.default_priority_class is not None:
+                    clone.priority = self.default_priority_class.value
+                pc = self.priority_classes.get(pc_name)
+                if pc is not None:
+                    clone.priority = pc.value
+                info.jobs[uid] = clone
+            return info
+
+    # ------------------------------------------------------------------
+    # effectors (cache.go:425-535)
+
+    def bind(self, task: TaskInfo, hostname: str) -> None:
+        """Delegate to the Binder; revert task status and queue a resync on
+        failure (cache.go:491-535)."""
+        if self.binder is None:
+            raise RuntimeError("no binder configured")
+        try:
+            self.binder.bind(task.pod, hostname)
+            self.events.append(("Scheduled", pod_key(task.pod), hostname))
+        except Exception:
+            self._resync_task(task)
+            raise
+
+    def evict(self, task: TaskInfo, reason: str) -> None:
+        """Delegate to the Evictor (cache.go:425-488)."""
+        if self.evictor is None:
+            raise RuntimeError("no evictor configured")
+        job = self.jobs.get(task.job)
+        try:
+            self.evictor.evict(task.pod)
+            self.events.append(("Evict", pod_key(task.pod), reason))
+        except Exception:
+            self._resync_task(task)
+            raise
+        # Mirror cluster-side status transition (cache.go:447-459).
+        with self.mutex:
+            if job is not None and task.uid in job.tasks:
+                job.update_task_status(job.tasks[task.uid], TaskStatus.Releasing)
+                node = self.nodes.get(task.node_name)
+                if node is not None:
+                    try:
+                        node.update_task(job.tasks[task.uid])
+                    except (KeyError, ValueError):
+                        pass
+
+    def _resync_task(self, task: TaskInfo) -> None:
+        self.err_tasks.append(task)
+
+    def process_resync_tasks(self, cluster=None) -> None:
+        """Drain the error queue against the cluster's ground truth."""
+        while self.err_tasks:
+            task = self.err_tasks.pop()
+            cluster_pod = cluster.get_pod(task.namespace, task.name) \
+                if cluster is not None else None
+            self.sync_task(task, cluster_pod)
+
+    def update_job_status(self, job: JobInfo) -> JobInfo:
+        """Push PodGroup status to the cluster (cache.go:763-775)."""
+        if self.status_updater is not None and not shadow_pod_group(job.pod_group):
+            self.status_updater.update_pod_group(job.pod_group)
+        self.record_job_status_event(job)
+        return job
+
+    def record_job_status_event(self, job: JobInfo) -> None:
+        if job.pod_group is not None and not job.ready():
+            self.events.append(
+                ("Unschedulable", job.uid, job.fit_error()))
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
+        if self.volume_binder is not None:
+            self.volume_binder.allocate_volumes(task, hostname)
+
+    def bind_volumes(self, task: TaskInfo) -> None:
+        if self.volume_binder is not None:
+            self.volume_binder.bind_volumes(task)
+
+    def task_unschedulable(self, task: TaskInfo, message: str) -> None:
+        """Record the pod condition for an unschedulable task
+        (cache.go:548-568)."""
+        if self.status_updater is not None:
+            self.status_updater.update_pod_condition(
+                task.pod, ("PodScheduled", "False", "Unschedulable", message))
+        self.events.append(("FailedScheduling", pod_key(task.pod), message))

@@ -1,0 +1,292 @@
+"""Cluster: an in-memory cluster-state store with watch semantics.
+
+The reference's communication backend is the Kubernetes API server spoken via
+client-go informers (watch in) and REST effectors (bind/evict/status out) —
+SURVEY.md §2.2.  This framework is standalone: ``Cluster`` is the durable
+cluster-state store, ``Informer`` fans change events to registered handlers
+(the SchedulerCache), and ``ClusterBinder``/``ClusterEvictor``/
+``ClusterStatusUpdater`` are the effectors that write decisions back.  The
+kind/kubemark e2e harnesses of the reference map onto driving this simulator.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..api.objects import Node, Pod, PriorityClass
+from .interface import Binder, Evictor, StatusUpdater
+
+
+class Informer:
+    """Fan-out of add/update/delete events for one resource kind."""
+
+    def __init__(self):
+        self.handlers: List[dict] = []
+
+    def add_handlers(self, on_add=None, on_update=None, on_delete=None,
+                     filter_fn=None):
+        self.handlers.append(dict(add=on_add, update=on_update,
+                                  delete=on_delete, filter=filter_fn))
+
+    def _fire(self, kind: str, *args):
+        for h in self.handlers:
+            if h["filter"] is not None and not h["filter"](args[-1]):
+                continue
+            fn = h[kind]
+            if fn is not None:
+                fn(*args)
+
+    def fire_add(self, obj):
+        self._fire("add", obj)
+
+    def fire_update(self, old, new):
+        self._fire("update", old, new)
+
+    def fire_delete(self, obj):
+        self._fire("delete", obj)
+
+
+class Cluster:
+    """In-memory object store + informers; the simulated API server."""
+
+    def __init__(self, auto_run_bound_pods: bool = True):
+        self.lock = threading.RLock()
+        self.pods: Dict[str, Pod] = {}
+        self.nodes: Dict[str, Node] = {}
+        self.pod_groups: Dict[str, object] = {}
+        self.queues: Dict[str, object] = {}
+        self.priority_classes: Dict[str, PriorityClass] = {}
+        self.pod_informer = Informer()
+        self.node_informer = Informer()
+        self.pod_group_informer = Informer()
+        self.queue_informer = Informer()
+        self.priority_class_informer = Informer()
+        # Kubelet stand-in: a bound pod starts Running immediately.
+        self.auto_run_bound_pods = auto_run_bound_pods
+        self._rv = itertools.count(1)
+
+    # -- helpers ------------------------------------------------------------
+
+    @staticmethod
+    def _pod_key(pod: Pod) -> str:
+        return f"{pod.metadata.namespace}/{pod.metadata.name}"
+
+    def get_pod(self, namespace: str, name: str) -> Optional[Pod]:
+        with self.lock:
+            return self.pods.get(f"{namespace}/{name}")
+
+    # -- pod verbs ----------------------------------------------------------
+
+    def create_pod(self, pod: Pod) -> Pod:
+        with self.lock:
+            key = self._pod_key(pod)
+            if key in self.pods:
+                raise ValueError(f"pod {key} already exists")
+            if not pod.metadata.creation_timestamp:
+                pod.metadata.creation_timestamp = time.time()
+            self.pods[key] = pod
+            self.pod_informer.fire_add(pod)
+            return pod
+
+    def update_pod(self, pod: Pod) -> Pod:
+        with self.lock:
+            key = self._pod_key(pod)
+            old = self.pods.get(key)
+            if old is None:
+                raise KeyError(f"pod {key} not found")
+            self.pods[key] = pod
+            self.pod_informer.fire_update(old, pod)
+            return pod
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        """Pod deletion; mirrors the two-phase delete the scheduler sees:
+        a deletionTimestamp update (-> Releasing) then removal."""
+        with self.lock:
+            key = f"{namespace}/{name}"
+            pod = self.pods.get(key)
+            if pod is None:
+                raise KeyError(f"pod {key} not found")
+            old = copy.deepcopy(pod)
+            pod.metadata.deletion_timestamp = time.time()
+            self.pod_informer.fire_update(old, pod)
+            del self.pods[key]
+            self.pod_informer.fire_delete(pod)
+
+    def bind_pod(self, namespace: str, name: str, hostname: str) -> None:
+        """The /bind subresource (reference cache.go:119-131)."""
+        with self.lock:
+            key = f"{namespace}/{name}"
+            pod = self.pods.get(key)
+            if pod is None:
+                raise KeyError(f"pod {key} not found")
+            if hostname not in self.nodes:
+                raise KeyError(f"node {hostname} not found")
+            old = copy.deepcopy(pod)
+            pod.spec.node_name = hostname
+            if self.auto_run_bound_pods:
+                pod.status.phase = "Running"
+            self.pod_informer.fire_update(old, pod)
+
+    # -- node verbs ---------------------------------------------------------
+
+    def create_node(self, node: Node) -> Node:
+        with self.lock:
+            self.nodes[node.name] = node
+            self.node_informer.fire_add(node)
+            return node
+
+    def update_node(self, node: Node) -> Node:
+        with self.lock:
+            old = self.nodes.get(node.name)
+            self.nodes[node.name] = node
+            if old is None:
+                self.node_informer.fire_add(node)
+            else:
+                self.node_informer.fire_update(old, node)
+            return node
+
+    def delete_node(self, name: str) -> None:
+        with self.lock:
+            node = self.nodes.pop(name, None)
+            if node is not None:
+                self.node_informer.fire_delete(node)
+
+    # -- CRD verbs ----------------------------------------------------------
+
+    def create_pod_group(self, pg) -> object:
+        with self.lock:
+            key = f"{pg.metadata.namespace}/{pg.metadata.name}"
+            if not pg.metadata.creation_timestamp:
+                pg.metadata.creation_timestamp = time.time()
+            self.pod_groups[key] = pg
+            self.pod_group_informer.fire_add(pg)
+            return pg
+
+    def update_pod_group(self, pg) -> object:
+        with self.lock:
+            key = f"{pg.metadata.namespace}/{pg.metadata.name}"
+            old = self.pod_groups.get(key)
+            self.pod_groups[key] = pg
+            if old is None:
+                self.pod_group_informer.fire_add(pg)
+            else:
+                self.pod_group_informer.fire_update(old, pg)
+            return pg
+
+    def delete_pod_group(self, namespace: str, name: str) -> None:
+        with self.lock:
+            pg = self.pod_groups.pop(f"{namespace}/{name}", None)
+            if pg is not None:
+                self.pod_group_informer.fire_delete(pg)
+
+    def create_queue(self, queue) -> object:
+        with self.lock:
+            self.queues[queue.metadata.name] = queue
+            self.queue_informer.fire_add(queue)
+            return queue
+
+    def delete_queue(self, name: str) -> None:
+        with self.lock:
+            q = self.queues.pop(name, None)
+            if q is not None:
+                self.queue_informer.fire_delete(q)
+
+    def create_priority_class(self, pc: PriorityClass) -> PriorityClass:
+        with self.lock:
+            self.priority_classes[pc.metadata.name] = pc
+            self.priority_class_informer.fire_add(pc)
+            return pc
+
+
+class ClusterBinder(Binder):
+    """Real binder against the simulator (reference cache.go:113-131)."""
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+
+    def bind(self, pod, hostname: str) -> None:
+        self.cluster.bind_pod(pod.metadata.namespace, pod.metadata.name, hostname)
+
+
+class ClusterEvictor(Evictor):
+    """Evicts by deleting the pod (reference cache.go:138-146)."""
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+
+    def evict(self, pod) -> None:
+        self.cluster.delete_pod(pod.metadata.namespace, pod.metadata.name)
+
+
+class ClusterStatusUpdater(StatusUpdater):
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+
+    def update_pod_condition(self, pod, condition) -> None:
+        pass  # conditions are not modeled on simulator pods yet
+
+    def update_pod_group(self, pg) -> None:
+        from ..api.pod_group_info import PodGroup, to_versioned
+        obj = to_versioned(pg) if isinstance(pg, PodGroup) else pg
+        key = f"{obj.metadata.namespace}/{obj.metadata.name}"
+        with self.cluster.lock:
+            if key in self.cluster.pod_groups:
+                self.cluster.pod_groups[key] = obj
+
+
+def connect_cache_to_cluster(cache, cluster: Cluster) -> None:
+    """Register the cache's event handlers on the cluster's informers,
+    mirroring the 12 informer registrations in reference cache.go:255-352
+    (pods filtered by scheduler name and phase)."""
+
+    def pod_filter(pod) -> bool:
+        # cache.go:286-304: either already scheduled (has node) or pending
+        # for our scheduler.
+        if pod.spec.node_name:
+            return True
+        return pod.spec.scheduler_name == cache.scheduler_name
+
+    cluster.pod_informer.add_handlers(
+        on_add=cache.add_pod, on_update=cache.update_pod,
+        on_delete=cache.delete_pod, filter_fn=pod_filter)
+    cluster.node_informer.add_handlers(
+        on_add=cache.add_node, on_update=cache.update_node,
+        on_delete=cache.delete_node)
+    cluster.pod_group_informer.add_handlers(
+        on_add=cache.add_pod_group, on_update=cache.update_pod_group,
+        on_delete=cache.delete_pod_group)
+    cluster.queue_informer.add_handlers(
+        on_add=cache.add_queue, on_update=cache.update_queue,
+        on_delete=cache.delete_queue)
+    cluster.priority_class_informer.add_handlers(
+        on_add=cache.add_priority_class, on_delete=cache.delete_priority_class)
+
+    # Replay current state (informer initial LIST).
+    with cluster.lock:
+        for node in cluster.nodes.values():
+            cache.add_node(node)
+        for queue in cluster.queues.values():
+            cache.add_queue(queue)
+        for pc in cluster.priority_classes.values():
+            cache.add_priority_class(pc)
+        for pg in cluster.pod_groups.values():
+            cache.add_pod_group(pg)
+        for pod in cluster.pods.values():
+            if pod_filter(pod):
+                cache.add_pod(pod)
+
+
+def new_scheduler_cache(cluster: Cluster, scheduler_name: str = "kube-batch",
+                        default_queue: str = "default"):
+    """Build a fully-wired SchedulerCache over a Cluster (cache.go:223-352)."""
+    from .cache import SchedulerCache
+    cache = SchedulerCache(
+        scheduler_name=scheduler_name, default_queue=default_queue,
+        binder=ClusterBinder(cluster), evictor=ClusterEvictor(cluster),
+        status_updater=ClusterStatusUpdater(cluster))
+    connect_cache_to_cluster(cache, cluster)
+    return cache

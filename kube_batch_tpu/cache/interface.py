@@ -1,0 +1,64 @@
+"""Cache and effector interfaces.
+
+Mirrors /root/reference/pkg/scheduler/cache/interface.go:26-77.
+"""
+
+from __future__ import annotations
+
+import abc
+
+from ..api import ClusterInfo, JobInfo, TaskInfo
+
+
+class Cache(abc.ABC):
+    """Cluster-state mirror consumed by the session (interface.go:26-55)."""
+
+    @abc.abstractmethod
+    def run(self) -> None: ...
+
+    @abc.abstractmethod
+    def wait_for_cache_sync(self) -> bool: ...
+
+    @abc.abstractmethod
+    def snapshot(self) -> ClusterInfo: ...
+
+    @abc.abstractmethod
+    def bind(self, task: TaskInfo, hostname: str) -> None: ...
+
+    @abc.abstractmethod
+    def evict(self, task: TaskInfo, reason: str) -> None: ...
+
+    @abc.abstractmethod
+    def update_job_status(self, job: JobInfo) -> JobInfo: ...
+
+    def record_job_status_event(self, job: JobInfo) -> None: ...
+
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None: ...
+
+    def bind_volumes(self, task: TaskInfo) -> None: ...
+
+
+class Binder(abc.ABC):
+    @abc.abstractmethod
+    def bind(self, pod, hostname: str) -> None: ...
+
+
+class Evictor(abc.ABC):
+    @abc.abstractmethod
+    def evict(self, pod) -> None: ...
+
+
+class StatusUpdater(abc.ABC):
+    @abc.abstractmethod
+    def update_pod_condition(self, pod, condition) -> None: ...
+
+    @abc.abstractmethod
+    def update_pod_group(self, pg) -> None: ...
+
+
+class VolumeBinder(abc.ABC):
+    @abc.abstractmethod
+    def allocate_volumes(self, task: TaskInfo, hostname: str) -> None: ...
+
+    @abc.abstractmethod
+    def bind_volumes(self, task: TaskInfo) -> None: ...

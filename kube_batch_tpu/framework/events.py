@@ -1,0 +1,21 @@
+"""Session events (reference framework/event.go:20-31)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from ..api.job_info import TaskInfo
+
+
+@dataclass
+class Event:
+    task: TaskInfo
+
+
+@dataclass
+class EventHandler:
+    """Allocate/Deallocate callbacks plugins register to keep incremental
+    state (DRF shares, proportion allocations) in sync with decisions."""
+    allocate_func: Optional[Callable[[Event], None]] = None
+    deallocate_func: Optional[Callable[[Event], None]] = None

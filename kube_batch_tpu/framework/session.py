@@ -1,0 +1,443 @@
+"""Session: snapshot-backed working state for one scheduling cycle.
+
+Mirrors /root/reference/pkg/scheduler/framework/session.go (lifecycle,
+Allocate/Pipeline/Evict/dispatch) and session_plugins.go (the tiered decision
+combinators: victim-intersection with first-decisive-tier for Preemptable/
+Reclaimable, veto-AND for JobReady/JobPipelined/JobValid/Overused,
+first-nonzero comparison chains for the order functions, all-tiers AND for
+predicates, concatenation for node-order functions).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Callable, Dict, List, Optional
+
+from ..api import (ClusterInfo, FitError, JobInfo, NodeInfo, QueueInfo,
+                   TaskInfo, TaskStatus, ValidateResult, allocated_status)
+from ..api.pod_group_info import (PodGroupCondition, PodGroupPending,
+                                  PodGroupRunning, PodGroupUnknown,
+                                  PodGroupUnschedulableType)
+from ..metrics import metrics
+from .events import Event, EventHandler
+from .interface import Plugin
+
+
+class Session:
+    """One scheduling cycle's working state + plugin callback registries
+    (session.go:37-61)."""
+
+    def __init__(self, cache):
+        self.uid: str = str(uuid.uuid4())
+        self.cache = cache
+
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.queues: Dict[str, QueueInfo] = {}
+        self.tiers: List[Tier] = []
+
+        self.plugins: Dict[str, Plugin] = {}
+        self.event_handlers: List[EventHandler] = []
+        self.job_order_fns: Dict[str, Callable] = {}
+        self.queue_order_fns: Dict[str, Callable] = {}
+        self.task_order_fns: Dict[str, Callable] = {}
+        self.predicate_fns: Dict[str, Callable] = {}
+        self.preemptable_fns: Dict[str, Callable] = {}
+        self.reclaimable_fns: Dict[str, Callable] = {}
+        self.overused_fns: Dict[str, Callable] = {}
+        self.job_ready_fns: Dict[str, Callable] = {}
+        self.job_pipelined_fns: Dict[str, Callable] = {}
+        self.job_valid_fns: Dict[str, Callable] = {}
+        self.node_order_fns: Dict[str, List] = {}
+        # Batch solvers registered by TPU-aware plugins: each maps the
+        # tensorized snapshot to mask/score contributions (see ops/).
+        self.tensor_plugins: Dict[str, object] = {}
+
+    # ------------------------------------------------------------------
+    # registration (session_plugins.go:25-77)
+
+    def add_job_order_fn(self, name, fn):
+        self.job_order_fns[name] = fn
+
+    def add_queue_order_fn(self, name, fn):
+        self.queue_order_fns[name] = fn
+
+    def add_task_order_fn(self, name, fn):
+        self.task_order_fns[name] = fn
+
+    def add_predicate_fn(self, name, fn):
+        self.predicate_fns[name] = fn
+
+    def add_preemptable_fn(self, name, fn):
+        self.preemptable_fns[name] = fn
+
+    def add_reclaimable_fn(self, name, fn):
+        self.reclaimable_fns[name] = fn
+
+    def add_overused_fn(self, name, fn):
+        self.overused_fns[name] = fn
+
+    def add_job_ready_fn(self, name, fn):
+        self.job_ready_fns[name] = fn
+
+    def add_job_pipelined_fn(self, name, fn):
+        self.job_pipelined_fns[name] = fn
+
+    def add_job_valid_fn(self, name, fn):
+        self.job_valid_fns[name] = fn
+
+    def add_node_order_fns(self, name, prioritizers):
+        """prioritizers: list of (weight, NodeOrderFn)."""
+        self.node_order_fns[name] = prioritizers
+
+    def add_tensor_plugin(self, name, plugin):
+        self.tensor_plugins[name] = plugin
+
+    def add_event_handler(self, handler: EventHandler):
+        self.event_handlers.append(handler)
+
+    # ------------------------------------------------------------------
+    # tiered combinators (session_plugins.go:80-369)
+
+    def _victims(self, fns: Dict[str, Callable], flag_attr: str,
+                 claimer: TaskInfo, claimees: List[TaskInfo]) -> List[TaskInfo]:
+        """Within a tier victims are intersected across plugins; the first
+        tier whose intersection is non-None decides (go:80-162; note Go's
+        nil-vs-empty distinction: a tier whose plugins all return nil defers
+        to the next tier, an empty-but-initialized result decides 'none')."""
+        victims: Optional[List[TaskInfo]] = None
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not getattr(plugin, flag_attr):
+                    continue
+                fn = fns.get(plugin.name)
+                if fn is None:
+                    continue
+                candidates = fn(claimer, claimees)
+                if victims is None:
+                    victims = candidates if candidates is not None else []
+                else:
+                    cand_uids = {c.uid for c in (candidates or [])}
+                    victims = [v for v in victims if v.uid in cand_uids]
+            if victims is not None:
+                return victims
+        return victims or []
+
+    def preemptable(self, preemptor: TaskInfo, preemptees: List[TaskInfo]):
+        return self._victims(self.preemptable_fns, "enabled_preemptable",
+                             preemptor, preemptees)
+
+    def reclaimable(self, reclaimer: TaskInfo, reclaimees: List[TaskInfo]):
+        return self._victims(self.reclaimable_fns, "enabled_reclaimable",
+                             reclaimer, reclaimees)
+
+    def overused(self, queue: QueueInfo) -> bool:
+        """Any plugin saying overused wins (go:165-181; note: not gated by an
+        enable flag in the reference either)."""
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                fn = self.overused_fns.get(plugin.name)
+                if fn is not None and fn(queue):
+                    return True
+        return False
+
+    def job_ready(self, job: JobInfo) -> bool:
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not plugin.enabled_job_ready:
+                    continue
+                fn = self.job_ready_fns.get(plugin.name)
+                if fn is not None and not fn(job):
+                    return False
+        return True
+
+    def job_pipelined(self, job: JobInfo) -> bool:
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not plugin.enabled_job_pipelined:
+                    continue
+                fn = self.job_pipelined_fns.get(plugin.name)
+                if fn is not None and not fn(job):
+                    return False
+        return True
+
+    def job_valid(self, job: JobInfo) -> Optional[ValidateResult]:
+        """First failing validator wins (go:228-244; not flag-gated)."""
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                fn = self.job_valid_fns.get(plugin.name)
+                if fn is None:
+                    continue
+                vr = fn(job)
+                if vr is not None and not vr.pass_:
+                    return vr
+        return None
+
+    def job_order_fn(self, l: JobInfo, r: JobInfo) -> bool:
+        """First non-zero comparison wins; fallback creation-time then UID
+        (go:247-271)."""
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not plugin.enabled_job_order:
+                    continue
+                fn = self.job_order_fns.get(plugin.name)
+                if fn is None:
+                    continue
+                j = fn(l, r)
+                if j != 0:
+                    return j < 0
+        if l.creation_timestamp == r.creation_timestamp:
+            return l.uid < r.uid
+        return l.creation_timestamp < r.creation_timestamp
+
+    def queue_order_fn(self, l: QueueInfo, r: QueueInfo) -> bool:
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not plugin.enabled_queue_order:
+                    continue
+                fn = self.queue_order_fns.get(plugin.name)
+                if fn is None:
+                    continue
+                j = fn(l, r)
+                if j != 0:
+                    return j < 0
+        lt = l.queue.metadata.creation_timestamp
+        rt = r.queue.metadata.creation_timestamp
+        if lt == rt:
+            return l.uid < r.uid
+        return lt < rt
+
+    def task_compare_fns(self, l: TaskInfo, r: TaskInfo) -> int:
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not plugin.enabled_task_order:
+                    continue
+                fn = self.task_order_fns.get(plugin.name)
+                if fn is None:
+                    continue
+                j = fn(l, r)
+                if j != 0:
+                    return j
+        return 0
+
+    def task_order_fn(self, l: TaskInfo, r: TaskInfo) -> bool:
+        res = self.task_compare_fns(l, r)
+        if res != 0:
+            return res < 0
+        lt = l.pod.metadata.creation_timestamp
+        rt = r.pod.metadata.creation_timestamp
+        if lt == rt:
+            return l.uid < r.uid
+        return lt < rt
+
+    def predicate_fn(self, task: TaskInfo, node: NodeInfo) -> None:
+        """All enabled predicates across all tiers must pass (go:334-351).
+        Raises FitError on the first rejection."""
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not plugin.enabled_predicate:
+                    continue
+                fn = self.predicate_fns.get(plugin.name)
+                if fn is not None:
+                    fn(task, node)
+
+    def node_prioritizers(self) -> List:
+        """Concatenate enabled (weight, fn) prioritizers (go:354-369)."""
+        configs: List = []
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if not plugin.enabled_node_order:
+                    continue
+                prioritizers = self.node_order_fns.get(plugin.name)
+                if prioritizers:
+                    configs.extend(prioritizers)
+        return configs
+
+    # ------------------------------------------------------------------
+    # decisions (session.go:186-345)
+
+    def statement(self):
+        from .statement import Statement
+        return Statement(self)
+
+    def _fire_allocate(self, task: TaskInfo):
+        for eh in self.event_handlers:
+            if eh.allocate_func is not None:
+                eh.allocate_func(Event(task))
+
+    def _fire_deallocate(self, task: TaskInfo):
+        for eh in self.event_handlers:
+            if eh.deallocate_func is not None:
+                eh.deallocate_func(Event(task))
+
+    def pipeline(self, task: TaskInfo, hostname: str) -> None:
+        """Session-only assignment onto releasing resources (session.go:194-232)."""
+        job = self.jobs.get(task.job)
+        if job is None:
+            raise KeyError(f"failed to find job {task.job} when pipelining")
+        job.update_task_status(task, TaskStatus.Pipelined)
+        node = self.nodes.get(hostname)
+        if node is None:
+            raise KeyError(f"failed to find node {hostname}")
+        node.add_task(task)
+        self._fire_allocate(task)
+
+    def allocate(self, task: TaskInfo, hostname: str) -> None:
+        """Assign idle resources; dispatch the whole gang once JobReady
+        (session.go:235-288)."""
+        self.cache.allocate_volumes(task, hostname)
+        job = self.jobs.get(task.job)
+        if job is None:
+            raise KeyError(f"failed to find job {task.job}")
+        job.update_task_status(task, TaskStatus.Allocated)
+        node = self.nodes.get(hostname)
+        if node is None:
+            raise KeyError(f"failed to find node {hostname}")
+        node.add_task(task)
+        self._fire_allocate(task)
+
+        if self.job_ready(job):
+            # Gang barrier: dispatch every Allocated task of the job at once.
+            for t in list(job.task_status_index.get(TaskStatus.Allocated, {}).values()):
+                self.dispatch(t)
+
+    def dispatch(self, task: TaskInfo) -> None:
+        """Bind to the cluster (session.go:290-314)."""
+        self.cache.bind_volumes(task)
+        self.cache.bind(task, task.node_name)
+        job = self.jobs.get(task.job)
+        if job is None:
+            raise KeyError(f"failed to find job {task.job}")
+        job.update_task_status(task, TaskStatus.Binding)
+        metrics.observe_task_schedule_latency(
+            time.time() - task.pod.metadata.creation_timestamp)
+
+    def evict(self, reclaimee: TaskInfo, reason: str) -> None:
+        """Evict through the cache, then mirror in-session (session.go:317-345)."""
+        self.cache.evict(reclaimee, reason)
+        job = self.jobs.get(reclaimee.job)
+        if job is None:
+            raise KeyError(f"failed to find job {reclaimee.job}")
+        job.update_task_status(reclaimee, TaskStatus.Releasing)
+        node = self.nodes.get(reclaimee.node_name)
+        if node is not None:
+            node.update_task(reclaimee)
+        self._fire_deallocate(reclaimee)
+
+    def update_job_condition(self, job_info: JobInfo, cond: PodGroupCondition):
+        """Upsert a PodGroup condition by type (session.go:348-369)."""
+        job = self.jobs.get(job_info.uid)
+        if job is None:
+            raise KeyError(f"failed to find job {job_info.namespace}/{job_info.name}")
+        conditions = job.pod_group.status.conditions
+        for i, c in enumerate(conditions):
+            if c.type == cond.type:
+                conditions[i] = cond
+                return
+        conditions.append(cond)
+
+
+# ----------------------------------------------------------------------
+# lifecycle (framework.go:30-63, session.go:63-184)
+
+def open_session(cache, tiers: List[Tier],
+                 plugin_builders=None) -> Session:
+    from .registry import get_plugin_builder
+
+    ssn = Session(cache)
+    snapshot: ClusterInfo = cache.snapshot()
+    ssn.jobs = snapshot.jobs
+    ssn.nodes = snapshot.nodes
+    ssn.queues = snapshot.queues
+    ssn.tiers = tiers
+
+    # Instantiate plugins and open them on the session.
+    for tier in tiers:
+        for option in tier.plugins:
+            if option.name in ssn.plugins:
+                continue
+            builder = (plugin_builders or {}).get(option.name) \
+                if plugin_builders else None
+            if builder is None:
+                builder = get_plugin_builder(option.name)
+            if builder is None:
+                raise KeyError(f"failed to get plugin {option.name}")
+            plugin = builder(option.arguments)
+            ssn.plugins[plugin.name()] = plugin
+
+    for plugin in ssn.plugins.values():
+        start = time.time()
+        plugin.on_session_open(ssn)
+        metrics.observe_plugin_latency(plugin.name(), "OnSessionOpen",
+                                       time.time() - start)
+
+    # Gate invalid jobs (gang minAvailable) out of the session, recording the
+    # unschedulable condition (session.go:89-108).
+    for job in list(ssn.jobs.values()):
+        vr = ssn.job_valid(job)
+        if vr is not None and not vr.pass_:
+            if job.pod_group is not None:
+                cond = PodGroupCondition(
+                    type=PodGroupUnschedulableType, status="True",
+                    transition_id=ssn.uid, last_transition_time=time.time(),
+                    reason=vr.reason, message=vr.message)
+                ssn.update_job_condition(job, cond)
+                try:
+                    ssn.cache.update_job_status(job)
+                except Exception:
+                    pass
+            del ssn.jobs[job.uid]
+
+    return ssn
+
+
+def close_session(ssn: Session) -> None:
+    for plugin in ssn.plugins.values():
+        start = time.time()
+        plugin.on_session_close(ssn)
+        metrics.observe_plugin_latency(plugin.name(), "OnSessionClose",
+                                       time.time() - start)
+
+    # PodGroup status writeback (session.go:119-144).
+    for job in ssn.jobs.values():
+        if job.pod_group is None:
+            ssn.cache.record_job_status_event(job)
+            continue
+        job.pod_group.status = job_status(ssn, job)
+        try:
+            ssn.cache.update_job_status(job)
+        except Exception:
+            pass
+
+    ssn.jobs = {}
+    ssn.nodes = {}
+    ssn.queues = {}
+    ssn.plugins = {}
+    ssn.event_handlers = []
+
+
+def job_status(ssn: Session, job_info: JobInfo):
+    """Derive the PodGroup phase from session state (session.go:146-184)."""
+    status = job_info.pod_group.status
+    unschedulable = any(
+        c.type == PodGroupUnschedulableType and c.status == "True"
+        and c.transition_id == ssn.uid
+        for c in status.conditions)
+
+    if job_info.task_status_index.get(TaskStatus.Running) and unschedulable:
+        status.phase = PodGroupUnknown
+    else:
+        allocated = 0
+        for st, tasks in job_info.task_status_index.items():
+            if allocated_status(st):
+                allocated += len(tasks)
+        if allocated >= job_info.pod_group.spec.min_member:
+            status.phase = PodGroupRunning
+        else:
+            status.phase = PodGroupPending
+
+    status.running = len(job_info.task_status_index.get(TaskStatus.Running, {}))
+    status.failed = len(job_info.task_status_index.get(TaskStatus.Failed, {}))
+    status.succeeded = len(job_info.task_status_index.get(TaskStatus.Succeeded, {}))
+    return status

@@ -1,0 +1,88 @@
+"""Statement: session-level transaction for speculative preemption.
+
+Mirrors /root/reference/pkg/scheduler/framework/statement.go: Evict/Pipeline
+apply session-side effects immediately and log operations; Commit replays
+evictions to the cluster; Discard rolls back in reverse order.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ..api import TaskInfo, TaskStatus
+from .events import Event
+
+
+class Statement:
+
+    def __init__(self, ssn):
+        self.ssn = ssn
+        self.operations: List[Tuple[str, tuple]] = []
+
+    # -- forward ops --------------------------------------------------------
+
+    def evict(self, reclaimee: TaskInfo, reason: str) -> None:
+        """Session-side eviction, logged for commit/rollback (go:36-76)."""
+        job = self.ssn.jobs.get(reclaimee.job)
+        if job is not None:
+            job.update_task_status(reclaimee, TaskStatus.Releasing)
+        node = self.ssn.nodes.get(reclaimee.node_name)
+        if node is not None:
+            node.update_task(reclaimee)
+        self.ssn._fire_deallocate(reclaimee)
+        self.operations.append(("evict", (reclaimee, reason)))
+
+    def pipeline(self, task: TaskInfo, hostname: str) -> None:
+        """Session-side pipeline, logged for rollback (go:113-155)."""
+        job = self.ssn.jobs.get(task.job)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.Pipelined)
+        node = self.ssn.nodes.get(hostname)
+        if node is not None:
+            node.add_task(task)
+        self.ssn._fire_allocate(task)
+        self.operations.append(("pipeline", (task, hostname)))
+
+    # -- rollback helpers ---------------------------------------------------
+
+    def _unevict(self, reclaimee: TaskInfo) -> None:
+        job = self.ssn.jobs.get(reclaimee.job)
+        if job is not None:
+            job.update_task_status(reclaimee, TaskStatus.Running)
+        node = self.ssn.nodes.get(reclaimee.node_name)
+        if node is not None:
+            node.update_task(reclaimee)
+        self.ssn._fire_allocate(reclaimee)
+
+    def _unpipeline(self, task: TaskInfo) -> None:
+        job = self.ssn.jobs.get(task.job)
+        if job is not None:
+            job.update_task_status(task, TaskStatus.Pending)
+        node = self.ssn.nodes.get(task.node_name)
+        if node is not None:
+            node.remove_task(task)
+        task.node_name = ""
+        self.ssn._fire_deallocate(task)
+
+    # -- transaction outcomes ----------------------------------------------
+
+    def discard(self) -> None:
+        """Roll back all logged operations in reverse (go:196-207)."""
+        for name, args in reversed(self.operations):
+            if name == "evict":
+                self._unevict(args[0])
+            elif name == "pipeline":
+                self._unpipeline(args[0])
+        self.operations.clear()
+
+    def commit(self) -> None:
+        """Replay evictions against the cluster; pipelines stay session-only
+        (go:210-220)."""
+        for name, args in self.operations:
+            if name == "evict":
+                reclaimee, reason = args
+                try:
+                    self.ssn.cache.evict(reclaimee, reason)
+                except Exception:
+                    self._unevict(reclaimee)
+        self.operations.clear()

@@ -1,0 +1,220 @@
+"""Scheduler metrics: a small dependency-free Prometheus-style registry.
+
+Keeps the reference's collector set and names
+(/root/reference/pkg/scheduler/metrics/metrics.go:27-121, subsystem
+``kube_batch``): e2e/plugin/action/task latency histograms,
+schedule_attempts_total, preemption victims/attempts, unschedule task/job
+counts, job_retry_counts.  Exposition-format text is served by
+``kube_batch_tpu.cli.server``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+SUBSYSTEM = "kube_batch"
+
+
+def _exp_buckets(start: float, factor: float, count: int) -> List[float]:
+    out, v = [], start
+    for _ in range(count):
+        out.append(v)
+        v *= factor
+    return out
+
+
+class Histogram:
+    def __init__(self, name: str, help_: str, buckets: List[float],
+                 label_names: Tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.buckets = buckets
+        self.label_names = label_names
+        self._lock = threading.Lock()
+        self._counts: Dict[tuple, List[int]] = defaultdict(
+            lambda: [0] * (len(buckets) + 1))
+        self._sums: Dict[tuple, float] = defaultdict(float)
+        self._totals: Dict[tuple, int] = defaultdict(int)
+
+    def observe(self, value: float, *labels: str) -> None:
+        with self._lock:
+            counts = self._counts[labels]
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            self._sums[labels] += value
+            self._totals[labels] += 1
+
+    def expose(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        with self._lock:
+            for labels, counts in self._counts.items():
+                label_str = ",".join(
+                    f'{n}="{v}"' for n, v in zip(self.label_names, labels))
+                cumulative = 0
+                for bound, c in zip(self.buckets, counts):
+                    cumulative += c
+                    le = f'le="{bound}"'
+                    sep = "," if label_str else ""
+                    lines.append(
+                        f"{self.name}_bucket{{{label_str}{sep}{le}}} {cumulative}")
+                cumulative += counts[-1]
+                sep = "," if label_str else ""
+                lines.append(
+                    f'{self.name}_bucket{{{label_str}{sep}le="+Inf"}} {cumulative}')
+                braces = f"{{{label_str}}}" if label_str else ""
+                lines.append(f"{self.name}_sum{braces} {self._sums[labels]}")
+                lines.append(f"{self.name}_count{braces} {self._totals[labels]}")
+        return "\n".join(lines)
+
+
+class Counter:
+    def __init__(self, name: str, help_: str, label_names: Tuple[str, ...] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = label_names
+        self._lock = threading.Lock()
+        self._values: Dict[tuple, float] = defaultdict(float)
+
+    def inc(self, amount: float = 1.0, *labels: str) -> None:
+        with self._lock:
+            self._values[labels] += amount
+
+    def expose(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} counter"]
+        with self._lock:
+            if not self._values:
+                lines.append(f"{self.name} 0")
+            for labels, v in self._values.items():
+                label_str = ",".join(
+                    f'{n}="{val}"' for n, val in zip(self.label_names, labels))
+                braces = f"{{{label_str}}}" if label_str else ""
+                lines.append(f"{self.name}{braces} {v}")
+        return "\n".join(lines)
+
+
+class Gauge(Counter):
+    def set(self, value: float, *labels: str) -> None:
+        with self._lock:
+            self._values[labels] = value
+
+    def expose(self) -> str:
+        return super().expose().replace("TYPE", "TYPE", 1).replace(
+            " counter", " gauge", 1)
+
+
+class Registry:
+    def __init__(self):
+        self.collectors: List = []
+
+    def register(self, collector):
+        self.collectors.append(collector)
+        return collector
+
+    def expose(self) -> str:
+        return "\n".join(c.expose() for c in self.collectors) + "\n"
+
+
+registry = Registry()
+
+# Latency buckets: 5ms * 2^k (metrics.go:38-45) and 5us * 2^k (:47-63).
+_MS_BUCKETS = _exp_buckets(5.0, 2.0, 10)
+_US_BUCKETS = _exp_buckets(5.0, 2.0, 10)
+
+e2e_scheduling_latency = registry.register(Histogram(
+    f"{SUBSYSTEM}_e2e_scheduling_latency_milliseconds",
+    "E2e scheduling latency in milliseconds (scheduling algorithm + binding)",
+    _MS_BUCKETS))
+plugin_scheduling_latency = registry.register(Histogram(
+    f"{SUBSYSTEM}_plugin_scheduling_latency_microseconds",
+    "Plugin scheduling latency in microseconds", _US_BUCKETS,
+    ("plugin", "on_session")))
+action_scheduling_latency = registry.register(Histogram(
+    f"{SUBSYSTEM}_action_scheduling_latency_microseconds",
+    "Action scheduling latency in microseconds", _US_BUCKETS, ("action",)))
+task_scheduling_latency = registry.register(Histogram(
+    f"{SUBSYSTEM}_task_scheduling_latency_microseconds",
+    "Task scheduling latency in microseconds", _US_BUCKETS))
+schedule_attempts = registry.register(Counter(
+    f"{SUBSYSTEM}_schedule_attempts_total",
+    "Number of attempts to schedule pods, by result.", ("result",)))
+preemption_victims = registry.register(Gauge(
+    f"{SUBSYSTEM}_pod_preemption_victims",
+    "Number of selected preemption victims"))
+preemption_attempts = registry.register(Counter(
+    f"{SUBSYSTEM}_total_preemption_attempts",
+    "Total preemption attempts in the cluster till now"))
+unschedule_task_count = registry.register(Gauge(
+    f"{SUBSYSTEM}_unschedule_task_count",
+    "Number of tasks could not be scheduled", ("job",)))
+unschedule_job_count = registry.register(Gauge(
+    f"{SUBSYSTEM}_unschedule_job_count",
+    "Number of jobs could not be scheduled"))
+job_retry_counts = registry.register(Counter(
+    f"{SUBSYSTEM}_job_retry_counts",
+    "Number of retry counts for one job", ("job",)))
+# TPU sidecar extras (no reference counterpart): device solve time and
+# transfer time for the tensorized sessions.
+tpu_solve_latency = registry.register(Histogram(
+    f"{SUBSYSTEM}_tpu_solve_latency_milliseconds",
+    "On-device batch solve latency in milliseconds", _MS_BUCKETS))
+tpu_transfer_latency = registry.register(Histogram(
+    f"{SUBSYSTEM}_tpu_transfer_latency_milliseconds",
+    "Host<->device snapshot transfer latency in milliseconds", _MS_BUCKETS))
+
+
+# Helper API (metrics.go:123-191).
+
+def observe_e2e_latency(seconds: float) -> None:
+    e2e_scheduling_latency.observe(seconds * 1e3)
+
+
+def observe_plugin_latency(plugin: str, on_session: str, seconds: float) -> None:
+    plugin_scheduling_latency.observe(seconds * 1e6, plugin, on_session)
+
+
+def observe_action_latency(action: str, seconds: float) -> None:
+    action_scheduling_latency.observe(seconds * 1e6, action)
+
+
+def observe_task_schedule_latency(seconds: float) -> None:
+    task_scheduling_latency.observe(seconds * 1e6)
+
+
+def register_schedule_attempt(result: str) -> None:
+    schedule_attempts.inc(1.0, result)
+
+
+def update_preemption_victims_count(count: int) -> None:
+    preemption_victims.set(float(count))
+
+
+def register_preemption_attempt() -> None:
+    preemption_attempts.inc()
+
+
+def update_unschedule_task_count(job: str, count: int) -> None:
+    unschedule_task_count.set(float(count), job)
+
+
+def update_unschedule_job_count(count: int) -> None:
+    unschedule_job_count.set(float(count))
+
+
+def register_job_retries(job: str) -> None:
+    job_retry_counts.inc(1.0, job)
+
+
+def observe_tpu_solve_latency(seconds: float) -> None:
+    tpu_solve_latency.observe(seconds * 1e3)
+
+
+def observe_tpu_transfer_latency(seconds: float) -> None:
+    tpu_transfer_latency.observe(seconds * 1e3)

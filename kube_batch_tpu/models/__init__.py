@@ -1,0 +1,5 @@
+"""Tensorized snapshot models: Session -> struct-of-arrays flattening."""
+
+from .tensor_snapshot import TensorSnapshot, bucket, tensorize_session
+
+__all__ = ["TensorSnapshot", "bucket", "tensorize_session"]

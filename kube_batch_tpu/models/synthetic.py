@@ -1,0 +1,135 @@
+"""Synthetic snapshot generator.
+
+Builds SolverInputs directly as arrays (bypassing the object model) for
+benchmarks and scale tests — the tensor analog of the reference's kubemark
+hollow-node clusters (test/kubemark: fake nodes at density-benchmark scale,
+SURVEY.md §4/§6).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def make_synthetic_inputs(n_tasks: int = 1000, n_nodes: int = 100,
+                          n_jobs: int = 50, n_queues: int = 4,
+                          gang_fraction: float = 0.8, seed: int = 0,
+                          dtype=None):
+    """Random-but-plausible cluster: uniform node shapes, task requests in
+    {0.25..4} cpu / {0.25..8}Gi, jobs striped over queues, minAvailable set
+    for a fraction of jobs (gangs)."""
+    import jax.numpy as jnp
+    from ..ops.resources import eps_vector, scalar_dims_mask
+    from ..ops.scoring import ScoreWeights
+    from ..ops.solver import SolverConfig, SolverInputs
+    from .tensor_snapshot import bucket
+
+    if dtype is None:
+        dtype = jnp.asarray(np.float64(1.0)).dtype
+    rng = np.random.default_rng(seed)
+    r = 2
+    f = np.float64
+
+    p_pad, n_pad = bucket(n_tasks), bucket(n_nodes)
+    j_pad, q_pad = bucket(n_jobs), bucket(max(n_queues, 1))
+
+    # nodes: 16 cpu / 64Gi each
+    node_alloc = np.zeros((n_pad, r), f)
+    node_alloc[:n_nodes, 0] = 16000.0
+    node_alloc[:n_nodes, 1] = 64.0 * 1024**3
+    node_idle = node_alloc.copy()
+    node_exists = np.zeros((n_pad,), bool)
+    node_exists[:n_nodes] = True
+
+    # tasks -> jobs round-robin-ish with contiguous blocks
+    job_of_task = np.sort(rng.integers(0, n_jobs, size=n_tasks))
+    task_req = np.zeros((p_pad, r), f)
+    task_req[:n_tasks, 0] = rng.choice([250, 500, 1000, 2000, 4000],
+                                       size=n_tasks).astype(f)
+    task_req[:n_tasks, 1] = rng.choice([0.25, 0.5, 1, 2, 4, 8],
+                                       size=n_tasks).astype(f) * 1024**3
+
+    job_start = np.zeros((j_pad,), np.int32)
+    job_count = np.zeros((j_pad,), np.int32)
+    for j in range(n_jobs):
+        members = np.nonzero(job_of_task == j)[0]
+        job_start[j] = members[0] if members.size else 0
+        job_count[j] = members.size
+
+    job_queue = np.zeros((j_pad,), np.int32)
+    job_queue[:n_jobs] = rng.integers(0, n_queues, size=n_jobs)
+    job_minavail = np.full((j_pad,), -1, np.int32)
+    is_gang = rng.random(n_jobs) < gang_fraction
+    job_minavail[:n_jobs] = np.where(
+        is_gang, np.maximum((job_count[:n_jobs] * 0.8).astype(np.int32), 1), 1)
+
+    queue_weight = np.zeros((q_pad,), f)
+    queue_weight[:n_queues] = rng.integers(1, 5, size=n_queues).astype(f)
+    queue_exists = np.zeros((q_pad,), bool)
+    queue_exists[:n_queues] = True
+
+    total = node_alloc[:n_nodes].sum(axis=0)
+
+    # proportion water-fill on host numpy (tiny), mirroring the plugin
+    request = np.zeros((q_pad, r), f)
+    for j in range(n_jobs):
+        request[job_queue[j]] += task_req[job_start[j]:job_start[j]
+                                          + job_count[j]].sum(axis=0)
+    deserved = _waterfill(total, queue_weight, request, queue_exists)
+
+    dev = lambda x, dt=None: jnp.asarray(x, dtype=dt or (dtype if x.dtype == f
+                                                         else None))
+    inputs = SolverInputs(
+        task_req=dev(task_req), task_res=dev(task_req),
+        task_sig=jnp.zeros((p_pad,), jnp.int32),
+        task_sorted=jnp.arange(p_pad, dtype=jnp.int32),
+        job_start=jnp.asarray(job_start), job_count=jnp.asarray(job_count),
+        job_queue=jnp.asarray(job_queue), job_minavail=jnp.asarray(job_minavail),
+        job_prio=dev(np.zeros((j_pad,), f)),
+        job_ts=dev(np.arange(j_pad, dtype=f)),
+        job_uid_rank=dev(np.arange(j_pad, dtype=f)),
+        job_init_ready=jnp.zeros((j_pad,), jnp.int32),
+        job_init_alloc=dev(np.zeros((j_pad, r), f)),
+        queue_deserved=dev(deserved),
+        queue_init_alloc=dev(np.zeros((q_pad, r), f)),
+        queue_ts=dev(np.arange(q_pad, dtype=f)),
+        queue_uid_rank=dev(np.arange(q_pad, dtype=f)),
+        queue_exists=jnp.asarray(queue_exists),
+        node_idle=dev(node_idle),
+        node_releasing=dev(np.zeros((n_pad, r), f)),
+        node_used=dev(np.zeros((n_pad, r), f)),
+        node_alloc=dev(node_alloc),
+        node_count=jnp.zeros((n_pad,), jnp.int32),
+        node_max_tasks=jnp.full((n_pad,), 1 << 30, jnp.int32),
+        node_exists=jnp.asarray(node_exists),
+        sig_mask=jnp.asarray(np.ones((1, n_pad), bool) & node_exists[None, :]),
+        total_res=dev(total),
+        eps=eps_vector(r, dtype),
+        scalar_dims=scalar_dims_mask(r))
+    config = SolverConfig()
+    return inputs, config
+
+
+def _waterfill(total, weight, request, active):
+    """Host water-fill (proportion.go:101-154) for synthetic inputs."""
+    q, r = request.shape
+    deserved = np.zeros_like(request)
+    remaining = total.astype(np.float64).copy()
+    met = np.zeros((q,), bool)
+    for _ in range(64):
+        live = active & ~met
+        tw = weight[live].sum()
+        if tw == 0:
+            break
+        inc = np.zeros((r,))
+        for i in np.nonzero(live)[0]:
+            old = deserved[i].copy()
+            deserved[i] = deserved[i] + remaining * (weight[i] / tw)
+            if np.all(request[i] < deserved[i]):
+                deserved[i] = np.minimum(deserved[i], request[i])
+                met[i] = True
+            inc += deserved[i] - old
+        remaining = remaining - inc
+        if np.all(remaining < np.array([10.0, 10 * 1024 * 1024])):
+            break
+    return deserved

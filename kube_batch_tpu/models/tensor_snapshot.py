@@ -1,0 +1,351 @@
+"""Tensorization: flatten a Session snapshot into SolverInputs.
+
+The struct-of-arrays flattening demanded by the north star (BASELINE.json):
+pods -> [P, R] request tensors + job/signature indices; nodes -> [N, R]
+idle/releasing/used/allocatable + static predicate mask; jobs/queues ->
+gang/fairness accounting vectors.  Shapes are padded to bucket sizes so the
+jitted solver compiles once per bucket, not once per cluster state
+(SURVEY.md §7 "fixed-size padded buckets").
+"""
+
+from __future__ import annotations
+
+import functools
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api import TaskStatus, allocated_status
+from ..plugins.predicates import (pod_matches_node_selector,
+                                  tolerates_node_taints)
+from ..plugins.nodeorder import NodeOrderPlugin
+
+_F = np.float64  # host-side staging dtype; cast at device put
+
+
+def bucket(n: int, minimum: int = 8) -> int:
+    """Next power-of-two bucket (compilation-cache friendly)."""
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
+
+
+@dataclass
+class TensorSnapshot:
+    """SolverInputs plus the host-side index maps needed to apply results."""
+    inputs: object                  # ops.solver.SolverInputs
+    config: object                  # ops.solver.SolverConfig
+    tasks: List = field(default_factory=list)       # index -> TaskInfo
+    node_names: List[str] = field(default_factory=list)
+    job_uids: List[str] = field(default_factory=list)
+    queue_ids: List[str] = field(default_factory=list)
+    resource_names: List[str] = field(default_factory=list)
+    fallback_reason: str = ""       # non-empty -> host path required
+
+    @property
+    def needs_fallback(self) -> bool:
+        return bool(self.fallback_reason)
+
+
+def _resource_axis(ssn) -> List[str]:
+    """Fixed resource layout: cpu, memory, then sorted scalar names present
+    anywhere in the snapshot."""
+    scalars = set()
+    for node in ssn.nodes.values():
+        scalars.update(node.allocatable.scalar_resources)
+    for job in ssn.jobs.values():
+        for task in job.tasks.values():
+            scalars.update(task.resreq.scalar_resources)
+            scalars.update(task.init_resreq.scalar_resources)
+    return ["cpu", "memory", *sorted(scalars)]
+
+
+def _vec(resource, axis: List[str]) -> np.ndarray:
+    out = np.zeros(len(axis), dtype=_F)
+    out[0] = resource.milli_cpu
+    out[1] = resource.memory
+    for i, name in enumerate(axis[2:], start=2):
+        out[i] = resource.scalar_resources.get(name, 0.0)
+    return out
+
+
+def _task_signature(task) -> tuple:
+    sel = tuple(sorted(task.pod.spec.node_selector.items()))
+    tol = tuple(sorted((t.key, t.operator, t.value, t.effect)
+                       for t in task.pod.spec.tolerations))
+    aff = ()
+    affinity = task.pod.spec.affinity
+    if affinity is not None and affinity.required_node_terms:
+        aff = tuple(tuple(sorted(t.items()))
+                    for t in affinity.required_node_terms)
+    return sel, tol, aff
+
+
+def _uses_dynamic_predicates(task) -> Optional[str]:
+    """Features the device mask can't express statically yet."""
+    for c in task.pod.spec.containers:
+        if any(p.host_port > 0 for p in c.ports):
+            return "host ports"
+    affinity = task.pod.spec.affinity
+    if affinity is not None and (affinity.required_pod_affinity
+                                 or affinity.required_pod_anti_affinity):
+        return "inter-pod affinity"
+    if affinity is not None and affinity.preferred_node_terms:
+        return "preferred node affinity scoring"
+    return None
+
+
+_SUPPORTED_PLUGINS = {"priority", "gang", "drf", "proportion", "predicates",
+                      "nodeorder", "conformance", "tpu-score"}
+_JOB_ORDER_PLUGINS = ("priority", "gang", "drf")
+_QUEUE_ORDER_PLUGINS = ("proportion",)
+
+
+def tensorize_session(ssn) -> TensorSnapshot:
+    """Flatten the session into SolverInputs (cpu-staged numpy; device put
+    happens in the action)."""
+    import jax.numpy as jnp
+    from ..ops.resources import eps_vector, scalar_dims_mask
+    from ..ops.scoring import ScoreWeights
+    from ..ops.solver import SolverConfig, SolverInputs
+
+    snap = TensorSnapshot(inputs=None, config=None)
+
+    # ---- plugin structure -> static config --------------------------------
+    enabled_job_order: List[str] = []
+    enabled_queue_order: List[str] = []
+    has_gang = False
+    has_proportion = False
+    has_predicates = False
+    # Scoring weights accumulate across plugins: the host path concatenates
+    # every enabled plugin's prioritizers and sums weighted scores
+    # (session_plugins.go:354-369), so nodeorder + tpu-score both enabled
+    # means their weights add.  No scoring plugin -> all-zero scores and the
+    # first feasible node wins on both paths.
+    w_least = w_most = w_balanced = 0.0
+    for tier in ssn.tiers:
+        for option in tier.plugins:
+            if option.name not in _SUPPORTED_PLUGINS:
+                snap.fallback_reason = f"unsupported plugin {option.name}"
+                return snap
+            if option.name in _JOB_ORDER_PLUGINS and option.enabled_job_order:
+                enabled_job_order.append(option.name)
+            if (option.name in _QUEUE_ORDER_PLUGINS
+                    and option.enabled_queue_order):
+                enabled_queue_order.append(option.name)
+            if option.name == "gang" and option.enabled_job_ready:
+                has_gang = True
+            if option.name == "proportion":
+                has_proportion = True
+            if option.name == "predicates" and option.enabled_predicate:
+                has_predicates = True
+            if (option.name in ("nodeorder", "tpu-score")
+                    and option.enabled_node_order):
+                w = NodeOrderPlugin(option.arguments).weights()
+                w_least += w["leastrequested"]
+                w_most += w["mostrequested"]
+                w_balanced += w["balancedresource"]
+    weights = ScoreWeights(least_requested=w_least, most_requested=w_most,
+                           balanced_resource=w_balanced)
+
+    axis = _resource_axis(ssn)
+    snap.resource_names = axis
+    r = len(axis)
+
+    # ---- nodes ------------------------------------------------------------
+    node_names = sorted(ssn.nodes)  # must match utils.get_node_list order
+    snap.node_names = node_names
+    n_real = len(node_names)
+    n_pad = bucket(max(n_real, 1))
+    node_idle = np.zeros((n_pad, r), _F)
+    node_rel = np.zeros((n_pad, r), _F)
+    node_used = np.zeros((n_pad, r), _F)
+    node_alloc = np.zeros((n_pad, r), _F)
+    node_count = np.zeros((n_pad,), np.int32)
+    node_max = np.zeros((n_pad,), np.int32)
+    node_exists = np.zeros((n_pad,), bool)
+    for i, name in enumerate(node_names):
+        node = ssn.nodes[name]
+        node_idle[i] = _vec(node.idle, axis)
+        node_rel[i] = _vec(node.releasing, axis)
+        node_used[i] = _vec(node.used, axis)
+        node_alloc[i] = _vec(node.allocatable, axis)
+        node_count[i] = len(node.tasks)
+        # Pod-count cap is a predicates-plugin check (predicates.go:127):
+        # enforced (including 0 = reject-all, upstream semantics) only when
+        # that plugin is enabled, matching the host path.
+        node_max[i] = node.allocatable.max_task_num if has_predicates \
+            else (1 << 30)
+        node_exists[i] = True
+
+    # ---- queues -----------------------------------------------------------
+    queue_ids = sorted(ssn.queues)
+    snap.queue_ids = queue_ids
+    queue_index = {qid: i for i, qid in enumerate(queue_ids)}
+    q_real = len(queue_ids)
+    q_pad = bucket(max(q_real, 1))
+    queue_deserved = np.zeros((q_pad, r), _F)
+    queue_alloc = np.zeros((q_pad, r), _F)
+    queue_ts = np.zeros((q_pad,), _F)
+    queue_exists = np.zeros((q_pad,), bool)
+    for i, qid in enumerate(queue_ids):
+        q = ssn.queues[qid]
+        queue_ts[i] = q.queue.metadata.creation_timestamp
+        queue_exists[i] = True
+    queue_rank = np.argsort(np.argsort(np.array(
+        queue_ids + [""] * (q_pad - q_real), dtype=object))).astype(_F)
+
+    # Deserved comes from the host proportion plugin when present so the
+    # device shares match the host's bit-for-bit; the device water-fill
+    # (ops.fairness.proportion_deserved) covers the plugin-free path.
+    prop = ssn.plugins.get("proportion")
+    if prop is not None and has_proportion:
+        for qid, attr in prop.queue_attrs.items():
+            if qid in queue_index:
+                queue_deserved[queue_index[qid]] = _vec(attr.deserved, axis)
+                queue_alloc[queue_index[qid]] = _vec(attr.allocated, axis)
+    total_res = np.sum(node_alloc[:n_real], axis=0) if n_real else \
+        np.zeros((r,), _F)
+
+    # ---- jobs + candidate tasks ------------------------------------------
+    job_uids = sorted(ssn.jobs)
+    job_uids = [uid for uid in job_uids
+                if ssn.jobs[uid].queue in queue_index]  # allocate.go:52-56
+    snap.job_uids = job_uids
+    j_real = len(job_uids)
+    j_pad = bucket(max(j_real, 1))
+
+    job_queue = np.zeros((j_pad,), np.int32)
+    job_minavail = np.full((j_pad,), -1, np.int32)  # -1 marks padding
+    job_prio = np.zeros((j_pad,), _F)
+    job_ts = np.zeros((j_pad,), _F)
+    job_start = np.zeros((j_pad,), np.int32)
+    job_count = np.zeros((j_pad,), np.int32)
+    job_init_ready = np.zeros((j_pad,), np.int32)
+    job_init_alloc = np.zeros((j_pad, r), _F)
+    job_rank = np.argsort(np.argsort(np.array(
+        job_uids + [chr(0x10FFFF)] * (j_pad - j_real),
+        dtype=object))).astype(_F)
+
+    tasks: List = []
+    task_rows: List[np.ndarray] = []
+    task_res_rows: List[np.ndarray] = []
+    sig_of_task: List[int] = []
+    signatures: Dict[tuple, int] = {}
+    sig_examples: List = []
+
+    for ji, uid in enumerate(job_uids):
+        job = ssn.jobs[uid]
+        job_queue[ji] = queue_index[job.queue]
+        job_minavail[ji] = job.min_available
+        job_prio[ji] = job.priority
+        job_ts[ji] = job.creation_timestamp
+        job_init_ready[ji] = job.ready_task_num()
+        alloc = np.zeros((r,), _F)
+        for status, st_tasks in job.task_status_index.items():
+            if allocated_status(status):
+                for t in st_tasks.values():
+                    alloc += _vec(t.resreq, axis)
+        job_init_alloc[ji] = alloc
+
+        # Candidate tasks: Pending, non-BestEffort (allocate.go:110-123),
+        # sorted by the session's task order (priority desc, ts, uid).
+        pending = [t for t in job.task_status_index.get(TaskStatus.Pending,
+                                                        {}).values()
+                   if not t.resreq.is_empty()]
+        pending.sort(key=functools.cmp_to_key(
+            lambda a, b: -1 if ssn.task_order_fn(a, b)
+            else (1 if ssn.task_order_fn(b, a) else 0)))
+        job_start[ji] = len(tasks)
+        job_count[ji] = len(pending)
+        for t in pending:
+            reason = _uses_dynamic_predicates(t)
+            if reason is not None:
+                snap.fallback_reason = reason
+                return snap
+            sig = _task_signature(t)
+            if sig not in signatures:
+                signatures[sig] = len(signatures)
+                sig_examples.append(t)
+            sig_of_task.append(signatures[sig])
+            tasks.append(t)
+            task_rows.append(_vec(t.init_resreq, axis))
+            task_res_rows.append(_vec(t.resreq, axis))
+
+    snap.tasks = tasks
+    p_real = len(tasks)
+    p_pad = bucket(max(p_real, 1))
+    task_req = np.zeros((p_pad, r), _F)
+    task_res = np.zeros((p_pad, r), _F)
+    task_sig = np.zeros((p_pad,), np.int32)
+    if p_real:
+        task_req[:p_real] = np.stack(task_rows)
+        task_res[:p_real] = np.stack(task_res_rows)
+        task_sig[:p_real] = np.array(sig_of_task, np.int32)
+    task_sorted = np.arange(p_pad, dtype=np.int32)  # already emitted in order
+
+    # ---- static predicate mask [S, N] ------------------------------------
+    s_real = max(len(sig_examples), 1)
+    sig_mask = np.zeros((s_real, n_pad), bool)
+    for si, example in enumerate(sig_examples):
+        for nix, name in enumerate(node_names):
+            node = ssn.nodes[name]
+            if node.node is None:
+                continue
+            if not has_predicates:
+                sig_mask[si, nix] = True
+                continue
+            if node.node.spec.unschedulable:
+                continue
+            if not pod_matches_node_selector(example, node):
+                continue
+            if not tolerates_node_taints(example, node):
+                continue
+            sig_mask[si, nix] = True
+    if not sig_examples:
+        sig_mask[:, :n_real] = True
+
+    from ..ops import solver as solver_mod  # late import keeps jax optional
+
+    # float64 when x64 is enabled (parity tests: bit-identical to the host's
+    # Python floats); float32 on default TPU configs (documented deviation:
+    # score ties may break differently than the f64 host oracle).
+    dtype = jnp.asarray(np.float64(1.0)).dtype
+
+    def dev(x, dt=None):
+        arr = jnp.asarray(x)
+        if dt is not None:
+            arr = arr.astype(dt)
+        elif arr.dtype in (jnp.float64, jnp.float32):
+            arr = arr.astype(dtype)
+        return arr
+
+    snap.inputs = SolverInputs(
+        task_req=dev(task_req), task_res=dev(task_res),
+        task_sig=dev(task_sig, jnp.int32), task_sorted=dev(task_sorted, jnp.int32),
+        job_start=dev(job_start, jnp.int32), job_count=dev(job_count, jnp.int32),
+        job_queue=dev(job_queue, jnp.int32),
+        job_minavail=dev(job_minavail, jnp.int32),
+        job_prio=dev(job_prio), job_ts=dev(job_ts), job_uid_rank=dev(job_rank),
+        job_init_ready=dev(job_init_ready, jnp.int32),
+        job_init_alloc=dev(job_init_alloc),
+        queue_deserved=dev(queue_deserved), queue_init_alloc=dev(queue_alloc),
+        queue_ts=dev(queue_ts), queue_uid_rank=dev(queue_rank),
+        queue_exists=dev(queue_exists, bool),
+        node_idle=dev(node_idle), node_releasing=dev(node_rel),
+        node_used=dev(node_used), node_alloc=dev(node_alloc),
+        node_count=dev(node_count, jnp.int32),
+        node_max_tasks=dev(node_max, jnp.int32),
+        node_exists=dev(node_exists, bool),
+        sig_mask=dev(sig_mask, bool),
+        total_res=dev(total_res),
+        eps=eps_vector(r, dtype),
+        scalar_dims=scalar_dims_mask(r))
+    snap.config = SolverConfig(
+        job_key_order=tuple(enabled_job_order),
+        queue_key_order=tuple(enabled_queue_order),
+        has_gang=has_gang, has_proportion=has_proportion,
+        weights=weights)
+    return snap

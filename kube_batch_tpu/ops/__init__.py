@@ -1,0 +1,12 @@
+"""Device kernels (JAX/XLA): resource comparisons, fairness, scoring, and
+the batched allocate solver.
+
+This package is the TPU-side of the architecture mandated by BASELINE.json's
+north star: the reference's per-session scheduling math re-expressed as
+tensor programs.  Import is lazy where possible so host-only deployments
+don't pay for jax.
+"""
+
+from . import fairness, resources, scoring, solver  # noqa: F401
+
+__all__ = ["fairness", "resources", "scoring", "solver"]

@@ -1,0 +1,79 @@
+"""Fairness math on device: DRF shares and proportion water-filling.
+
+Device counterparts of plugins/drf.py (dominant share = max over resources of
+allocated/total, reference drf.go:161-171) and plugins/proportion.py (the
+iterative ``deserved`` water-fill, reference proportion.go:101-154) — the
+fixed-point loop becomes a ``lax.while_loop`` over [Q, R] tensors.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .resources import EPS_VEC_FN, is_empty_vec, less_vec
+
+
+def safe_share(alloc: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
+    """share() semantics per element: x/0 -> 1 (0/0 -> 0)
+    (reference api/helpers/helpers.go:47-59)."""
+    zero_total = total == 0
+    return jnp.where(zero_total, jnp.where(alloc == 0, 0.0, 1.0),
+                     alloc / jnp.where(zero_total, 1.0, total))
+
+
+def drf_shares(job_alloc: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
+    """[J, R] allocated, [R] total -> [J] dominant shares."""
+    return jnp.max(safe_share(job_alloc, total[None, :]), axis=-1)
+
+
+def queue_shares(queue_alloc: jnp.ndarray, deserved: jnp.ndarray) -> jnp.ndarray:
+    """[Q, R] allocated, [Q, R] deserved -> [Q] shares (proportion.go:239-251)."""
+    return jnp.max(safe_share(queue_alloc, deserved), axis=-1)
+
+
+def proportion_deserved(total: jnp.ndarray, weight: jnp.ndarray,
+                        request: jnp.ndarray, active: jnp.ndarray,
+                        max_iters: int = 64):
+    """Weighted max-min water-filling of deserved resources.
+
+    total: [R]; weight: [Q]; request: [Q, R]; active: [Q] bool (queues that
+    have jobs this session).  Returns deserved [Q, R].
+
+    Mirrors proportion.go:101-154: each round splits ``remaining`` by weight
+    among unmet queues, caps a queue at its request (then it is 'met' and its
+    surplus returns to the pool), and stops when remaining is epsilon-empty
+    or every queue is met.
+    """
+    eps = EPS_VEC_FN(total.shape[-1], total.dtype)
+    q = weight.shape[0]
+
+    def cond(state):
+        deserved, remaining, met, it = state
+        total_weight = jnp.sum(jnp.where(active & ~met, weight, 0.0))
+        return (it < max_iters) & (total_weight > 0) \
+            & ~is_empty_vec(remaining, eps)
+
+    def body(state):
+        deserved, remaining, met, it = state
+        live = active & ~met
+        total_weight = jnp.sum(jnp.where(live, weight, 0.0))
+        frac = jnp.where(live, weight, 0.0) / jnp.maximum(total_weight, 1e-30)
+        proposed = deserved + frac[:, None] * remaining[None, :]
+        # Queue met when request < proposed (strict Resource.Less).
+        newly_met = live & less_vec(request, proposed, eps)
+        capped = jnp.where(newly_met[:, None], jnp.minimum(proposed, request),
+                           proposed)
+        new_deserved = jnp.where(live[:, None], capped, deserved)
+        # remaining -= (new - old) summed over live queues, matching the
+        # increased/decreased bookkeeping in proportion.go:138-147.
+        delta = jnp.sum(jnp.where(live[:, None], new_deserved - deserved, 0.0),
+                        axis=0)
+        return new_deserved, remaining - delta, met | newly_met, it + 1
+
+    deserved0 = jnp.zeros_like(request)
+    met0 = jnp.zeros((q,), dtype=bool)
+    deserved, _, _, _ = jax.lax.while_loop(
+        cond, body, (deserved0, total.astype(request.dtype) * 0 + total,
+                     met0, jnp.int32(0)))
+    return deserved

@@ -1,0 +1,58 @@
+"""Vectorized resource-comparison semantics.
+
+The epsilon-tolerant comparisons of the host Resource algebra
+(api/resource.py, mirroring reference resource_info.go:239-311) expressed
+over a fixed resource axis R = [milli-cpu, memory-bytes, scalar...].
+All device tensors use this layout; the epsilon vector is
+[10, 10MiB, 10, 10, ...].
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..api.resource import MIN_MEMORY, MIN_MILLI_CPU, MIN_MILLI_SCALAR
+
+
+def eps_vector(r: int, dtype=jnp.float32) -> jnp.ndarray:
+    """Per-dimension epsilon: [minMilliCPU, minMemory, minScalar...]."""
+    eps = [MIN_MILLI_CPU, MIN_MEMORY] + [MIN_MILLI_SCALAR] * (max(r, 2) - 2)
+    return jnp.asarray(eps, dtype=dtype)
+
+
+def scalar_dims_mask(r: int) -> jnp.ndarray:
+    """[R] bool marking scalar-resource dims (index >= 2)."""
+    return jnp.asarray([False, False] + [True] * (max(r, 2) - 2))
+
+
+EPS_VEC_FN = eps_vector
+
+
+def less_equal_vec(l: jnp.ndarray, r: jnp.ndarray, eps: jnp.ndarray,
+                   scalar_dims: jnp.ndarray) -> jnp.ndarray:
+    """Epsilon-tolerant Resource.LessEqual reduced over the last axis.
+
+    Per dim: l < r or |l-r| < eps; scalar dims with l <= eps are skipped
+    (the host path skips low/absent scalars, resource_info.go:293-296).
+    """
+    ok = (l < r) | (jnp.abs(l - r) < eps)
+    skip = scalar_dims & (l <= eps)
+    return jnp.all(ok | skip, axis=-1)
+
+
+def less_vec(l: jnp.ndarray, r: jnp.ndarray, eps: jnp.ndarray,
+             scalar_dims: jnp.ndarray) -> jnp.ndarray:
+    """Strict Resource.Less over the last axis.
+
+    Per dim strictly less; for scalar dims the reference's absent-scalar
+    asymmetry (resource_info.go:247-262) maps to: a scalar dim with l <= eps
+    counts as less only when r's dim exceeds eps.
+    """
+    strict = l < r
+    trivial = scalar_dims & (l <= eps) & (r > eps)
+    return jnp.all(strict | trivial, axis=-1)
+
+
+def is_empty_vec(v: jnp.ndarray, eps: jnp.ndarray) -> jnp.ndarray:
+    """Resource.IsEmpty: every dim below its epsilon."""
+    return jnp.all(v < eps, axis=-1)

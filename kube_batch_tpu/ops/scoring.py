@@ -1,0 +1,52 @@
+"""Node scoring kernels.
+
+Device counterparts of plugins/nodeorder.py (reimplementing the upstream
+kube-scheduler priorities the reference wraps, nodeorder.go:140-168):
+least-requested, most-requested, balanced-resource-allocation, evaluated for
+one task against all N nodes from the *current* used/allocatable tensors.
+Identical math to the host path so placements agree.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+
+MAX_PRIORITY = 10.0
+
+
+class ScoreWeights(NamedTuple):
+    least_requested: float = 1.0
+    most_requested: float = 0.0
+    balanced_resource: float = 1.0
+
+
+def node_fractions(task_res: jnp.ndarray, used: jnp.ndarray,
+                   allocatable: jnp.ndarray):
+    """Projected cpu/mem utilization fractions if the task lands on each
+    node.  task_res: [R]; used, allocatable: [N, R] -> ([N], [N])."""
+    req = used + task_res[None, :]
+    denom_ok = allocatable > 0
+    frac = jnp.where(denom_ok,
+                     jnp.minimum(req / jnp.where(denom_ok, allocatable, 1.0), 1.0),
+                     1.0)
+    return frac[:, 0], frac[:, 1]  # cpu, memory dims
+
+
+def score_nodes(task_res: jnp.ndarray, used: jnp.ndarray,
+                allocatable: jnp.ndarray, weights: ScoreWeights) -> jnp.ndarray:
+    """Weighted-sum score [N] for one task over all nodes."""
+    cpu_frac, mem_frac = node_fractions(task_res, used, allocatable)
+    score = jnp.zeros(used.shape[0], dtype=used.dtype)
+    if weights.least_requested:
+        least = ((1.0 - cpu_frac) * MAX_PRIORITY
+                 + (1.0 - mem_frac) * MAX_PRIORITY) / 2.0
+        score = score + weights.least_requested * least
+    if weights.most_requested:
+        most = (cpu_frac * MAX_PRIORITY + mem_frac * MAX_PRIORITY) / 2.0
+        score = score + weights.most_requested * most
+    if weights.balanced_resource:
+        balanced = MAX_PRIORITY - jnp.abs(cpu_frac - mem_frac) * MAX_PRIORITY
+        score = score + weights.balanced_resource * balanced
+    return score

@@ -1,0 +1,297 @@
+"""The batched allocate solver: kube-batch's session loop as one XLA program.
+
+This is the TPU-native reformulation demanded by the north star
+(BASELINE.json): the reference's allocate action (allocate.go:43-195) — queue
+PQ / job PQ / task PQ with DRF+proportion shares recomputed after every
+single placement — becomes a ``lax.while_loop`` state machine over dense
+tensors that runs entirely on device:
+
+  * queue/job selection = lexicographic masked argmin over [Q]/[J] key
+    vectors (replacing the priority queues);
+  * predicates = boolean [N] feasibility vectors from epsilon-correct
+    resource fit + a precomputed [S, N] static-predicate mask indexed by
+    task signature (replacing the 16-goroutine fan-out,
+    scheduler_helper.go:63-86);
+  * scoring = the nodeorder kernel over current [N, R] state;
+  * fairness = DRF / proportion share updates as segment additions.
+
+One loop iteration performs exactly one reference-loop event (a task
+placement, or a job/queue retiring from rotation), so the device trace
+reproduces the host path's order-dependent outcome placement-for-placement.
+Ties are broken deterministically (first index in sorted-name node order /
+first max score), matching utils/scheduler_helper.py.
+
+The state layout is chosen for SPMD sharding: all [N, ...] tensors shard
+over the node axis of a device mesh (parallel/sharded.py); job/queue state
+is replicated and updated identically on every device.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .fairness import queue_shares, safe_share
+from .resources import less_equal_vec
+from .scoring import ScoreWeights, score_nodes
+
+NEG_INF = -jnp.inf
+
+
+class SolverInputs(NamedTuple):
+    """Static per-session tensors (see models/tensor_snapshot.py)."""
+    # tasks (P = padded candidate count)
+    task_req: jnp.ndarray       # [P, R] launch requirement (init_resreq)
+    task_res: jnp.ndarray       # [P, R] steady requirement (resreq)
+    task_sig: jnp.ndarray       # [P] i32 index into sig_mask
+    task_sorted: jnp.ndarray    # [P] i32 task ids in (job, task-order) order
+    # jobs (J)
+    job_start: jnp.ndarray      # [J] i32 offset into task_sorted
+    job_count: jnp.ndarray      # [J] i32 number of candidate tasks
+    job_queue: jnp.ndarray      # [J] i32 queue index
+    job_minavail: jnp.ndarray   # [J] i32
+    job_prio: jnp.ndarray       # [J] f  PriorityClass value
+    job_ts: jnp.ndarray         # [J] f  creation timestamp
+    job_uid_rank: jnp.ndarray   # [J] f  rank of UID (tie-break)
+    job_init_ready: jnp.ndarray  # [J] i32 ready_task_num at session open
+    job_init_alloc: jnp.ndarray  # [J, R] allocated at session open (drf)
+    # queues (Q)
+    queue_deserved: jnp.ndarray  # [Q, R] proportion water-fill result
+    queue_init_alloc: jnp.ndarray  # [Q, R]
+    queue_ts: jnp.ndarray       # [Q] f
+    queue_uid_rank: jnp.ndarray  # [Q] f
+    queue_exists: jnp.ndarray   # [Q] bool (padding rows False)
+    # nodes (N)
+    node_idle: jnp.ndarray      # [N, R]
+    node_releasing: jnp.ndarray  # [N, R]
+    node_used: jnp.ndarray      # [N, R]
+    node_alloc: jnp.ndarray     # [N, R] allocatable (scoring denominator)
+    node_count: jnp.ndarray     # [N] i32 resident task count
+    node_max_tasks: jnp.ndarray  # [N] i32 pod-count cap
+    node_exists: jnp.ndarray    # [N] bool (padding rows False)
+    sig_mask: jnp.ndarray       # [S, N] bool static predicate mask
+    # cluster
+    total_res: jnp.ndarray      # [R] sum of allocatable (drf denominator)
+    eps: jnp.ndarray            # [R] epsilon vector
+    scalar_dims: jnp.ndarray    # [R] bool
+
+
+class SolverConfig(NamedTuple):
+    """Static plugin/tier structure baked into the compiled program.
+
+    ``job_key_order``/``queue_key_order`` list the order-contributing plugins
+    in tier order (session_plugins.go evaluates order fns tier by tier, first
+    non-zero wins), so the lexicographic device keys reproduce the exact
+    tiered chain of the loaded conf.
+    """
+    job_key_order: tuple = ("priority", "gang", "drf")
+    queue_key_order: tuple = ("proportion",)
+    has_gang: bool = True          # gang registers JobReady
+    has_proportion: bool = True    # proportion registers Overused
+    weights: ScoreWeights = ScoreWeights()
+
+
+class SolverState(NamedTuple):
+    idle: jnp.ndarray           # [N, R]
+    releasing: jnp.ndarray      # [N, R]
+    used: jnp.ndarray           # [N, R]
+    count: jnp.ndarray          # [N] i32
+    job_ptr: jnp.ndarray        # [J] i32 next task offset
+    job_active: jnp.ndarray     # [J] bool still in rotation
+    job_ready_cnt: jnp.ndarray  # [J] i32 dynamic ready_task_num
+    job_alloc: jnp.ndarray      # [J, R] dynamic drf allocation
+    queue_alloc: jnp.ndarray    # [Q, R]
+    queue_active: jnp.ndarray   # [Q] bool
+    locked_job: jnp.ndarray     # scalar i32, -1 when none
+    assignment: jnp.ndarray     # [P] i32 node index or -1
+    kind: jnp.ndarray           # [P] i32 0=none 1=allocate 2=pipeline
+    order: jnp.ndarray          # [P] i32 step at which placed
+    step: jnp.ndarray           # scalar i32
+
+
+def _lex_argmin(mask: jnp.ndarray, keys) -> jnp.ndarray:
+    """Index of the masked lexicographic minimum; assumes mask.any()."""
+    for k in keys:
+        kv = jnp.where(mask, k, jnp.inf)
+        mask = mask & (kv == jnp.min(kv))
+    return jnp.argmax(mask).astype(jnp.int32)
+
+
+def _select_queue(inp: SolverInputs, st: SolverState, cfg: SolverConfig):
+    """Pop the front queue (allocate.go:90-95): min share (proportion), then
+    creation time, then UID."""
+    keys = []
+    for name in cfg.queue_key_order:
+        if name == "proportion":
+            keys.append(queue_shares(st.queue_alloc, inp.queue_deserved))
+    keys.extend([inp.queue_ts, inp.queue_uid_rank])
+    return _lex_argmin(st.queue_active, keys)
+
+
+def _queue_overused(inp: SolverInputs, st: SolverState, q, cfg: SolverConfig):
+    if not cfg.has_proportion:
+        return jnp.bool_(False)
+    return less_equal_vec(inp.queue_deserved[q], st.queue_alloc[q], inp.eps,
+                          inp.scalar_dims)
+
+
+def _select_job(inp: SolverInputs, st: SolverState, q, cfg: SolverConfig):
+    """Pop the front job of queue q: tiered JobOrderFn chain — priority desc,
+    gang not-ready first, DRF share asc, then creation time / UID
+    (session_plugins.go:247-271 with the default tier layout)."""
+    mask = st.job_active & (inp.job_queue == q)
+    keys = []
+    for name in cfg.job_key_order:
+        if name == "priority":
+            keys.append(-inp.job_prio)
+        elif name == "gang":
+            ready = (st.job_ready_cnt >= inp.job_minavail)
+            keys.append(ready.astype(inp.job_ts.dtype))
+        elif name == "drf":
+            keys.append(jnp.max(
+                safe_share(st.job_alloc, inp.total_res[None, :]), axis=-1))
+    keys.extend([inp.job_ts, inp.job_uid_rank])
+    return _lex_argmin(mask, keys), mask
+
+
+def _job_ready(inp: SolverInputs, st: SolverState, j, cfg: SolverConfig):
+    """ssn.JobReady: gang's ready_task_num >= minAvailable; True when gang is
+    absent (session_plugins.go:184-203)."""
+    if not cfg.has_gang:
+        return jnp.bool_(True)
+    return st.job_ready_cnt[j] >= inp.job_minavail[j]
+
+
+def solver_step(inp: SolverInputs, cfg: SolverConfig,
+                st: SolverState) -> SolverState:
+    """One reference-loop event (see module docstring)."""
+    have_locked = st.locked_job >= 0
+
+    # ---- queue + job selection (skipped while a job is locked) -----------
+    q_sel = _select_queue(inp, st, cfg)
+    overused = _queue_overused(inp, st, q_sel, cfg)
+    j_sel, job_mask = _select_job(inp, st, q_sel, cfg)
+    queue_has_job = job_mask.any()
+    # Queue retires from rotation when overused or jobless (allocate.go:95-108
+    # `continue` without re-push).
+    retire_queue = ~have_locked & (overused | ~queue_has_job)
+
+    j = jnp.where(have_locked, st.locked_job, j_sel)
+    act = ~retire_queue  # this iteration processes a task of job j
+    jq = inp.job_queue[j]
+
+    # ---- task of job j ----------------------------------------------------
+    ptr = st.job_ptr[j]
+    exhausted = ptr >= inp.job_count[j]
+    t = inp.task_sorted[jnp.clip(inp.job_start[j] + ptr, 0,
+                                 inp.task_sorted.shape[0] - 1)]
+
+    req = inp.task_req[t]
+    res = inp.task_res[t]
+
+    fit_idle = less_equal_vec(req[None, :], st.idle, inp.eps, inp.scalar_dims)
+    fit_rel = less_equal_vec(req[None, :], st.releasing, inp.eps,
+                             inp.scalar_dims)
+    feasible = (inp.sig_mask[inp.task_sig[t]] & inp.node_exists
+                & (st.count < inp.node_max_tasks) & (fit_idle | fit_rel))
+    any_feasible = feasible.any()
+
+    placing = act & ~exhausted & any_feasible
+
+    score = score_nodes(res, st.used, inp.node_alloc, cfg.weights)
+    score = jnp.where(feasible, score, NEG_INF)
+    # first max = deterministic tie-break
+    n = jnp.argmax(score).astype(jnp.int32)
+
+    alloc_ok = placing & fit_idle[n]
+    pipe_ok = placing & ~fit_idle[n] & fit_rel[n]
+    placed = alloc_ok | pipe_ok
+
+    # ---- state updates ----------------------------------------------------
+    dres = jnp.where(placed, 1.0, 0.0).astype(res.dtype) * res
+    idle = st.idle.at[n].add(jnp.where(alloc_ok, -dres, 0.0))
+    releasing = st.releasing.at[n].add(jnp.where(pipe_ok, -dres, 0.0))
+    used = st.used.at[n].add(dres)
+    count = st.count.at[n].add(placed.astype(st.count.dtype))
+
+    # Event handlers fire for both allocate and pipeline (session.go:269-275):
+    # DRF job share and proportion queue share grow by resreq.
+    job_alloc = st.job_alloc.at[j].add(dres)
+    queue_alloc = st.queue_alloc.at[jq].add(dres)
+    job_ready_cnt = st.job_ready_cnt.at[j].add(alloc_ok.astype(jnp.int32))
+
+    consumed = act & ~exhausted & any_feasible  # task consumed even if placed on neither (can't happen; kept for clarity)
+    job_ptr = st.job_ptr.at[j].add(consumed.astype(jnp.int32))
+
+    assignment = st.assignment.at[t].set(
+        jnp.where(placed, n, st.assignment[t]))
+    kind = st.kind.at[t].set(
+        jnp.where(alloc_ok, 1, jnp.where(pipe_ok, 2, st.kind[t])))
+    order = st.order.at[t].set(
+        jnp.where(placed, st.step, st.order[t]))
+
+    # ---- rotation bookkeeping ---------------------------------------------
+    st2 = st._replace(job_ready_cnt=job_ready_cnt)
+    now_ready = _job_ready(inp, st2, j, cfg)
+    remaining = job_ptr[j] < inp.job_count[j]
+
+    # Job leaves rotation on: exhausted-at-pop, predicate-dead-end
+    # (allocate.go:146-150 break), or task loop ending without a re-push
+    # (ready with tasks remaining is the only re-push, allocate.go:185-188).
+    job_dies = act & (exhausted | (~any_feasible)
+                      | (~remaining))
+    job_active = st.job_active.at[j].set(
+        jnp.where(job_dies, False, st.job_active[j]))
+
+    # Lock semantics: keep draining this job's tasks until it turns ready or
+    # dies (the inner `for !tasks.Empty()` loop).
+    stay_locked = act & placed & ~now_ready & remaining
+    locked_job = jnp.where(stay_locked, j, -1)
+
+    queue_active = st.queue_active.at[q_sel].set(
+        jnp.where(retire_queue, False, st.queue_active[q_sel]))
+
+    return SolverState(
+        idle=idle, releasing=releasing, used=used, count=count,
+        job_ptr=job_ptr, job_active=job_active,
+        job_ready_cnt=job_ready_cnt, job_alloc=job_alloc,
+        queue_alloc=queue_alloc, queue_active=queue_active,
+        locked_job=locked_job, assignment=assignment, kind=kind,
+        order=order, step=st.step + 1)
+
+
+def initial_state(inp: SolverInputs) -> SolverState:
+    p = inp.task_req.shape[0]
+    j = inp.job_start.shape[0]
+    q = inp.queue_deserved.shape[0]
+    # Jobs enter rotation when their queue exists (allocate.go:52-65 pushes
+    # every job whose queue is found, even with zero pending tasks).
+    job_active = inp.queue_exists[inp.job_queue] & (inp.job_minavail >= 0)
+    # Queues enter rotation when any job references them.
+    queue_active = jnp.zeros((q,), dtype=bool).at[inp.job_queue].set(
+        True) & inp.queue_exists
+    return SolverState(
+        idle=inp.node_idle, releasing=inp.node_releasing, used=inp.node_used,
+        count=inp.node_count,
+        job_ptr=jnp.zeros((j,), jnp.int32), job_active=job_active,
+        job_ready_cnt=inp.job_init_ready, job_alloc=inp.job_init_alloc,
+        queue_alloc=inp.queue_init_alloc, queue_active=queue_active,
+        locked_job=jnp.int32(-1),
+        assignment=jnp.full((p,), -1, jnp.int32),
+        kind=jnp.zeros((p,), jnp.int32),
+        order=jnp.full((p,), -1, jnp.int32),
+        step=jnp.int32(0))
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolverState:
+    """Run the session's allocate loop to completion on device."""
+    st = initial_state(inp)
+
+    def cond(st: SolverState):
+        return st.queue_active.any() | (st.locked_job >= 0)
+
+    return jax.lax.while_loop(cond, lambda s: solver_step(inp, cfg, s), st)

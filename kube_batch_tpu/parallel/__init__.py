@@ -1,0 +1,7 @@
+"""Multi-chip parallelism: mesh layout and sharded solver entry points."""
+
+from .mesh import (NODE_AXIS, make_mesh, shard_solver_inputs,
+                   solver_input_shardings)
+
+__all__ = ["NODE_AXIS", "make_mesh", "shard_solver_inputs",
+           "solver_input_shardings"]

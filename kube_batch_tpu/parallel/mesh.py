@@ -1,0 +1,57 @@
+"""Device mesh + sharding layout for the batched solver.
+
+The scale axis of this framework is nodes x tasks, not sequence length
+(SURVEY.md §5): when [N, R] node state or the [S, N] static mask outgrows one
+chip's HBM, they shard over the ``nodes`` axis of a 1-D mesh.  Job/queue
+state is replicated; the per-step argmax over nodes becomes an XLA
+cross-device reduction riding ICI.  We express this with NamedSharding and
+let GSPMD insert the collectives (the scaling-book recipe: pick a mesh,
+annotate shardings, let XLA do the rest).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NODE_AXIS = "nodes"
+
+
+def make_mesh(n_devices: Optional[int] = None) -> Mesh:
+    devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(devices, (NODE_AXIS,))
+
+
+def solver_input_shardings(mesh: Mesh):
+    """NamedShardings for ops.solver.SolverInputs: node-major tensors split
+    over the mesh, everything else replicated."""
+    from ..ops.solver import SolverInputs
+
+    node_1d = NamedSharding(mesh, P(NODE_AXIS))
+    node_2d = NamedSharding(mesh, P(NODE_AXIS, None))
+    sig = NamedSharding(mesh, P(None, NODE_AXIS))
+    rep = NamedSharding(mesh, P())
+    rep2 = NamedSharding(mesh, P(None, None))
+    return SolverInputs(
+        task_req=rep2, task_res=rep2, task_sig=rep, task_sorted=rep,
+        job_start=rep, job_count=rep, job_queue=rep, job_minavail=rep,
+        job_prio=rep, job_ts=rep, job_uid_rank=rep, job_init_ready=rep,
+        job_init_alloc=rep2,
+        queue_deserved=rep2, queue_init_alloc=rep2, queue_ts=rep,
+        queue_uid_rank=rep, queue_exists=rep,
+        node_idle=node_2d, node_releasing=node_2d, node_used=node_2d,
+        node_alloc=node_2d, node_count=node_1d, node_max_tasks=node_1d,
+        node_exists=node_1d, sig_mask=sig,
+        total_res=rep, eps=rep, scalar_dims=rep,
+    )._replace(task_sig=rep, task_sorted=rep)
+
+
+def shard_solver_inputs(inputs, mesh: Mesh):
+    """Device-put SolverInputs with the node-axis layout."""
+    shardings = solver_input_shardings(mesh)
+    return jax.tree.map(lambda x, s: jax.device_put(x, s), inputs,
+                        shardings, is_leaf=lambda x: x is None)

@@ -1,0 +1,5 @@
+"""Scheduler plugins (L3): gang, drf, proportion, priority, predicates,
+nodeorder, conformance, tpu-score.
+
+TPU-native counterpart of /root/reference/pkg/scheduler/plugins/.
+"""

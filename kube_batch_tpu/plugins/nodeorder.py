@@ -1,0 +1,107 @@
+"""Nodeorder plugin: node scoring.
+
+The reference wraps upstream kube-scheduler priorities with YAML-tunable
+weights (/root/reference/pkg/scheduler/plugins/nodeorder/nodeorder.go:27-38,
+107-168): LeastRequested (w=1), MostRequested (w=0), BalancedResource (w=1),
+NodeAffinity (w=1), InterPodAffinity (w=1).  These are standalone
+reimplementations of those scoring formulas; the identical math runs
+vectorized on TPU in ops/scoring.py, which parity tests check against this
+host path.
+"""
+
+from __future__ import annotations
+
+from ..api import NodeInfo, TaskInfo
+from ..framework import Arguments, Plugin
+
+# Argument keys (nodeorder.go:41-66).
+NODE_AFFINITY_WEIGHT = "nodeaffinity.weight"
+POD_AFFINITY_WEIGHT = "podaffinity.weight"
+LEAST_REQUESTED_WEIGHT = "leastrequested.weight"
+BALANCED_RESOURCE_WEIGHT = "balancedresource.weight"
+MOST_REQUESTED_WEIGHT = "mostrequested.weight"
+
+MAX_PRIORITY = 10.0
+
+
+def _fractions(task: TaskInfo, node: NodeInfo):
+    """Projected cpu/memory utilization fractions if task lands on node."""
+    cpu_alloc = node.allocatable.milli_cpu
+    mem_alloc = node.allocatable.memory
+    cpu_req = node.used.milli_cpu + task.resreq.milli_cpu
+    mem_req = node.used.memory + task.resreq.memory
+    cpu_frac = 1.0 if cpu_alloc == 0 else min(cpu_req / cpu_alloc, 1.0)
+    mem_frac = 1.0 if mem_alloc == 0 else min(mem_req / mem_alloc, 1.0)
+    return cpu_frac, mem_frac
+
+
+def least_requested_score(task: TaskInfo, node: NodeInfo) -> float:
+    """Mean over cpu/mem of (free after placement) * 10 / allocatable
+    (upstream least_requested.go semantics)."""
+    cpu_frac, mem_frac = _fractions(task, node)
+    return ((1.0 - cpu_frac) * MAX_PRIORITY + (1.0 - mem_frac) * MAX_PRIORITY) / 2.0
+
+
+def most_requested_score(task: TaskInfo, node: NodeInfo) -> float:
+    cpu_frac, mem_frac = _fractions(task, node)
+    return (cpu_frac * MAX_PRIORITY + mem_frac * MAX_PRIORITY) / 2.0
+
+
+def balanced_resource_score(task: TaskInfo, node: NodeInfo) -> float:
+    """10 - |cpuFraction - memFraction| * 10 (upstream
+    balanced_resource_allocation.go)."""
+    cpu_frac, mem_frac = _fractions(task, node)
+    return MAX_PRIORITY - abs(cpu_frac - mem_frac) * MAX_PRIORITY
+
+
+def node_affinity_score(task: TaskInfo, node: NodeInfo) -> float:
+    """Sum of matching preferred-node-affinity term weights (upstream
+    node_affinity.go map phase; we skip the max-normalizing reduce so the
+    score stays a pure per-(task,node) function — weights act directly)."""
+    affinity = task.pod.spec.affinity
+    if affinity is None or not affinity.preferred_node_terms:
+        return 0.0
+    labels = node.node.metadata.labels if node.node else {}
+    score = 0.0
+    for weight, term in affinity.preferred_node_terms:
+        if all(labels.get(k) == v for k, v in term.items()):
+            score += weight
+    return score
+
+
+class NodeOrderPlugin(Plugin):
+
+    def __init__(self, arguments: Arguments):
+        self.arguments = arguments
+
+    def name(self) -> str:
+        return "nodeorder"
+
+    def weights(self):
+        a = self.arguments
+        return {
+            "leastrequested": a.get_float(LEAST_REQUESTED_WEIGHT, 1.0),
+            "mostrequested": a.get_float(MOST_REQUESTED_WEIGHT, 0.0),
+            "balancedresource": a.get_float(BALANCED_RESOURCE_WEIGHT, 1.0),
+            "nodeaffinity": a.get_float(NODE_AFFINITY_WEIGHT, 1.0),
+        }
+
+    def on_session_open(self, ssn) -> None:
+        w = self.weights()
+        prioritizers = []
+        if w["leastrequested"]:
+            prioritizers.append((w["leastrequested"], least_requested_score))
+        if w["mostrequested"]:
+            prioritizers.append((w["mostrequested"], most_requested_score))
+        if w["balancedresource"]:
+            prioritizers.append((w["balancedresource"], balanced_resource_score))
+        if w["nodeaffinity"]:
+            prioritizers.append((w["nodeaffinity"], node_affinity_score))
+        ssn.add_node_order_fns(self.name(), prioritizers)
+
+    def on_session_close(self, ssn) -> None:
+        pass
+
+
+def new(arguments: Arguments) -> NodeOrderPlugin:
+    return NodeOrderPlugin(arguments)

@@ -1,0 +1,126 @@
+"""Scheduler: the periodic session loop (L5).
+
+Mirrors /root/reference/pkg/scheduler/scheduler.go (Run/runOnce every
+schedule-period) and util.go (YAML conf loading with the default
+``allocate, backfill`` pipeline).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional, Tuple
+
+from .conf import (SchedulerConfiguration, Tier, apply_plugin_conf_defaults,
+                   configuration_from_dict)
+from .framework import (Action, close_session, get_action, open_session)
+from .metrics import metrics
+
+DEFAULT_SCHEDULER_CONF = """
+actions: "allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+def load_scheduler_conf(conf_str: str) -> Tuple[List[Action], List[Tier]]:
+    """Parse the YAML conf into (actions, tiers) (reference
+    scheduler/util.go:44-73)."""
+    try:
+        import yaml
+        data = yaml.safe_load(conf_str) or {}
+    except ImportError:  # fall back to a micro-parser for the default shape
+        data = _mini_yaml(conf_str)
+
+    conf = configuration_from_dict(data)
+    for tier in conf.tiers:
+        for option in tier.plugins:
+            apply_plugin_conf_defaults(option)
+
+    actions = []
+    for name in conf.actions.split(","):
+        action = get_action(name.strip())
+        if action is None:
+            raise KeyError(f"failed to find Action {name.strip()}")
+        actions.append(action)
+    return actions, conf.tiers
+
+
+def _mini_yaml(conf_str: str) -> dict:
+    """Tiny parser for the conf subset (actions + tiers/plugins/name)."""
+    data: dict = {"actions": "", "tiers": []}
+    tier = None
+    for raw in conf_str.splitlines():
+        line = raw.strip()
+        if line.startswith("actions:"):
+            data["actions"] = line.split(":", 1)[1].strip().strip('"')
+        elif line.startswith("- plugins:"):
+            tier = {"plugins": []}
+            data["tiers"].append(tier)
+        elif line.startswith("- name:") and tier is not None:
+            tier["plugins"].append({"name": line.split(":", 1)[1].strip()})
+    return data
+
+
+class Scheduler:
+    """Periodic runner (scheduler.go:33-102)."""
+
+    def __init__(self, cache, scheduler_conf: Optional[str] = None,
+                 schedule_period: float = 1.0):
+        from .actions.factory import register_default_actions
+        from .plugins.factory import register_default_plugins
+        register_default_actions()
+        register_default_plugins()
+
+        self.cache = cache
+        self.schedule_period = schedule_period
+        self.actions, self.tiers = load_scheduler_conf(
+            scheduler_conf or DEFAULT_SCHEDULER_CONF)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def run_once(self) -> None:
+        """One scheduling cycle (scheduler.go:88-102)."""
+        start = time.time()
+        ssn = open_session(self.cache, self.tiers)
+        try:
+            for action in self.actions:
+                action_start = time.time()
+                action.execute(ssn)
+                metrics.observe_action_latency(
+                    action.name(), time.time() - action_start)
+        finally:
+            close_session(ssn)
+        metrics.observe_e2e_latency(time.time() - start)
+
+    def run(self) -> None:
+        """Start the wait.Until-style loop in a background thread
+        (scheduler.go:63-86)."""
+        self.cache.run()
+        self.cache.wait_for_cache_sync()
+
+        def loop():
+            while not self._stop.is_set():
+                cycle_start = time.time()
+                try:
+                    self.run_once()
+                except Exception:  # loop must survive a bad cycle
+                    metrics.register_schedule_attempt("error")
+                delay = self.schedule_period - (time.time() - cycle_start)
+                if delay > 0:
+                    self._stop.wait(delay)
+
+        self._thread = threading.Thread(target=loop, daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)

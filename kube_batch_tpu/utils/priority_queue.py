@@ -1,0 +1,50 @@
+"""Priority queue over a less-than function, with live re-evaluation.
+
+Counterpart of /root/reference/pkg/scheduler/util/priority_queue.go:26-94,
+with one deliberate semantic strengthening: kube-batch's heap stores items
+whose ordering keys (DRF/proportion shares) mutate *while queued*, so Go's
+container/heap can pop stale, non-minimal items depending on sift history.
+That behavior is accidental and unreproducible on an accelerator.  This queue
+re-evaluates the less-fn at pop time and returns the true current minimum —
+the semantics the plugins declare — and the device solver's lexicographic
+argmin (ops/solver.py) matches it exactly.  Pop is O(n); the session-level
+queues hold queues/jobs (small), and per-job task keys are immutable, so this
+is never the bottleneck (the [tasks x nodes] work lives on the TPU).
+
+Ties (less(a,b) and less(b,a) both false) pop in insertion order; the
+session order functions end with creation-time/UID fallbacks making the
+order total, so ties only occur for duplicate pushes of the same object.
+"""
+
+from __future__ import annotations
+
+from collections import deque
+from typing import Callable
+
+
+class PriorityQueue:
+
+    def __init__(self, less_fn: Callable[[object, object], bool]):
+        self._less = less_fn
+        self._items: deque = deque()
+
+    def push(self, value) -> None:
+        self._items.append(value)
+
+    def pop(self):
+        if not self._items:
+            return None
+        best_i = 0
+        best = self._items[0]
+        for i in range(1, len(self._items)):
+            if self._less(self._items[i], best):
+                best = self._items[i]
+                best_i = i
+        del self._items[best_i]
+        return best
+
+    def empty(self) -> bool:
+        return not self._items
+
+    def __len__(self) -> int:
+        return len(self._items)

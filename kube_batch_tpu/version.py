@@ -1,0 +1,10 @@
+"""Version info (reference pkg/version/version.go injects via ldflags; we
+keep a plain module constant plus an optional git SHA probe)."""
+
+__version__ = "0.1.0"
+
+API_VERSION = "v1alpha1"
+
+
+def version_string() -> str:
+    return f"kube-batch-tpu {__version__} (api {API_VERSION})"

@@ -1,0 +1,22 @@
+"""Test harness configuration.
+
+Forces JAX onto a virtual 8-device CPU platform with x64 enabled so the
+multi-chip sharding paths (pjit/shard_map over a Mesh) are exercised without
+TPU hardware and parity assertions are bit-exact against the host float path.
+
+Note: the runtime environment may import jax at interpreter startup (the
+axon TPU tunnel does), so env vars alone are too late — we use
+jax.config.update, which takes effect any time before backend init.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

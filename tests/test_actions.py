@@ -1,0 +1,217 @@
+"""Action-level integration tests without a cluster.
+
+The key pattern replicated from the reference
+(actions/allocate/allocate_test.go:149-211): hand-build a SchedulerCache with
+fake effectors, run the real open_session -> action.execute pipeline, and
+assert on the FakeBinder's recorded decisions.
+"""
+
+import pytest
+
+from kube_batch_tpu.actions.allocate import AllocateAction
+from kube_batch_tpu.actions.backfill import BackfillAction
+from kube_batch_tpu.actions.preempt import PreemptAction
+from kube_batch_tpu.actions.reclaim import ReclaimAction
+from kube_batch_tpu.api import ObjectMeta
+from kube_batch_tpu.api.queue_info import Queue
+from kube_batch_tpu.apis.scheduling import v1alpha1
+from kube_batch_tpu.cache import (FakeBinder, FakeEvictor, FakeStatusUpdater,
+                                  FakeVolumeBinder, SchedulerCache)
+from kube_batch_tpu.framework import close_session, open_session
+from kube_batch_tpu.plugins.factory import register_default_plugins
+from kube_batch_tpu.scheduler import DEFAULT_SCHEDULER_CONF, load_scheduler_conf
+from tests.test_utils import build_node, build_pod, build_resource_list
+
+
+@pytest.fixture(autouse=True)
+def _plugins():
+    from kube_batch_tpu.actions.factory import register_default_actions
+    register_default_actions()
+    register_default_plugins()
+
+
+def make_cache(pods=(), nodes=(), pod_groups=(), queues=("c1",)):
+    binder = FakeBinder()
+    evictor = FakeEvictor()
+    cache = SchedulerCache(binder=binder, evictor=evictor,
+                           status_updater=FakeStatusUpdater(),
+                           volume_binder=FakeVolumeBinder())
+    for name in queues:
+        cache.add_queue(Queue(metadata=ObjectMeta(name=name), weight=1))
+    for pg in pod_groups:
+        cache.add_pod_group(pg)
+    for node in nodes:
+        cache.add_node(node)
+    for pod in pods:
+        cache.add_pod(pod)
+    return cache, binder, evictor
+
+
+def make_pg(name, namespace="c1", min_member=1, queue="c1"):
+    return v1alpha1.PodGroup(
+        metadata=ObjectMeta(name=name, namespace=namespace),
+        spec=v1alpha1.PodGroupSpec(min_member=min_member, queue=queue))
+
+
+def run_session(cache, action, conf=DEFAULT_SCHEDULER_CONF):
+    _, tiers = load_scheduler_conf(conf)
+    ssn = open_session(cache, tiers)
+    try:
+        action.execute(ssn)
+    finally:
+        close_session(ssn)
+
+
+class TestAllocate:
+    def test_one_queue_one_job(self):
+        # Mirrors allocate_test.go "one Job with two Pods on one node".
+        pods = [
+            build_pod("c1", "p1", "", "Pending",
+                      build_resource_list("1", "1Gi"), "pg1"),
+            build_pod("c1", "p2", "", "Pending",
+                      build_resource_list("1", "1Gi"), "pg1"),
+        ]
+        nodes = [build_node("n1", build_resource_list("2", "4Gi", pods=10))]
+        cache, binder, _ = make_cache(pods, nodes, [make_pg("pg1")])
+        run_session(cache, AllocateAction())
+        assert binder.binds == {"c1/p1": "n1", "c1/p2": "n1"}
+
+    def test_two_queues_fair_share(self):
+        # Mirrors allocate_test.go "two Jobs on one node": queues interleave.
+        pods = [
+            build_pod("c1", "p1", "", "Pending",
+                      build_resource_list("1", "1G"), "pg1"),
+            build_pod("c1", "p2", "", "Pending",
+                      build_resource_list("1", "1G"), "pg1"),
+            build_pod("c2", "p1", "", "Pending",
+                      build_resource_list("1", "1G"), "pg2"),
+            build_pod("c2", "p2", "", "Pending",
+                      build_resource_list("1", "1G"), "pg2"),
+        ]
+        nodes = [build_node("n1", build_resource_list("2", "4G", pods=10))]
+        cache, binder, _ = make_cache(
+            pods, nodes,
+            [make_pg("pg1", "c1", queue="c1"), make_pg("pg2", "c2", queue="c2")],
+            queues=("c1", "c2"))
+        run_session(cache, AllocateAction())
+        # Node fits 2 of the 4 pods; fairness gives one to each queue.
+        assert len(binder.binds) == 2
+        bound_queues = {k.split("/")[0] for k in binder.binds}
+        assert bound_queues == {"c1", "c2"}
+
+    def test_gang_blocks_partial_placement(self):
+        # minMember=3 but only 2 fit -> nothing binds (gang barrier).
+        pods = [build_pod("c1", f"p{i}", "", "Pending",
+                          build_resource_list("1", "1Gi"), "pg1")
+                for i in range(3)]
+        nodes = [build_node("n1", build_resource_list("2", "8Gi", pods=10))]
+        cache, binder, _ = make_cache(pods, nodes,
+                                      [make_pg("pg1", min_member=3)])
+        run_session(cache, AllocateAction())
+        assert binder.binds == {}
+
+    def test_gang_dispatches_when_ready(self):
+        pods = [build_pod("c1", f"p{i}", "", "Pending",
+                          build_resource_list("1", "1Gi"), "pg1")
+                for i in range(3)]
+        nodes = [build_node("n1", build_resource_list("4", "8Gi", pods=10))]
+        cache, binder, _ = make_cache(pods, nodes,
+                                      [make_pg("pg1", min_member=3)])
+        run_session(cache, AllocateAction())
+        assert len(binder.binds) == 3
+
+    def test_job_invalid_without_enough_tasks(self):
+        # JobValid gate: 1 task but minMember=2 -> session drops the job.
+        pods = [build_pod("c1", "p1", "", "Pending",
+                          build_resource_list("1", "1Gi"), "pg1")]
+        nodes = [build_node("n1", build_resource_list("4", "8Gi", pods=10))]
+        cache, binder, _ = make_cache(pods, nodes,
+                                      [make_pg("pg1", min_member=2)])
+        run_session(cache, AllocateAction())
+        assert binder.binds == {}
+
+    def test_best_effort_skipped(self):
+        pods = [build_pod("c1", "p1", "", "Pending", {}, "pg1")]
+        nodes = [build_node("n1", build_resource_list("4", "8Gi", pods=10))]
+        cache, binder, _ = make_cache(pods, nodes, [make_pg("pg1")])
+        run_session(cache, AllocateAction())
+        assert binder.binds == {}
+
+    def test_node_selector_respected(self):
+        pods = [build_pod("c1", "p1", "", "Pending",
+                          build_resource_list("1", "1Gi"), "pg1",
+                          selector={"zone": "a"})]
+        nodes = [build_node("n1", build_resource_list("4", "8Gi", pods=10),
+                            labels={"zone": "b"}),
+                 build_node("n2", build_resource_list("4", "8Gi", pods=10),
+                            labels={"zone": "a"})]
+        cache, binder, _ = make_cache(pods, nodes, [make_pg("pg1")])
+        run_session(cache, AllocateAction())
+        assert binder.binds == {"c1/p1": "n2"}
+
+
+class TestBackfill:
+    def test_best_effort_lands(self):
+        pods = [build_pod("c1", "p1", "", "Pending", {}, "pg1")]
+        nodes = [build_node("n1", build_resource_list("4", "8Gi", pods=10))]
+        cache, binder, _ = make_cache(pods, nodes, [make_pg("pg1")])
+        run_session(cache, BackfillAction())
+        assert binder.binds == {"c1/p1": "n1"}
+
+
+class TestPreempt:
+    def test_high_priority_preempts(self):
+        # Mirrors preempt_test.go: node full with low-prio job; high-prio
+        # pending job evicts enough to pipeline.
+        pods = [
+            build_pod("c1", "low1", "n1", "Running",
+                      build_resource_list("1", "1G"), "low", priority=1),
+            build_pod("c1", "low2", "n1", "Running",
+                      build_resource_list("1", "1G"), "low", priority=1),
+            build_pod("c1", "high1", "", "Pending",
+                      build_resource_list("1", "1G"), "high", priority=100),
+        ]
+        nodes = [build_node("n1", build_resource_list("2", "2G", pods=10))]
+        pgs = [make_pg("low", min_member=1), make_pg("high", min_member=1)]
+        cache, binder, evictor = make_cache(pods, nodes, pgs)
+        # Give jobs PriorityClass-resolved priorities via pod priority.
+        for job in cache.jobs.values():
+            if job.name == "high":
+                job.priority = 100
+        run_session(cache, PreemptAction())
+        assert len(evictor.evicts) == 1
+        assert evictor.evicts[0].startswith("c1/low")
+
+    def test_no_preempt_within_equal_priority(self):
+        pods = [
+            build_pod("c1", "a1", "n1", "Running",
+                      build_resource_list("2", "2G"), "pga", priority=5),
+            build_pod("c1", "b1", "", "Pending",
+                      build_resource_list("2", "2G"), "pgb", priority=5),
+        ]
+        nodes = [build_node("n1", build_resource_list("2", "2G", pods=10))]
+        pgs = [make_pg("pga", min_member=1), make_pg("pgb", min_member=1)]
+        cache, _, evictor = make_cache(pods, nodes, pgs)
+        run_session(cache, PreemptAction())
+        assert evictor.evicts == []
+
+
+class TestReclaim:
+    def test_cross_queue_reclaim(self):
+        # Mirrors reclaim_test.go: q2's pending job reclaims from q1 which
+        # holds the whole node (2 queues, weight 1:1 -> deserved half each).
+        pods = [
+            build_pod("c1", "owner1", "n1", "Running",
+                      build_resource_list("1", "1G"), "pg1"),
+            build_pod("c1", "owner2", "n1", "Running",
+                      build_resource_list("1", "1G"), "pg1"),
+            build_pod("c2", "starved", "", "Pending",
+                      build_resource_list("1", "1G"), "pg2"),
+        ]
+        nodes = [build_node("n1", build_resource_list("2", "2G", pods=10))]
+        pgs = [make_pg("pg1", "c1", queue="q1"),
+               make_pg("pg2", "c2", queue="q2")]
+        cache, _, evictor = make_cache(pods, nodes, pgs, queues=("q1", "q2"))
+        run_session(cache, ReclaimAction())
+        assert len(evictor.evicts) == 1
+        assert evictor.evicts[0].startswith("c1/owner")

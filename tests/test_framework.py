@@ -1,0 +1,88 @@
+"""Framework-layer tests: arguments, conf parsing, priority queue, tiered
+combinators (reference framework/arguments_test.go, scheduler/util_test.go)."""
+
+import pytest
+
+from kube_batch_tpu.conf import apply_plugin_conf_defaults, configuration_from_dict
+from kube_batch_tpu.framework import Arguments
+from kube_batch_tpu.scheduler import load_scheduler_conf
+from kube_batch_tpu.utils import PriorityQueue
+
+
+class TestArguments:
+    def test_get_int(self):
+        args = Arguments({"a": "5", "b": "x", "c": ""})
+        assert args.get_int("a") == 5
+        assert args.get_int("b", 7) == 7
+        assert args.get_int("c", 3) == 3
+        assert args.get_int("missing") is None
+
+    def test_get_bool(self):
+        args = Arguments({"t": "true", "f": "false", "y": "1"})
+        assert args.get_bool("t") is True
+        assert args.get_bool("f") is False
+        assert args.get_bool("y") is True
+        assert args.get_bool("missing", True) is True
+
+    def test_get_float(self):
+        args = Arguments({"w": "2.5"})
+        assert args.get_float("w") == 2.5
+
+
+class TestConf:
+    def test_defaults_applied(self):
+        conf = configuration_from_dict({
+            "actions": "allocate",
+            "tiers": [{"plugins": [{"name": "gang",
+                                    "enableJobOrder": False}]}]})
+        option = conf.tiers[0].plugins[0]
+        apply_plugin_conf_defaults(option)
+        assert option.enabled_job_order is False
+        assert option.enabled_job_ready is True
+        assert option.enabled_predicate is True
+
+    def test_load_scheduler_conf(self):
+        from kube_batch_tpu.actions.factory import register_default_actions
+        from kube_batch_tpu.plugins.factory import register_default_plugins
+        register_default_actions()
+        register_default_plugins()
+        conf = """
+actions: "allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+        actions, tiers = load_scheduler_conf(conf)
+        assert [a.name() for a in actions] == ["allocate", "backfill"]
+        assert len(tiers) == 2
+        assert [p.name for p in tiers[0].plugins] == ["priority", "gang"]
+        assert tiers[1].plugins[0].enabled_job_order is True
+
+    def test_unknown_action_raises(self):
+        with pytest.raises(KeyError):
+            load_scheduler_conf('actions: "nope"\n')
+
+
+class TestPriorityQueue:
+    def test_order(self):
+        pq = PriorityQueue(lambda l, r: l < r)
+        for v in [5, 1, 3, 2, 4]:
+            pq.push(v)
+        assert [pq.pop() for _ in range(5)] == [1, 2, 3, 4, 5]
+
+    def test_stable_for_equal(self):
+        pq = PriorityQueue(lambda l, r: False)  # everything equal
+        for v in ["a", "b", "c"]:
+            pq.push(v)
+        assert [pq.pop() for _ in range(3)] == ["a", "b", "c"]
+
+    def test_empty_pop(self):
+        pq = PriorityQueue(lambda l, r: l < r)
+        assert pq.pop() is None
+        assert pq.empty()

@@ -1,0 +1,104 @@
+"""JobInfo/TaskInfo invariants, following the reference's api/job_info_test.go
+table-driven pattern."""
+
+import pytest
+
+from kube_batch_tpu.api import (JobInfo, TaskInfo, TaskStatus, Resource,
+                                get_job_id)
+from tests.test_utils import build_pod, build_resource_list
+
+
+def task(ns, name, node, phase, cpu="1", mem="1Gi", group="group1"):
+    return TaskInfo(build_pod(ns, name, node, phase,
+                              build_resource_list(cpu, mem), group))
+
+
+class TestTaskInfo:
+    def test_from_pod(self):
+        t = task("ns", "p1", "n1", "Running")
+        assert t.job == "ns/group1"
+        assert t.status == TaskStatus.Running
+        assert t.resreq.milli_cpu == 1000.0
+        assert t.priority == 1
+
+    def test_no_group_annotation(self):
+        pod = build_pod("ns", "p1", "", "Pending", build_resource_list("1", "1Gi"))
+        assert get_job_id(pod) == ""
+
+    def test_status_mapping(self):
+        assert task("n", "a", "", "Pending").status == TaskStatus.Pending
+        assert task("n", "b", "n1", "Pending").status == TaskStatus.Bound
+        assert task("n", "c", "n1", "Running").status == TaskStatus.Running
+        assert task("n", "d", "n1", "Succeeded").status == TaskStatus.Succeeded
+        assert task("n", "e", "n1", "Failed").status == TaskStatus.Failed
+        assert task("n", "f", "n1", "Unknown").status == TaskStatus.Unknown
+
+    def test_releasing_on_deletion(self):
+        pod = build_pod("n", "g", "n1", "Running", build_resource_list("1", "1Gi"))
+        pod.metadata.deletion_timestamp = 1.0
+        assert TaskInfo(pod).status == TaskStatus.Releasing
+
+
+class TestJobInfo:
+    def test_add_task(self):
+        job = JobInfo("uid",
+                      task("ns", "p1", "n1", "Running"),
+                      task("ns", "p2", "n1", "Running"))
+        assert len(job.tasks) == 2
+        assert job.total_request.milli_cpu == 2000.0
+        assert job.allocated.milli_cpu == 2000.0
+        assert len(job.task_status_index[TaskStatus.Running]) == 2
+
+    def test_pending_not_allocated(self):
+        job = JobInfo("uid", task("ns", "p1", "", "Pending"))
+        assert job.allocated.milli_cpu == 0.0
+        assert job.total_request.milli_cpu == 1000.0
+
+    def test_delete_task(self):
+        t1 = task("ns", "p1", "n1", "Running")
+        t2 = task("ns", "p2", "n1", "Running")
+        job = JobInfo("uid", t1, t2)
+        job.delete_task_info(t1)
+        assert len(job.tasks) == 1
+        assert job.allocated.milli_cpu == 1000.0
+        assert TaskStatus.Running in job.task_status_index
+        job.delete_task_info(t2)
+        assert TaskStatus.Running not in job.task_status_index
+
+    def test_delete_missing_raises(self):
+        job = JobInfo("uid")
+        with pytest.raises(KeyError):
+            job.delete_task_info(task("ns", "nope", "n1", "Running"))
+
+    def test_update_status_moves_index(self):
+        t = task("ns", "p1", "", "Pending")
+        job = JobInfo("uid", t)
+        job.update_task_status(t, TaskStatus.Allocated)
+        assert TaskStatus.Pending not in job.task_status_index
+        assert t.uid in job.task_status_index[TaskStatus.Allocated]
+        assert job.allocated.milli_cpu == 1000.0
+
+    def test_gang_counters(self):
+        tasks = [task("ns", f"p{i}", "", "Pending") for i in range(3)]
+        job = JobInfo("uid", *tasks)
+        job.min_available = 2
+        assert job.ready_task_num() == 0
+        assert job.valid_task_num() == 3
+        assert not job.ready()
+        job.update_task_status(tasks[0], TaskStatus.Allocated)
+        job.update_task_status(tasks[1], TaskStatus.Pipelined)
+        assert job.ready_task_num() == 1
+        assert job.waiting_task_num() == 1
+        assert not job.ready()
+        assert job.pipelined()
+        job.update_task_status(tasks[1], TaskStatus.Allocated)
+        assert job.ready()
+
+    def test_clone(self):
+        t = task("ns", "p1", "n1", "Running")
+        job = JobInfo("uid", t)
+        job.min_available = 1
+        c = job.clone()
+        c.tasks[t.uid].resreq.add(Resource(1000))
+        assert job.tasks[t.uid].resreq.milli_cpu == 1000.0
+        assert c.min_available == 1

@@ -1,0 +1,108 @@
+"""NodeInfo accounting tests (reference api/node_info_test.go pattern)."""
+
+import pytest
+
+from kube_batch_tpu.api import NodeInfo, TaskInfo, TaskStatus
+from tests.test_utils import build_node, build_pod, build_resource_list
+
+
+def mk_node(cpu="8", mem="8Gi"):
+    return NodeInfo(build_node("n1", build_resource_list(cpu, mem)))
+
+
+def mk_task(name, phase="Running", node="n1", cpu="1", mem="1Gi"):
+    return TaskInfo(build_pod("ns", name, node, phase,
+                              build_resource_list(cpu, mem)))
+
+
+class TestNodeInfo:
+    def test_add_task_accounting(self):
+        ni = mk_node()
+        ni.add_task(mk_task("p1"))
+        ni.add_task(mk_task("p2", cpu="2"))
+        assert ni.used.milli_cpu == 3000.0
+        assert ni.idle.milli_cpu == 5000.0
+        assert len(ni.tasks) == 2
+
+    def test_add_duplicate_raises(self):
+        ni = mk_node()
+        ni.add_task(mk_task("p1"))
+        with pytest.raises(ValueError):
+            ni.add_task(mk_task("p1"))
+
+    def test_add_wrong_node_raises(self):
+        ni = mk_node()
+        with pytest.raises(ValueError):
+            ni.add_task(mk_task("p1", node="other"))
+
+    def test_releasing_accounting(self):
+        ni = mk_node()
+        t = mk_task("p1", phase="Running")
+        t.status = TaskStatus.Releasing
+        ni.add_task(t)
+        assert ni.releasing.milli_cpu == 1000.0
+        assert ni.idle.milli_cpu == 7000.0  # releasing still holds idle
+        assert ni.used.milli_cpu == 1000.0
+        ni.remove_task(t)
+        assert ni.releasing.milli_cpu == 0.0
+        assert ni.idle.milli_cpu == 8000.0
+
+    def test_pipelined_consumes_releasing(self):
+        ni = mk_node()
+        rel = mk_task("p1")
+        rel.status = TaskStatus.Releasing
+        ni.add_task(rel)
+        pip = mk_task("p2")
+        pip.status = TaskStatus.Pipelined
+        ni.add_task(pip)
+        assert ni.releasing.milli_cpu == 0.0
+        assert ni.used.milli_cpu == 2000.0
+        # idle unchanged by pipelined task
+        assert ni.idle.milli_cpu == 7000.0
+
+    def test_remove_task(self):
+        ni = mk_node()
+        t = mk_task("p1")
+        ni.add_task(t)
+        ni.remove_task(t)
+        assert ni.idle.milli_cpu == 8000.0
+        assert ni.used.milli_cpu == 0.0
+        with pytest.raises(KeyError):
+            ni.remove_task(t)
+
+    def test_overcommit_raises(self):
+        ni = mk_node(cpu="1")
+        with pytest.raises(ValueError):
+            ni.add_task(mk_task("big", cpu="4"))
+
+    def test_status_snapshot_on_node(self):
+        # The node keeps a clone: later status churn on the task must not
+        # corrupt node accounting.
+        ni = mk_node()
+        t = mk_task("p1")
+        ni.add_task(t)
+        t.status = TaskStatus.Releasing
+        ni_task = list(ni.tasks.values())[0]
+        assert ni_task.status == TaskStatus.Running
+
+    def test_set_node_rebuilds(self):
+        ni = mk_node()
+        ni.add_task(mk_task("p1"))
+        ni.set_node(build_node("n1", build_resource_list("16", "16Gi")))
+        assert ni.idle.milli_cpu == 15000.0
+        assert ni.used.milli_cpu == 1000.0
+
+    def test_out_of_sync_detection(self):
+        ni = mk_node()
+        ni.add_task(mk_task("p1", cpu="6"))
+        # Node shrinks below current usage -> OutOfSync, not ready.
+        ni.set_node(build_node("n1", build_resource_list("2", "2Gi")))
+        assert not ni.ready()
+        assert ni.state.reason == "OutOfSync"
+
+    def test_clone(self):
+        ni = mk_node()
+        ni.add_task(mk_task("p1"))
+        c = ni.clone()
+        assert c.idle.milli_cpu == ni.idle.milli_cpu
+        assert len(c.tasks) == 1

@@ -1,0 +1,142 @@
+"""Resource algebra tests, table-driven like the reference's
+api/resource_info_test.go."""
+
+import pytest
+
+from kube_batch_tpu.api import Resource, minimum, share, parse_quantity
+
+
+def res(cpu=0.0, mem=0.0, **scalars):
+    return Resource(milli_cpu=cpu, memory=mem, scalar_resources=scalars)
+
+
+class TestParseQuantity:
+    def test_plain(self):
+        assert parse_quantity(2) == 2.0
+        assert parse_quantity("2") == 2.0
+        assert parse_quantity("250m") == 0.25
+        assert parse_quantity("1Gi") == 1024 ** 3
+        assert parse_quantity("1G") == 1e9
+        assert parse_quantity("512Ki") == 512 * 1024
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            parse_quantity("abc")
+        with pytest.raises(ValueError):
+            parse_quantity("1Qx")
+
+
+class TestFromResourceList:
+    def test_units(self):
+        r = Resource.from_resource_list(
+            {"cpu": "2", "memory": "1Gi", "pods": 110, "nvidia.com/gpu": 1})
+        assert r.milli_cpu == 2000.0
+        assert r.memory == 1024 ** 3
+        assert r.max_task_num == 110
+        assert r.scalar_resources["nvidia.com/gpu"] == 1000.0
+
+    def test_milli_cpu(self):
+        r = Resource.from_resource_list({"cpu": "250m", "memory": "100Mi"})
+        assert r.milli_cpu == 250.0
+
+
+class TestArithmetic:
+    def test_add(self):
+        tests = [
+            (res(1000, 100), res(2000, 1000), res(3000, 1100)),
+            (res(1000, 100, **{"gpu": 1}), res(2000, 1000, **{"gpu": 2}),
+             res(3000, 1100, **{"gpu": 3})),
+            (res(), res(2000, 1000), res(2000, 1000)),
+        ]
+        for l, r, expected in tests:
+            assert l.add(r) == expected
+
+    def test_sub(self):
+        assert res(3000, 1100).sub(res(1000, 100)) == res(2000, 1000)
+        assert (res(3000, 1100, g=3000).sub(res(1000, 100, g=1000))
+                == res(2000, 1000, g=2000))
+
+    def test_sub_insufficient_raises(self):
+        with pytest.raises(ValueError):
+            res(1000, 100).sub(res(2000, 100))
+
+    def test_sub_within_epsilon_ok(self):
+        # abs diff below the minMilliCPU epsilon counts as fitting.
+        r = res(1000, 100).sub(res(1005, 100))
+        assert r.milli_cpu == -5.0
+
+    def test_multi(self):
+        assert res(1000, 100, g=2000).multi(2) == res(2000, 200, g=4000)
+
+    def test_set_max_resource(self):
+        r = res(1000, 2000, g=1000)
+        r.set_max_resource(res(2000, 100, h=5))
+        assert r == res(2000, 2000, g=1000, h=5)
+
+    def test_fit_delta(self):
+        r = res(1000, 20 * 1024 * 1024)
+        r.fit_delta(res(500, 10 * 1024 * 1024))
+        assert r.milli_cpu == 1000 - 500 - 10
+        assert r.memory == 0.0
+
+    def test_clone_independent(self):
+        r = res(1, 2, g=3)
+        c = r.clone()
+        c.add(res(1, 1, g=1))
+        assert r == res(1, 2, g=3)
+
+
+class TestComparisons:
+    def test_is_empty(self):
+        assert res().is_empty()
+        assert res(9.99, 0).is_empty()
+        assert res(0, 10 * 1024 * 1024 - 1).is_empty()
+        assert not res(10, 0).is_empty()
+        assert not res(0, 10 * 1024 * 1024).is_empty()
+        assert not res(0, 0, g=10).is_empty()
+        assert res(0, 0, g=9.9).is_empty()
+
+    def test_is_zero(self):
+        r = res(5, 5, g=5)
+        assert r.is_zero("cpu")
+        assert r.is_zero("memory")
+        assert r.is_zero("g")
+        with pytest.raises(KeyError):
+            r.is_zero("unknown")
+
+    def test_less(self):
+        assert res(100, 100).less(res(200, 200))
+        assert not res(100, 100).less(res(100, 200))
+        assert not res(100, 300).less(res(200, 200))
+        # scalar asymmetries mirrored from the reference:
+        # l without scalars vs r with scalars > epsilon -> less
+        assert res(100, 100).less(res(200, 200, g=100))
+        # l without scalars vs r with scalar <= epsilon -> not less
+        assert not res(100, 100).less(res(200, 200, g=10))
+        # l with scalars vs r without -> not less
+        assert not res(100, 100, g=1).less(res(200, 200))
+
+    def test_less_equal(self):
+        assert res(100, 100).less_equal(res(100, 100))
+        assert res(105, 100).less_equal(res(100, 100))  # within epsilon
+        assert not res(111, 100).less_equal(res(100, 100))
+        assert res(0, 0, g=9).less_equal(res(0, 0))  # scalar below epsilon skipped
+        assert not res(0, 0, g=100).less_equal(res(0, 0))
+        assert res(0, 0, g=100).less_equal(res(0, 0, g=105))
+
+    def test_diff(self):
+        inc, dec = res(300, 100, g=10).diff(res(100, 300, g=10))
+        assert inc == res(200, 0)
+        assert dec == res(0, 200)
+
+
+class TestHelpers:
+    def test_minimum(self):
+        assert minimum(res(100, 200), res(200, 100)) == res(100, 100)
+        m = minimum(res(100, 200, g=5), res(200, 100, g=3))
+        assert m.scalar_resources["g"] == 3
+
+    def test_share(self):
+        assert share(0, 0) == 0.0
+        assert share(5, 0) == 1.0
+        assert share(5, 10) == 0.5

@@ -1,0 +1,37 @@
+"""Shared object builders for tests.
+
+The reference's action-level tests hand-build pods/nodes via
+util/test_utils.go (BuildPod/BuildNode/BuildResourceList); these are the
+equivalents for our object model.
+"""
+
+from kube_batch_tpu.api import (Container, Node, NodeSpec, NodeStatus,
+                                ObjectMeta, Pod, PodSpec, PodStatus)
+from kube_batch_tpu.apis.scheduling.v1alpha1 import GroupNameAnnotationKey
+
+
+def build_resource_list(cpu, memory, **scalars):
+    rl = {"cpu": cpu, "memory": memory}
+    rl.update(scalars)
+    return rl
+
+
+def build_pod(namespace, name, nodename, phase, req, groupname="",
+              labels=None, selector=None, priority=None, uid=None, ts=0.0):
+    return Pod(
+        metadata=ObjectMeta(
+            name=name, namespace=namespace, uid=uid or f"{namespace}-{name}",
+            annotations={GroupNameAnnotationKey: groupname} if groupname else {},
+            labels=labels or {}, creation_timestamp=ts),
+        spec=PodSpec(node_name=nodename, node_selector=selector or {},
+                     priority=priority, containers=[Container(requests=req)]),
+        status=PodStatus(phase=phase),
+    )
+
+
+def build_node(name, alloc, labels=None):
+    return Node(
+        metadata=ObjectMeta(name=name, uid=name, labels=labels or {}),
+        spec=NodeSpec(),
+        status=NodeStatus(allocatable=alloc, capacity=dict(alloc)),
+    )

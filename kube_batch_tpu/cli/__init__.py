@@ -1,0 +1,12 @@
+"""Process runtime (L6): flags, server, metrics endpoint, leader election.
+
+TPU-native counterpart of /root/reference/cmd/kube-batch/.
+"""
+
+from .options import ServerOption, parse_options
+from .server import ServerRuntime, start_metrics_server, load_cluster_state
+from .leader_election import LeaderElectionConfig, LeaderElector
+
+__all__ = ["ServerOption", "parse_options", "ServerRuntime",
+           "start_metrics_server", "load_cluster_state",
+           "LeaderElectionConfig", "LeaderElector"]

@@ -1,0 +1,108 @@
+"""Leader election over a shared lock object.
+
+The reference coordinates HA standbys with a ConfigMap-lock
+LeaderElector (lease 15s / renew 10s / retry 5s,
+/root/reference/cmd/kube-batch/app/server.go:48-53,115-139); loss of lease
+kills the process and a standby takes over.  Here the lock object lives in
+the cluster-state store's namespace — for the file-backed simulator that is
+a lock file with the same lease semantics, which gives identical failover
+behavior for multi-process deployments sharing a state directory.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+DEFAULT_LEASE_DURATION = 15.0
+DEFAULT_RENEW_DEADLINE = 10.0
+DEFAULT_RETRY_PERIOD = 5.0
+
+
+@dataclass
+class LeaderElectionConfig:
+    lock_path: str
+    identity: str = ""
+    lease_duration: float = DEFAULT_LEASE_DURATION
+    renew_deadline: float = DEFAULT_RENEW_DEADLINE
+    retry_period: float = DEFAULT_RETRY_PERIOD
+
+    def __post_init__(self):
+        if not self.identity:
+            self.identity = f"{socket.gethostname()}-{os.getpid()}"
+
+
+class LeaderElector:
+    """Acquire-and-renew loop (client-go leaderelection semantics)."""
+
+    def __init__(self, config: LeaderElectionConfig,
+                 on_started_leading: Callable[[], None],
+                 on_stopped_leading: Callable[[], None]):
+        self.config = config
+        self.on_started_leading = on_started_leading
+        self.on_stopped_leading = on_stopped_leading
+        self._stop = threading.Event()
+        self.is_leader = False
+
+    # -- lock record --------------------------------------------------------
+
+    def _read_record(self) -> Optional[dict]:
+        try:
+            with open(self.config.lock_path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def _write_record(self) -> bool:
+        record = {"holderIdentity": self.config.identity,
+                  "renewTime": time.time(),
+                  "leaseDurationSeconds": self.config.lease_duration}
+        tmp = f"{self.config.lock_path}.{self.config.identity}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(record, f)
+            os.replace(tmp, self.config.lock_path)
+            return True
+        except OSError:
+            return False
+
+    def try_acquire_or_renew(self) -> bool:
+        record = self._read_record()
+        now = time.time()
+        if record is not None and record.get("holderIdentity") != self.config.identity:
+            expires = record.get("renewTime", 0) + record.get(
+                "leaseDurationSeconds", self.config.lease_duration)
+            if now < expires:
+                return False  # someone else holds a live lease
+        return self._write_record()
+
+    # -- loop ---------------------------------------------------------------
+
+    def run(self) -> None:
+        """Block until leadership is acquired, run the callback, then renew
+        until the lease is lost (then on_stopped_leading, like the
+        reference's fatal exit path)."""
+        while not self._stop.is_set():
+            if self.try_acquire_or_renew():
+                break
+            self._stop.wait(self.config.retry_period)
+        if self._stop.is_set():
+            return
+        self.is_leader = True
+        self.on_started_leading()
+        while not self._stop.is_set():
+            self._stop.wait(self.config.renew_deadline / 2)
+            if self._stop.is_set():
+                break
+            if not self.try_acquire_or_renew():
+                self.is_leader = False
+                self.on_stopped_leading()
+                return
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,42 @@
+"""Process entry point (reference cmd/kube-batch/main.go:39)."""
+
+from __future__ import annotations
+
+import signal
+import sys
+import threading
+
+
+def main(argv=None) -> int:
+    from ..actions.factory import register_default_actions
+    from ..plugins.factory import register_default_plugins
+    from ..version import version_string
+    from .options import parse_options
+    from .server import ServerRuntime
+
+    opt = parse_options(argv)
+    if opt.print_version:
+        print(version_string())
+        return 0
+
+    # Blank-import equivalent: register actions/plugins (main.go:32-35).
+    register_default_actions()
+    register_default_plugins()
+
+    runtime = ServerRuntime(opt)
+    runtime.run()
+
+    stop = threading.Event()
+
+    def handle(sig, frame):
+        stop.set()
+
+    signal.signal(signal.SIGINT, handle)
+    signal.signal(signal.SIGTERM, handle)
+    stop.wait()
+    runtime.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

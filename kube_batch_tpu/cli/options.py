@@ -1,0 +1,80 @@
+"""CLI options.
+
+Mirrors /root/reference/cmd/kube-batch/app/options/options.go:34-89 — the 11
+flags (master/kubeconfig become the simulator's state-file path here),
+defaults included (schedule-period 1s, default-queue "default", listen
+address :8080).
+"""
+
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass, field
+
+DEFAULT_SCHEDULER_NAME = "kube-batch"
+DEFAULT_SCHEDULE_PERIOD = 1.0
+DEFAULT_QUEUE = "default"
+DEFAULT_LISTEN_ADDRESS = ":8080"
+
+
+@dataclass
+class ServerOption:
+    master: str = ""
+    kubeconfig: str = ""
+    scheduler_name: str = DEFAULT_SCHEDULER_NAME
+    scheduler_conf: str = ""
+    schedule_period: float = DEFAULT_SCHEDULE_PERIOD
+    default_queue: str = DEFAULT_QUEUE
+    enable_leader_election: bool = True
+    lock_object_namespace: str = ""
+    print_version: bool = False
+    listen_address: str = DEFAULT_LISTEN_ADDRESS
+    priority_class: bool = True
+    # Simulator extras (no reference counterpart): cluster spec to load.
+    cluster_state: str = ""
+
+    def check_option_or_die(self) -> None:
+        """options.go:81-88: leader election requires a lock namespace."""
+        if self.enable_leader_election and not self.lock_object_namespace:
+            raise ValueError(
+                "lock-object-namespace must not be nil when LeaderElection is enabled")
+
+
+def add_flags(parser: argparse.ArgumentParser) -> None:
+    parser.add_argument("--master", default="",
+                        help="The address of the cluster state server")
+    parser.add_argument("--kubeconfig", default="",
+                        help="Path to a cluster connection config file")
+    parser.add_argument("--scheduler-name", default=DEFAULT_SCHEDULER_NAME,
+                        help="Only schedule pods with this schedulerName")
+    parser.add_argument("--scheduler-conf", default="",
+                        help="Path to the YAML scheduler configuration")
+    parser.add_argument("--schedule-period", type=float,
+                        default=DEFAULT_SCHEDULE_PERIOD,
+                        help="Seconds between scheduling cycles")
+    parser.add_argument("--default-queue", default=DEFAULT_QUEUE,
+                        help="Queue for jobs that specify none")
+    parser.add_argument("--leader-elect", action="store_true", default=False,
+                        help="Enable leader election for HA deployments")
+    parser.add_argument("--lock-object-namespace", default="",
+                        help="Namespace of the leader-election lock object")
+    parser.add_argument("--version", action="store_true", default=False,
+                        help="Print version and exit")
+    parser.add_argument("--listen-address", default=DEFAULT_LISTEN_ADDRESS,
+                        help="Address for the /metrics endpoint")
+    parser.add_argument("--cluster-state", default="",
+                        help="Path to a JSON cluster snapshot for the simulator")
+
+
+def parse_options(argv=None) -> ServerOption:
+    parser = argparse.ArgumentParser(prog="kube-batch-tpu")
+    add_flags(parser)
+    ns = parser.parse_args(argv)
+    return ServerOption(
+        master=ns.master, kubeconfig=ns.kubeconfig,
+        scheduler_name=ns.scheduler_name, scheduler_conf=ns.scheduler_conf,
+        schedule_period=ns.schedule_period, default_queue=ns.default_queue,
+        enable_leader_election=ns.leader_elect,
+        lock_object_namespace=ns.lock_object_namespace,
+        print_version=ns.version, listen_address=ns.listen_address,
+        cluster_state=ns.cluster_state)

@@ -1,0 +1,136 @@
+"""Server runtime: scheduler startup, /metrics endpoint, leader election.
+
+Mirrors /root/reference/cmd/kube-batch/app/server.go:63-139 — Run() builds
+the cache and scheduler, serves Prometheus metrics over HTTP, and wraps the
+scheduling loop in leader election when enabled.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..cache import Cluster, new_scheduler_cache
+from ..metrics.metrics import registry
+from ..scheduler import Scheduler
+from .leader_election import LeaderElectionConfig, LeaderElector
+from .options import ServerOption
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    def do_GET(self):
+        if self.path == "/metrics":
+            body = registry.expose().encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/plain; version=0.0.4")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif self.path == "/healthz":
+            self.send_response(200)
+            self.send_header("Content-Length", "2")
+            self.end_headers()
+            self.wfile.write(b"ok")
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+    def log_message(self, *args):  # quiet
+        pass
+
+
+def start_metrics_server(listen_address: str) -> ThreadingHTTPServer:
+    """Serve /metrics like server.go:83-86; returns the server (its port is
+    discoverable via .server_address for ':0' style binds)."""
+    host, _, port = listen_address.rpartition(":")
+    server = ThreadingHTTPServer((host or "0.0.0.0", int(port or 8080)),
+                                 _MetricsHandler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True)
+    thread.start()
+    return server
+
+
+def load_cluster_state(cluster: Cluster, path: str) -> None:
+    """Populate the simulator from a JSON snapshot file (the standalone
+    analog of pointing --master at an API server)."""
+    from ..api.objects import (Container, Node, NodeSpec, NodeStatus,
+                               ObjectMeta, Pod, PodSpec, PodStatus)
+    from ..apis.scheduling import v1alpha1
+
+    with open(path) as f:
+        state = json.load(f)
+    for n in state.get("nodes", []):
+        cluster.create_node(Node(
+            metadata=ObjectMeta(name=n["name"], labels=n.get("labels", {})),
+            spec=NodeSpec(),
+            status=NodeStatus(allocatable=n.get("allocatable", {}),
+                              capacity=n.get("capacity",
+                                             n.get("allocatable", {})))))
+    for q in state.get("queues", []):
+        cluster.create_queue(v1alpha1.Queue(
+            metadata=ObjectMeta(name=q["name"]),
+            spec=v1alpha1.QueueSpec(weight=q.get("weight", 1))))
+    for pg in state.get("podGroups", []):
+        cluster.create_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name=pg["name"],
+                                namespace=pg.get("namespace", "default")),
+            spec=v1alpha1.PodGroupSpec(
+                min_member=pg.get("minMember", 1),
+                queue=pg.get("queue", "default"))))
+    for p in state.get("pods", []):
+        annotations = {}
+        if p.get("group"):
+            annotations[v1alpha1.GroupNameAnnotationKey] = p["group"]
+        cluster.create_pod(Pod(
+            metadata=ObjectMeta(name=p["name"],
+                                namespace=p.get("namespace", "default"),
+                                annotations=annotations),
+            spec=PodSpec(node_name=p.get("nodeName", ""),
+                         containers=[Container(requests=p.get("requests", {}))]),
+            status=PodStatus(phase=p.get("phase", "Pending"))))
+
+
+class ServerRuntime:
+    """The running process: cluster edge + scheduler + metrics endpoint."""
+
+    def __init__(self, opt: ServerOption, cluster: Optional[Cluster] = None):
+        self.opt = opt
+        self.cluster = cluster if cluster is not None else Cluster()
+        if opt.cluster_state:
+            load_cluster_state(self.cluster, opt.cluster_state)
+        self.cache = new_scheduler_cache(
+            self.cluster, scheduler_name=opt.scheduler_name,
+            default_queue=opt.default_queue)
+        conf_str = None
+        if opt.scheduler_conf:
+            with open(opt.scheduler_conf) as f:
+                conf_str = f.read()
+        self.scheduler = Scheduler(self.cache, scheduler_conf=conf_str,
+                                   schedule_period=opt.schedule_period)
+        self.metrics_server: Optional[ThreadingHTTPServer] = None
+        self.elector: Optional[LeaderElector] = None
+
+    def run(self) -> None:
+        """server.go Run(): metrics endpoint, then leader-elect or start."""
+        if self.opt.listen_address:
+            self.metrics_server = start_metrics_server(self.opt.listen_address)
+        if self.opt.enable_leader_election:
+            self.opt.check_option_or_die()
+            config = LeaderElectionConfig(
+                lock_path=f"{self.opt.lock_object_namespace}/kube-batch-lock.json")
+            self.elector = LeaderElector(
+                config,
+                on_started_leading=self.scheduler.run,
+                on_stopped_leading=self.scheduler.stop)
+            threading.Thread(target=self.elector.run, daemon=True).start()
+        else:
+            self.scheduler.run()
+
+    def stop(self) -> None:
+        if self.elector is not None:
+            self.elector.stop()
+        self.scheduler.stop()
+        if self.metrics_server is not None:
+            self.metrics_server.shutdown()

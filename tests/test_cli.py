@@ -1,0 +1,127 @@
+"""CLI/runtime tests: options, metrics endpoint, leader election, server."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kube_batch_tpu.cli.leader_election import (LeaderElectionConfig,
+                                                LeaderElector)
+from kube_batch_tpu.cli.options import ServerOption, parse_options
+from kube_batch_tpu.cli.server import ServerRuntime, load_cluster_state
+from kube_batch_tpu.cache import Cluster
+from kube_batch_tpu.apis.scheduling import v1alpha1
+
+
+class TestOptions:
+    def test_defaults(self):
+        opt = parse_options([])
+        assert opt.scheduler_name == "kube-batch"
+        assert opt.schedule_period == 1.0
+        assert opt.default_queue == "default"
+        assert opt.listen_address == ":8080"
+        assert opt.enable_leader_election is False
+
+    def test_flags(self):
+        opt = parse_options(["--schedule-period", "0.5",
+                             "--default-queue", "batch",
+                             "--leader-elect",
+                             "--lock-object-namespace", "/tmp"])
+        assert opt.schedule_period == 0.5
+        assert opt.default_queue == "batch"
+        assert opt.enable_leader_election
+
+    def test_leader_election_requires_namespace(self):
+        opt = ServerOption(enable_leader_election=True)
+        with pytest.raises(ValueError):
+            opt.check_option_or_die()
+
+
+class TestLeaderElection:
+    def test_single_candidate_acquires(self, tmp_path):
+        events = []
+        elector = LeaderElector(
+            LeaderElectionConfig(lock_path=str(tmp_path / "lock.json"),
+                                 identity="a", retry_period=0.05),
+            on_started_leading=lambda: events.append("started"),
+            on_stopped_leading=lambda: events.append("stopped"))
+        import threading
+        t = threading.Thread(target=elector.run, daemon=True)
+        t.start()
+        time.sleep(0.3)
+        assert elector.is_leader
+        assert events == ["started"]
+        elector.stop()
+
+    def test_second_candidate_blocked_until_lease_expires(self, tmp_path):
+        lock = str(tmp_path / "lock.json")
+        a = LeaderElector(LeaderElectionConfig(lock_path=lock, identity="a"),
+                          lambda: None, lambda: None)
+        assert a.try_acquire_or_renew()
+        b = LeaderElector(
+            LeaderElectionConfig(lock_path=lock, identity="b",
+                                 lease_duration=0.2),
+            lambda: None, lambda: None)
+        assert not b.try_acquire_or_renew()
+        # a's record has the default 15s lease; write a short one for b's view
+        with open(lock) as f:
+            rec = json.load(f)
+        rec["leaseDurationSeconds"] = 0.1
+        rec["renewTime"] = time.time() - 1
+        with open(lock, "w") as f:
+            json.dump(rec, f)
+        assert b.try_acquire_or_renew()
+
+
+class TestServerRuntime:
+    def test_end_to_end_with_metrics(self, tmp_path):
+        state = {
+            "nodes": [{"name": "n1",
+                       "allocatable": {"cpu": "8", "memory": "16Gi",
+                                       "pods": 110}}],
+            "queues": [{"name": "default", "weight": 1}],
+            "podGroups": [{"name": "pg1", "namespace": "ns", "minMember": 1,
+                           "queue": "default"}],
+            "pods": [{"name": "p1", "namespace": "ns", "group": "pg1",
+                      "requests": {"cpu": "1", "memory": "1Gi"}}],
+        }
+        state_file = tmp_path / "cluster.json"
+        state_file.write_text(json.dumps(state))
+
+        opt = ServerOption(schedule_period=0.1, listen_address="127.0.0.1:0",
+                           enable_leader_election=False,
+                           cluster_state=str(state_file))
+        runtime = ServerRuntime(opt)
+        runtime.run()
+        try:
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                pod = runtime.cluster.pods.get("ns/p1")
+                if pod is not None and pod.spec.node_name:
+                    break
+                time.sleep(0.1)
+            assert runtime.cluster.pods["ns/p1"].spec.node_name == "n1"
+
+            port = runtime.metrics_server.server_address[1]
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics").read().decode()
+            assert "kube_batch_e2e_scheduling_latency_milliseconds" in body
+            assert "kube_batch_schedule_attempts_total" in body
+            health = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz").read()
+            assert health == b"ok"
+        finally:
+            runtime.stop()
+
+    def test_load_cluster_state(self, tmp_path):
+        state_file = tmp_path / "s.json"
+        state_file.write_text(json.dumps({
+            "nodes": [{"name": "x", "allocatable": {"cpu": "1",
+                                                    "memory": "1Gi"}}],
+            "queues": [{"name": "q", "weight": 3}],
+        }))
+        cluster = Cluster()
+        load_cluster_state(cluster, str(state_file))
+        assert "x" in cluster.nodes
+        assert cluster.queues["q"].spec.weight == 3

@@ -1,0 +1,288 @@
+"""End-to-end scenarios against the cluster simulator.
+
+Mirrors the reference's e2e suite (test/e2e/{job,queue,predicates,
+nodeorder}.go run on kind clusters, SURVEY.md §4): full informer -> cache ->
+session -> bind/evict round-trips, driven deterministically via
+scheduler.run_once().
+"""
+
+import pytest
+
+from kube_batch_tpu.api import ObjectMeta, Container, ContainerPort, Pod, \
+    PodSpec, PodStatus, Taint, Toleration
+from kube_batch_tpu.api.objects import Affinity, PriorityClass
+from kube_batch_tpu.apis.scheduling import v1alpha1, v1alpha2
+from kube_batch_tpu.cache import Cluster, new_scheduler_cache
+from kube_batch_tpu.scheduler import Scheduler
+from tests.test_utils import build_node, build_resource_list
+
+
+CONF_ALL_ACTIONS = """
+actions: "allocate, preempt, reclaim, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+CONF_TPU = """
+actions: "tpu-allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+
+
+def mk_pod(name, group, ns="test", cpu="1", mem="1Gi", prio=None,
+           tolerations=(), ports=(), affinity=None, phase="Pending",
+           node=""):
+    requests = {"cpu": cpu, "memory": mem} if cpu else {}
+    return Pod(
+        metadata=ObjectMeta(
+            name=name, namespace=ns,
+            annotations={v1alpha1.GroupNameAnnotationKey: group}),
+        spec=PodSpec(node_name=node, priority=prio,
+                     tolerations=list(tolerations), affinity=affinity,
+                     containers=[Container(requests=requests,
+                                           ports=list(ports))]),
+        status=PodStatus(phase=phase))
+
+
+class Harness:
+    """Test context like test/e2e/util.go:86-127: namespace, queues q1/q2,
+    two priority classes."""
+
+    def __init__(self, conf=CONF_ALL_ACTIONS, queues=("q1", "q2"),
+                 weights=(1, 1)):
+        self.cluster = Cluster()
+        # The deployment always installs the default queue
+        # (reference config/queue/default.yaml); shadow PodGroups land there.
+        self.cluster.create_queue(v1alpha1.Queue(
+            metadata=ObjectMeta(name="default"),
+            spec=v1alpha1.QueueSpec(weight=1)))
+        for name, w in zip(queues, weights):
+            self.cluster.create_queue(v1alpha1.Queue(
+                metadata=ObjectMeta(name=name),
+                spec=v1alpha1.QueueSpec(weight=w)))
+        self.cluster.create_priority_class(
+            PriorityClass(metadata=ObjectMeta(name="high-priority"),
+                          value=1000))
+        self.cluster.create_priority_class(
+            PriorityClass(metadata=ObjectMeta(name="low-priority"), value=1))
+        self.cache = new_scheduler_cache(self.cluster)
+        self.scheduler = Scheduler(self.cache, scheduler_conf=conf,
+                                   schedule_period=3600)
+
+    def add_nodes(self, count, cpu="4", mem="8Gi", labels=None, taints=()):
+        for i in range(count):
+            node = build_node(f"node-{i}", build_resource_list(
+                cpu, mem, pods=110), labels=labels)
+            node.spec.taints = list(taints)
+            self.cluster.create_node(node)
+
+    def create_job(self, name, replicas, min_member, queue="q1", ns="test",
+                   cpu="1", mem="1Gi", prio_class="", **pod_kw):
+        self.cluster.create_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name=name, namespace=ns),
+            spec=v1alpha1.PodGroupSpec(min_member=min_member, queue=queue,
+                                       priority_class_name=prio_class)))
+        prio = {"high-priority": 1000, "low-priority": 1}.get(prio_class)
+        for i in range(replicas):
+            self.cluster.create_pod(mk_pod(f"{name}-{i}", name, ns=ns,
+                                           cpu=cpu, mem=mem, prio=prio,
+                                           **pod_kw))
+
+    def cycle(self, n=1):
+        for _ in range(n):
+            self.scheduler.run_once()
+
+    def bound(self, prefix="", ns="test"):
+        return {k: p.spec.node_name for k, p in self.cluster.pods.items()
+                if p.spec.node_name and k.startswith(f"{ns}/{prefix}")}
+
+    def pod_group_phase(self, name, ns="test"):
+        return self.cluster.pod_groups[f"{ns}/{name}"].status.phase
+
+
+class TestGangScheduling:
+    def test_gang_ready_when_fits(self):
+        h = Harness()
+        h.add_nodes(2)
+        h.create_job("qj-1", 3, 3)
+        h.cycle()
+        assert len(h.bound("qj-1")) == 3
+        assert h.pod_group_phase("qj-1") == "Running"
+
+    def test_gang_unschedulable_when_cluster_full(self):
+        # e2e job.go "gang scheduling full occupied": second gang stays
+        # pending with no partial placement.
+        h = Harness()
+        h.add_nodes(1, cpu="4")
+        h.create_job("occupier", 4, 4)
+        h.cycle()
+        h.create_job("waiter", 4, 4)
+        h.cycle()
+        assert len(h.bound("occupier")) == 4
+        assert h.bound("waiter") == {}
+        assert h.pod_group_phase("waiter") == "Pending"
+        pg = h.cluster.pod_groups["test/waiter"]
+        assert any(c.type == "Unschedulable" for c in pg.status.conditions)
+
+    def test_gang_schedules_after_release(self):
+        # e2e job.go "resource release then ready": gang lands once the
+        # occupier is deleted.
+        h = Harness()
+        h.add_nodes(1, cpu="4")
+        h.create_job("occupier", 4, 4)
+        h.cycle()
+        h.create_job("waiter", 4, 4)
+        h.cycle()
+        assert h.bound("waiter") == {}
+        for i in range(4):
+            h.cluster.delete_pod("test", f"occupier-{i}")
+        h.cycle()
+        assert len(h.bound("waiter")) == 4
+
+    def test_multi_job_on_tpu_action(self):
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(3)
+        h.create_job("a", 3, 3)
+        h.create_job("b", 3, 3, queue="q2")
+        h.cycle()
+        assert len(h.bound("a")) == 3
+        assert len(h.bound("b")) == 3
+
+
+class TestPreemptionReclaim:
+    def test_preempt_between_jobs(self):
+        # e2e queue.go:26-46 analog: high-priority job preempts low.
+        h = Harness()
+        h.add_nodes(1, cpu="4")
+        h.create_job("low", 4, 1, prio_class="low-priority")
+        h.cycle()
+        assert len(h.bound("low")) == 4
+        h.create_job("high", 2, 2, prio_class="high-priority")
+        h.cycle(3)  # evict (releasing) -> rebind cycles
+        assert len(h.bound("high")) == 2
+        assert len([k for k in h.cluster.pods if k.startswith("test/low")]) < 4
+
+    def test_reclaim_between_queues(self):
+        # e2e queue.go:48-70 analog: q2 job reclaims share from q1.
+        h = Harness(weights=(1, 1))
+        h.add_nodes(1, cpu="4")
+        h.create_job("greedy", 4, 1, queue="q1")
+        h.cycle()
+        assert len(h.bound("greedy")) == 4
+        h.create_job("starved", 2, 1, queue="q2")
+        h.cycle(3)
+        assert len(h.bound("starved")) >= 1
+        assert len([k for k in h.cluster.pods
+                    if k.startswith("test/greedy")]) < 4
+
+
+class TestPredicates:
+    def test_hostport_conflict(self):
+        # e2e predicates.go hostport: two pods wanting the same host port
+        # land on different nodes.
+        h = Harness()
+        h.add_nodes(2)
+        h.cluster.create_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name="hp", namespace="test"),
+            spec=v1alpha1.PodGroupSpec(min_member=1, queue="q1")))
+        for i in range(2):
+            h.cluster.create_pod(mk_pod(
+                f"hp-{i}", "hp", ports=[ContainerPort(host_port=8080)]))
+        h.cycle()
+        binds = h.bound("hp")
+        assert len(binds) == 2
+        assert binds["test/hp-0"] != binds["test/hp-1"]
+
+    def test_taints_and_tolerations(self):
+        h = Harness()
+        taint = Taint(key="dedicated", value="batch", effect="NoSchedule")
+        h.add_nodes(1, taints=[taint])
+        h.create_job("plain", 1, 1)
+        h.cycle()
+        assert h.bound("plain") == {}
+        h.create_job("tolerant", 1, 1, tolerations=[
+            Toleration(key="dedicated", operator="Equal", value="batch",
+                       effect="NoSchedule")])
+        h.cycle()
+        assert len(h.bound("tolerant")) == 1
+
+
+class TestNodeOrder:
+    def test_required_node_affinity(self):
+        # e2e nodeorder.go analog: required affinity pins to labeled node.
+        h = Harness()
+        h.cluster.create_node(build_node(
+            "node-a", build_resource_list("4", "8Gi", pods=110),
+            labels={"zone": "a"}))
+        h.cluster.create_node(build_node(
+            "node-b", build_resource_list("4", "8Gi", pods=110),
+            labels={"zone": "b"}))
+        h.cluster.create_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name="aff", namespace="test"),
+            spec=v1alpha1.PodGroupSpec(min_member=1, queue="q1")))
+        h.cluster.create_pod(mk_pod(
+            "aff-0", "aff",
+            affinity=Affinity(required_node_terms=[{"zone": "b"}])))
+        h.cycle()
+        assert h.bound("aff") == {"test/aff-0": "node-b"}
+
+    def test_preferred_node_affinity_scoring(self):
+        h = Harness()
+        h.cluster.create_node(build_node(
+            "node-a", build_resource_list("4", "8Gi", pods=110),
+            labels={"disk": "hdd"}))
+        h.cluster.create_node(build_node(
+            "node-b", build_resource_list("4", "8Gi", pods=110),
+            labels={"disk": "ssd"}))
+        h.cluster.create_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name="pref", namespace="test"),
+            spec=v1alpha1.PodGroupSpec(min_member=1, queue="q1")))
+        h.cluster.create_pod(mk_pod(
+            "pref-0", "pref",
+            affinity=Affinity(preferred_node_terms=[(50, {"disk": "ssd"})])))
+        h.cycle()
+        assert h.bound("pref") == {"test/pref-0": "node-b"}
+
+
+class TestVersionedAPIs:
+    def test_v1alpha2_pod_group_round_trip(self):
+        h = Harness()
+        h.add_nodes(1)
+        h.cluster.create_pod_group(v1alpha2.PodGroup(
+            metadata=ObjectMeta(name="v2job", namespace="test"),
+            spec=v1alpha2.PodGroupSpec(min_member=1, queue="q1")))
+        h.cluster.create_pod(mk_pod("v2job-0", "v2job"))
+        h.cycle()
+        assert len(h.bound("v2job")) == 1
+        # Status writeback keeps the v1alpha2 identity.
+        pg = h.cluster.pod_groups["test/v2job"]
+        assert isinstance(pg, v1alpha2.PodGroup)
+        assert pg.status.phase == "Running"
+
+    def test_shadow_pod_group_for_bare_pod(self):
+        h = Harness()
+        h.add_nodes(1)
+        pod = Pod(metadata=ObjectMeta(name="bare", namespace="test",
+                                      owner_uid="rs-1"),
+                  spec=PodSpec(containers=[
+                      Container(requests={"cpu": "1", "memory": "1Gi"})]),
+                  status=PodStatus(phase="Pending"))
+        h.cluster.create_pod(pod)
+        h.cycle()
+        assert h.cluster.pods["test/bare"].spec.node_name == "node-0"

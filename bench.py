@@ -22,7 +22,7 @@ def main():
     n_queues = int(os.environ.get("BENCH_QUEUES", 4))
 
     from kube_batch_tpu.models.synthetic import make_synthetic_inputs
-    from kube_batch_tpu.ops.solver import solve_allocate
+    from kube_batch_tpu.ops.solver import best_solve_allocate
 
     inputs, config = make_synthetic_inputs(
         n_tasks=n_tasks, n_nodes=n_nodes, n_jobs=n_jobs, n_queues=n_queues,
@@ -33,15 +33,17 @@ def main():
     # Warm-up: compile (cached for subsequent sessions of the same bucket).
     # np.asarray forces device completion + transfer; block_until_ready is
     # not reliable on the experimental axon TPU tunnel.
-    np.asarray(solve_allocate(inputs, config).assignment)
+    warm = best_solve_allocate(inputs, config)
+    placed = int((np.asarray(warm.assignment) >= 0).sum())
 
     runs = []
     for _ in range(3):
         start = time.perf_counter()
-        result = solve_allocate(inputs, config)
+        result = best_solve_allocate(inputs, config)
         np.asarray(result.assignment)
         runs.append((time.perf_counter() - start) * 1e3)
     value = min(runs)
+    assert placed > 0, "solver placed nothing"
 
     baseline_ms = 1000.0  # north-star target per session
     print(json.dumps({

@@ -42,11 +42,11 @@ class TpuAllocateAction(Action):
         if not snap.tasks:
             return
 
-        from ..ops.solver import solve_allocate
+        from ..ops.solver import best_solve_allocate
 
         import numpy as np
         solve_start = time.time()
-        result = solve_allocate(snap.inputs, snap.config)
+        result = best_solve_allocate(snap.inputs, snap.config)
         # np.asarray forces completion; block_until_ready is unreliable on
         # the experimental axon TPU tunnel.
         assignment = np.asarray(result.assignment)

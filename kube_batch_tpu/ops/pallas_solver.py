@@ -1,0 +1,420 @@
+"""Pallas TPU kernels for the allocate solver.
+
+Two levels of kernelization over ops/solver.py's two-level XLA solver:
+
+* ``solve_allocate_pallas``: the whole session solve — queue/job selection,
+  fairness shares, and every placement — as ONE Pallas kernel.  Device loop
+  iterations in XLA cost ~35µs each in kernel dispatch on TPU runtimes; in
+  a single kernel a placement costs only its actual VPU work (a dozen
+  vector ops over [rows, N] node state resident in VMEM), and a queue/job
+  pop costs vector ops over [1, J]/[1, Q] rows.
+
+State layout (all float rows, padded to sublane multiples of 8):
+
+  node_buf [NROWS, N]: idle[0:R], releasing[R:2R], used[2R:3R], count,
+      pod cap, exists flag, 1/alloc(cpu,mem), alloc==0 flags(cpu,mem)
+  job_sta  [8, J]: start, count, queue, minavail, priority, ts, uid_rank
+  job_dyn  [R+3 -> 8, J]: drf alloc rows, ptr, ready_cnt, active
+  que_sta  [R+3 -> 8, Q]: deserved rows, ts, uid_rank, exists
+  que_dyn  [R+1 -> 8, Q]: alloc rows, active
+
+Placement updates are rank-1 (delta-column ⊗ one-hot) adds.  Ties break
+first-in-order everywhere (Mosaic's argmax picks the LAST max, so argmax is
+implemented as max + min-index-where-equal).
+
+Semantics match ops/solver.solve_allocate placement-for-placement;
+cross-validated by tests/test_pallas_solver.py (interpreter mode) and on
+real TPU by bench.py.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..api.resource import MIN_MEMORY, MIN_MILLI_CPU, MIN_MILLI_SCALAR
+from .solver import SolveResult, SolverConfig, SolverInputs
+
+
+def _pad8(x: int) -> int:
+    return ((x + 7) // 8) * 8
+
+
+def _eps_for_dim(i: int) -> float:
+    return (MIN_MILLI_CPU, MIN_MEMORY)[i] if i < 2 else MIN_MILLI_SCALAR
+
+
+def _first_min_index(mask, values, col_ids, size):
+    """Index of the first masked minimum (lexicographic building block)."""
+    kv = jnp.where(mask, values, jnp.inf)
+    m = mask & (kv == jnp.min(kv))
+    return m
+
+
+def _solve_kernel(r: int, cfg: SolverConfig,
+                  scal_ref, total_ref, task_ref, sig_ref, sig_mask_ref,
+                  node_in, out_in, jdyn_in, qdyn_in, jsta_ref, qsta_ref,
+                  node_ref, out_ref, jdyn_ref, qdyn_ref, scal_out_ref):
+    """One kernel = one full session solve.  scal_ref (SMEM [1,8] i32):
+    [0]=P.  total_ref (SMEM [1,R] float): cluster totals (DRF denominator).
+    The *_in refs are aliased input views of the corresponding output refs."""
+    n = node_ref.shape[1]
+    jdim = jsta_ref.shape[1]
+    qdim = qsta_ref.shape[1]
+    nrows = node_ref.shape[0]
+    dtype = node_ref.dtype
+    inf = jnp.asarray(jnp.inf, dtype)
+    neg_inf = -inf
+
+    col_n = jax.lax.broadcasted_iota(jnp.int32, (1, n), 1)
+    col_j = jax.lax.broadcasted_iota(jnp.int32, (1, jdim), 1)
+    col_q = jax.lax.broadcasted_iota(jnp.int32, (1, qdim), 1)
+
+    # node_buf row indices
+    IDLE, REL, USED = 0, r, 2 * r
+    CNT, CAP, EXISTS = 3 * r, 3 * r + 1, 3 * r + 2
+    INV, ZERO = 3 * r + 3, 3 * r + 5
+    # job_sta rows
+    JSTART, JCOUNT, JQUEUE, JMIN, JPRIO, JTS, JUID = 0, 1, 2, 3, 4, 5, 6
+    # job_dyn rows: [0:r] alloc, then ptr, ready, active
+    JPTR, JREADY, JACT = r, r + 1, r + 2
+    # que_sta rows: [0:r] deserved, ts, uid, exists
+    QTS, QUID = r, r + 1
+    # que_dyn rows: [0:r] alloc, active
+    QACT = r
+
+    w_least = float(cfg.weights.least_requested)
+    w_most = float(cfg.weights.most_requested)
+    w_bal = float(cfg.weights.balanced_resource)
+
+    def scalar_at(row, hot):
+        """Extract row value at the one-hot lane."""
+        return jnp.sum(jnp.where(hot, row, 0.0))
+
+    def lex_first(mask, keys, col_ids):
+        m = mask
+        for k in keys:
+            kv = jnp.where(m, k, inf)
+            m = m & (kv == jnp.min(kv))
+        return m
+
+    def queue_share_row():
+        """[1, Q] proportion shares: max_r safe_share(alloc_r, deserved_r)."""
+        share = jnp.zeros((1, qdim), dtype)
+        for i in range(r):
+            alloc = qdyn_ref[i:i + 1, :]
+            des = qsta_ref[i:i + 1, :]
+            s = jnp.where(des == 0, jnp.where(alloc == 0, 0.0, 1.0),
+                          alloc / jnp.where(des == 0, 1.0, des))
+            share = jnp.maximum(share, s)
+        return share
+
+    def drf_share_row():
+        share = jnp.zeros((1, jdim), dtype)
+        for i in range(r):
+            alloc = jdyn_ref[i:i + 1, :]
+            t = total_ref[0, i]
+            s = jnp.where(t == 0, jnp.where(alloc == 0, 0.0, 1.0),
+                          alloc / jnp.where(t == 0, 1.0, t))
+            share = jnp.maximum(share, s)
+        return share
+
+    def outer_body(carry):
+        _, step = carry
+
+        # ---- queue pop (allocate.go:90-108) -------------------------------
+        q_active = qdyn_ref[QACT:QACT + 1, :] > 0.5
+        qkeys = []
+        for name in cfg.queue_key_order:
+            if name == "proportion":
+                qkeys.append(queue_share_row())
+        qkeys.append(qsta_ref[QTS:QTS + 1, :])
+        qkeys.append(qsta_ref[QUID:QUID + 1, :])
+        qmask = lex_first(q_active, qkeys, col_q)
+        q = jnp.min(jnp.where(qmask, col_q, qdim)).astype(jnp.int32)
+        qhot = col_q == q
+
+        if cfg.has_proportion:
+            ou = jnp.bool_(True)
+            for i in range(r):
+                e = _eps_for_dim(i)
+                des = scalar_at(qsta_ref[i:i + 1, :], qhot)
+                alc = scalar_at(qdyn_ref[i:i + 1, :], qhot)
+                oki = (des < alc) | (jnp.abs(des - alc) < e)
+                if i >= 2:
+                    oki = oki | (des <= e)
+                ou = ou & oki
+            overused = ou
+        else:
+            overused = jnp.bool_(False)
+
+        # ---- job pop (tiered JobOrderFn chain) ----------------------------
+        jq = jsta_ref[JQUEUE:JQUEUE + 1, :]
+        j_active = (jdyn_ref[JACT:JACT + 1, :] > 0.5) \
+            & (jq == q.astype(dtype))
+        jkeys = []
+        for name in cfg.job_key_order:
+            if name == "priority":
+                jkeys.append(-jsta_ref[JPRIO:JPRIO + 1, :])
+            elif name == "gang":
+                ready_row = (jdyn_ref[JREADY:JREADY + 1, :]
+                             >= jsta_ref[JMIN:JMIN + 1, :])
+                jkeys.append(ready_row.astype(dtype))
+            elif name == "drf":
+                jkeys.append(drf_share_row())
+        jkeys.append(jsta_ref[JTS:JTS + 1, :])
+        jkeys.append(jsta_ref[JUID:JUID + 1, :])
+        jmask = lex_first(j_active, jkeys, col_j)
+        j = jnp.min(jnp.where(jmask, col_j, jdim)).astype(jnp.int32)
+        jhot = col_j == j
+        has_job = j < jdim
+
+        retire = overused | ~has_job
+
+        start = scalar_at(jsta_ref[JSTART:JSTART + 1, :], jhot).astype(jnp.int32)
+        count_j = jnp.where(retire, 0,
+                            scalar_at(jsta_ref[JCOUNT:JCOUNT + 1, :], jhot)
+                            ).astype(jnp.int32)
+        minavail = scalar_at(jsta_ref[JMIN:JMIN + 1, :], jhot).astype(jnp.int32)
+        ptr0 = scalar_at(jdyn_ref[JPTR:JPTR + 1, :], jhot).astype(jnp.int32)
+        ready0 = scalar_at(jdyn_ref[JREADY:JREADY + 1, :], jhot).astype(jnp.int32)
+
+        # ---- drain the popped job (allocate.go:125-193) -------------------
+        def drain_body(ic):
+            done, survive, ptr, ready_cnt, dstep, dres = ic
+            exhausted = ptr >= count_j
+            t = jnp.clip(start + ptr, 0, task_ref.shape[0] - 1)
+            req = [task_ref[t, i] for i in range(r)]
+            res = [task_ref[t, r + i] for i in range(r)]
+            sig = sig_ref[t, 0]
+
+            fit_idle = None
+            fit_rel = None
+            for i in range(r):
+                e = _eps_for_dim(i)
+                mi = node_ref[IDLE + i:IDLE + i + 1, :]
+                mr = node_ref[REL + i:REL + i + 1, :]
+                oki = (req[i] < mi) | (jnp.abs(req[i] - mi) < e)
+                okr = (req[i] < mr) | (jnp.abs(req[i] - mr) < e)
+                if i >= 2:
+                    low = req[i] <= e
+                    oki = oki | low
+                    okr = okr | low
+                fit_idle = oki if fit_idle is None else (fit_idle & oki)
+                fit_rel = okr if fit_rel is None else (fit_rel & okr)
+
+            sig_row = sig_mask_ref[pl.ds(sig, 1), :] > 0.5
+            cap_ok = node_ref[CNT:CNT + 1, :] < node_ref[CAP:CAP + 1, :]
+            exists = node_ref[EXISTS:EXISTS + 1, :] > 0.5
+            feasible = sig_row & exists & cap_ok & (fit_idle | fit_rel)
+
+            used_cm = node_ref[USED:USED + 2, :]
+            inv = node_ref[INV:INV + 2, :]
+            zero = node_ref[ZERO:ZERO + 2, :] > 0.5
+            res_cm = jnp.concatenate(
+                [jnp.full((1, n), res[0], dtype),
+                 jnp.full((1, n), res[1], dtype)], axis=0)
+            frac = jnp.where(zero, 1.0,
+                             jnp.minimum((used_cm + res_cm) * inv, 1.0))
+            cpu_frac, mem_frac = frac[0:1, :], frac[1:2, :]
+            score = jnp.zeros((1, n), dtype)
+            if w_least:
+                score = score + w_least * 5.0 * ((1.0 - cpu_frac)
+                                                 + (1.0 - mem_frac))
+            if w_most:
+                score = score + w_most * 5.0 * (cpu_frac + mem_frac)
+            if w_bal:
+                score = score + w_bal * (10.0 - jnp.abs(cpu_frac - mem_frac)
+                                         * 10.0)
+            score = jnp.where(feasible, score, neg_inf)
+
+            best = jnp.max(score)
+            nsel = jnp.min(jnp.where(score == best, col_n, n)).astype(jnp.int32)
+            feasible_any = best > neg_inf
+            onehot = col_n == nsel
+            pick = lambda v: jnp.sum(
+                jnp.where(onehot, v.astype(dtype), 0.0)) > 0.5
+            fit_idle_n = pick(fit_idle)
+            fit_rel_n = pick(fit_rel)
+
+            placing = ~done & ~exhausted & feasible_any
+            alloc_ok = placing & fit_idle_n
+            pipe_ok = placing & ~fit_idle_n & fit_rel_n
+            placed = alloc_ok | pipe_ok
+
+            af = jnp.where(alloc_ok, 1.0, 0.0).astype(dtype)
+            pf = jnp.where(pipe_ok, 1.0, 0.0).astype(dtype)
+            plf = jnp.where(placed, 1.0, 0.0).astype(dtype)
+            delta_col = [(-af * res[i]) for i in range(r)] \
+                + [(-pf * res[i]) for i in range(r)] \
+                + [(plf * res[i]) for i in range(r)] \
+                + [plf] + [jnp.zeros((), dtype)] * (nrows - 3 * r - 1)
+            delta = jnp.stack(delta_col).reshape(nrows, 1)
+            node_ref[:, :] = node_ref[:, :] + delta * onehot.astype(dtype)
+
+            row = jnp.stack([jnp.where(placed, nsel, -1),
+                             jnp.where(alloc_ok, 1,
+                                       jnp.where(pipe_ok, 2, 0)),
+                             jnp.where(placed, dstep, -1),
+                             jnp.int32(0)]).reshape(1, 4)
+
+            @pl.when(placed)
+            def _():
+                out_ref[pl.ds(t, 1), :] = row
+
+            ptr = ptr + placed.astype(jnp.int32)
+            ready_cnt = ready_cnt + alloc_ok.astype(jnp.int32)
+            dstep = dstep + placed.astype(jnp.int32)
+            dres = dres + plf * jnp.stack(res).reshape(1, r)
+
+            if cfg.has_gang:
+                ready = ready_cnt >= minavail
+            else:
+                ready = jnp.bool_(True)
+            remaining = ptr < count_j
+            new_done = exhausted | ~feasible_any | ready | ~remaining
+            new_survive = ~exhausted & feasible_any & ready & remaining
+            return (done | new_done, jnp.where(done, survive, new_survive),
+                    ptr, ready_cnt, dstep, dres)
+
+        init = (jnp.bool_(False), jnp.bool_(False), ptr0, ready0, step,
+                jnp.zeros((1, r), dtype))
+        done, survive, ptr, ready_cnt, step, dres = jax.lax.while_loop(
+            lambda c: ~c[0], drain_body, init)
+
+        # ---- writeback + rotation (allocate.go:185-193) -------------------
+        processed = (~retire).astype(dtype)
+        jhot_f = jhot.astype(dtype) * processed
+        qhot_f = qhot.astype(dtype)
+        for i in range(r):
+            jdyn_ref[i:i + 1, :] = jdyn_ref[i:i + 1, :] + dres[0, i] * jhot_f
+            qdyn_ref[i:i + 1, :] = qdyn_ref[i:i + 1, :] \
+                + dres[0, i] * qhot_f * processed
+        jdyn_ref[JPTR:JPTR + 1, :] = jnp.where(
+            jhot_f > 0.5, ptr.astype(dtype), jdyn_ref[JPTR:JPTR + 1, :])
+        jdyn_ref[JREADY:JREADY + 1, :] = jnp.where(
+            jhot_f > 0.5, ready_cnt.astype(dtype),
+            jdyn_ref[JREADY:JREADY + 1, :])
+        jdyn_ref[JACT:JACT + 1, :] = jnp.where(
+            jhot_f > 0.5, jnp.where(survive, 1.0, 0.0).astype(dtype),
+            jdyn_ref[JACT:JACT + 1, :])
+        qdyn_ref[QACT:QACT + 1, :] = jnp.where(
+            (qhot & retire), 0.0, qdyn_ref[QACT:QACT + 1, :])
+
+        any_active = jnp.max(qdyn_ref[QACT:QACT + 1, :]) > 0.5
+        return any_active, step
+
+    any0 = jnp.max(qdyn_in[QACT:QACT + 1, :]) > 0.5
+    _, total_steps = jax.lax.while_loop(
+        lambda c: c[0], outer_body, (any0, scal_ref[0, 1]))
+    scal_out_ref[0, 0] = total_steps
+
+
+def _build_buffers(inp: SolverInputs):
+    r = inp.task_req.shape[1]
+    n = inp.node_idle.shape[0]
+    dtype = inp.task_req.dtype
+    nrows = _pad8(3 * r + 7)
+
+    alloc2 = inp.node_alloc[:, :2]
+    inv2 = jnp.where(alloc2 > 0, 1.0 / jnp.where(alloc2 > 0, alloc2, 1.0), 0.0)
+    zero2 = (alloc2 <= 0).astype(dtype)
+    parts = [inp.node_idle.T, inp.node_releasing.T, inp.node_used.T,
+             inp.node_count.astype(dtype)[None, :],
+             inp.node_max_tasks.astype(dtype)[None, :],
+             inp.node_exists.astype(dtype)[None, :],
+             inv2.T, zero2.T]
+    node_buf = jnp.concatenate(parts, axis=0)
+    node_buf = jnp.concatenate(
+        [node_buf, jnp.zeros((nrows - node_buf.shape[0], n), dtype)], axis=0)
+
+    f = lambda x: x.astype(dtype)[None, :]
+    job_active0 = (inp.queue_exists[inp.job_queue]
+                   & (inp.job_minavail >= 0)).astype(dtype)
+    jsta = jnp.concatenate([
+        f(inp.job_start), f(inp.job_count), f(inp.job_queue),
+        f(inp.job_minavail), f(inp.job_prio), f(inp.job_ts),
+        f(inp.job_uid_rank), jnp.zeros((1, inp.job_start.shape[0]), dtype)],
+        axis=0)
+    jd_rows = _pad8(r + 3)
+    jdyn = jnp.concatenate([
+        inp.job_init_alloc.T.astype(dtype),
+        jnp.zeros((1, inp.job_start.shape[0]), dtype),  # ptr
+        f(inp.job_init_ready),
+        job_active0[None, :],
+        jnp.zeros((jd_rows - r - 3, inp.job_start.shape[0]), dtype)], axis=0)
+
+    qdim = inp.queue_deserved.shape[0]
+    queue_active0 = (jnp.zeros((qdim,), bool).at[inp.job_queue].set(True)
+                     & inp.queue_exists).astype(dtype)
+    qs_rows = _pad8(r + 3)
+    qsta = jnp.concatenate([
+        inp.queue_deserved.T.astype(dtype),
+        f(inp.queue_ts), f(inp.queue_uid_rank),
+        f(inp.queue_exists),
+        jnp.zeros((qs_rows - r - 3, qdim), dtype)], axis=0)
+    qd_rows = _pad8(r + 1)
+    qdyn = jnp.concatenate([
+        inp.queue_init_alloc.T.astype(dtype),
+        queue_active0[None, :],
+        jnp.zeros((qd_rows - r - 1, qdim), dtype)], axis=0)
+    return node_buf, jsta, jdyn, qsta, qdyn
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "interpret"))
+def solve_allocate_pallas(inp: SolverInputs, cfg: SolverConfig,
+                          interpret: bool = False) -> SolveResult:
+    """Full-session solve as a single Pallas kernel launch."""
+    r = inp.task_req.shape[1]
+    p = inp.task_req.shape[0]
+    dtype = inp.task_req.dtype
+
+    task_data = jnp.concatenate([inp.task_req, inp.task_res], axis=1)
+    task_sig2 = inp.task_sig[:, None]
+    sig_mask_f = inp.sig_mask.astype(dtype)
+    node_buf, jsta, jdyn, qsta, qdyn = _build_buffers(inp)
+    out_buf0 = jnp.concatenate(
+        [jnp.full((p, 1), -1, jnp.int32), jnp.zeros((p, 1), jnp.int32),
+         jnp.full((p, 1), -1, jnp.int32), jnp.zeros((p, 1), jnp.int32)],
+        axis=1)
+    scal = jnp.array([[p, 0, 0, 0, 0, 0, 0, 0]], jnp.int32)
+    total = inp.total_res.astype(dtype)[None, :]
+
+    kernel = functools.partial(_solve_kernel, r, cfg)
+    nrows, n = node_buf.shape
+    outs = pl.pallas_call(
+        kernel,
+        out_shape=(jax.ShapeDtypeStruct((nrows, n), dtype),
+                   jax.ShapeDtypeStruct((p, 4), jnp.int32),
+                   jax.ShapeDtypeStruct(jdyn.shape, dtype),
+                   jax.ShapeDtypeStruct(qdyn.shape, dtype),
+                   jax.ShapeDtypeStruct((1, 8), jnp.int32)),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.VMEM),
+                   pl.BlockSpec(memory_space=pltpu.SMEM)),
+        input_output_aliases={5: 0, 6: 1, 7: 2, 8: 3},
+        interpret=interpret,
+    )(scal, total, task_data, task_sig2, sig_mask_f,
+      node_buf, out_buf0, jdyn, qdyn, jsta, qsta)
+
+    out = outs[1]
+    return SolveResult(assignment=out[:, 0], kind=out[:, 1],
+                       order=out[:, 2], step=outs[4][0, 0])

@@ -40,6 +40,11 @@ from .scoring import ScoreWeights, score_nodes
 
 NEG_INF = -jnp.inf
 
+# Placements unrolled per inner-loop iteration: device loop iterations carry
+# a fixed dispatch overhead (~tens of µs on some TPU runtimes), so the drain
+# loop executes UNROLL guarded placements per iteration to amortize it.
+UNROLL = 8
+
 
 class SolverInputs(NamedTuple):
     """Static per-session tensors (see models/tensor_snapshot.py)."""
@@ -287,11 +292,262 @@ def initial_state(inp: SolverInputs) -> SolverState:
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))
-def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolverState:
-    """Run the session's allocate loop to completion on device."""
+def solve_allocate_stepwise(inp: SolverInputs, cfg: SolverConfig) -> SolverState:
+    """Single-level reference solver: one loop iteration per event.  Kept as
+    the readable specification and cross-validation oracle for the optimized
+    two-level solver below."""
     st = initial_state(inp)
 
     def cond(st: SolverState):
         return st.queue_active.any() | (st.locked_job >= 0)
 
     return jax.lax.while_loop(cond, lambda s: solver_step(inp, cfg, s), st)
+
+
+class SolveResult(NamedTuple):
+    assignment: jnp.ndarray  # [P] i32 node index or -1
+    kind: jnp.ndarray        # [P] i32 0=none 1=allocate 2=pipeline
+    order: jnp.ndarray       # [P] i32 placement sequence number
+    step: jnp.ndarray        # scalar i32 total placements
+
+
+def best_solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
+    """Pick the fastest correct solver for the current backend: the
+    single-kernel Pallas solve on TPU (ops/pallas_solver.py), the two-level
+    XLA solve elsewhere.  Both are placement-identical (parity suite)."""
+    if jax.default_backend() == "tpu":
+        from .pallas_solver import solve_allocate_pallas
+        return solve_allocate_pallas(inp, cfg)
+    return solve_allocate(inp, cfg)
+
+
+def _unrolled_le(req, mat, r):
+    """Epsilon LessEqual of a task vector against [N, R] state, unrolled over
+    the static resource axis so XLA sees one elementwise chain instead of a
+    reduction (less_equal_vec semantics, resource_info.go:279-311).  The
+    epsilon layout is static: dim 0 cpu, dim 1 memory, dims >= 2 scalars
+    (skipped when the request is epsilon-low)."""
+    from ..api.resource import MIN_MEMORY, MIN_MILLI_CPU, MIN_MILLI_SCALAR
+    ok = None
+    for i in range(r):
+        e = (MIN_MILLI_CPU, MIN_MEMORY)[i] if i < 2 else MIN_MILLI_SCALAR
+        l, m = req[i], mat[:, i]
+        oki = (l < m) | (jnp.abs(l - m) < e)
+        if i >= 2:
+            oki = oki | (l <= e)
+        ok = oki if ok is None else (ok & oki)
+    return ok
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
+    """Optimized two-level solver with identical placement semantics.
+
+    Outer loop = one iteration per queue-pop event (selection, overused
+    gating, rotation bookkeeping — the expensive lexicographic argmins).
+    Inner ``lax.while_loop`` = one iteration per task placement of the
+    locked job, with a minimal body: the reference's inner task loop
+    (allocate.go:125-193) never re-reads queue/job order or shares, so the
+    DRF/proportion allocation updates are deferred to the pop boundary —
+    outcome-identical because shares are only consulted during selection.
+
+    Validated against solve_allocate_stepwise and the host path by the
+    parity suite.
+    """
+    r = inp.task_req.shape[1]
+    p = inp.task_req.shape[0]
+    dtype = inp.task_req.dtype
+
+    # Precompute scoring constants: inverse allocatable for cpu/mem dims.
+    alloc2 = inp.node_alloc[:, :2]
+    inv_alloc2 = jnp.where(alloc2 > 0, 1.0 / jnp.where(alloc2 > 0, alloc2, 1.0),
+                           0.0)
+    zero_alloc2 = alloc2 <= 0
+    w = cfg.weights
+    neg_inf = jnp.asarray(-jnp.inf, dtype)
+
+    def score_fn(res, used):
+        """Weighted nodeorder score [N] from current used (ops/scoring.py
+        math, divisions replaced by precomputed reciprocals)."""
+        frac = jnp.where(zero_alloc2, 1.0,
+                         jnp.minimum((used[:, :2] + res[None, :2]) * inv_alloc2,
+                                     1.0))
+        cpu_frac, mem_frac = frac[:, 0], frac[:, 1]
+        score = jnp.zeros((used.shape[0],), dtype)
+        if w.least_requested:
+            score = score + w.least_requested * 0.5 * 10.0 * (
+                (1.0 - cpu_frac) + (1.0 - mem_frac))
+        if w.most_requested:
+            score = score + w.most_requested * 0.5 * 10.0 * (cpu_frac + mem_frac)
+        if w.balanced_resource:
+            score = score + w.balanced_resource * (
+                10.0 - jnp.abs(cpu_frac - mem_frac) * 10.0)
+        return score
+
+    def drain_job(j, carry):
+        """Inner loop: place tasks of job j until the reference's task loop
+        would break.  Returns (carry', survive)."""
+        (idle, releasing, used, count, out_node, out_kind, out_order,
+         job_ptr, job_ready_cnt, step) = carry
+        start = inp.job_start[j]
+        count_j = inp.job_count[j]
+        minavail = inp.job_minavail[j]
+
+        def inner_cond(ic):
+            return ~ic[0]
+
+        def place_once(ic):
+            """One placement of the reference inner task loop; a no-op once
+            the done flag is set (lets UNROLL placements share one loop
+            iteration's dispatch overhead)."""
+            (done, survive, idle, releasing, used, count,
+             out_node, out_kind, out_order, ptr, ready_cnt, dstep, dres) = ic
+            exhausted = ptr >= count_j
+            t = inp.task_sorted[jnp.clip(start + ptr, 0, p - 1)]
+            req = inp.task_req[t]
+            res = inp.task_res[t]
+
+            fit_idle = _unrolled_le(req, idle, r)
+            fit_rel = _unrolled_le(req, releasing, r)
+            feasible = (inp.sig_mask[inp.task_sig[t]] & inp.node_exists
+                        & (count < inp.node_max_tasks) & (fit_idle | fit_rel))
+
+            score = jnp.where(feasible, score_fn(res, used), neg_inf)
+            nsel = jnp.argmax(score).astype(jnp.int32)
+            feasible_any = score[nsel] > neg_inf
+
+            placing = ~done & ~exhausted & feasible_any
+            alloc_ok = placing & fit_idle[nsel]
+            pipe_ok = placing & ~fit_idle[nsel] & fit_rel[nsel]
+            placed = alloc_ok | pipe_ok
+
+            fres = jnp.where(placed, 1.0, 0.0).astype(dtype) * res
+            idle = idle.at[nsel].add(jnp.where(alloc_ok, -fres, 0.0))
+            releasing = releasing.at[nsel].add(jnp.where(pipe_ok, -fres, 0.0))
+            used = used.at[nsel].add(fres)
+            count = count.at[nsel].add(placed.astype(count.dtype))
+
+            out_node = out_node.at[t].set(jnp.where(placed, nsel, out_node[t]))
+            out_kind = out_kind.at[t].set(
+                jnp.where(alloc_ok, 1, jnp.where(pipe_ok, 2, out_kind[t])))
+            out_order = out_order.at[t].set(
+                jnp.where(placed, dstep, out_order[t]))
+
+            ptr = ptr + placed.astype(jnp.int32)
+            ready_cnt = ready_cnt + alloc_ok.astype(jnp.int32)
+            dstep = dstep + placed.astype(jnp.int32)
+            dres = dres + fres
+
+            if cfg.has_gang:
+                ready = ready_cnt >= minavail
+            else:
+                ready = jnp.bool_(True)
+            remaining = ptr < count_j
+            new_done = exhausted | ~feasible_any | ready | ~remaining
+            new_survive = ~exhausted & feasible_any & ready & remaining
+            return (done | new_done,
+                    jnp.where(done, survive, new_survive),
+                    idle, releasing, used, count,
+                    out_node, out_kind, out_order, ptr, ready_cnt, dstep, dres)
+
+        def inner_body(ic):
+            for _ in range(UNROLL):
+                ic = place_once(ic)
+            return ic
+
+        init = (jnp.bool_(False), jnp.bool_(False), idle, releasing, used,
+                count, out_node, out_kind, out_order, job_ptr[j],
+                job_ready_cnt[j], step, jnp.zeros((r,), dtype))
+        (done, survive, idle, releasing, used, count, out_node, out_kind,
+         out_order, ptr, ready_cnt, step, dres) = jax.lax.while_loop(
+            inner_cond, inner_body, init)
+
+        job_ptr = job_ptr.at[j].set(ptr)
+        job_ready_cnt = job_ready_cnt.at[j].set(ready_cnt)
+        carry = (idle, releasing, used, count, out_node, out_kind, out_order,
+                 job_ptr, job_ready_cnt, step)
+        return carry, survive, dres
+
+    def outer_cond(oc):
+        return oc[0].any()
+
+    def outer_body(oc):
+        (queue_active, job_active, job_alloc, queue_alloc, idle, releasing,
+         used, count, out_node, out_kind, out_order, job_ptr, job_ready_cnt,
+         step) = oc
+
+        # -- queue selection (allocate.go:90-108) ---------------------------
+        qkeys = []
+        for name in cfg.queue_key_order:
+            if name == "proportion":
+                qkeys.append(queue_shares(queue_alloc, inp.queue_deserved))
+        qkeys.extend([inp.queue_ts, inp.queue_uid_rank])
+        q = _lex_argmin(queue_active, qkeys)
+
+        if cfg.has_proportion:
+            overused = less_equal_vec(inp.queue_deserved[q], queue_alloc[q],
+                                      inp.eps, inp.scalar_dims)
+        else:
+            overused = jnp.bool_(False)
+
+        jmask = job_active & (inp.job_queue == q)
+        jkeys = []
+        for name in cfg.job_key_order:
+            if name == "priority":
+                jkeys.append(-inp.job_prio)
+            elif name == "gang":
+                jkeys.append((job_ready_cnt >= inp.job_minavail)
+                             .astype(inp.job_ts.dtype))
+            elif name == "drf":
+                jkeys.append(jnp.max(
+                    safe_share(job_alloc, inp.total_res[None, :]), axis=-1))
+        jkeys.extend([inp.job_ts, inp.job_uid_rank])
+        j = _lex_argmin(jmask, jkeys)
+        queue_has_job = jmask.any()
+        retire_queue = overused | ~queue_has_job
+
+        # -- drain the popped job ------------------------------------------
+        carry = (idle, releasing, used, count, out_node, out_kind, out_order,
+                 job_ptr, job_ready_cnt, step)
+
+        def do_drain(args):
+            carry, j = args
+            new_carry, survive, dres = drain_job(j, carry)
+            return new_carry, survive, dres
+
+        def skip_drain(args):
+            carry, _ = args
+            return carry, jnp.bool_(False), jnp.zeros((r,), dtype)
+
+        carry, survive, dres = jax.lax.cond(
+            retire_queue, skip_drain, do_drain, (carry, j))
+        (idle, releasing, used, count, out_node, out_kind, out_order,
+         job_ptr, job_ready_cnt, step) = carry
+
+        processed = ~retire_queue
+        # Deferred fairness events: one segment-add per pop boundary.
+        job_alloc = job_alloc.at[j].add(jnp.where(processed, dres, 0.0))
+        queue_alloc = queue_alloc.at[q].add(jnp.where(processed, dres, 0.0))
+        job_active = job_active.at[j].set(
+            jnp.where(processed, survive, job_active[j]))
+        queue_active = queue_active.at[q].set(
+            jnp.where(retire_queue, False, queue_active[q]))
+
+        return (queue_active, job_active, job_alloc, queue_alloc, idle,
+                releasing, used, count, out_node, out_kind, out_order,
+                job_ptr, job_ready_cnt, step)
+
+    jdim = inp.job_start.shape[0]
+    qdim = inp.queue_deserved.shape[0]
+    job_active0 = inp.queue_exists[inp.job_queue] & (inp.job_minavail >= 0)
+    queue_active0 = jnp.zeros((qdim,), bool).at[inp.job_queue].set(
+        True) & inp.queue_exists
+    init = (queue_active0, job_active0, inp.job_init_alloc,
+            inp.queue_init_alloc, inp.node_idle, inp.node_releasing,
+            inp.node_used, inp.node_count,
+            jnp.full((p,), -1, jnp.int32), jnp.zeros((p,), jnp.int32),
+            jnp.full((p,), -1, jnp.int32),
+            jnp.zeros((jdim,), jnp.int32), inp.job_init_ready, jnp.int32(0))
+    final = jax.lax.while_loop(outer_cond, outer_body, init)
+    return SolveResult(assignment=final[8], kind=final[9], order=final[10],
+                       step=final[13])

@@ -1,0 +1,33 @@
+"""Pallas drain-kernel solver vs the stepwise reference solver.
+
+Runs the Pallas path in interpreter mode on the CPU mesh; the real-TPU
+execution of the same kernel is exercised by bench.py and the driver.
+"""
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu.models.synthetic import make_synthetic_inputs
+from kube_batch_tpu.ops.pallas_solver import solve_allocate_pallas
+from kube_batch_tpu.ops.solver import solve_allocate, solve_allocate_stepwise
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_pallas_matches_stepwise(seed):
+    inputs, config = make_synthetic_inputs(
+        n_tasks=200, n_nodes=40, n_jobs=20, n_queues=3, seed=seed)
+    fast = solve_allocate_pallas(inputs, config, interpret=True)
+    slow = solve_allocate_stepwise(inputs, config)
+    assert np.array_equal(np.asarray(fast.assignment),
+                          np.asarray(slow.assignment))
+    assert np.array_equal(np.asarray(fast.kind), np.asarray(slow.kind))
+
+
+def test_pallas_matches_xla_two_level():
+    inputs, config = make_synthetic_inputs(
+        n_tasks=300, n_nodes=60, n_jobs=25, n_queues=4, gang_fraction=0.5,
+        seed=7)
+    a = solve_allocate_pallas(inputs, config, interpret=True)
+    b = solve_allocate(inputs, config)
+    assert np.array_equal(np.asarray(a.assignment), np.asarray(b.assignment))
+    assert np.array_equal(np.asarray(a.order), np.asarray(b.order))

@@ -42,11 +42,16 @@ class TpuAllocateAction(Action):
         if not snap.tasks:
             return
 
+        from ..models.shipping import ship_inputs
         from ..ops.solver import best_solve_allocate
 
         import numpy as np
+        ship_start = time.time()
+        inputs = ship_inputs(snap.inputs)
+        metrics.observe_tpu_transfer_latency(time.time() - ship_start)
+
         solve_start = time.time()
-        result = best_solve_allocate(snap.inputs, snap.config)
+        result = best_solve_allocate(inputs, snap.config)
         # np.asarray forces completion; block_until_ready is unreliable on
         # the experimental axon TPU tunnel.
         assignment = np.asarray(result.assignment)

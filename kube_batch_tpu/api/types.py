@@ -29,10 +29,14 @@ class TaskStatus(enum.IntFlag):
 ALLOCATED_STATUSES = (TaskStatus.Bound | TaskStatus.Binding
                       | TaskStatus.Running | TaskStatus.Allocated)
 
+_ALLOCATED_MASK = int(ALLOCATED_STATUSES)
+
 
 def allocated_status(status: TaskStatus) -> bool:
-    """Whether the status counts as holding resources (helpers.go:62-70)."""
-    return bool(status & ALLOCATED_STATUSES)
+    """Whether the status counts as holding resources (helpers.go:62-70).
+    Plain-int bit test: IntFlag.__and__ constructs enum members and shows up
+    hot in the bulk apply path."""
+    return bool(int(status) & _ALLOCATED_MASK)
 
 
 def get_task_status(pod) -> TaskStatus:

@@ -166,19 +166,27 @@ def tensorize_session(ssn) -> TensorSnapshot:
     node_count = np.zeros((n_pad,), np.int32)
     node_max = np.zeros((n_pad,), np.int32)
     node_exists = np.zeros((n_pad,), bool)
-    for i, name in enumerate(node_names):
-        node = ssn.nodes[name]
-        node_idle[i] = _vec(node.idle, axis)
-        node_rel[i] = _vec(node.releasing, axis)
-        node_used[i] = _vec(node.used, axis)
-        node_alloc[i] = _vec(node.allocatable, axis)
-        node_count[i] = len(node.tasks)
+    node_objs = [ssn.nodes[name] for name in node_names]
+    if n_real:
+        # Column-wise extraction (one list comprehension per column) beats
+        # one numpy row per node by ~10x at 10k+ nodes.
+        for arr, res_of in ((node_idle, lambda nd: nd.idle),
+                            (node_rel, lambda nd: nd.releasing),
+                            (node_used, lambda nd: nd.used),
+                            (node_alloc, lambda nd: nd.allocatable)):
+            arr[:n_real, 0] = [res_of(nd).milli_cpu for nd in node_objs]
+            arr[:n_real, 1] = [res_of(nd).memory for nd in node_objs]
+            for i, name in enumerate(axis[2:], start=2):
+                arr[:n_real, i] = [
+                    res_of(nd).scalar_resources.get(name, 0.0)
+                    for nd in node_objs]
+        node_count[:n_real] = [len(nd.tasks) for nd in node_objs]
         # Pod-count cap is a predicates-plugin check (predicates.go:127):
         # enforced (including 0 = reject-all, upstream semantics) only when
         # that plugin is enabled, matching the host path.
-        node_max[i] = node.allocatable.max_task_num if has_predicates \
-            else (1 << 30)
-        node_exists[i] = True
+        node_max[:n_real] = [nd.allocatable.max_task_num if has_predicates
+                             else (1 << 30) for nd in node_objs]
+        node_exists[:n_real] = True
 
     # ---- queues -----------------------------------------------------------
     queue_ids = sorted(ssn.queues)
@@ -230,8 +238,6 @@ def tensorize_session(ssn) -> TensorSnapshot:
         dtype=object))).astype(_F)
 
     tasks: List = []
-    task_rows: List[np.ndarray] = []
-    task_res_rows: List[np.ndarray] = []
     sig_of_task: List[int] = []
     signatures: Dict[tuple, int] = {}
     sig_examples: List = []
@@ -243,36 +249,56 @@ def tensorize_session(ssn) -> TensorSnapshot:
         job_prio[ji] = job.priority
         job_ts[ji] = job.creation_timestamp
         job_init_ready[ji] = job.ready_task_num()
-        alloc = np.zeros((r,), _F)
+        # DRF initial allocation: same accumulation order as the drf plugin
+        # (task_status_index iteration) so device shares match the host's
+        # floats exactly; plain scalar adds, no per-task array allocation.
+        acc = [0.0] * r
         for status, st_tasks in job.task_status_index.items():
             if allocated_status(status):
                 for t in st_tasks.values():
-                    alloc += _vec(t.resreq, axis)
-        job_init_alloc[ji] = alloc
+                    acc[0] += t.resreq.milli_cpu
+                    acc[1] += t.resreq.memory
+                    if r > 2 and t.resreq.scalar_resources:
+                        for i, name in enumerate(axis[2:], start=2):
+                            acc[i] += t.resreq.scalar_resources.get(name, 0.0)
+        job_init_alloc[ji] = acc
 
         # Candidate tasks: Pending, non-BestEffort (allocate.go:110-123),
-        # sorted by the session's task order (priority desc, ts, uid).
+        # sorted by the session's task order.  With only stock plugins
+        # (guaranteed by the _SUPPORTED_PLUGINS gate above) the task order
+        # is exactly (priority desc, creation ts, uid) — a key sort, much
+        # faster than cmp_to_key over the generic chain.
         pending = [t for t in job.task_status_index.get(TaskStatus.Pending,
                                                         {}).values()
                    if not t.resreq.is_empty()]
-        pending.sort(key=functools.cmp_to_key(
-            lambda a, b: -1 if ssn.task_order_fn(a, b)
-            else (1 if ssn.task_order_fn(b, a) else 0)))
+        if set(ssn.task_order_fns) <= {"priority"}:
+            pending.sort(key=lambda t: (-t.priority,
+                                        t.pod.metadata.creation_timestamp,
+                                        t.uid))
+        else:
+            pending.sort(key=functools.cmp_to_key(
+                lambda a, b: -1 if ssn.task_order_fn(a, b)
+                else (1 if ssn.task_order_fn(b, a) else 0)))
         job_start[ji] = len(tasks)
         job_count[ji] = len(pending)
         for t in pending:
-            reason = _uses_dynamic_predicates(t)
-            if reason is not None:
-                snap.fallback_reason = reason
-                return snap
-            sig = _task_signature(t)
+            spec = t.pod.spec
+            if (spec.node_selector or spec.tolerations
+                    or spec.affinity is not None
+                    or any(p.host_port > 0 for c in spec.containers
+                           for p in c.ports)):
+                reason = _uses_dynamic_predicates(t)
+                if reason is not None:
+                    snap.fallback_reason = reason
+                    return snap
+                sig = _task_signature(t)
+            else:
+                sig = ((), (), ())  # the common unconstrained pod
             if sig not in signatures:
                 signatures[sig] = len(signatures)
                 sig_examples.append(t)
             sig_of_task.append(signatures[sig])
             tasks.append(t)
-            task_rows.append(_vec(t.init_resreq, axis))
-            task_res_rows.append(_vec(t.resreq, axis))
 
     snap.tasks = tasks
     p_real = len(tasks)
@@ -281,17 +307,24 @@ def tensorize_session(ssn) -> TensorSnapshot:
     task_res = np.zeros((p_pad, r), _F)
     task_sig = np.zeros((p_pad,), np.int32)
     if p_real:
-        task_req[:p_real] = np.stack(task_rows)
-        task_res[:p_real] = np.stack(task_res_rows)
-        task_sig[:p_real] = np.array(sig_of_task, np.int32)
+        # Column-wise extraction beats one numpy row per task by ~10x.
+        task_req[:p_real, 0] = [t.init_resreq.milli_cpu for t in tasks]
+        task_req[:p_real, 1] = [t.init_resreq.memory for t in tasks]
+        task_res[:p_real, 0] = [t.resreq.milli_cpu for t in tasks]
+        task_res[:p_real, 1] = [t.resreq.memory for t in tasks]
+        for i, name in enumerate(axis[2:], start=2):
+            task_req[:p_real, i] = [
+                t.init_resreq.scalar_resources.get(name, 0.0) for t in tasks]
+            task_res[:p_real, i] = [
+                t.resreq.scalar_resources.get(name, 0.0) for t in tasks]
+        task_sig[:p_real] = sig_of_task
     task_sorted = np.arange(p_pad, dtype=np.int32)  # already emitted in order
 
     # ---- static predicate mask [S, N] ------------------------------------
     s_real = max(len(sig_examples), 1)
     sig_mask = np.zeros((s_real, n_pad), bool)
     for si, example in enumerate(sig_examples):
-        for nix, name in enumerate(node_names):
-            node = ssn.nodes[name]
+        for nix, node in enumerate(node_objs):
             if node.node is None:
                 continue
             if not has_predicates:
@@ -314,13 +347,19 @@ def tensorize_session(ssn) -> TensorSnapshot:
     # score ties may break differently than the f64 host oracle).
     dtype = jnp.asarray(np.float64(1.0)).dtype
 
+    np_dtype = np.float64 if dtype == jnp.float64 else np.float32
+    _np_of = {jnp.int32: np.int32, bool: np.bool_}
+
     def dev(x, dt=None):
-        arr = jnp.asarray(x)
-        if dt is not None:
-            arr = arr.astype(dt)
-        elif arr.dtype in (jnp.float64, jnp.float32):
-            arr = arr.astype(dtype)
-        return arr
+        # Stage on host with final dtypes; the leaves stay numpy.  The
+        # device transfer happens in one packed shipment (models/shipping.py)
+        # because the TPU tunnel charges fixed latency per transfer.
+        if dt is None:
+            if x.dtype.kind == "f":
+                x = np.ascontiguousarray(x, dtype=np_dtype)
+        else:
+            x = np.ascontiguousarray(x, dtype=_np_of.get(dt, dt))
+        return x
 
     snap.inputs = SolverInputs(
         task_req=dev(task_req), task_res=dev(task_res),
@@ -341,8 +380,9 @@ def tensorize_session(ssn) -> TensorSnapshot:
         node_exists=dev(node_exists, bool),
         sig_mask=dev(sig_mask, bool),
         total_res=dev(total_res),
-        eps=eps_vector(r, dtype),
-        scalar_dims=scalar_dims_mask(r))
+        eps=np.asarray([10.0, 10.0 * 1024 * 1024] + [10.0] * (r - 2),
+                       dtype=np_dtype),
+        scalar_dims=np.asarray([False, False] + [True] * (r - 2)))
     snap.config = SolverConfig(
         job_key_order=tuple(enabled_job_order),
         queue_key_order=tuple(enabled_queue_order),

@@ -12,10 +12,24 @@ affinity) fall back to the host allocate action transparently.
 
 from __future__ import annotations
 
+import contextlib
+import os
 import time
 
 from ..framework import Action
 from ..metrics import metrics
+
+# Set to a directory path to capture an XLA profiler trace of each session
+# solve (the sidecar profiling hook, SURVEY.md §5).
+PROFILE_ENV = "KUBE_BATCH_TPU_PROFILE"
+
+
+def _maybe_profile():
+    profile_dir = os.environ.get(PROFILE_ENV)
+    if not profile_dir:
+        return contextlib.nullcontext()
+    import jax
+    return jax.profiler.trace(profile_dir)
 
 
 class TpuAllocateAction(Action):
@@ -51,10 +65,11 @@ class TpuAllocateAction(Action):
         metrics.observe_tpu_transfer_latency(time.time() - ship_start)
 
         solve_start = time.time()
-        result = best_solve_allocate(inputs, snap.config)
-        # np.asarray forces completion; block_until_ready is unreliable on
-        # the experimental axon TPU tunnel.
-        assignment = np.asarray(result.assignment)
+        with _maybe_profile():
+            result = best_solve_allocate(inputs, snap.config)
+            # np.asarray forces completion; block_until_ready is unreliable
+            # on the experimental axon TPU tunnel.
+            assignment = np.asarray(result.assignment)
         metrics.observe_tpu_solve_latency(time.time() - solve_start)
         kind = np.asarray(result.kind)
         order = np.asarray(result.order)

@@ -87,6 +87,7 @@ class JobInfo:
         self.total_request: Resource = Resource.empty()
         self.creation_timestamp: float = 0.0
         self.pod_group: Optional[PodGroup] = None
+        self.pdb = None  # legacy PodDisruptionBudget gang source
         for task in tasks:
             self.add_task_info(task)
 
@@ -102,6 +103,17 @@ class JobInfo:
 
     def unset_pod_group(self) -> None:
         self.pod_group = None
+
+    def set_pdb(self, pdb) -> None:
+        """Legacy gang source (job_info.go:196-204)."""
+        self.name = pdb.metadata.name
+        self.min_available = pdb.min_available
+        self.namespace = pdb.metadata.namespace
+        self.creation_timestamp = pdb.metadata.creation_timestamp
+        self.pdb = pdb
+
+    def unset_pdb(self) -> None:
+        self.pdb = None
 
     # -- task bookkeeping (invariant-preserving) ----------------------------
 
@@ -196,6 +208,7 @@ class JobInfo:
         info.node_selector = dict(self.node_selector)
         info.creation_timestamp = self.creation_timestamp
         info.pod_group = copy.deepcopy(self.pod_group)
+        info.pdb = self.pdb
         for task in self.tasks.values():
             info.add_task_info(task.clone())
         return info
@@ -206,5 +219,5 @@ class JobInfo:
 
 
 def job_terminated(job: JobInfo) -> bool:
-    """Job has no group and no tasks left (helpers.go:115-119)."""
-    return job.pod_group is None and not job.tasks
+    """Job has no group/PDB and no tasks left (helpers.go:115-119)."""
+    return job.pod_group is None and job.pdb is None and not job.tasks

@@ -123,6 +123,9 @@ class Pod:
 class NodeStatus:
     allocatable: Dict[str, object] = field(default_factory=dict)
     capacity: Dict[str, object] = field(default_factory=dict)
+    # Node conditions, e.g. {"MemoryPressure": "True"} (the pressure
+    # predicates read these; upstream predicates.go:201-247).
+    conditions: Dict[str, str] = field(default_factory=dict)
 
 
 @dataclass
@@ -147,6 +150,15 @@ class PriorityClass:
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     value: int = 0
     global_default: bool = False
+
+
+@dataclass
+class PodDisruptionBudget:
+    """Legacy gang source (reference keeps PDB support for backward
+    compatibility, job_info.go:196-208; PDB jobs always land in the default
+    queue, event_handlers.go:676)."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    min_available: int = 0
 
 
 def pod_key(pod: Pod) -> str:

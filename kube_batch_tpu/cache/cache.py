@@ -52,7 +52,10 @@ class SchedulerCache(Cache):
         # bind/evict failed are resynced against cluster ground truth.
         self.err_tasks: List[TaskInfo] = []
         self.deleted_jobs: List[JobInfo] = []
-        self.events: List[tuple] = []  # recorded cluster events
+        # Recorded cluster events (bounded; the reference emits to the k8s
+        # event stream which is similarly retention-limited).
+        from collections import deque
+        self.events = deque(maxlen=10000)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -196,6 +199,32 @@ class SchedulerCache(Cache):
         with self.mutex:
             self.queues.pop(name, None)
 
+    def add_pdb(self, pdb) -> None:
+        """Legacy gang source; PDB jobs land in the default queue
+        (event_handlers.go:664-681)."""
+        key = f"{pdb.metadata.namespace}/{pdb.metadata.name}"
+        with self.mutex:
+            if key not in self.jobs:
+                self.jobs[key] = JobInfo(key)
+            job = self.jobs[key]
+            job.set_pdb(pdb)
+            job.queue = self.default_queue
+
+    def update_pdb(self, old_pdb, new_pdb) -> None:
+        self.add_pdb(new_pdb)
+
+    def delete_pdb(self, pdb) -> None:
+        key = f"{pdb.metadata.namespace}/{pdb.metadata.name}"
+        with self.mutex:
+            job = self.jobs.get(key)
+            if job is None:
+                return
+            job.unset_pdb()
+            if job_terminated(job):
+                del self.jobs[key]
+            else:
+                self.deleted_jobs.append(job)
+
     def add_priority_class(self, pc) -> None:
         with self.mutex:
             self.priority_classes[pc.metadata.name] = pc
@@ -223,20 +252,24 @@ class SchedulerCache(Cache):
             for name, queue in self.queues.items():
                 info.queues[name] = QueueInfo(queue)
             for uid, job in self.jobs.items():
-                # Jobs without PodGroup (or PDB analog) are skipped with an
-                # unschedulable event (cache.go:650-662).
-                if job.pod_group is None:
+                # Jobs without a scheduling spec (PodGroup or legacy PDB)
+                # are skipped (cache.go:650-656).
+                if job.pod_group is None and job.pdb is None:
                     self.events.append(
                         ("FailedScheduling", uid, "job without PodGroup"))
                     continue
+                # Jobs whose queue is missing are skipped (cache.go:658-662).
+                if job.queue not in info.queues:
+                    continue
                 clone = job.clone()
-                # Resolve job priority from PriorityClass (cache.go:664-674).
-                pc_name = clone.pod_group.spec.priority_class_name
-                if self.default_priority_class is not None:
-                    clone.priority = self.default_priority_class.value
-                pc = self.priority_classes.get(pc_name)
-                if pc is not None:
-                    clone.priority = pc.value
+                if clone.pod_group is not None:
+                    # Resolve priority from PriorityClass (cache.go:664-674).
+                    pc_name = clone.pod_group.spec.priority_class_name
+                    if self.default_priority_class is not None:
+                        clone.priority = self.default_priority_class.value
+                    pc = self.priority_classes.get(pc_name)
+                    if pc is not None:
+                        clone.priority = pc.value
                 info.jobs[uid] = clone
             return info
 
@@ -281,12 +314,24 @@ class SchedulerCache(Cache):
         self.err_tasks.append(task)
 
     def process_resync_tasks(self, cluster=None) -> None:
-        """Drain the error queue against the cluster's ground truth."""
+        """Drain the error queue against the cluster's ground truth
+        (cache.go:602-611 processResyncTask)."""
         while self.err_tasks:
             task = self.err_tasks.pop()
             cluster_pod = cluster.get_pod(task.namespace, task.name) \
                 if cluster is not None else None
             self.sync_task(task, cluster_pod)
+
+    def process_cleanup_jobs(self) -> None:
+        """Drop terminated jobs queued for deletion (cache.go:576-600)."""
+        with self.mutex:
+            remaining = []
+            for job in self.deleted_jobs:
+                if job_terminated(job):
+                    self.jobs.pop(job.uid, None)
+                else:
+                    remaining.append(job)
+            self.deleted_jobs = remaining
 
     def update_job_status(self, job: JobInfo) -> JobInfo:
         """Push PodGroup status to the cluster (cache.go:763-775)."""
@@ -296,9 +341,26 @@ class SchedulerCache(Cache):
         return job
 
     def record_job_status_event(self, job: JobInfo) -> None:
-        if job.pod_group is not None and not job.ready():
-            self.events.append(
-                ("Unschedulable", job.uid, job.fit_error()))
+        """Unschedulable events + pod conditions for stuck tasks
+        (cache.go RecordJobStatusEvent)."""
+        from ..api.pod_group_info import PodGroupPending, PodGroupUnknown
+        job_err = job.fit_error()
+        if not shadow_pod_group(job.pod_group):
+            pg_unschedulable = job.pod_group is not None and \
+                job.pod_group.status.phase in (PodGroupUnknown, PodGroupPending)
+            pdb_unschedulable = job.pdb is not None and \
+                bool(job.task_status_index.get(TaskStatus.Pending))
+            if pg_unschedulable or pdb_unschedulable:
+                pending = len(job.task_status_index.get(TaskStatus.Pending, {}))
+                self.events.append(
+                    ("Unschedulable", job.uid,
+                     f"{pending}/{len(job.tasks)} tasks in gang "
+                     f"unschedulable: {job_err}"))
+        # Pod conditions for Allocated and Pending tasks before the job is
+        # discarded (cache.go:754-763).
+        for status in (TaskStatus.Allocated, TaskStatus.Pending):
+            for task in job.task_status_index.get(status, {}).values():
+                self.task_unschedulable(task, job_err)
 
     def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
         if self.volume_binder is not None:

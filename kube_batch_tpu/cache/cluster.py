@@ -60,11 +60,13 @@ class Cluster:
         self.pod_groups: Dict[str, object] = {}
         self.queues: Dict[str, object] = {}
         self.priority_classes: Dict[str, PriorityClass] = {}
+        self.pdbs: Dict[str, object] = {}
         self.pod_informer = Informer()
         self.node_informer = Informer()
         self.pod_group_informer = Informer()
         self.queue_informer = Informer()
         self.priority_class_informer = Informer()
+        self.pdb_informer = Informer()
         # Kubelet stand-in: a bound pod starts Running immediately.
         self.auto_run_bound_pods = auto_run_bound_pods
         self._rv = itertools.count(1)
@@ -201,6 +203,19 @@ class Cluster:
             self.priority_class_informer.fire_add(pc)
             return pc
 
+    def create_pdb(self, pdb) -> object:
+        with self.lock:
+            key = f"{pdb.metadata.namespace}/{pdb.metadata.name}"
+            self.pdbs[key] = pdb
+            self.pdb_informer.fire_add(pdb)
+            return pdb
+
+    def delete_pdb(self, namespace: str, name: str) -> None:
+        with self.lock:
+            pdb = self.pdbs.pop(f"{namespace}/{name}", None)
+            if pdb is not None:
+                self.pdb_informer.fire_delete(pdb)
+
 
 class ClusterBinder(Binder):
     """Real binder against the simulator (reference cache.go:113-131)."""
@@ -264,6 +279,9 @@ def connect_cache_to_cluster(cache, cluster: Cluster) -> None:
         on_delete=cache.delete_queue)
     cluster.priority_class_informer.add_handlers(
         on_add=cache.add_priority_class, on_delete=cache.delete_priority_class)
+    cluster.pdb_informer.add_handlers(
+        on_add=cache.add_pdb, on_update=cache.update_pdb,
+        on_delete=cache.delete_pdb)
 
     # Replay current state (informer initial LIST).
     with cluster.lock:
@@ -273,6 +291,8 @@ def connect_cache_to_cluster(cache, cluster: Cluster) -> None:
             cache.add_queue(queue)
         for pc in cluster.priority_classes.values():
             cache.add_priority_class(pc)
+        for pdb in cluster.pdbs.values():
+            cache.add_pdb(pdb)
         for pg in cluster.pod_groups.values():
             cache.add_pod_group(pg)
         for pod in cluster.pods.values():

@@ -17,8 +17,6 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..api import TaskStatus, allocated_status
-from ..plugins.predicates import (pod_matches_node_selector,
-                                  tolerates_node_taints)
 from ..plugins.nodeorder import NodeOrderPlugin
 
 _F = np.float64  # host-side staging dtype; cast at device put
@@ -323,18 +321,17 @@ def tensorize_session(ssn) -> TensorSnapshot:
     # ---- static predicate mask [S, N] ------------------------------------
     s_real = max(len(sig_examples), 1)
     sig_mask = np.zeros((s_real, n_pad), bool)
+    # Static mask = the session's tiered predicate chain evaluated once per
+    # (signature, node).  Tasks with dynamic predicates (host ports,
+    # inter-pod affinity) already forced a fallback above, and the
+    # pod-count cap is re-checked dynamically on device, so the remaining
+    # checks (unschedulable, selector/affinity, taints, pressure) are
+    # static for the session.
     for si, example in enumerate(sig_examples):
         for nix, node in enumerate(node_objs):
-            if node.node is None:
-                continue
-            if not has_predicates:
-                sig_mask[si, nix] = True
-                continue
-            if node.node.spec.unschedulable:
-                continue
-            if not pod_matches_node_selector(example, node):
-                continue
-            if not tolerates_node_taints(example, node):
+            try:
+                ssn.predicate_fn(example, node)
+            except Exception:
                 continue
             sig_mask[si, nix] = True
     if not sig_examples:

@@ -96,6 +96,11 @@ class PredicatesPlugin(Plugin):
 
     def __init__(self, arguments: Arguments):
         self.arguments = arguments
+        # Pressure checks are opt-in via Arguments (predicates.go:71-110,
+        # defaults false).
+        self.check_memory = arguments.get_bool(MEMORY_PRESSURE_PREDICATE)
+        self.check_disk = arguments.get_bool(DISK_PRESSURE_PREDICATE)
+        self.check_pid = arguments.get_bool(PID_PRESSURE_PREDICATE)
 
     def name(self) -> str:
         return "predicates"
@@ -104,6 +109,14 @@ class PredicatesPlugin(Plugin):
         def predicate_fn(task: TaskInfo, node: NodeInfo) -> None:
             if node.node is None:
                 raise FitError(task, node, "node not initialized")
+            # Node pressure conditions (predicates.go:201-247).
+            conditions = node.node.status.conditions
+            if self.check_memory and conditions.get("MemoryPressure") == "True":
+                raise FitError(task, node, "node has memory pressure")
+            if self.check_disk and conditions.get("DiskPressure") == "True":
+                raise FitError(task, node, "node has disk pressure")
+            if self.check_pid and conditions.get("PIDPressure") == "True":
+                raise FitError(task, node, "node has pid pressure")
             # Pod-count cap (predicates.go:127).
             if node.allocatable.max_task_num <= len(node.tasks):
                 raise FitError(task, node, "node has too many pods")

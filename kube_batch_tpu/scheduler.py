@@ -113,6 +113,14 @@ class Scheduler:
                     self.run_once()
                 except Exception:  # loop must survive a bad cycle
                     metrics.register_schedule_attempt("error")
+                # Repair workers (cache.go:357-378: resync + cleanup run
+                # alongside the scheduling loop).
+                try:
+                    self.cache.process_cleanup_jobs()
+                    self.cache.process_resync_tasks(
+                        getattr(self.cache.binder, "cluster", None))
+                except Exception:
+                    pass
                 delay = self.schedule_period - (time.time() - cycle_start)
                 if delay > 0:
                     self._stop.wait(delay)

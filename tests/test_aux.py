@@ -1,0 +1,165 @@
+"""Auxiliary-subsystem tests: PDB legacy gang, pressure predicates,
+unschedulable pod conditions, resync/cleanup workers."""
+
+import pytest
+
+from kube_batch_tpu.api import ObjectMeta, TaskStatus
+from kube_batch_tpu.api.objects import PodDisruptionBudget
+from kube_batch_tpu.api.queue_info import Queue
+from kube_batch_tpu.apis.scheduling import v1alpha1
+from kube_batch_tpu.cache import (Cluster, FakeBinder, FakeEvictor,
+                                  FakeStatusUpdater, FakeVolumeBinder,
+                                  SchedulerCache, new_scheduler_cache)
+from kube_batch_tpu.framework import close_session, open_session
+from kube_batch_tpu.plugins.factory import register_default_plugins
+from kube_batch_tpu.actions.factory import register_default_actions
+from kube_batch_tpu.actions.allocate import AllocateAction
+from kube_batch_tpu.scheduler import DEFAULT_SCHEDULER_CONF, load_scheduler_conf
+from tests.test_utils import build_node, build_pod, build_resource_list
+
+
+@pytest.fixture(autouse=True)
+def _register():
+    register_default_actions()
+    register_default_plugins()
+
+
+def fresh_cache():
+    binder = FakeBinder()
+    status = FakeStatusUpdater()
+    cache = SchedulerCache(binder=binder, evictor=FakeEvictor(),
+                           status_updater=status,
+                           volume_binder=FakeVolumeBinder())
+    cache.add_queue(Queue(metadata=ObjectMeta(name="default"), weight=1))
+    return cache, binder, status
+
+
+class TestPDB:
+    def test_pdb_drives_gang(self):
+        # A PDB with min_available acts as the gang spec: the job schedules
+        # all-or-nothing without any PodGroup (legacy path).
+        cache, binder, _ = fresh_cache()
+        cache.add_node(build_node("n1", build_resource_list("2", "4Gi",
+                                                            pods=10)))
+        cache.add_pdb(PodDisruptionBudget(
+            metadata=ObjectMeta(name="legacy", namespace="ns"),
+            min_available=3))
+        for i in range(3):
+            cache.add_pod(build_pod("ns", f"p{i}", "", "Pending",
+                                    build_resource_list("1", "1Gi"),
+                                    "legacy"))
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        AllocateAction().execute(ssn)
+        close_session(ssn)
+        # 3 pods on a 2-cpu node cannot all fit: gang blocks everything.
+        assert binder.binds == {}
+
+    def test_pdb_job_in_snapshot(self):
+        cache, _, _ = fresh_cache()
+        cache.add_pdb(PodDisruptionBudget(
+            metadata=ObjectMeta(name="legacy", namespace="ns"),
+            min_available=1))
+        cache.add_pod(build_pod("ns", "p0", "", "Pending",
+                                build_resource_list("1", "1Gi"), "legacy"))
+        snap = cache.snapshot()
+        job = snap.jobs["ns/legacy"]
+        assert job.min_available == 1
+        assert job.queue == "default"
+
+    def test_delete_pdb_cleans_job(self):
+        cache, _, _ = fresh_cache()
+        pdb = PodDisruptionBudget(
+            metadata=ObjectMeta(name="legacy", namespace="ns"),
+            min_available=1)
+        cache.add_pdb(pdb)
+        assert "ns/legacy" in cache.jobs
+        cache.delete_pdb(pdb)
+        assert "ns/legacy" not in cache.jobs
+
+
+class TestPressurePredicates:
+    def _run(self, arguments, conditions):
+        cache, binder, _ = fresh_cache()
+        node = build_node("n1", build_resource_list("8", "8Gi", pods=10))
+        node.status.conditions = conditions
+        cache.add_node(node)
+        cache.add_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name="pg", namespace="ns"),
+            spec=v1alpha1.PodGroupSpec(min_member=1, queue="default")))
+        cache.add_pod(build_pod("ns", "p0", "", "Pending",
+                                build_resource_list("1", "1Gi"), "pg"))
+        conf = f"""
+actions: "allocate"
+tiers:
+- plugins:
+  - name: gang
+- plugins:
+  - name: predicates
+    arguments:
+      predicate.MemoryPressureEnable: "{arguments}"
+"""
+        _, tiers = load_scheduler_conf(conf)
+        ssn = open_session(cache, tiers)
+        AllocateAction().execute(ssn)
+        close_session(ssn)
+        return binder.binds
+
+    def test_pressure_blocks_when_enabled(self):
+        assert self._run("true", {"MemoryPressure": "True"}) == {}
+
+    def test_pressure_ignored_by_default(self):
+        assert self._run("false", {"MemoryPressure": "True"}) == \
+            {"ns/p0": "n1"}
+
+
+class TestConditionsAndWorkers:
+    def test_unschedulable_pod_conditions_written(self):
+        cache, _, status = fresh_cache()
+        cache.add_node(build_node("n1", build_resource_list("1", "1Gi",
+                                                            pods=10)))
+        cache.add_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name="big", namespace="ns"),
+            spec=v1alpha1.PodGroupSpec(min_member=2, queue="default")))
+        for i in range(2):
+            cache.add_pod(build_pod("ns", f"p{i}", "", "Pending",
+                                    build_resource_list("4", "4Gi"), "big"))
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        AllocateAction().execute(ssn)
+        close_session(ssn)
+        # Pod conditions recorded for the stuck pending tasks.
+        assert any(key.startswith("ns/p") for key, _ in status.pod_conditions)
+
+    def test_cleanup_worker_drops_terminated_jobs(self):
+        cache, _, _ = fresh_cache()
+        pg = v1alpha1.PodGroup(
+            metadata=ObjectMeta(name="gone", namespace="ns"),
+            spec=v1alpha1.PodGroupSpec(min_member=1, queue="default"))
+        cache.add_pod_group(pg)
+        pod = build_pod("ns", "p0", "", "Pending",
+                        build_resource_list("1", "1Gi"), "gone")
+        cache.add_pod(pod)
+        cache.delete_pod_group(pg)
+        assert "ns/gone" in cache.jobs  # still has the task
+        cache.delete_pod(pod)
+        cache.process_cleanup_jobs()
+        assert "ns/gone" not in cache.jobs
+
+    def test_resync_worker_refetches_truth(self):
+        cluster = Cluster()
+        cluster.create_queue(v1alpha1.Queue(
+            metadata=ObjectMeta(name="default"),
+            spec=v1alpha1.QueueSpec(weight=1)))
+        cache = new_scheduler_cache(cluster)
+        cluster.create_node(build_node("n1", build_resource_list(
+            "8", "8Gi", pods=10)))
+        pod = build_pod("ns", "p0", "", "Pending",
+                        build_resource_list("1", "1Gi"), "pg")
+        cluster.create_pod(pod)
+        task = list(cache.jobs["ns/pg"].tasks.values())[0]
+        cache._resync_task(task)
+        cache.process_resync_tasks(cluster)
+        # Task resynced against cluster ground truth; still present.
+        assert "ns/pg" in cache.jobs
+        assert len(cache.jobs["ns/pg"].tasks) == 1

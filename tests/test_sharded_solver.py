@@ -1,0 +1,30 @@
+"""Distributed (shard_map) solver vs the single-chip solver on the virtual
+8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu.models.synthetic import make_synthetic_inputs
+from kube_batch_tpu.ops.solver import solve_allocate
+from kube_batch_tpu.parallel import make_mesh
+from kube_batch_tpu.parallel.sharded_solver import solve_allocate_sharded
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_sharded_matches_single_chip(seed):
+    inputs, config = make_synthetic_inputs(
+        n_tasks=200, n_nodes=64, n_jobs=20, n_queues=3, seed=seed)
+    mesh = make_mesh(8)
+    sharded = solve_allocate_sharded(inputs, config, mesh)
+    single = solve_allocate(inputs, config)
+    assert np.array_equal(np.asarray(sharded.assignment),
+                          np.asarray(single.assignment))
+    assert np.array_equal(np.asarray(sharded.kind), np.asarray(single.kind))
+
+
+def test_sharded_runs_on_two_devices():
+    inputs, config = make_synthetic_inputs(
+        n_tasks=128, n_nodes=32, n_jobs=10, n_queues=2, seed=5)
+    mesh = make_mesh(2)
+    result = solve_allocate_sharded(inputs, config, mesh)
+    assert (np.asarray(result.assignment) >= 0).sum() > 0

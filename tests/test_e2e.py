@@ -286,3 +286,32 @@ class TestVersionedAPIs:
         h.cluster.create_pod(pod)
         h.cycle()
         assert h.cluster.pods["test/bare"].spec.node_name == "node-0"
+
+
+class TestTpuActionPipeline:
+    def test_tpu_allocate_then_preempt(self):
+        # Full pipeline with the device action first: tpu-allocate handles
+        # placement, then host preempt evicts for the high-priority gang.
+        conf = """
+actions: "tpu-allocate, preempt, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+"""
+        h = Harness(conf=conf)
+        h.add_nodes(1, cpu="4")
+        h.create_job("low", 4, 1, prio_class="low-priority")
+        h.cycle()
+        assert len(h.bound("low")) == 4
+        h.create_job("high", 2, 2, prio_class="high-priority")
+        h.cycle(3)
+        assert len(h.bound("high")) == 2
+        assert len([k for k in h.cluster.pods
+                    if k.startswith("test/low")]) < 4

@@ -1,0 +1,136 @@
+"""Density benchmark: the kubemark suite analog.
+
+Mirrors the reference's density/latency e2e benchmark
+(test/e2e/benchmark.go "Schedule Density Job" + metric_util.go run on
+kubemark hollow nodes): drives the cluster simulator with a gang job plus
+repeated latency-probe pods against a hollow-node cluster, measures
+create->schedule latency per pod from recorded bind times, and writes a
+percentiled JSON artifact (``MetricsForE2ESuite_<ts>.json``).
+
+Usage: python tools/density_bench.py [--nodes 100] [--gang 100]
+       [--latency-pods 30] [--out DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from kube_batch_tpu.api import (Container, ObjectMeta, Pod, PodSpec,
+                                PodStatus)
+from kube_batch_tpu.apis.scheduling import v1alpha1
+from kube_batch_tpu.cache import Cluster, new_scheduler_cache
+from kube_batch_tpu.scheduler import Scheduler
+from tests.test_utils import build_node, build_resource_list
+
+
+def percentiles(values, ps=(50, 90, 99, 100)):
+    if not values:
+        return {}
+    ordered = sorted(values)
+    out = {}
+    for p in ps:
+        idx = min(len(ordered) - 1, max(0, int(len(ordered) * p / 100) - 1))
+        out[f"Perc{p}"] = round(ordered[idx] * 1e3, 3)  # ms
+    return out
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--nodes", type=int, default=100)
+    ap.add_argument("--gang", type=int, default=100)
+    ap.add_argument("--latency-pods", type=int, default=30)
+    ap.add_argument("--conf", default="config/kube-batch-tpu-conf.yaml")
+    ap.add_argument("--out", default=".")
+    args = ap.parse_args(argv)
+
+    cluster = Cluster()
+    for i in range(args.nodes):  # hollow nodes (kubemark analog)
+        cluster.create_node(build_node(
+            f"hollow-{i:04d}", build_resource_list("16", "32Gi", pods=110)))
+    cluster.create_queue(v1alpha1.Queue(
+        metadata=ObjectMeta(name="default"),
+        spec=v1alpha1.QueueSpec(weight=1)))
+    cache = new_scheduler_cache(cluster)
+    with open(args.conf) as f:
+        conf = f.read()
+    sched = Scheduler(cache, scheduler_conf=conf, schedule_period=0.05)
+    sched.run()
+
+    create_times = {}
+    bind_times = {}
+
+    def watch(old, new):
+        key = f"{new.metadata.namespace}/{new.metadata.name}"
+        if new.spec.node_name and key not in bind_times:
+            bind_times[key] = time.time()
+
+    cluster.pod_informer.add_handlers(on_update=watch)
+
+    def submit(name, group, cpu="2m"):
+        key = f"density/{name}"
+        create_times[key] = time.time()
+        cluster.create_pod(Pod(
+            metadata=ObjectMeta(
+                name=name, namespace="density",
+                annotations={v1alpha1.GroupNameAnnotationKey: group}),
+            spec=PodSpec(containers=[Container(
+                requests={"cpu": cpu, "memory": "1Mi"})]),
+            status=PodStatus(phase="Pending")))
+
+    # Density gang (benchmark.go:48-71: minMember gang of tiny pods).
+    cluster.create_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name="density-gang", namespace="density"),
+        spec=v1alpha1.PodGroupSpec(min_member=args.gang, queue="default")))
+    for i in range(args.gang):
+        submit(f"gang-{i:04d}", "density-gang")
+
+    # Latency probes: one pod at a time, measured individually
+    # (benchmark.go:158-177).
+    for i in range(args.latency_pods):
+        cluster.create_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name=f"probe-{i:03d}", namespace="density"),
+            spec=v1alpha1.PodGroupSpec(min_member=1, queue="default")))
+        submit(f"probe-{i:03d}", f"probe-{i:03d}", cpu="1m")
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            if f"density/probe-{i:03d}" in bind_times:
+                break
+            time.sleep(0.01)
+
+    deadline = time.time() + 60
+    while time.time() < deadline and len(bind_times) < len(create_times):
+        time.sleep(0.05)
+    sched.stop()
+
+    lat = {k: bind_times[k] - create_times[k]
+           for k in bind_times if k in create_times}
+    gang_lat = [v for k, v in lat.items() if "/gang-" in k]
+    probe_lat = [v for k, v in lat.items() if "/probe-" in k]
+    report = {
+        "version": "v1",
+        "dataItems": [
+            {"data": percentiles(gang_lat), "unit": "ms",
+             "labels": {"Metric": "create_to_schedule_gang"}},
+            {"data": percentiles(probe_lat), "unit": "ms",
+             "labels": {"Metric": "create_to_schedule_latency_pod"}},
+        ],
+        "scheduled": len(bind_times),
+        "submitted": len(create_times),
+    }
+    ts = time.strftime("%Y%m%dT%H%M%S")
+    path = os.path.join(args.out, f"MetricsForE2ESuite_{ts}.json")
+    with open(path, "w") as f:
+        json.dump(report, f, indent=2)
+    print(json.dumps(report["dataItems"], indent=2))
+    print(f"wrote {path}; scheduled {len(bind_times)}/{len(create_times)}")
+    return 0 if len(bind_times) == len(create_times) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

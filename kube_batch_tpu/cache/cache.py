@@ -32,10 +32,14 @@ class SchedulerCache(Cache):
                  binder: Optional[Binder] = None,
                  evictor: Optional[Evictor] = None,
                  status_updater: Optional[StatusUpdater] = None,
-                 volume_binder: Optional[VolumeBinder] = None):
+                 volume_binder: Optional[VolumeBinder] = None,
+                 priority_class_enabled: bool = True):
         self.mutex = threading.RLock()
         self.scheduler_name = scheduler_name
         self.default_queue = default_queue
+        # --priority-class flag: when disabled, PriorityClass objects are
+        # ignored (the reference skips the informer, cache.go:337-344).
+        self.priority_class_enabled = priority_class_enabled
 
         self.jobs: Dict[str, JobInfo] = {}
         self.nodes: Dict[str, NodeInfo] = {}
@@ -226,6 +230,8 @@ class SchedulerCache(Cache):
                 self.deleted_jobs.append(job)
 
     def add_priority_class(self, pc) -> None:
+        if not self.priority_class_enabled:
+            return
         with self.mutex:
             self.priority_classes[pc.metadata.name] = pc
             if pc.global_default:

@@ -301,12 +301,14 @@ def connect_cache_to_cluster(cache, cluster: Cluster) -> None:
 
 
 def new_scheduler_cache(cluster: Cluster, scheduler_name: str = "kube-batch",
-                        default_queue: str = "default"):
+                        default_queue: str = "default",
+                        priority_class_enabled: bool = True):
     """Build a fully-wired SchedulerCache over a Cluster (cache.go:223-352)."""
     from .cache import SchedulerCache
     cache = SchedulerCache(
         scheduler_name=scheduler_name, default_queue=default_queue,
         binder=ClusterBinder(cluster), evictor=ClusterEvictor(cluster),
-        status_updater=ClusterStatusUpdater(cluster))
+        status_updater=ClusterStatusUpdater(cluster),
+        priority_class_enabled=priority_class_enabled)
     connect_cache_to_cluster(cache, cluster)
     return cache

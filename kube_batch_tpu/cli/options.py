@@ -62,6 +62,12 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         help="Print version and exit")
     parser.add_argument("--listen-address", default=DEFAULT_LISTEN_ADDRESS,
                         help="Address for the /metrics endpoint")
+    parser.add_argument("--priority-class", dest="priority_class",
+                        action="store_true", default=True,
+                        help="Enable PriorityClass-based job priority")
+    parser.add_argument("--no-priority-class", dest="priority_class",
+                        action="store_false",
+                        help="Disable PriorityClass-based job priority")
     parser.add_argument("--cluster-state", default="",
                         help="Path to a JSON cluster snapshot for the simulator")
 
@@ -77,4 +83,4 @@ def parse_options(argv=None) -> ServerOption:
         enable_leader_election=ns.leader_elect,
         lock_object_namespace=ns.lock_object_namespace,
         print_version=ns.version, listen_address=ns.listen_address,
-        cluster_state=ns.cluster_state)
+        priority_class=ns.priority_class, cluster_state=ns.cluster_state)

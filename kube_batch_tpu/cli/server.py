@@ -102,7 +102,8 @@ class ServerRuntime:
             load_cluster_state(self.cluster, opt.cluster_state)
         self.cache = new_scheduler_cache(
             self.cluster, scheduler_name=opt.scheduler_name,
-            default_queue=opt.default_queue)
+            default_queue=opt.default_queue,
+            priority_class_enabled=opt.priority_class)
         conf_str = None
         if opt.scheduler_conf:
             with open(opt.scheduler_conf) as f:

@@ -1,0 +1,148 @@
+"""Benchmark suite: the five BASELINE.json configs.
+
+bench.py prints the single headline line the driver records; this tool runs
+every configuration from BASELINE.json `configs` and prints one JSON line
+per config:
+
+1. example gang job end-to-end through the simulator (kind-analog)
+2. allocate + predicates + nodeorder scoring, 1k pods x 100 nodes
+3. DRF + proportion multi-queue fairness, 4 queues, 10k pods
+4. preempt + reclaim + backfill with PriorityClass churn
+5. kubemark-scale 50k pods x 10k nodes gang bin-packing (the headline)
+
+Solve-latency configs report the on-device batched session solve; the
+end-to-end configs report wall-clock through the object model.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+
+def report(name, ms, target_ms=1000.0):
+    print(json.dumps({"metric": name, "value": round(ms, 2), "unit": "ms",
+                      "vs_baseline": round(target_ms / ms, 3)}))
+
+
+def solve_case(name, **kw):
+    from kube_batch_tpu.models.synthetic import make_synthetic_inputs
+    from kube_batch_tpu.ops.solver import best_solve_allocate
+    inputs, config = make_synthetic_inputs(**kw)
+    np.asarray(best_solve_allocate(inputs, config).assignment)  # compile
+    runs = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        np.asarray(best_solve_allocate(inputs, config).assignment)
+        runs.append((time.perf_counter() - t0) * 1e3)
+    report(name, min(runs))
+
+
+def e2e_example_job():
+    """Config 1: example/job.json gang through the live loop."""
+    from kube_batch_tpu.cli.options import ServerOption
+    from kube_batch_tpu.cli.server import ServerRuntime
+    opt = ServerOption(schedule_period=0.05, listen_address="",
+                       enable_leader_election=False,
+                       cluster_state=os.path.join(
+                           os.path.dirname(__file__), "..", "example",
+                           "job.json"))
+    runtime = ServerRuntime(opt)
+    t0 = time.perf_counter()
+    runtime.run()
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if all(p.spec.node_name for p in runtime.cluster.pods.values()):
+            break
+        time.sleep(0.02)
+    ms = (time.perf_counter() - t0) * 1e3
+    runtime.stop()
+    assert all(p.spec.node_name for p in runtime.cluster.pods.values())
+    report("example gang job (minMember=6) submit->all-bound e2e", ms,
+           target_ms=1000.0)
+
+
+def churn_case():
+    """Config 4: preempt + reclaim + backfill under PriorityClass churn."""
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tests"))
+    from test_utils import build_node, build_resource_list
+    from kube_batch_tpu.api import (Container, ObjectMeta, Pod, PodSpec,
+                                    PodStatus)
+    from kube_batch_tpu.api.objects import PriorityClass
+    from kube_batch_tpu.apis.scheduling import v1alpha1
+    from kube_batch_tpu.cache import Cluster, new_scheduler_cache
+    from kube_batch_tpu.scheduler import Scheduler
+
+    cluster = Cluster()
+    cluster.create_queue(v1alpha1.Queue(
+        metadata=ObjectMeta(name="default"),
+        spec=v1alpha1.QueueSpec(weight=1)))
+    for i, (name, value) in enumerate((("p10", 10), ("p100", 100),
+                                       ("p1000", 1000))):
+        cluster.create_priority_class(PriorityClass(
+            metadata=ObjectMeta(name=name), value=value))
+    for i in range(20):
+        cluster.create_node(build_node(
+            f"n{i}", build_resource_list("8", "16Gi", pods=110)))
+    cache = new_scheduler_cache(cluster)
+    conf = ('actions: "allocate, preempt, reclaim, backfill"\n'
+            'tiers:\n- plugins:\n  - name: priority\n  - name: gang\n'
+            '  - name: conformance\n- plugins:\n  - name: drf\n'
+            '  - name: predicates\n  - name: proportion\n'
+            '  - name: nodeorder\n')
+    sched = Scheduler(cache, scheduler_conf=conf, schedule_period=3600)
+
+    def submit(wave, prio_class, count):
+        for i in range(count):
+            name = f"{prio_class}-{wave}-{i}"
+            cluster.create_pod_group(v1alpha1.PodGroup(
+                metadata=ObjectMeta(name=name, namespace="churn"),
+                spec=v1alpha1.PodGroupSpec(
+                    min_member=1, queue="default",
+                    priority_class_name=prio_class)))
+            cluster.create_pod(Pod(
+                metadata=ObjectMeta(name=name, namespace="churn",
+                                    annotations={
+                                        v1alpha1.GroupNameAnnotationKey:
+                                        name}),
+                spec=PodSpec(priority={"p10": 10, "p100": 100,
+                                       "p1000": 1000}[prio_class],
+                             containers=[Container(requests={
+                                 "cpu": "2", "memory": "2Gi"})]),
+                status=PodStatus(phase="Pending")))
+
+    t0 = time.perf_counter()
+    submit(0, "p10", 80)       # fill the cluster with low-priority
+    sched.run_once()
+    submit(1, "p1000", 30)     # high-priority wave forces preemption
+    for _ in range(4):
+        sched.run_once()
+    ms = (time.perf_counter() - t0) * 1e3
+    high_bound = sum(1 for k, p in cluster.pods.items()
+                     if "p1000" in k and p.spec.node_name)
+    assert high_bound == 30, f"only {high_bound}/30 high-priority bound"
+    report("preempt+reclaim+backfill, PriorityClass churn (110 jobs)", ms,
+           target_ms=5000.0)
+
+
+def main():
+    e2e_example_job()
+    solve_case("session solve @ 1k tasks x 100 nodes (allocate+predicates"
+               "+nodeorder)", n_tasks=1000, n_nodes=100, n_jobs=50,
+               n_queues=1, seed=0)
+    solve_case("session solve @ 10k tasks, 4 weighted queues (DRF"
+               "+proportion)", n_tasks=10000, n_nodes=2000, n_jobs=400,
+               n_queues=4, seed=0)
+    churn_case()
+    solve_case("session solve @ 50k tasks x 10k nodes (headline)",
+               n_tasks=50000, n_nodes=10000, n_jobs=2000, n_queues=4, seed=0)
+
+
+if __name__ == "__main__":
+    main()

@@ -32,10 +32,14 @@ from tests.test_utils import build_node, build_resource_list
 def percentiles(values, ps=(50, 90, 99, 100)):
     if not values:
         return {}
+    import math
     ordered = sorted(values)
     out = {}
     for p in ps:
-        idx = min(len(ordered) - 1, max(0, int(len(ordered) * p / 100) - 1))
+        # Nearest-rank: ceil(n*p/100); int() truncation would under-report
+        # the high percentiles whenever n*p/100 is non-integral.
+        idx = min(len(ordered) - 1,
+                  max(0, math.ceil(len(ordered) * p / 100) - 1))
         out[f"Perc{p}"] = round(ordered[idx] * 1e3, 3)  # ms
     return out
 
@@ -45,7 +49,9 @@ def main(argv=None):
     ap.add_argument("--nodes", type=int, default=100)
     ap.add_argument("--gang", type=int, default=100)
     ap.add_argument("--latency-pods", type=int, default=30)
-    ap.add_argument("--conf", default="config/kube-batch-tpu-conf.yaml")
+    ap.add_argument("--conf", default=os.path.join(
+        os.path.dirname(__file__), "..", "config",
+        "kube-batch-tpu-conf.yaml"))
     ap.add_argument("--out", default=".")
     args = ap.parse_args(argv)
 

@@ -87,7 +87,13 @@ class AllocateAction(Action):
                 node = ssn.nodes[node_name]
 
                 if task.init_resreq.less_equal(node.idle):
-                    ssn.allocate(task, node.name)
+                    try:
+                        ssn.allocate(task, node.name)
+                    except (KeyError, ValueError):
+                        # Log-and-continue like the reference
+                        # (allocate.go:162-166); failed volume allocation or
+                        # stale state leaves the task pending for resync.
+                        pass
                 else:
                     # Record why the best node did not fit idle.
                     delta = node.idle.clone()

@@ -97,6 +97,16 @@ class PodSpec:
     init_containers: List[Container] = field(default_factory=list)
     tolerations: List[Toleration] = field(default_factory=list)
     affinity: Optional[Affinity] = None
+    # Names of PersistentVolumeClaims the pod mounts (volume binding).
+    volumes: List[str] = field(default_factory=list)
+
+
+@dataclass
+class PersistentVolumeClaim:
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    storage_class: str = "standard"
+    phase: str = "Pending"  # Pending | Bound
+    volume_name: str = ""
 
 
 @dataclass

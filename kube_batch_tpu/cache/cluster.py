@@ -17,8 +17,8 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-from ..api.objects import Node, Pod, PriorityClass
-from .interface import Binder, Evictor, StatusUpdater
+from ..api.objects import Node, PersistentVolumeClaim, Pod, PriorityClass
+from .interface import Binder, Evictor, StatusUpdater, VolumeBinder
 
 
 class Informer:
@@ -61,6 +61,7 @@ class Cluster:
         self.queues: Dict[str, object] = {}
         self.priority_classes: Dict[str, PriorityClass] = {}
         self.pdbs: Dict[str, object] = {}
+        self.pvcs: Dict[str, PersistentVolumeClaim] = {}
         self.pod_informer = Informer()
         self.node_informer = Informer()
         self.pod_group_informer = Informer()
@@ -203,6 +204,20 @@ class Cluster:
             self.priority_class_informer.fire_add(pc)
             return pc
 
+    def create_pvc(self, pvc: PersistentVolumeClaim) -> PersistentVolumeClaim:
+        with self.lock:
+            key = f"{pvc.metadata.namespace}/{pvc.metadata.name}"
+            self.pvcs[key] = pvc
+            return pvc
+
+    def bind_pvc(self, namespace: str, name: str, volume_name: str) -> None:
+        with self.lock:
+            pvc = self.pvcs.get(f"{namespace}/{name}")
+            if pvc is None:
+                raise KeyError(f"pvc {namespace}/{name} not found")
+            pvc.phase = "Bound"
+            pvc.volume_name = volume_name
+
     def create_pdb(self, pdb) -> object:
         with self.lock:
             key = f"{pdb.metadata.namespace}/{pdb.metadata.name}"
@@ -235,6 +250,35 @@ class ClusterEvictor(Evictor):
 
     def evict(self, pod) -> None:
         self.cluster.delete_pod(pod.metadata.namespace, pod.metadata.name)
+
+
+class ClusterVolumeBinder(VolumeBinder):
+    """Two-phase volume binding against the simulator's PVC store: the
+    analog of the reference's VolumeBinder (cache.go:538-546 AllocateVolumes
+    assumes claims for a host; BindVolumes commits them)."""
+
+    def __init__(self, cluster: Cluster):
+        self.cluster = cluster
+        self.assumed: Dict[str, str] = {}  # pvc key -> node
+
+    def allocate_volumes(self, task, hostname: str) -> None:
+        for claim in task.pod.spec.volumes:
+            key = f"{task.namespace}/{claim}"
+            with self.cluster.lock:
+                pvc = self.cluster.pvcs.get(key)
+            if pvc is None:
+                raise KeyError(
+                    f"pod {task.namespace}/{task.name} references missing "
+                    f"PVC {claim}")
+            self.assumed[key] = hostname
+
+    def bind_volumes(self, task) -> None:
+        for claim in task.pod.spec.volumes:
+            key = f"{task.namespace}/{claim}"
+            if key in self.assumed:
+                self.cluster.bind_pvc(task.namespace, claim, f"pv-{claim}")
+                del self.assumed[key]
+        task.volume_ready = True
 
 
 class ClusterStatusUpdater(StatusUpdater):
@@ -309,6 +353,7 @@ def new_scheduler_cache(cluster: Cluster, scheduler_name: str = "kube-batch",
         scheduler_name=scheduler_name, default_queue=default_queue,
         binder=ClusterBinder(cluster), evictor=ClusterEvictor(cluster),
         status_updater=ClusterStatusUpdater(cluster),
+        volume_binder=ClusterVolumeBinder(cluster),
         priority_class_enabled=priority_class_enabled)
     connect_cache_to_cluster(cache, cluster)
     return cache

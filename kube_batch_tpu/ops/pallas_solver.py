@@ -250,12 +250,15 @@ def _solve_kernel(r: int, cfg: SolverConfig,
             af = jnp.where(alloc_ok, 1.0, 0.0).astype(dtype)
             pf = jnp.where(pipe_ok, 1.0, 0.0).astype(dtype)
             plf = jnp.where(placed, 1.0, 0.0).astype(dtype)
+            # Rank-1 update over the dynamic rows only (idle, releasing,
+            # used, count); the static rows below never change.
+            ndyn = 3 * r + 1
             delta_col = [(-af * res[i]) for i in range(r)] \
                 + [(-pf * res[i]) for i in range(r)] \
-                + [(plf * res[i]) for i in range(r)] \
-                + [plf] + [jnp.zeros((), dtype)] * (nrows - 3 * r - 1)
-            delta = jnp.stack(delta_col).reshape(nrows, 1)
-            node_ref[:, :] = node_ref[:, :] + delta * onehot.astype(dtype)
+                + [(plf * res[i]) for i in range(r)] + [plf]
+            delta = jnp.stack(delta_col).reshape(ndyn, 1)
+            node_ref[0:ndyn, :] = node_ref[0:ndyn, :] \
+                + delta * onehot.astype(dtype)
 
             row = jnp.stack([jnp.where(placed, nsel, -1),
                              jnp.where(alloc_ok, 1,

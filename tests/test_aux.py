@@ -163,3 +163,45 @@ class TestConditionsAndWorkers:
         # Task resynced against cluster ground truth; still present.
         assert "ns/pg" in cache.jobs
         assert len(cache.jobs["ns/pg"].tasks) == 1
+
+
+class TestVolumeBinding:
+    def _cluster(self):
+        cluster = Cluster()
+        cluster.create_queue(v1alpha1.Queue(
+            metadata=ObjectMeta(name="default"),
+            spec=v1alpha1.QueueSpec(weight=1)))
+        cluster.create_node(build_node(
+            "n1", build_resource_list("8", "8Gi", pods=10)))
+        cluster.create_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name="pg", namespace="ns"),
+            spec=v1alpha1.PodGroupSpec(min_member=1, queue="default")))
+        return cluster
+
+    def _pod(self, volumes):
+        pod = build_pod("ns", "p0", "", "Pending",
+                        build_resource_list("1", "1Gi"), "pg")
+        pod.spec.volumes = list(volumes)
+        return pod
+
+    def test_pvc_bound_on_dispatch(self):
+        from kube_batch_tpu.api.objects import PersistentVolumeClaim
+        from kube_batch_tpu.scheduler import Scheduler
+        cluster = self._cluster()
+        cluster.create_pvc(PersistentVolumeClaim(
+            metadata=ObjectMeta(name="data", namespace="ns")))
+        cluster.create_pod(self._pod(["data"]))
+        cache = new_scheduler_cache(cluster)
+        Scheduler(cache, schedule_period=3600).run_once()
+        assert cluster.pods["ns/p0"].spec.node_name == "n1"
+        pvc = cluster.pvcs["ns/data"]
+        assert pvc.phase == "Bound"
+        assert pvc.volume_name == "pv-data"
+
+    def test_missing_pvc_blocks_allocation(self):
+        from kube_batch_tpu.scheduler import Scheduler
+        cluster = self._cluster()
+        cluster.create_pod(self._pod(["nope"]))
+        cache = new_scheduler_cache(cluster)
+        Scheduler(cache, schedule_period=3600).run_once()
+        assert cluster.pods["ns/p0"].spec.node_name == ""

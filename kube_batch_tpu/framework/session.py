@@ -50,9 +50,6 @@ class Session:
         self.job_pipelined_fns: Dict[str, Callable] = {}
         self.job_valid_fns: Dict[str, Callable] = {}
         self.node_order_fns: Dict[str, List] = {}
-        # Batch solvers registered by TPU-aware plugins: each maps the
-        # tensorized snapshot to mask/score contributions (see ops/).
-        self.tensor_plugins: Dict[str, object] = {}
 
     # ------------------------------------------------------------------
     # registration (session_plugins.go:25-77)
@@ -90,9 +87,6 @@ class Session:
     def add_node_order_fns(self, name, prioritizers):
         """prioritizers: list of (weight, NodeOrderFn)."""
         self.node_order_fns[name] = prioritizers
-
-    def add_tensor_plugin(self, name, plugin):
-        self.tensor_plugins[name] = plugin
 
     def add_event_handler(self, handler: EventHandler):
         self.event_handlers.append(handler)

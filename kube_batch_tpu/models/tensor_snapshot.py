@@ -337,8 +337,6 @@ def tensorize_session(ssn) -> TensorSnapshot:
     if not sig_examples:
         sig_mask[:, :n_real] = True
 
-    from ..ops import solver as solver_mod  # late import keeps jax optional
-
     # float64 when x64 is enabled (parity tests: bit-identical to the host's
     # Python floats); float32 on default TPU configs (documented deviation:
     # score ties may break differently than the f64 host oracle).

@@ -99,7 +99,15 @@ class SchedulerCache(Cache):
             if ti.node_name not in self.nodes:
                 self.nodes[ti.node_name] = NodeInfo(None)
                 self.nodes[ti.node_name].name = ti.node_name
-            self.nodes[ti.node_name].add_task(ti)
+            try:
+                self.nodes[ti.node_name].add_task(ti)
+            except ValueError as exc:
+                # Informer truth can transiently overcommit a node; the
+                # reference logs and tolerates (event_handlers.go AddPod),
+                # letting OutOfSync detection exclude the node if accounting
+                # stays inconsistent.
+                self.events.append(("FailedAddTask", pod_key(ti.pod),
+                                    str(exc)))
 
     def _delete_task(self, ti: _TaskInfo) -> None:
         job = self.jobs.get(ti.job)

@@ -317,3 +317,83 @@ class TestSolverEquivalence:
         placed = np.asarray(fast.kind) > 0
         assert np.array_equal(np.argsort(fo[placed], kind="stable"),
                               np.argsort(so[placed], kind="stable"))
+
+
+class TestParityReleasing:
+    def test_pipeline_onto_releasing(self):
+        # A terminating pod (deletionTimestamp set -> Releasing) holds Idle
+        # but frees Releasing capacity: a pending task that fits only the
+        # releasing share must be Pipelined (session-only), not bound —
+        # identically on both paths.
+        spec = dict(
+            queues=[("q1", 1)],
+            pod_groups=[("old", "ns", 1, "q1"), ("new", "ns", 1, "q1")],
+            pods=[("ns", "dying", "n1", "Running", "3", "3G", "old"),
+                  ("ns", "fresh", "", "Pending", "3", "3G", "new")],
+            nodes=[("n1", "4", "8G")])
+
+        def run(action_cls):
+            cache, binder = build_cache(spec)
+            job = cache.jobs["ns/old"]
+            task = list(job.tasks.values())[0]
+            task.pod.metadata.deletion_timestamp = 1.0
+            # Re-ingest so the cache sees Releasing status.
+            cache.update_pod(task.pod, task.pod)
+            _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+            ssn = open_session(cache, tiers)
+            try:
+                action_cls().execute(ssn)
+                from kube_batch_tpu.api import TaskStatus
+                new_job = ssn.jobs["ns/new"]
+                pipelined = len(new_job.task_status_index.get(
+                    TaskStatus.Pipelined, {}))
+            finally:
+                close_session(ssn)
+            return binder.binds, pipelined
+
+        host_binds, host_pipelined = run(AllocateAction)
+        tpu_binds, tpu_pipelined = run(TpuAllocateAction)
+        assert host_binds == tpu_binds == {}  # pipelined, never bound
+        assert host_pipelined == tpu_pipelined == 1
+
+    @pytest.mark.parametrize("seed", [20, 21, 22])
+    def test_random_with_releasing(self, seed):
+        rng = random.Random(seed)
+        queues = [("q0", 1), ("q1", 2)]
+        pod_groups, pods = [], []
+        nodes = [(f"n{i}", "8", "16Gi") for i in range(3)]
+        for j in range(6):
+            queue = f"q{rng.randrange(2)}"
+            size = rng.randint(1, 4)
+            pod_groups.append((f"pg{j}", "ns", rng.randint(1, size), queue))
+            for i in range(size):
+                state = rng.random()
+                if state < 0.25:
+                    pods.append(("ns", f"j{j}-p{i}", f"n{rng.randrange(3)}",
+                                 "Running", str(rng.choice([1, 2])),
+                                 f"{rng.choice([1, 2])}Gi", f"pg{j}"))
+                else:
+                    pods.append(("ns", f"j{j}-p{i}", "", "Pending",
+                                 str(rng.choice([1, 2])),
+                                 f"{rng.choice([1, 2])}Gi", f"pg{j}"))
+        spec = dict(queues=queues, pod_groups=pod_groups, pods=pods,
+                    nodes=nodes)
+
+        def run(action_cls):
+            cache, binder = build_cache(spec)
+            # Mark ~40% of running pods terminating (Releasing).
+            rng2 = random.Random(seed + 1000)
+            for job in cache.jobs.values():
+                for task in list(job.tasks.values()):
+                    if task.pod.spec.node_name and rng2.random() < 0.4:
+                        task.pod.metadata.deletion_timestamp = 1.0
+                        cache.update_pod(task.pod, task.pod)
+            _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+            ssn = open_session(cache, tiers)
+            try:
+                action_cls().execute(ssn)
+            finally:
+                close_session(ssn)
+            return binder.binds
+
+        assert run(TpuAllocateAction) == run(AllocateAction)

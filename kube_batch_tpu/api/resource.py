@@ -23,30 +23,61 @@ MIN_MEMORY = 10.0 * 1024 * 1024
 GPU_RESOURCE_NAME = "nvidia.com/gpu"
 TPU_RESOURCE_NAME = "google.com/tpu"
 
-_QUANTITY_RE = re.compile(r"^([0-9.]+)([a-zA-Z]*)$")
+_QUANTITY_RE = re.compile(
+    r"^([+-]?(?:[0-9]+(?:\.[0-9]*)?|\.[0-9]+))([a-zA-Z][a-zA-Z0-9+-]*)?$")
 
 _SUFFIX = {
     "": 1.0,
-    "m": 1e-3,
+    "n": 1e-9, "u": 1e-6, "m": 1e-3,
     "k": 1e3, "K": 1e3, "Ki": 1024.0,
     "M": 1e6, "Mi": 1024.0 ** 2,
     "G": 1e9, "Gi": 1024.0 ** 3,
     "T": 1e12, "Ti": 1024.0 ** 4,
     "P": 1e15, "Pi": 1024.0 ** 5,
+    "E": 1e18, "Ei": 1024.0 ** 6,
 }
 
 
 def parse_quantity(q) -> float:
-    """Parse a Kubernetes-style quantity ("250m", "1Gi", 2, 1.5) to a float."""
+    """Parse a Kubernetes-style quantity to a float.
+
+    Accepts the full legal quantity grammar (apimachinery resource.Quantity):
+    plain numbers ("2", 1.5), signs ("-1"), SI/binary suffixes from "n" up to
+    "Ei" ("250m", "1Gi"), and decimal-exponent notation ("1e3", "12E2").
+    """
     if isinstance(q, (int, float)):
         return float(q)
     m = _QUANTITY_RE.match(str(q).strip())
     if not m:
         raise ValueError(f"invalid quantity: {q!r}")
     value, suffix = m.groups()
+    suffix = suffix or ""
+    # Exponent form: "e"/"E" followed by a (signed) integer. A bare "E" is
+    # the exa suffix, not an exponent.
+    if len(suffix) > 1 and suffix[0] in "eE":
+        exp = suffix[1:]
+        if exp[0] in "+-":
+            exp = exp[1:]
+        if exp.isdigit():
+            return float(value + suffix)
     if suffix not in _SUFFIX:
         raise ValueError(f"invalid quantity suffix: {q!r}")
     return float(value) * _SUFFIX[suffix]
+
+
+def is_scalar_resource_name(name: str) -> bool:
+    """IsScalarResourceName analog (k8s.io v1helper helpers.go:100-104, cited
+    by the reference's NewResource at resource_info.go:84): extended resources
+    ('/'-qualified, outside *kubernetes.io/, not "requests."-prefixed),
+    hugepages-*, *kubernetes.io/-prefixed native names, and
+    attachable-volumes-*.  Anything else (e.g. ephemeral-storage) is NOT a
+    fit-relevant scalar dimension."""
+    if name.startswith("hugepages-") or name.startswith("attachable-volumes-"):
+        return True
+    if "kubernetes.io/" in name:  # IsPrefixedNativeResource: *kubernetes.io/
+        return True
+    return ("/" in name  # extended: qualified, non-native, not quota-form
+            and not name.startswith("requests."))
 
 
 class Resource:
@@ -88,7 +119,7 @@ class Resource:
                 r.memory += v
             elif name == "pods":
                 r.max_task_num += int(v)
-            else:
+            elif is_scalar_resource_name(name):
                 r.scalar_resources[name] = r.scalar_resources.get(name, 0.0) + v * 1000.0
         return r
 

@@ -95,6 +95,11 @@ class SchedulerCache(Cache):
         job = self._get_or_create_job(ti)
         if job is not None:
             job.add_task_info(ti)
+        # Terminated pods no longer hold node resources: the reference's
+        # addTask only does node accounting for live tasks
+        # (event_handlers.go:86 isTerminated gate).
+        if ti.status in (TaskStatus.Succeeded, TaskStatus.Failed):
+            return
         if ti.node_name:
             if ti.node_name not in self.nodes:
                 self.nodes[ti.node_name] = NodeInfo(None)
@@ -124,18 +129,36 @@ class SchedulerCache(Cache):
             except KeyError:
                 pass
 
+    def _task_info(self, pod: Pod) -> Optional[_TaskInfo]:
+        """Build a TaskInfo, tolerating malformed resource quantities: one
+        bad pod must not crash the informer callback (it is recorded as an
+        event and skipped, like the reference logs-and-continues)."""
+        try:
+            return _TaskInfo(pod)
+        except ValueError as exc:
+            self.events.append(("FailedParsePod", pod_key(pod), str(exc)))
+            return None
+
     def add_pod(self, pod: Pod) -> None:
         with self.mutex:
-            self._add_task(_TaskInfo(pod))
+            ti = self._task_info(pod)
+            if ti is not None:
+                self._add_task(ti)
 
     def update_pod(self, old_pod: Pod, new_pod: Pod) -> None:
         with self.mutex:
-            self._delete_task(_TaskInfo(old_pod))
-            self._add_task(_TaskInfo(new_pod))
+            old_ti = self._task_info(old_pod)
+            if old_ti is not None:
+                self._delete_task(old_ti)
+            ti = self._task_info(new_pod)
+            if ti is not None:
+                self._add_task(ti)
 
     def delete_pod(self, pod: Pod) -> None:
         with self.mutex:
-            self._delete_task(_TaskInfo(pod))
+            ti = self._task_info(pod)
+            if ti is not None:
+                self._delete_task(ti)
 
     def sync_task(self, old_task: TaskInfo, cluster_pod: Optional[Pod]) -> None:
         """Refetch ground truth for a task whose effect failed
@@ -143,7 +166,9 @@ class SchedulerCache(Cache):
         with self.mutex:
             self._delete_task(old_task)
             if cluster_pod is not None:
-                self._add_task(_TaskInfo(cluster_pod))
+                ti = self._task_info(cluster_pod)
+                if ti is not None:
+                    self._add_task(ti)
 
     # ------------------------------------------------------------------
     # node ingestion (event_handlers.go:296-365)

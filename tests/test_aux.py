@@ -205,3 +205,30 @@ class TestVolumeBinding:
         cache = new_scheduler_cache(cluster)
         Scheduler(cache, schedule_period=3600).run_once()
         assert cluster.pods["ns/p0"].spec.node_name == ""
+
+
+class TestIngestRobustness:
+    def test_terminated_pod_skips_node_accounting(self):
+        # event_handlers.go:86 isTerminated gate: a Succeeded/Failed pod
+        # still on a node must not consume node resources.
+        cache, _, _ = fresh_cache()
+        cache.add_node(build_node("n1", build_resource_list("4", "8Gi",
+                                                            pods=10)))
+        cache.add_pod(build_pod("ns", "done", "n1", "Succeeded",
+                                build_resource_list("2", "4Gi"), "pg"))
+        node = cache.nodes["n1"]
+        assert node.idle.milli_cpu == 4000.0
+        assert not node.tasks  # keyed by pod_key "ns/done"; must be absent
+        # Delete of the terminated pod stays tolerant (no KeyError).
+        cache.delete_pod(build_pod("ns", "done", "n1", "Succeeded",
+                                   build_resource_list("2", "4Gi"), "pg"))
+
+    def test_malformed_quantity_does_not_crash_informer(self):
+        cache, _, _ = fresh_cache()
+        cache.add_pod(build_pod("ns", "bad", "", "Pending",
+                                build_resource_list("not-a-cpu", "1Gi"),
+                                "pg"))
+        # job.tasks is keyed by pod uid (build_pod sets "ns-bad").
+        assert all("ns-bad" != uid for j in cache.jobs.values()
+                   for uid in j.tasks)
+        assert any(e[0] == "FailedParsePod" for e in cache.events)

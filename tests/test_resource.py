@@ -25,6 +25,19 @@ class TestParseQuantity:
         with pytest.raises(ValueError):
             parse_quantity("1Qx")
 
+    def test_full_grammar(self):
+        # Exponent notation, sub-milli suffixes, signs — all legal
+        # apimachinery quantity forms.
+        assert parse_quantity("1e3") == 1000.0
+        assert parse_quantity("12E2") == 1200.0
+        assert parse_quantity("1e-3") == 0.001
+        assert parse_quantity("1E") == 1e18  # bare E is exa, not exponent
+        assert parse_quantity("100n") == pytest.approx(1e-7)
+        assert parse_quantity("5u") == pytest.approx(5e-6)
+        assert parse_quantity("-1") == -1.0
+        assert parse_quantity("+2.5Gi") == 2.5 * 1024 ** 3
+        assert parse_quantity(".5") == 0.5
+
 
 class TestFromResourceList:
     def test_units(self):
@@ -38,6 +51,19 @@ class TestFromResourceList:
     def test_milli_cpu(self):
         r = Resource.from_resource_list({"cpu": "250m", "memory": "100Mi"})
         assert r.milli_cpu == 250.0
+
+    def test_scalar_name_filter(self):
+        # Only IsScalarResourceName names become fit-relevant dimensions
+        # (resource_info.go:84): extended '/'-qualified or hugepages-*.
+        r = Resource.from_resource_list(
+            {"cpu": "1", "memory": "1Gi", "ephemeral-storage": "10Gi",
+             "requests.example.com/gpu": 1,
+             "hugepages-2Mi": "4Mi", "example.com/fpga": 2,
+             "kubernetes.io/batteries": 1,
+             "attachable-volumes-aws-ebs": 39})
+        assert set(r.scalar_resources) == {
+            "hugepages-2Mi", "example.com/fpga", "kubernetes.io/batteries",
+            "attachable-volumes-aws-ebs"}
 
 
 class TestArithmetic:

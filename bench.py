@@ -34,7 +34,19 @@ def main():
     # np.asarray forces device completion + transfer; block_until_ready is
     # not reliable on the experimental axon TPU tunnel.
     warm = best_solve_allocate(inputs, config)
-    placed = int((np.asarray(warm.assignment) >= 0).sum())
+    assignment = np.asarray(warm.assignment)
+    placed = int((assignment >= 0).sum())
+
+    # Placement parity on the real backend: the fast path (Pallas on TPU)
+    # must match the XLA two-level solver exactly — guards Mosaic argmax /
+    # rounding quirks shipping silently (VERDICT r1 weak #5).
+    import jax as _jax
+    parity = None  # null when the check does not apply (non-TPU backend)
+    if _jax.default_backend() == "tpu":
+        from kube_batch_tpu.ops.solver import solve_allocate
+        xla = np.asarray(solve_allocate(inputs, config).assignment)
+        parity = bool(np.array_equal(assignment, xla))
+        assert parity, "pallas vs XLA placement mismatch on TPU"
 
     runs = []
     for _ in range(3):
@@ -45,13 +57,16 @@ def main():
     value = min(runs)
     assert placed > 0, "solver placed nothing"
 
-    baseline_ms = 1000.0  # north-star target per session
+    baseline_ms = 1000.0  # north-star TARGET per session (BASELINE.md
+    # publishes no measured reference numbers, so vs_baseline is
+    # target-relative, not reference-relative)
     print(json.dumps({
         "metric": f"sched-session solve latency @ {n_tasks} tasks x "
                   f"{n_nodes} nodes (gang+DRF+proportion)",
         "value": round(value, 2),
         "unit": "ms",
         "vs_baseline": round(baseline_ms / value, 3),
+        "parity": parity,
     }))
 
 

@@ -19,7 +19,7 @@ def make_synthetic_inputs(n_tasks: int = 1000, n_nodes: int = 100,
     {0.25..4} cpu / {0.25..8}Gi, jobs striped over queues, minAvailable set
     for a fraction of jobs (gangs)."""
     import jax.numpy as jnp
-    from ..ops.resources import eps_vector, scalar_dims_mask
+    from ..ops.resources import eps_vector, scalar_dims_mask, score_shift_for
     from ..ops.scoring import ScoreWeights
     from ..ops.solver import SolverConfig, SolverInputs
     from .tensor_snapshot import bucket
@@ -33,21 +33,21 @@ def make_synthetic_inputs(n_tasks: int = 1000, n_nodes: int = 100,
     p_pad, n_pad = bucket(n_tasks), bucket(n_nodes)
     j_pad, q_pad = bucket(n_jobs), bucket(max(n_queues, 1))
 
-    # nodes: 16 cpu / 64Gi each
-    node_alloc = np.zeros((n_pad, r), f)
-    node_alloc[:n_nodes, 0] = 16000.0
-    node_alloc[:n_nodes, 1] = 64.0 * 1024**3
+    # nodes: 16 cpu / 64Gi each (quantized units: milli-cpu, MiB)
+    node_alloc = np.zeros((n_pad, r), np.int32)
+    node_alloc[:n_nodes, 0] = 16000
+    node_alloc[:n_nodes, 1] = 64 * 1024
     node_idle = node_alloc.copy()
     node_exists = np.zeros((n_pad,), bool)
     node_exists[:n_nodes] = True
 
     # tasks -> jobs round-robin-ish with contiguous blocks
     job_of_task = np.sort(rng.integers(0, n_jobs, size=n_tasks))
-    task_req = np.zeros((p_pad, r), f)
+    task_req = np.zeros((p_pad, r), np.int32)
     task_req[:n_tasks, 0] = rng.choice([250, 500, 1000, 2000, 4000],
-                                       size=n_tasks).astype(f)
-    task_req[:n_tasks, 1] = rng.choice([0.25, 0.5, 1, 2, 4, 8],
-                                       size=n_tasks).astype(f) * 1024**3
+                                       size=n_tasks)
+    task_req[:n_tasks, 1] = (rng.choice([0.25, 0.5, 1, 2, 4, 8],
+                                        size=n_tasks) * 1024).astype(np.int32)
 
     job_start = np.zeros((j_pad,), np.int32)
     job_count = np.zeros((j_pad,), np.int32)
@@ -68,19 +68,24 @@ def make_synthetic_inputs(n_tasks: int = 1000, n_nodes: int = 100,
     queue_exists = np.zeros((q_pad,), bool)
     queue_exists[:n_queues] = True
 
-    total = node_alloc[:n_nodes].sum(axis=0)
+    total = node_alloc[:n_nodes].sum(axis=0, dtype=np.int64)
 
     # proportion water-fill on host numpy (tiny), mirroring the plugin
     request = np.zeros((q_pad, r), f)
     for j in range(n_jobs):
         request[job_queue[j]] += task_req[job_start[j]:job_start[j]
                                           + job_count[j]].sum(axis=0)
-    deserved = _waterfill(total, queue_weight, request, queue_exists)
+    # Clip before narrowing: at extreme scales a queue's deserved approaches
+    # the cluster total, which can exceed int32 (the real tensorize path
+    # falls back instead; a saturated synthetic bench stays well-formed).
+    deserved = np.clip(np.rint(_waterfill(total.astype(f), queue_weight,
+                                          request, queue_exists)),
+                       0, np.iinfo(np.int32).max).astype(np.int32)
 
     dev = lambda x, dt=None: jnp.asarray(x, dtype=dt or (dtype if x.dtype == f
                                                          else None))
     inputs = SolverInputs(
-        task_req=dev(task_req), task_res=dev(task_req),
+        task_req=jnp.asarray(task_req), task_res=jnp.asarray(task_req),
         task_sig=jnp.zeros((p_pad,), jnp.int32),
         task_sorted=jnp.arange(p_pad, dtype=jnp.int32),
         job_start=jnp.asarray(job_start), job_count=jnp.asarray(job_count),
@@ -89,23 +94,26 @@ def make_synthetic_inputs(n_tasks: int = 1000, n_nodes: int = 100,
         job_ts=dev(np.arange(j_pad, dtype=f)),
         job_uid_rank=dev(np.arange(j_pad, dtype=f)),
         job_init_ready=jnp.zeros((j_pad,), jnp.int32),
-        job_init_alloc=dev(np.zeros((j_pad, r), f)),
-        queue_deserved=dev(deserved),
-        queue_init_alloc=dev(np.zeros((q_pad, r), f)),
+        job_init_alloc=jnp.zeros((j_pad, r), jnp.int32),
+        queue_deserved=jnp.asarray(deserved),
+        queue_init_alloc=jnp.zeros((q_pad, r), jnp.int32),
         queue_ts=dev(np.arange(q_pad, dtype=f)),
         queue_uid_rank=dev(np.arange(q_pad, dtype=f)),
         queue_exists=jnp.asarray(queue_exists),
-        node_idle=dev(node_idle),
-        node_releasing=dev(np.zeros((n_pad, r), f)),
-        node_used=dev(np.zeros((n_pad, r), f)),
-        node_alloc=dev(node_alloc),
+        node_idle=jnp.asarray(node_idle),
+        node_releasing=jnp.zeros((n_pad, r), jnp.int32),
+        node_used=jnp.zeros((n_pad, r), jnp.int32),
+        node_alloc=jnp.asarray(node_alloc),
         node_count=jnp.zeros((n_pad,), jnp.int32),
         node_max_tasks=jnp.full((n_pad,), 1 << 30, jnp.int32),
         node_exists=jnp.asarray(node_exists),
         sig_mask=jnp.asarray(np.ones((1, n_pad), bool) & node_exists[None, :]),
-        total_res=dev(total),
-        eps=eps_vector(r, dtype),
-        scalar_dims=scalar_dims_mask(r))
+        total_res=jnp.asarray(total.astype(np.float64), dtype=dtype),
+        eps=eps_vector(r),
+        scalar_dims=scalar_dims_mask(r),
+        score_shift=jnp.asarray(
+            [score_shift_for(int(node_alloc[:, d].max())) for d in range(2)],
+            jnp.int32))
     config = SolverConfig()
     return inputs, config
 
@@ -130,6 +138,6 @@ def _waterfill(total, weight, request, active):
                 met[i] = True
             inc += deserved[i] - old
         remaining = remaining - inc
-        if np.all(remaining < np.array([10.0, 10 * 1024 * 1024])):
+        if np.all(remaining < 10.0):  # eps = 10 quanta on every dim
             break
     return deserved

@@ -105,7 +105,8 @@ def tensorize_session(ssn) -> TensorSnapshot:
     """Flatten the session into SolverInputs (cpu-staged numpy; device put
     happens in the action)."""
     import jax.numpy as jnp
-    from ..ops.resources import eps_vector, scalar_dims_mask
+    from ..ops.resources import (EPS_QUANTA, quantize_columns,
+                                 score_shift_for)
     from ..ops.scoring import ScoreWeights
     from ..ops.solver import SolverConfig, SolverInputs
 
@@ -145,8 +146,19 @@ def tensorize_session(ssn) -> TensorSnapshot:
                 w_least += w["leastrequested"]
                 w_most += w["mostrequested"]
                 w_balanced += w["balancedresource"]
-    weights = ScoreWeights(least_requested=w_least, most_requested=w_most,
-                           balanced_resource=w_balanced)
+    if any(w != int(w) for w in (w_least, w_most, w_balanced)):
+        # Grid scoring combines integer weights exactly; fractional weights
+        # would need float score sums with platform-dependent rounding.
+        snap.fallback_reason = "fractional nodeorder weights"
+        return snap
+    weights = ScoreWeights(least_requested=int(w_least),
+                           most_requested=int(w_most),
+                           balanced_resource=int(w_balanced))
+    from ..ops.scoring import max_weight_sum
+    from ..ops.resources import SCORE_GRID_K
+    if max_weight_sum(weights) * 10 * SCORE_GRID_K > np.iinfo(np.int32).max:
+        snap.fallback_reason = "nodeorder weights overflow int32 scores"
+        return snap
 
     axis = _resource_axis(ssn)
     snap.resource_names = axis
@@ -212,8 +224,6 @@ def tensorize_session(ssn) -> TensorSnapshot:
             if qid in queue_index:
                 queue_deserved[queue_index[qid]] = _vec(attr.deserved, axis)
                 queue_alloc[queue_index[qid]] = _vec(attr.allocated, axis)
-    total_res = np.sum(node_alloc[:n_real], axis=0) if n_real else \
-        np.zeros((r,), _F)
 
     # ---- jobs + candidate tasks ------------------------------------------
     job_uids = sorted(ssn.jobs)
@@ -337,9 +347,11 @@ def tensorize_session(ssn) -> TensorSnapshot:
     if not sig_examples:
         sig_mask[:, :n_real] = True
 
-    # float64 when x64 is enabled (parity tests: bit-identical to the host's
-    # Python floats); float32 on default TPU configs (documented deviation:
-    # score ties may break differently than the f64 host oracle).
+    # Resource tensors quantize to int32 fixed point (ops/resources.py:
+    # milli-cpu / MiB / milli-scalar, every epsilon exactly 10 quanta) so
+    # device accounting is exact integer math without jax_enable_x64.
+    # Float keys (ts/prio/rank) and total_res stay float: f64 with x64 for
+    # bit-identical share math in the parity suite, f32 otherwise.
     dtype = jnp.asarray(np.float64(1.0)).dtype
 
     np_dtype = np.float64 if dtype == jnp.float64 else np.float32
@@ -356,28 +368,48 @@ def tensorize_session(ssn) -> TensorSnapshot:
             x = np.ascontiguousarray(x, dtype=_np_of.get(dt, dt))
         return x
 
+    quantized = [quantize_columns(a) for a in
+                 (task_req, task_res, node_idle, node_rel, node_used,
+                  node_alloc, job_init_alloc, queue_deserved, queue_alloc)]
+    hi = max((int(np.abs(a).max()) if a.size else 0) for a in quantized)
+    # Accumulation bound: queue/job alloc grows by at most the sum of all
+    # candidate requests plus what is already allocated.
+    acc = int(np.abs(quantized[1]).sum(axis=0).max()
+              + np.abs(quantized[6]).sum(axis=0).max()
+              + np.abs(quantized[8]).sum(axis=0).max())
+    if max(hi, acc) > np.iinfo(np.int32).max:
+        snap.fallback_reason = "resource magnitude overflows int32 quanta"
+        return snap
+    (task_req_q, task_res_q, node_idle_q, node_rel_q, node_used_q,
+     node_alloc_q, job_init_alloc_q, queue_deserved_q, queue_alloc_q) = (
+        np.ascontiguousarray(a, dtype=np.int32) for a in quantized)
+    total_res_q = node_alloc_q[:n_real].sum(axis=0, dtype=np.int64) \
+        if n_real else np.zeros((r,), np.int64)
+
     snap.inputs = SolverInputs(
-        task_req=dev(task_req), task_res=dev(task_res),
+        task_req=task_req_q, task_res=task_res_q,
         task_sig=dev(task_sig, jnp.int32), task_sorted=dev(task_sorted, jnp.int32),
         job_start=dev(job_start, jnp.int32), job_count=dev(job_count, jnp.int32),
         job_queue=dev(job_queue, jnp.int32),
         job_minavail=dev(job_minavail, jnp.int32),
         job_prio=dev(job_prio), job_ts=dev(job_ts), job_uid_rank=dev(job_rank),
         job_init_ready=dev(job_init_ready, jnp.int32),
-        job_init_alloc=dev(job_init_alloc),
-        queue_deserved=dev(queue_deserved), queue_init_alloc=dev(queue_alloc),
+        job_init_alloc=job_init_alloc_q,
+        queue_deserved=queue_deserved_q, queue_init_alloc=queue_alloc_q,
         queue_ts=dev(queue_ts), queue_uid_rank=dev(queue_rank),
         queue_exists=dev(queue_exists, bool),
-        node_idle=dev(node_idle), node_releasing=dev(node_rel),
-        node_used=dev(node_used), node_alloc=dev(node_alloc),
+        node_idle=node_idle_q, node_releasing=node_rel_q,
+        node_used=node_used_q, node_alloc=node_alloc_q,
         node_count=dev(node_count, jnp.int32),
         node_max_tasks=dev(node_max, jnp.int32),
         node_exists=dev(node_exists, bool),
         sig_mask=dev(sig_mask, bool),
-        total_res=dev(total_res),
-        eps=np.asarray([10.0, 10.0 * 1024 * 1024] + [10.0] * (r - 2),
-                       dtype=np_dtype),
-        scalar_dims=np.asarray([False, False] + [True] * (r - 2)))
+        total_res=np.ascontiguousarray(total_res_q, dtype=np_dtype),
+        eps=np.full((r,), EPS_QUANTA, dtype=np.int32),
+        scalar_dims=np.asarray([False, False] + [True] * (r - 2)),
+        score_shift=np.asarray(
+            [score_shift_for(int(node_alloc_q[:, d].max()) if n_real else 0)
+             for d in range(2)], dtype=np.int32))
     snap.config = SolverConfig(
         job_key_order=tuple(enabled_job_order),
         queue_key_order=tuple(enabled_queue_order),

@@ -11,15 +11,17 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .resources import EPS_VEC_FN, is_empty_vec, less_vec
+from .resources import EPS_VEC_FN, is_empty_vec, less_vec, scalar_dims_mask
 
 
 def safe_share(alloc: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
     """share() semantics per element: x/0 -> 1 (0/0 -> 0)
-    (reference api/helpers/helpers.go:47-59)."""
+    (reference api/helpers/helpers.go:47-59).  Accepts int32 quanta (the
+    solver's exact fixed-point state): true division promotes to float, and
+    power-of-two quantization keeps the ratio equal to the unscaled one."""
     zero_total = total == 0
     return jnp.where(zero_total, jnp.where(alloc == 0, 0.0, 1.0),
-                     alloc / jnp.where(zero_total, 1.0, total))
+                     alloc / jnp.where(zero_total, 1, total))
 
 
 def drf_shares(job_alloc: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
@@ -43,9 +45,16 @@ def proportion_deserved(total: jnp.ndarray, weight: jnp.ndarray,
     Mirrors proportion.go:101-154: each round splits ``remaining`` by weight
     among unmet queues, caps a queue at its request (then it is 'met' and its
     surplus returns to the pool), and stops when remaining is epsilon-empty
-    or every queue is met.
+    or every queue is met.  Inputs may be int32 quanta; the fill itself is
+    float (weight splits are fractional) and the result is returned as float
+    quanta — callers round before feeding the int compare paths.
     """
-    eps = EPS_VEC_FN(total.shape[-1], total.dtype)
+    fdt = jnp.promote_types(total.dtype, jnp.float32)
+    total = total.astype(fdt)
+    weight = weight.astype(fdt)
+    request = request.astype(fdt)
+    eps = EPS_VEC_FN(total.shape[-1], fdt)
+    scalar_dims = scalar_dims_mask(total.shape[-1])
     q = weight.shape[0]
 
     def cond(state):
@@ -61,7 +70,7 @@ def proportion_deserved(total: jnp.ndarray, weight: jnp.ndarray,
         frac = jnp.where(live, weight, 0.0) / jnp.maximum(total_weight, 1e-30)
         proposed = deserved + frac[:, None] * remaining[None, :]
         # Queue met when request < proposed (strict Resource.Less).
-        newly_met = live & less_vec(request, proposed, eps)
+        newly_met = live & less_vec(request, proposed, eps, scalar_dims)
         capped = jnp.where(newly_met[:, None], jnp.minimum(proposed, request),
                            proposed)
         new_deserved = jnp.where(live[:, None], capped, deserved)

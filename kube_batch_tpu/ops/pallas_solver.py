@@ -9,22 +9,30 @@ Two levels of kernelization over ops/solver.py's two-level XLA solver:
   vector ops over [rows, N] node state resident in VMEM), and a queue/job
   pop costs vector ops over [1, J]/[1, Q] rows.
 
-State layout (all float rows, padded to sublane multiples of 8):
+State layout (rows padded to sublane multiples of 8):
 
-  node_buf [NROWS, N]: idle[0:R], releasing[R:2R], used[2R:3R], count,
-      pod cap, exists flag, 1/alloc(cpu,mem), alloc==0 flags(cpu,mem)
-  job_sta  [8, J]: start, count, queue, minavail, priority, ts, uid_rank
-  job_dyn  [R+3 -> 8, J]: drf alloc rows, ptr, ready_cnt, active
-  que_sta  [R+3 -> 8, Q]: deserved rows, ts, uid_rank, exists
-  que_dyn  [R+1 -> 8, Q]: alloc rows, active
+  node_int [3R+3 -> pad8, N] i32: idle[0:R], releasing[R:2R], used[2R:3R],
+      count, pod cap, exists flag — ALL resource state is int32 quanta
+      (ops/resources.py), so every add/subtract and epsilon compare in the
+      loop is exact integer math (f32 rows would drift past 2**24).
+  node_cs  [2 -> 8, N] i32: shift-normalized cpu/mem capacities for the
+      integer-grid scorer (ops/scoring.py; shifts ride scal_ref SMEM).
+  job_sta  [8, J] float: start, count, queue, minavail, priority, ts,
+      uid_rank (ints here stay < 2**24, exact in f32)
+  job_dyn  [R+3 -> pad8, J] i32: drf alloc rows, ptr, ready_cnt, active
+  que_des  [R -> pad8, Q] i32: proportion deserved (exact for the
+      epsilon-overused compare)
+  que_sta  [8, Q] float: ts, uid_rank, exists
+  que_dyn  [R+1 -> pad8, Q] i32: alloc rows, active
 
 Placement updates are rank-1 (delta-column ⊗ one-hot) adds.  Ties break
 first-in-order everywhere (Mosaic's argmax picks the LAST max, so argmax is
-implemented as max + min-index-where-equal).
+implemented as max + min-index-where-equal).  Shares/scores convert the
+exact ints to float only at the division.
 
 Semantics match ops/solver.solve_allocate placement-for-placement;
 cross-validated by tests/test_pallas_solver.py (interpreter mode) and on
-real TPU by bench.py.
+real TPU by bench.py's parity assert.
 """
 
 from __future__ import annotations
@@ -37,7 +45,8 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..api.resource import MIN_MEMORY, MIN_MILLI_CPU, MIN_MILLI_SCALAR
+from .resources import EPS_QUANTA, SCORE_GRID_K
+from .scoring import SCORE_NEG_INF
 from .solver import SolveResult, SolverConfig, SolverInputs
 
 
@@ -45,29 +54,19 @@ def _pad8(x: int) -> int:
     return ((x + 7) // 8) * 8
 
 
-def _eps_for_dim(i: int) -> float:
-    return (MIN_MILLI_CPU, MIN_MEMORY)[i] if i < 2 else MIN_MILLI_SCALAR
-
-
-def _first_min_index(mask, values, col_ids, size):
-    """Index of the first masked minimum (lexicographic building block)."""
-    kv = jnp.where(mask, values, jnp.inf)
-    m = mask & (kv == jnp.min(kv))
-    return m
-
-
 def _solve_kernel(r: int, cfg: SolverConfig,
                   scal_ref, total_ref, task_ref, sig_ref, sig_mask_ref,
-                  node_in, out_in, jdyn_in, qdyn_in, jsta_ref, qsta_ref,
-                  node_ref, out_ref, jdyn_ref, qdyn_ref, scal_out_ref):
+                  nint_in, ncs_ref, out_in, jdyn_in, qdyn_in,
+                  jsta_ref, qsta_ref, qdes_ref,
+                  nint_ref, out_ref, jdyn_ref, qdyn_ref, scal_out_ref):
     """One kernel = one full session solve.  scal_ref (SMEM [1,8] i32):
-    [0]=P.  total_ref (SMEM [1,R] float): cluster totals (DRF denominator).
-    The *_in refs are aliased input views of the corresponding output refs."""
-    n = node_ref.shape[1]
+    [0]=P, [2]=cpu grid shift, [3]=mem grid shift.  total_ref (SMEM [1,R]
+    float): cluster totals (DRF denominator).  The *_in refs are aliased
+    input views of the corresponding output refs."""
+    n = nint_ref.shape[1]
     jdim = jsta_ref.shape[1]
     qdim = qsta_ref.shape[1]
-    nrows = node_ref.shape[0]
-    dtype = node_ref.dtype
+    dtype = jsta_ref.dtype            # float dtype for keys/scores
     inf = jnp.asarray(jnp.inf, dtype)
     neg_inf = -inf
 
@@ -75,28 +74,34 @@ def _solve_kernel(r: int, cfg: SolverConfig,
     col_j = jax.lax.broadcasted_iota(jnp.int32, (1, jdim), 1)
     col_q = jax.lax.broadcasted_iota(jnp.int32, (1, qdim), 1)
 
-    # node_buf row indices
+    # node_int row indices
     IDLE, REL, USED = 0, r, 2 * r
     CNT, CAP, EXISTS = 3 * r, 3 * r + 1, 3 * r + 2
-    INV, ZERO = 3 * r + 3, 3 * r + 5
+    # node_cs rows: shifted cpu/mem capacities
+    CS = 0
     # job_sta rows
     JSTART, JCOUNT, JQUEUE, JMIN, JPRIO, JTS, JUID = 0, 1, 2, 3, 4, 5, 6
     # job_dyn rows: [0:r] alloc, then ptr, ready, active
     JPTR, JREADY, JACT = r, r + 1, r + 2
-    # que_sta rows: [0:r] deserved, ts, uid, exists
-    QTS, QUID = r, r + 1
+    # que_sta rows
+    QTS, QUID = 0, 1
     # que_dyn rows: [0:r] alloc, active
     QACT = r
 
-    w_least = float(cfg.weights.least_requested)
-    w_most = float(cfg.weights.most_requested)
-    w_bal = float(cfg.weights.balanced_resource)
+    w_least = int(cfg.weights.least_requested)
+    w_most = int(cfg.weights.most_requested)
+    w_bal = int(cfg.weights.balanced_resource)
+    neg_score = SCORE_NEG_INF
 
     def scalar_at(row, hot):
-        """Extract row value at the one-hot lane."""
+        """Extract row value at the one-hot lane (float rows)."""
         return jnp.sum(jnp.where(hot, row, 0.0))
 
-    def lex_first(mask, keys, col_ids):
+    def scalar_at_i(row, hot):
+        """Extract row value at the one-hot lane (int rows)."""
+        return jnp.sum(jnp.where(hot, row, 0))
+
+    def lex_first(mask, keys):
         m = mask
         for k in keys:
             kv = jnp.where(m, k, inf)
@@ -108,9 +113,10 @@ def _solve_kernel(r: int, cfg: SolverConfig,
         share = jnp.zeros((1, qdim), dtype)
         for i in range(r):
             alloc = qdyn_ref[i:i + 1, :]
-            des = qsta_ref[i:i + 1, :]
+            des = qdes_ref[i:i + 1, :]
             s = jnp.where(des == 0, jnp.where(alloc == 0, 0.0, 1.0),
-                          alloc / jnp.where(des == 0, 1.0, des))
+                          alloc.astype(dtype)
+                          / jnp.where(des == 0, 1, des).astype(dtype))
             share = jnp.maximum(share, s)
         return share
 
@@ -120,7 +126,7 @@ def _solve_kernel(r: int, cfg: SolverConfig,
             alloc = jdyn_ref[i:i + 1, :]
             t = total_ref[0, i]
             s = jnp.where(t == 0, jnp.where(alloc == 0, 0.0, 1.0),
-                          alloc / jnp.where(t == 0, 1.0, t))
+                          alloc.astype(dtype) / jnp.where(t == 0, 1.0, t))
             share = jnp.maximum(share, s)
         return share
 
@@ -128,26 +134,25 @@ def _solve_kernel(r: int, cfg: SolverConfig,
         _, step = carry
 
         # ---- queue pop (allocate.go:90-108) -------------------------------
-        q_active = qdyn_ref[QACT:QACT + 1, :] > 0.5
+        q_active = qdyn_ref[QACT:QACT + 1, :] > 0
         qkeys = []
         for name in cfg.queue_key_order:
             if name == "proportion":
                 qkeys.append(queue_share_row())
         qkeys.append(qsta_ref[QTS:QTS + 1, :])
         qkeys.append(qsta_ref[QUID:QUID + 1, :])
-        qmask = lex_first(q_active, qkeys, col_q)
+        qmask = lex_first(q_active, qkeys)
         q = jnp.min(jnp.where(qmask, col_q, qdim)).astype(jnp.int32)
         qhot = col_q == q
 
         if cfg.has_proportion:
             ou = jnp.bool_(True)
             for i in range(r):
-                e = _eps_for_dim(i)
-                des = scalar_at(qsta_ref[i:i + 1, :], qhot)
-                alc = scalar_at(qdyn_ref[i:i + 1, :], qhot)
-                oki = (des < alc) | (jnp.abs(des - alc) < e)
+                des = scalar_at_i(qdes_ref[i:i + 1, :], qhot)
+                alc = scalar_at_i(qdyn_ref[i:i + 1, :], qhot)
+                oki = (des < alc) | (jnp.abs(des - alc) < EPS_QUANTA)
                 if i >= 2:
-                    oki = oki | (des <= e)
+                    oki = oki | (des <= EPS_QUANTA)
                 ou = ou & oki
             overused = ou
         else:
@@ -155,21 +160,21 @@ def _solve_kernel(r: int, cfg: SolverConfig,
 
         # ---- job pop (tiered JobOrderFn chain) ----------------------------
         jq = jsta_ref[JQUEUE:JQUEUE + 1, :]
-        j_active = (jdyn_ref[JACT:JACT + 1, :] > 0.5) \
+        j_active = (jdyn_ref[JACT:JACT + 1, :] > 0) \
             & (jq == q.astype(dtype))
         jkeys = []
         for name in cfg.job_key_order:
             if name == "priority":
                 jkeys.append(-jsta_ref[JPRIO:JPRIO + 1, :])
             elif name == "gang":
-                ready_row = (jdyn_ref[JREADY:JREADY + 1, :]
+                ready_row = (jdyn_ref[JREADY:JREADY + 1, :].astype(dtype)
                              >= jsta_ref[JMIN:JMIN + 1, :])
                 jkeys.append(ready_row.astype(dtype))
             elif name == "drf":
                 jkeys.append(drf_share_row())
         jkeys.append(jsta_ref[JTS:JTS + 1, :])
         jkeys.append(jsta_ref[JUID:JUID + 1, :])
-        jmask = lex_first(j_active, jkeys, col_j)
+        jmask = lex_first(j_active, jkeys)
         j = jnp.min(jnp.where(jmask, col_j, jdim)).astype(jnp.int32)
         jhot = col_j == j
         has_job = j < jdim
@@ -181,8 +186,8 @@ def _solve_kernel(r: int, cfg: SolverConfig,
                             scalar_at(jsta_ref[JCOUNT:JCOUNT + 1, :], jhot)
                             ).astype(jnp.int32)
         minavail = scalar_at(jsta_ref[JMIN:JMIN + 1, :], jhot).astype(jnp.int32)
-        ptr0 = scalar_at(jdyn_ref[JPTR:JPTR + 1, :], jhot).astype(jnp.int32)
-        ready0 = scalar_at(jdyn_ref[JREADY:JREADY + 1, :], jhot).astype(jnp.int32)
+        ptr0 = scalar_at_i(jdyn_ref[JPTR:JPTR + 1, :], jhot)
+        ready0 = scalar_at_i(jdyn_ref[JREADY:JREADY + 1, :], jhot)
 
         # ---- drain the popped job (allocate.go:125-193) -------------------
         def drain_body(ic):
@@ -196,49 +201,51 @@ def _solve_kernel(r: int, cfg: SolverConfig,
             fit_idle = None
             fit_rel = None
             for i in range(r):
-                e = _eps_for_dim(i)
-                mi = node_ref[IDLE + i:IDLE + i + 1, :]
-                mr = node_ref[REL + i:REL + i + 1, :]
-                oki = (req[i] < mi) | (jnp.abs(req[i] - mi) < e)
-                okr = (req[i] < mr) | (jnp.abs(req[i] - mr) < e)
+                mi = nint_ref[IDLE + i:IDLE + i + 1, :]
+                mr = nint_ref[REL + i:REL + i + 1, :]
+                oki = (req[i] < mi) | (jnp.abs(req[i] - mi) < EPS_QUANTA)
+                okr = (req[i] < mr) | (jnp.abs(req[i] - mr) < EPS_QUANTA)
                 if i >= 2:
-                    low = req[i] <= e
+                    low = req[i] <= EPS_QUANTA
                     oki = oki | low
                     okr = okr | low
                 fit_idle = oki if fit_idle is None else (fit_idle & oki)
                 fit_rel = okr if fit_rel is None else (fit_rel & okr)
 
             sig_row = sig_mask_ref[pl.ds(sig, 1), :] > 0.5
-            cap_ok = node_ref[CNT:CNT + 1, :] < node_ref[CAP:CAP + 1, :]
-            exists = node_ref[EXISTS:EXISTS + 1, :] > 0.5
+            cap_ok = nint_ref[CNT:CNT + 1, :] < nint_ref[CAP:CAP + 1, :]
+            exists = nint_ref[EXISTS:EXISTS + 1, :] > 0
             feasible = sig_row & exists & cap_ok & (fit_idle | fit_rel)
 
-            used_cm = node_ref[USED:USED + 2, :]
-            inv = node_ref[INV:INV + 2, :]
-            zero = node_ref[ZERO:ZERO + 2, :] > 0.5
-            res_cm = jnp.concatenate(
-                [jnp.full((1, n), res[0], dtype),
-                 jnp.full((1, n), res[1], dtype)], axis=0)
-            frac = jnp.where(zero, 1.0,
-                             jnp.minimum((used_cm + res_cm) * inv, 1.0))
-            cpu_frac, mem_frac = frac[0:1, :], frac[1:2, :]
-            score = jnp.zeros((1, n), dtype)
+            # Integer grid scoring (ops/scoring.py): exact ints, identical
+            # to host and XLA paths on every platform.
+            g = []
+            for d in range(2):
+                s = scal_ref[0, 2 + d]
+                cs = ncs_ref[CS + d:CS + d + 1, :]
+                used_d = nint_ref[USED + d:USED + d + 1, :]
+                xs = jnp.minimum(
+                    jax.lax.shift_right_logical(used_d + res[d], s), cs)
+                q = ((xs * SCORE_GRID_K).astype(dtype)
+                     / jnp.maximum(cs, 1).astype(dtype)).astype(jnp.int32)
+                g.append(jnp.where(cs == 0, SCORE_GRID_K, q))
+            gc, gm = g
+            score = jnp.zeros((1, n), jnp.int32)
             if w_least:
-                score = score + w_least * 5.0 * ((1.0 - cpu_frac)
-                                                 + (1.0 - mem_frac))
+                score = score + w_least * 5 * (2 * SCORE_GRID_K - gc - gm)
             if w_most:
-                score = score + w_most * 5.0 * (cpu_frac + mem_frac)
+                score = score + w_most * 5 * (gc + gm)
             if w_bal:
-                score = score + w_bal * (10.0 - jnp.abs(cpu_frac - mem_frac)
-                                         * 10.0)
-            score = jnp.where(feasible, score, neg_inf)
+                score = score + w_bal * (10 * SCORE_GRID_K
+                                         - 10 * jnp.abs(gc - gm))
+            score = jnp.where(feasible, score, neg_score)
 
             best = jnp.max(score)
             nsel = jnp.min(jnp.where(score == best, col_n, n)).astype(jnp.int32)
-            feasible_any = best > neg_inf
+            feasible_any = best > neg_score
             onehot = col_n == nsel
             pick = lambda v: jnp.sum(
-                jnp.where(onehot, v.astype(dtype), 0.0)) > 0.5
+                jnp.where(onehot, v.astype(jnp.int32), 0)) > 0
             fit_idle_n = pick(fit_idle)
             fit_rel_n = pick(fit_rel)
 
@@ -247,18 +254,18 @@ def _solve_kernel(r: int, cfg: SolverConfig,
             pipe_ok = placing & ~fit_idle_n & fit_rel_n
             placed = alloc_ok | pipe_ok
 
-            af = jnp.where(alloc_ok, 1.0, 0.0).astype(dtype)
-            pf = jnp.where(pipe_ok, 1.0, 0.0).astype(dtype)
-            plf = jnp.where(placed, 1.0, 0.0).astype(dtype)
-            # Rank-1 update over the dynamic rows only (idle, releasing,
-            # used, count); the static rows below never change.
+            ai = alloc_ok.astype(jnp.int32)
+            pi = pipe_ok.astype(jnp.int32)
+            pli = placed.astype(jnp.int32)
+            # Rank-1 integer update over the dynamic rows only (idle,
+            # releasing, used, count); the static rows below never change.
             ndyn = 3 * r + 1
-            delta_col = [(-af * res[i]) for i in range(r)] \
-                + [(-pf * res[i]) for i in range(r)] \
-                + [(plf * res[i]) for i in range(r)] + [plf]
+            delta_col = [(-ai * res[i]) for i in range(r)] \
+                + [(-pi * res[i]) for i in range(r)] \
+                + [(pli * res[i]) for i in range(r)] + [pli]
             delta = jnp.stack(delta_col).reshape(ndyn, 1)
-            node_ref[0:ndyn, :] = node_ref[0:ndyn, :] \
-                + delta * onehot.astype(dtype)
+            nint_ref[0:ndyn, :] = nint_ref[0:ndyn, :] \
+                + delta * onehot.astype(jnp.int32)
 
             row = jnp.stack([jnp.where(placed, nsel, -1),
                              jnp.where(alloc_ok, 1,
@@ -270,10 +277,10 @@ def _solve_kernel(r: int, cfg: SolverConfig,
             def _():
                 out_ref[pl.ds(t, 1), :] = row
 
-            ptr = ptr + placed.astype(jnp.int32)
-            ready_cnt = ready_cnt + alloc_ok.astype(jnp.int32)
-            dstep = dstep + placed.astype(jnp.int32)
-            dres = dres + plf * jnp.stack(res).reshape(1, r)
+            ptr = ptr + pli
+            ready_cnt = ready_cnt + ai
+            dstep = dstep + pli
+            dres = dres + pli * jnp.stack(res).reshape(1, r)
 
             if cfg.has_gang:
                 ready = ready_cnt >= minavail
@@ -286,33 +293,31 @@ def _solve_kernel(r: int, cfg: SolverConfig,
                     ptr, ready_cnt, dstep, dres)
 
         init = (jnp.bool_(False), jnp.bool_(False), ptr0, ready0, step,
-                jnp.zeros((1, r), dtype))
+                jnp.zeros((1, r), jnp.int32))
         done, survive, ptr, ready_cnt, step, dres = jax.lax.while_loop(
             lambda c: ~c[0], drain_body, init)
 
         # ---- writeback + rotation (allocate.go:185-193) -------------------
-        processed = (~retire).astype(dtype)
-        jhot_f = jhot.astype(dtype) * processed
-        qhot_f = qhot.astype(dtype)
+        proc_i = (~retire).astype(jnp.int32)
+        jhot_i = jhot.astype(jnp.int32) * proc_i
+        qhot_i = qhot.astype(jnp.int32) * proc_i
         for i in range(r):
-            jdyn_ref[i:i + 1, :] = jdyn_ref[i:i + 1, :] + dres[0, i] * jhot_f
-            qdyn_ref[i:i + 1, :] = qdyn_ref[i:i + 1, :] \
-                + dres[0, i] * qhot_f * processed
+            jdyn_ref[i:i + 1, :] = jdyn_ref[i:i + 1, :] + dres[0, i] * jhot_i
+            qdyn_ref[i:i + 1, :] = qdyn_ref[i:i + 1, :] + dres[0, i] * qhot_i
         jdyn_ref[JPTR:JPTR + 1, :] = jnp.where(
-            jhot_f > 0.5, ptr.astype(dtype), jdyn_ref[JPTR:JPTR + 1, :])
+            jhot_i > 0, ptr, jdyn_ref[JPTR:JPTR + 1, :])
         jdyn_ref[JREADY:JREADY + 1, :] = jnp.where(
-            jhot_f > 0.5, ready_cnt.astype(dtype),
-            jdyn_ref[JREADY:JREADY + 1, :])
+            jhot_i > 0, ready_cnt, jdyn_ref[JREADY:JREADY + 1, :])
         jdyn_ref[JACT:JACT + 1, :] = jnp.where(
-            jhot_f > 0.5, jnp.where(survive, 1.0, 0.0).astype(dtype),
+            jhot_i > 0, jnp.where(survive, 1, 0),
             jdyn_ref[JACT:JACT + 1, :])
         qdyn_ref[QACT:QACT + 1, :] = jnp.where(
-            (qhot & retire), 0.0, qdyn_ref[QACT:QACT + 1, :])
+            (qhot & retire), 0, qdyn_ref[QACT:QACT + 1, :])
 
-        any_active = jnp.max(qdyn_ref[QACT:QACT + 1, :]) > 0.5
+        any_active = jnp.max(qdyn_ref[QACT:QACT + 1, :]) > 0
         return any_active, step
 
-    any0 = jnp.max(qdyn_in[QACT:QACT + 1, :]) > 0.5
+    any0 = jnp.max(qdyn_in[QACT:QACT + 1, :]) > 0
     _, total_steps = jax.lax.while_loop(
         lambda c: c[0], outer_body, (any0, scal_ref[0, 1]))
     scal_out_ref[0, 0] = total_steps
@@ -321,52 +326,54 @@ def _solve_kernel(r: int, cfg: SolverConfig,
 def _build_buffers(inp: SolverInputs):
     r = inp.task_req.shape[1]
     n = inp.node_idle.shape[0]
-    dtype = inp.task_req.dtype
-    nrows = _pad8(3 * r + 7)
+    fdt = inp.job_ts.dtype
+    ni_rows = _pad8(3 * r + 3)
 
-    alloc2 = inp.node_alloc[:, :2]
-    inv2 = jnp.where(alloc2 > 0, 1.0 / jnp.where(alloc2 > 0, alloc2, 1.0), 0.0)
-    zero2 = (alloc2 <= 0).astype(dtype)
-    parts = [inp.node_idle.T, inp.node_releasing.T, inp.node_used.T,
-             inp.node_count.astype(dtype)[None, :],
-             inp.node_max_tasks.astype(dtype)[None, :],
-             inp.node_exists.astype(dtype)[None, :],
-             inv2.T, zero2.T]
-    node_buf = jnp.concatenate(parts, axis=0)
-    node_buf = jnp.concatenate(
-        [node_buf, jnp.zeros((nrows - node_buf.shape[0], n), dtype)], axis=0)
+    i32 = lambda x: x.astype(jnp.int32)
+    cs2 = jnp.stack(
+        [jnp.right_shift(i32(inp.node_alloc[:, d]), inp.score_shift[d])
+         for d in range(2)], axis=0)
+    node_int = jnp.concatenate(
+        [i32(inp.node_idle).T, i32(inp.node_releasing).T, i32(inp.node_used).T,
+         i32(inp.node_count)[None, :], i32(inp.node_max_tasks)[None, :],
+         i32(inp.node_exists)[None, :]], axis=0)
+    node_int = jnp.concatenate(
+        [node_int, jnp.zeros((ni_rows - node_int.shape[0], n), jnp.int32)],
+        axis=0)
+    node_cs = jnp.concatenate(
+        [cs2, jnp.zeros((8 - 2, n), jnp.int32)], axis=0)
 
-    f = lambda x: x.astype(dtype)[None, :]
+    f = lambda x: x.astype(fdt)[None, :]
+    jdim = inp.job_start.shape[0]
     job_active0 = (inp.queue_exists[inp.job_queue]
-                   & (inp.job_minavail >= 0)).astype(dtype)
+                   & (inp.job_minavail >= 0)).astype(jnp.int32)
     jsta = jnp.concatenate([
         f(inp.job_start), f(inp.job_count), f(inp.job_queue),
         f(inp.job_minavail), f(inp.job_prio), f(inp.job_ts),
-        f(inp.job_uid_rank), jnp.zeros((1, inp.job_start.shape[0]), dtype)],
-        axis=0)
+        f(inp.job_uid_rank), jnp.zeros((1, jdim), fdt)], axis=0)
     jd_rows = _pad8(r + 3)
     jdyn = jnp.concatenate([
-        inp.job_init_alloc.T.astype(dtype),
-        jnp.zeros((1, inp.job_start.shape[0]), dtype),  # ptr
-        f(inp.job_init_ready),
+        i32(inp.job_init_alloc).T,
+        jnp.zeros((1, jdim), jnp.int32),  # ptr
+        i32(inp.job_init_ready)[None, :],
         job_active0[None, :],
-        jnp.zeros((jd_rows - r - 3, inp.job_start.shape[0]), dtype)], axis=0)
+        jnp.zeros((jd_rows - r - 3, jdim), jnp.int32)], axis=0)
 
     qdim = inp.queue_deserved.shape[0]
     queue_active0 = (jnp.zeros((qdim,), bool).at[inp.job_queue].set(True)
-                     & inp.queue_exists).astype(dtype)
-    qs_rows = _pad8(r + 3)
+                     & inp.queue_exists).astype(jnp.int32)
+    qdes = jnp.concatenate(
+        [i32(inp.queue_deserved).T,
+         jnp.zeros((_pad8(r) - r, qdim), jnp.int32)], axis=0)
     qsta = jnp.concatenate([
-        inp.queue_deserved.T.astype(dtype),
-        f(inp.queue_ts), f(inp.queue_uid_rank),
-        f(inp.queue_exists),
-        jnp.zeros((qs_rows - r - 3, qdim), dtype)], axis=0)
+        f(inp.queue_ts), f(inp.queue_uid_rank), f(inp.queue_exists),
+        jnp.zeros((8 - 3, qdim), fdt)], axis=0)
     qd_rows = _pad8(r + 1)
     qdyn = jnp.concatenate([
-        inp.queue_init_alloc.T.astype(dtype),
+        i32(inp.queue_init_alloc).T,
         queue_active0[None, :],
-        jnp.zeros((qd_rows - r - 1, qdim), dtype)], axis=0)
-    return node_buf, jsta, jdyn, qsta, qdyn
+        jnp.zeros((qd_rows - r - 1, qdim), jnp.int32)], axis=0)
+    return node_int, node_cs, jsta, jdyn, qdes, qsta, qdyn
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "interpret"))
@@ -375,30 +382,36 @@ def solve_allocate_pallas(inp: SolverInputs, cfg: SolverConfig,
     """Full-session solve as a single Pallas kernel launch."""
     r = inp.task_req.shape[1]
     p = inp.task_req.shape[0]
-    dtype = inp.task_req.dtype
+    fdt = inp.job_ts.dtype
 
-    task_data = jnp.concatenate([inp.task_req, inp.task_res], axis=1)
+    task_data = jnp.concatenate([inp.task_req, inp.task_res],
+                                axis=1).astype(jnp.int32)
     task_sig2 = inp.task_sig[:, None]
-    sig_mask_f = inp.sig_mask.astype(dtype)
-    node_buf, jsta, jdyn, qsta, qdyn = _build_buffers(inp)
+    sig_mask_f = inp.sig_mask.astype(fdt)
+    (node_int, node_cs, jsta, jdyn, qdes, qsta,
+     qdyn) = _build_buffers(inp)
     out_buf0 = jnp.concatenate(
         [jnp.full((p, 1), -1, jnp.int32), jnp.zeros((p, 1), jnp.int32),
          jnp.full((p, 1), -1, jnp.int32), jnp.zeros((p, 1), jnp.int32)],
         axis=1)
-    scal = jnp.array([[p, 0, 0, 0, 0, 0, 0, 0]], jnp.int32)
-    total = inp.total_res.astype(dtype)[None, :]
+    scal = jnp.concatenate(
+        [jnp.asarray([p, 0], jnp.int32), inp.score_shift.astype(jnp.int32),
+         jnp.zeros((4,), jnp.int32)])[None, :]
+    total = inp.total_res.astype(fdt)[None, :]
 
     kernel = functools.partial(_solve_kernel, r, cfg)
-    nrows, n = node_buf.shape
+    ni_rows, n = node_int.shape
     outs = pl.pallas_call(
         kernel,
-        out_shape=(jax.ShapeDtypeStruct((nrows, n), dtype),
+        out_shape=(jax.ShapeDtypeStruct((ni_rows, n), jnp.int32),
                    jax.ShapeDtypeStruct((p, 4), jnp.int32),
-                   jax.ShapeDtypeStruct(jdyn.shape, dtype),
-                   jax.ShapeDtypeStruct(qdyn.shape, dtype),
+                   jax.ShapeDtypeStruct(jdyn.shape, jnp.int32),
+                   jax.ShapeDtypeStruct(qdyn.shape, jnp.int32),
                    jax.ShapeDtypeStruct((1, 8), jnp.int32)),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
                   pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM),
                   pl.BlockSpec(memory_space=pltpu.VMEM),
                   pl.BlockSpec(memory_space=pltpu.VMEM),
                   pl.BlockSpec(memory_space=pltpu.VMEM),
@@ -413,10 +426,10 @@ def solve_allocate_pallas(inp: SolverInputs, cfg: SolverConfig,
                    pl.BlockSpec(memory_space=pltpu.VMEM),
                    pl.BlockSpec(memory_space=pltpu.VMEM),
                    pl.BlockSpec(memory_space=pltpu.SMEM)),
-        input_output_aliases={5: 0, 6: 1, 7: 2, 8: 3},
+        input_output_aliases={5: 0, 7: 1, 8: 2, 9: 3},
         interpret=interpret,
     )(scal, total, task_data, task_sig2, sig_mask_f,
-      node_buf, out_buf0, jdyn, qdyn, jsta, qsta)
+      node_int, node_cs, out_buf0, jdyn, qdyn, jsta, qsta, qdes)
 
     out = outs[1]
     return SolveResult(assignment=out[:, 0], kind=out[:, 1],

@@ -1,23 +1,100 @@
-"""Vectorized resource-comparison semantics.
+"""Vectorized resource-comparison semantics in integer milli-units.
 
 The epsilon-tolerant comparisons of the host Resource algebra
 (api/resource.py, mirroring reference resource_info.go:239-311) expressed
-over a fixed resource axis R = [milli-cpu, memory-bytes, scalar...].
-All device tensors use this layout; the epsilon vector is
-[10, 10MiB, 10, 10, ...].
+over a fixed resource axis R = [milli-cpu, memory, scalar...].
+
+Device tensors hold **int32 fixed-point quanta** rather than floats: the
+host's float64 values are scaled by a power-of-two quantum per dimension
+(cpu: 1 milli-CPU, memory: 1 MiB = 2**20 bytes, scalars: 1 milli-unit) and
+rounded to integers at tensorization.  This makes every add/subtract in the
+solver loop *exact* — no f32 drift at 50k-task accumulations, where memory
+in bytes overflows f32's 24-bit mantissa — and turns every epsilon into
+exactly 10 quanta (minMilliCPU=10 / minMemory=10MiB=10 quanta /
+minScalar=10, resource_info.go:68-70), so fit decisions match the host's
+float64 math without jax_enable_x64 for quantities that are whole
+multiples of the quantum (the practical case).  Sub-quantum quantities
+round with <= 0.5-quantum error, so an epsilon compare whose true margin
+lies within half a quantum of the 10-quantum boundary can flip vs the
+host's exact bytes — a documented deviation, bounded by 1/20 of the
+epsilon itself.  Power-of-two scaling keeps ratios (DRF shares, scoring
+fractions) bit-identical to the unscaled ratios for quantum multiples.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
+import numpy as np
 
-from ..api.resource import MIN_MEMORY, MIN_MILLI_CPU, MIN_MILLI_SCALAR
+# One quantum per dimension kind; all epsilons become EPS_QUANTA.
+CPU_QUANTUM = 1.0                 # milli-CPU
+MEMORY_QUANTUM = float(2 ** 20)   # bytes per quantum (1 MiB)
+SCALAR_QUANTUM = 1.0              # milli-units
+EPS_QUANTA = 10                   # 10 milli / 10 MiB / 10 milli-scalar
 
 
-def eps_vector(r: int, dtype=jnp.float32) -> jnp.ndarray:
-    """Per-dimension epsilon: [minMilliCPU, minMemory, minScalar...]."""
-    eps = [MIN_MILLI_CPU, MIN_MEMORY] + [MIN_MILLI_SCALAR] * (max(r, 2) - 2)
-    return jnp.asarray(eps, dtype=dtype)
+# --- integer grid scoring ---------------------------------------------------
+# Node-scoring fractions (used/allocatable) are computed on a fixed integer
+# grid so host (Python ints) and device (int32 + an exactness-proven f32
+# floor-division) produce IDENTICAL score integers on every platform —
+# float scores near-tie differently in f32 vs f64 and broke placement
+# parity.  Formula, identical on both sides:
+#
+#   cs = cap >> shift            (shift normalizes the largest cap < 2**10)
+#   xs = min((used + res) >> shift, cs)       (the min(frac, 1) clip)
+#   frac_grid = SCORE_GRID_K                  if cs == 0
+#             = (xs * SCORE_GRID_K) // cs     otherwise
+#
+# Exactness of the device's  floor(f32(xs*K) / f32(cs)):  numerator
+# <= 2**10 * 2**12 = 2**22 is f32-exact, division is correctly rounded, and
+# for a <= 2**22 the quotient error (<= a/b * 2**-24 < 2**-2/b) is smaller
+# than the 1/b gap to the nearest integer, so the floor never flips.
+# Grid resolution is 1/1024 of capacity — coarser than the reference's f64
+# scores, but any within-grid coalescing lands in the reference's own
+# random-among-max tie envelope (scheduler_helper.go:188-208).
+SCORE_GRID_K = 1 << 12
+_SCORE_CAP_LIMIT = 1 << 10
+
+
+def score_shift_for(max_cap_quanta: int) -> int:
+    """Per-dimension shift normalizing the largest capacity below 2**10."""
+    s = 0
+    while (int(max_cap_quanta) >> s) >= _SCORE_CAP_LIMIT:
+        s += 1
+    return s
+
+
+def grid_fraction_int(x: int, cap: int, shift: int) -> int:
+    """Host-side grid fraction (exact Python ints); see formula above."""
+    cs = int(cap) >> shift
+    if cs == 0:
+        return SCORE_GRID_K
+    xs = min(int(x) >> shift, cs)
+    return (xs * SCORE_GRID_K) // cs
+
+
+def quantum_for_dim(i: int) -> float:
+    return (CPU_QUANTUM, MEMORY_QUANTUM)[i] if i < 2 else SCALAR_QUANTUM
+
+
+def quantize_value(value: float, dim: int) -> int:
+    """Host-side: one float64 quantity -> integer quanta."""
+    return int(round(value / quantum_for_dim(dim)))
+
+
+def quantize_columns(arr: np.ndarray) -> np.ndarray:
+    """Host-side: [..., R] float64 resource array -> int64 quanta (callers
+    range-check before narrowing to int32)."""
+    out = np.rint(arr / MEMORY_QUANTUM).astype(np.int64)
+    out[..., 0] = np.rint(arr[..., 0] / CPU_QUANTUM).astype(np.int64)
+    if arr.shape[-1] > 2:
+        out[..., 2:] = np.rint(arr[..., 2:] / SCALAR_QUANTUM).astype(np.int64)
+    return out
+
+
+def eps_vector(r: int, dtype=jnp.int32) -> jnp.ndarray:
+    """Per-dimension epsilon in quanta: 10 everywhere by construction."""
+    return jnp.full((max(r, 2),), EPS_QUANTA, dtype=dtype)
 
 
 def scalar_dims_mask(r: int) -> jnp.ndarray:
@@ -34,6 +111,7 @@ def less_equal_vec(l: jnp.ndarray, r: jnp.ndarray, eps: jnp.ndarray,
 
     Per dim: l < r or |l-r| < eps; scalar dims with l <= eps are skipped
     (the host path skips low/absent scalars, resource_info.go:293-296).
+    Exact on int32 quanta; also valid on float inputs.
     """
     ok = (l < r) | (jnp.abs(l - r) < eps)
     skip = scalar_dims & (l <= eps)

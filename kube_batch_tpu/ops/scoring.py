@@ -1,10 +1,20 @@
-"""Node scoring kernels.
+"""Node scoring kernels on the integer grid.
 
 Device counterparts of plugins/nodeorder.py (reimplementing the upstream
 kube-scheduler priorities the reference wraps, nodeorder.go:140-168):
 least-requested, most-requested, balanced-resource-allocation, evaluated for
 one task against all N nodes from the *current* used/allocatable tensors.
-Identical math to the host path so placements agree.
+
+Scores are **integers**: utilization fractions are computed on the shared
+SCORE_GRID_K grid (ops/resources.py — identical formula and values on host
+and device, exact on every platform), then combined with integer weights.
+A grid fraction g stands for g/K; the float formulas scale by K:
+
+  least    = 5*(2K - gc - gm)     (was ((1-cf) + (1-mf)) * 10 / 2)
+  most     = 5*(gc + gm)
+  balanced = 10*K - 10*|gc - gm|
+
+Identical integer math to the host path so placements agree bit-for-bit.
 """
 
 from __future__ import annotations
@@ -13,40 +23,74 @@ from typing import NamedTuple
 
 import jax.numpy as jnp
 
-MAX_PRIORITY = 10.0
+from .resources import SCORE_GRID_K
+
+MAX_PRIORITY = 10
+
+# Sentinel for infeasible nodes in integer score argmaxes: far below any
+# real score (scores are >= 0, <= ~2**27 for sane weights).  Plain int so
+# closures (e.g. the Pallas kernel) don't capture a traced constant.
+SCORE_NEG_INF = -(2 ** 31) + 1
 
 
 class ScoreWeights(NamedTuple):
-    least_requested: float = 1.0
-    most_requested: float = 0.0
-    balanced_resource: float = 1.0
+    """Integer plugin weights (the reference reads them via GetInt,
+    nodeorder.go:107-131; tensorize falls back to the host path on
+    fractional weights)."""
+    least_requested: int = 1
+    most_requested: int = 0
+    balanced_resource: int = 1
 
 
-def node_fractions(task_res: jnp.ndarray, used: jnp.ndarray,
-                   allocatable: jnp.ndarray):
-    """Projected cpu/mem utilization fractions if the task lands on each
-    node.  task_res: [R]; used, allocatable: [N, R] -> ([N], [N])."""
-    req = used + task_res[None, :]
-    denom_ok = allocatable > 0
-    frac = jnp.where(denom_ok,
-                     jnp.minimum(req / jnp.where(denom_ok, allocatable, 1.0), 1.0),
-                     1.0)
-    return frac[:, 0], frac[:, 1]  # cpu, memory dims
+def shifted_caps(allocatable: jnp.ndarray, shift: jnp.ndarray):
+    """Precompute (cs, cs_den) per cpu/mem dim for grid_score.
+    allocatable: [N, R] i32; shift: [2] i32."""
+    cs = [jnp.right_shift(allocatable[:, d], shift[d]) for d in range(2)]
+    den = [jnp.maximum(c, 1).astype(jnp.float32) for c in cs]
+    return cs, den
+
+
+def grid_score(task_res: jnp.ndarray, used: jnp.ndarray, shift: jnp.ndarray,
+               cs, cs_den, weights: ScoreWeights) -> jnp.ndarray:
+    """Weighted-sum integer score [N] for one task over all nodes.
+
+    THE grid-score formula: every device path (stepwise/two-level XLA,
+    sharded) calls this one function so score integers cannot drift apart;
+    the Pallas kernel re-implements it over its row layout (kept in sync by
+    the parity suite)."""
+    g = []
+    for d in range(2):
+        xs = jnp.minimum(
+            jnp.right_shift(used[:, d] + task_res[d], shift[d]), cs[d])
+        num = (xs * SCORE_GRID_K).astype(jnp.float32)
+        q = (num / cs_den[d]).astype(jnp.int32)  # trunc == floor (>= 0)
+        g.append(jnp.where(cs[d] == 0, SCORE_GRID_K, q))
+    gc, gm = g
+    score = jnp.zeros(used.shape[0], dtype=jnp.int32)
+    w_least = int(weights.least_requested)
+    w_most = int(weights.most_requested)
+    w_bal = int(weights.balanced_resource)
+    if w_least:
+        score = score + w_least * 5 * (2 * SCORE_GRID_K - gc - gm)
+    if w_most:
+        score = score + w_most * 5 * (gc + gm)
+    if w_bal:
+        score = score + w_bal * (10 * SCORE_GRID_K
+                                 - 10 * jnp.abs(gc - gm))
+    return score
+
+
+def max_weight_sum(weights: ScoreWeights) -> int:
+    """Upper bound scale factor for a combined score: callers keep
+    max_weight_sum * 10 * SCORE_GRID_K inside int32 (tensorize falls back
+    to the host path otherwise)."""
+    return (abs(int(weights.least_requested)) + abs(int(weights.most_requested))
+            + abs(int(weights.balanced_resource)))
 
 
 def score_nodes(task_res: jnp.ndarray, used: jnp.ndarray,
-                allocatable: jnp.ndarray, weights: ScoreWeights) -> jnp.ndarray:
-    """Weighted-sum score [N] for one task over all nodes."""
-    cpu_frac, mem_frac = node_fractions(task_res, used, allocatable)
-    score = jnp.zeros(used.shape[0], dtype=used.dtype)
-    if weights.least_requested:
-        least = ((1.0 - cpu_frac) * MAX_PRIORITY
-                 + (1.0 - mem_frac) * MAX_PRIORITY) / 2.0
-        score = score + weights.least_requested * least
-    if weights.most_requested:
-        most = (cpu_frac * MAX_PRIORITY + mem_frac * MAX_PRIORITY) / 2.0
-        score = score + weights.most_requested * most
-    if weights.balanced_resource:
-        balanced = MAX_PRIORITY - jnp.abs(cpu_frac - mem_frac) * MAX_PRIORITY
-        score = score + weights.balanced_resource * balanced
-    return score
+                allocatable: jnp.ndarray, shift: jnp.ndarray,
+                weights: ScoreWeights) -> jnp.ndarray:
+    """grid_score with caps computed on the fly (stepwise solver path)."""
+    cs, den = shifted_caps(allocatable, shift)
+    return grid_score(task_res, used, shift, cs, den, weights)

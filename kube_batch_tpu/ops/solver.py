@@ -36,9 +36,8 @@ import jax.numpy as jnp
 
 from .fairness import queue_shares, safe_share
 from .resources import less_equal_vec
-from .scoring import ScoreWeights, score_nodes
-
-NEG_INF = -jnp.inf
+from .scoring import (SCORE_NEG_INF, ScoreWeights, grid_score, score_nodes,
+                      shifted_caps)
 
 # Placements unrolled per inner-loop iteration: device loop iterations carry
 # a fixed dispatch overhead (~tens of µs on some TPU runtimes), so the drain
@@ -47,10 +46,16 @@ UNROLL = 8
 
 
 class SolverInputs(NamedTuple):
-    """Static per-session tensors (see models/tensor_snapshot.py)."""
+    """Static per-session tensors (see models/tensor_snapshot.py).
+
+    Resource tensors ([.., R]) are **int32 fixed-point quanta**
+    (ops/resources.py: milli-cpu / MiB / milli-scalar), so solver-loop
+    accounting is exact integer math; ts/prio/rank keys and total_res are
+    float.
+    """
     # tasks (P = padded candidate count)
-    task_req: jnp.ndarray       # [P, R] launch requirement (init_resreq)
-    task_res: jnp.ndarray       # [P, R] steady requirement (resreq)
+    task_req: jnp.ndarray       # [P, R] i32 launch requirement (init_resreq)
+    task_res: jnp.ndarray       # [P, R] i32 steady requirement (resreq)
     task_sig: jnp.ndarray       # [P] i32 index into sig_mask
     task_sorted: jnp.ndarray    # [P] i32 task ids in (job, task-order) order
     # jobs (J)
@@ -82,6 +87,7 @@ class SolverInputs(NamedTuple):
     total_res: jnp.ndarray      # [R] sum of allocatable (drf denominator)
     eps: jnp.ndarray            # [R] epsilon vector
     scalar_dims: jnp.ndarray    # [R] bool
+    score_shift: jnp.ndarray    # [2] i32 grid shifts for cpu/mem scoring
 
 
 class SolverConfig(NamedTuple):
@@ -206,8 +212,9 @@ def solver_step(inp: SolverInputs, cfg: SolverConfig,
 
     placing = act & ~exhausted & any_feasible
 
-    score = score_nodes(res, st.used, inp.node_alloc, cfg.weights)
-    score = jnp.where(feasible, score, NEG_INF)
+    score = score_nodes(res, st.used, inp.node_alloc, inp.score_shift,
+                        cfg.weights)
+    score = jnp.where(feasible, score, SCORE_NEG_INF)
     # first max = deterministic tie-break
     n = jnp.argmax(score).astype(jnp.int32)
 
@@ -215,10 +222,10 @@ def solver_step(inp: SolverInputs, cfg: SolverConfig,
     pipe_ok = placing & ~fit_idle[n] & fit_rel[n]
     placed = alloc_ok | pipe_ok
 
-    # ---- state updates ----------------------------------------------------
-    dres = jnp.where(placed, 1.0, 0.0).astype(res.dtype) * res
-    idle = st.idle.at[n].add(jnp.where(alloc_ok, -dres, 0.0))
-    releasing = st.releasing.at[n].add(jnp.where(pipe_ok, -dres, 0.0))
+    # ---- state updates (exact integer quanta) -----------------------------
+    dres = jnp.where(placed, res, 0)
+    idle = st.idle.at[n].add(jnp.where(alloc_ok, -dres, 0))
+    releasing = st.releasing.at[n].add(jnp.where(pipe_ok, -dres, 0))
     used = st.used.at[n].add(dres)
     count = st.count.at[n].add(placed.astype(st.count.dtype))
 
@@ -324,17 +331,16 @@ def best_solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
 def _unrolled_le(req, mat, r):
     """Epsilon LessEqual of a task vector against [N, R] state, unrolled over
     the static resource axis so XLA sees one elementwise chain instead of a
-    reduction (less_equal_vec semantics, resource_info.go:279-311).  The
-    epsilon layout is static: dim 0 cpu, dim 1 memory, dims >= 2 scalars
-    (skipped when the request is epsilon-low)."""
-    from ..api.resource import MIN_MEMORY, MIN_MILLI_CPU, MIN_MILLI_SCALAR
+    reduction (less_equal_vec semantics, resource_info.go:279-311).  In
+    quantized units every dimension's epsilon is EPS_QUANTA; scalar dims
+    (>= 2) are skipped when the request is epsilon-low."""
+    from .resources import EPS_QUANTA
     ok = None
     for i in range(r):
-        e = (MIN_MILLI_CPU, MIN_MEMORY)[i] if i < 2 else MIN_MILLI_SCALAR
         l, m = req[i], mat[:, i]
-        oki = (l < m) | (jnp.abs(l - m) < e)
+        oki = (l < m) | (jnp.abs(l - m) < EPS_QUANTA)
         if i >= 2:
-            oki = oki | (l <= e)
+            oki = oki | (l <= EPS_QUANTA)
         ok = oki if ok is None else (ok & oki)
     return ok
 
@@ -356,33 +362,15 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
     """
     r = inp.task_req.shape[1]
     p = inp.task_req.shape[0]
-    dtype = inp.task_req.dtype
 
-    # Precompute scoring constants: inverse allocatable for cpu/mem dims.
-    alloc2 = inp.node_alloc[:, :2]
-    inv_alloc2 = jnp.where(alloc2 > 0, 1.0 / jnp.where(alloc2 > 0, alloc2, 1.0),
-                           0.0)
-    zero_alloc2 = alloc2 <= 0
-    w = cfg.weights
-    neg_inf = jnp.asarray(-jnp.inf, dtype)
+    # Precompute scoring constants: shifted capacities for the integer grid
+    # (ops/scoring.py — identical score integers to the host path).
+    cs2, cs2_den = shifted_caps(inp.node_alloc, inp.score_shift)
+    neg_inf = SCORE_NEG_INF
 
     def score_fn(res, used):
-        """Weighted nodeorder score [N] from current used (ops/scoring.py
-        math, divisions replaced by precomputed reciprocals)."""
-        frac = jnp.where(zero_alloc2, 1.0,
-                         jnp.minimum((used[:, :2] + res[None, :2]) * inv_alloc2,
-                                     1.0))
-        cpu_frac, mem_frac = frac[:, 0], frac[:, 1]
-        score = jnp.zeros((used.shape[0],), dtype)
-        if w.least_requested:
-            score = score + w.least_requested * 0.5 * 10.0 * (
-                (1.0 - cpu_frac) + (1.0 - mem_frac))
-        if w.most_requested:
-            score = score + w.most_requested * 0.5 * 10.0 * (cpu_frac + mem_frac)
-        if w.balanced_resource:
-            score = score + w.balanced_resource * (
-                10.0 - jnp.abs(cpu_frac - mem_frac) * 10.0)
-        return score
+        return grid_score(res, used, inp.score_shift, cs2, cs2_den,
+                          cfg.weights)
 
     def drain_job(j, carry):
         """Inner loop: place tasks of job j until the reference's task loop
@@ -421,9 +409,9 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
             pipe_ok = placing & ~fit_idle[nsel] & fit_rel[nsel]
             placed = alloc_ok | pipe_ok
 
-            fres = jnp.where(placed, 1.0, 0.0).astype(dtype) * res
-            idle = idle.at[nsel].add(jnp.where(alloc_ok, -fres, 0.0))
-            releasing = releasing.at[nsel].add(jnp.where(pipe_ok, -fres, 0.0))
+            fres = jnp.where(placed, res, 0)
+            idle = idle.at[nsel].add(jnp.where(alloc_ok, -fres, 0))
+            releasing = releasing.at[nsel].add(jnp.where(pipe_ok, -fres, 0))
             used = used.at[nsel].add(fres)
             count = count.at[nsel].add(placed.astype(count.dtype))
 
@@ -457,7 +445,7 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
 
         init = (jnp.bool_(False), jnp.bool_(False), idle, releasing, used,
                 count, out_node, out_kind, out_order, job_ptr[j],
-                job_ready_cnt[j], step, jnp.zeros((r,), dtype))
+                job_ready_cnt[j], step, jnp.zeros((r,), inp.task_res.dtype))
         (done, survive, idle, releasing, used, count, out_node, out_kind,
          out_order, ptr, ready_cnt, step, dres) = jax.lax.while_loop(
             inner_cond, inner_body, init)
@@ -517,7 +505,7 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
 
         def skip_drain(args):
             carry, _ = args
-            return carry, jnp.bool_(False), jnp.zeros((r,), dtype)
+            return carry, jnp.bool_(False), jnp.zeros((r,), inp.task_res.dtype)
 
         carry, survive, dres = jax.lax.cond(
             retire_queue, skip_drain, do_drain, (carry, j))
@@ -526,8 +514,8 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
 
         processed = ~retire_queue
         # Deferred fairness events: one segment-add per pop boundary.
-        job_alloc = job_alloc.at[j].add(jnp.where(processed, dres, 0.0))
-        queue_alloc = queue_alloc.at[q].add(jnp.where(processed, dres, 0.0))
+        job_alloc = job_alloc.at[j].add(jnp.where(processed, dres, 0))
+        queue_alloc = queue_alloc.at[q].add(jnp.where(processed, dres, 0))
         job_active = job_active.at[j].set(
             jnp.where(processed, survive, job_active[j]))
         queue_active = queue_active.at[q].set(

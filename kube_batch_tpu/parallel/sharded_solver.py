@@ -29,12 +29,12 @@ try:
     from jax import shard_map
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, PartitionSpec as P
 
 from ..ops.fairness import queue_shares, safe_share
 from ..ops.resources import less_equal_vec
-from ..ops.scoring import ScoreWeights
-from ..ops.solver import (NEG_INF, SolveResult, SolverConfig, SolverInputs,
+from ..ops.scoring import SCORE_NEG_INF, grid_score, shifted_caps
+from ..ops.solver import (SolveResult, SolverConfig, SolverInputs,
                           _lex_argmin, _unrolled_le)
 from .mesh import NODE_AXIS
 
@@ -54,7 +54,8 @@ def _node_specs():
         queue_uid_rank=P(None), queue_exists=P(None),
         node_idle=n2, node_releasing=n2, node_used=n2, node_alloc=n2,
         node_count=n1, node_max_tasks=n1, node_exists=n1, sig_mask=sig,
-        total_res=P(None), eps=P(None), scalar_dims=P(None))
+        total_res=P(None), eps=P(None), scalar_dims=P(None),
+        score_shift=P(None))
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "mesh"))
@@ -64,7 +65,6 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
     r = inp.task_req.shape[1]
     p = inp.task_req.shape[0]
     n_total = inp.node_idle.shape[0]
-    dtype = inp.task_req.dtype
     n_dev = mesh.shape[NODE_AXIS]
     n_local = n_total // n_dev
 
@@ -73,28 +73,15 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
         axis_idx = jax.lax.axis_index(NODE_AXIS)
         node_offset = axis_idx * n_local
 
-        alloc2 = inp.node_alloc[:, :2]
-        inv_alloc2 = jnp.where(alloc2 > 0,
-                               1.0 / jnp.where(alloc2 > 0, alloc2, 1.0), 0.0)
-        zero_alloc2 = alloc2 <= 0
-        w = cfg.weights
-        neg_inf = jnp.asarray(-jnp.inf, dtype)
+        # Integer grid scoring over the local node shard (ops/scoring.py):
+        # identical score ints on every shard, so the ICI argmax reduction
+        # is exact.
+        cs2, cs2_den = shifted_caps(inp.node_alloc, inp.score_shift)
+        neg_inf = SCORE_NEG_INF
 
         def score_fn(res, used):
-            frac = jnp.where(zero_alloc2, 1.0,
-                             jnp.minimum((used[:, :2] + res[None, :2])
-                                         * inv_alloc2, 1.0))
-            cpu_frac, mem_frac = frac[:, 0], frac[:, 1]
-            score = jnp.zeros((used.shape[0],), dtype)
-            if w.least_requested:
-                score = score + w.least_requested * 5.0 * (
-                    (1.0 - cpu_frac) + (1.0 - mem_frac))
-            if w.most_requested:
-                score = score + w.most_requested * 5.0 * (cpu_frac + mem_frac)
-            if w.balanced_resource:
-                score = score + w.balanced_resource * (
-                    10.0 - jnp.abs(cpu_frac - mem_frac) * 10.0)
-            return score
+            return grid_score(res, used, inp.score_shift, cs2, cs2_den,
+                              cfg.weights)
 
         def drain_job(j, carry):
             (idle, releasing, used, count, out_node, out_kind, out_order,
@@ -148,11 +135,11 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
                 placed = alloc_ok | pipe_ok
 
                 upd = placed & mine
-                fres = jnp.where(upd, 1.0, 0.0).astype(dtype) * res
+                fres = jnp.where(upd, res, 0)
                 idle = idle.at[nsel].add(jnp.where(alloc_ok & mine,
-                                                   -fres, 0.0))
+                                                   -fres, 0))
                 releasing = releasing.at[nsel].add(
-                    jnp.where(pipe_ok & mine, -fres, 0.0))
+                    jnp.where(pipe_ok & mine, -fres, 0))
                 used = used.at[nsel].add(fres)
                 count = count.at[nsel].add(upd.astype(count.dtype))
 
@@ -168,7 +155,7 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
                 ptr = ptr + placed.astype(jnp.int32)
                 ready_cnt = ready_cnt + alloc_ok.astype(jnp.int32)
                 dstep = dstep + placed.astype(jnp.int32)
-                dres = dres + jnp.where(placed, 1.0, 0.0).astype(dtype) * res
+                dres = dres + jnp.where(placed, res, 0)
 
                 if cfg.has_gang:
                     ready = ready_cnt >= minavail
@@ -183,7 +170,7 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
 
             init = (jnp.bool_(False), jnp.bool_(False), idle, releasing,
                     used, count, out_node, out_kind, out_order, job_ptr[j],
-                    job_ready_cnt[j], step, jnp.zeros((r,), dtype))
+                    job_ready_cnt[j], step, jnp.zeros((r,), inp.task_res.dtype))
             (done, survive, idle, releasing, used, count, out_node,
              out_kind, out_order, ptr, ready_cnt, step, dres) = \
                 jax.lax.while_loop(lambda c: ~c[0], inner_body, init)
@@ -239,7 +226,7 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
 
             def skip_drain(args):
                 carry, _ = args
-                return carry, jnp.bool_(False), jnp.zeros((r,), dtype)
+                return carry, jnp.bool_(False), jnp.zeros((r,), inp.task_res.dtype)
 
             carry, survive, dres = jax.lax.cond(
                 retire_queue, skip_drain, do_drain, (carry, j))
@@ -247,9 +234,9 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
              job_ptr, job_ready_cnt, step) = carry
 
             processed = ~retire_queue
-            job_alloc = job_alloc.at[j].add(jnp.where(processed, dres, 0.0))
+            job_alloc = job_alloc.at[j].add(jnp.where(processed, dres, 0))
             queue_alloc = queue_alloc.at[q].add(
-                jnp.where(processed, dres, 0.0))
+                jnp.where(processed, dres, 0))
             job_active = job_active.at[j].set(
                 jnp.where(processed, survive, job_active[j]))
             queue_active = queue_active.at[q].set(

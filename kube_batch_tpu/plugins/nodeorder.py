@@ -1,18 +1,29 @@
-"""Nodeorder plugin: node scoring.
+"""Nodeorder plugin: node scoring on the integer grid.
 
 The reference wraps upstream kube-scheduler priorities with YAML-tunable
 weights (/root/reference/pkg/scheduler/plugins/nodeorder/nodeorder.go:27-38,
 107-168): LeastRequested (w=1), MostRequested (w=0), BalancedResource (w=1),
 NodeAffinity (w=1), InterPodAffinity (w=1).  These are standalone
-reimplementations of those scoring formulas; the identical math runs
-vectorized on TPU in ops/scoring.py, which parity tests check against this
-host path.
+reimplementations of those scoring formulas.
+
+Scores are **exact integers** on the shared SCORE_GRID_K fraction grid
+(ops/resources.py): utilization is tracked in quantized int quanta —
+initialized from the snapshot, updated per placement through session event
+handlers (the same incremental pattern drf/proportion use) — so this host
+path and the vectorized device path (ops/scoring.py) produce identical
+score integers on every platform.  Affinity term scores scale by the same
+grid constant, preserving the reference's relative weighting.
 """
 
 from __future__ import annotations
 
+from typing import Dict, Tuple
+
 from ..api import NodeInfo, TaskInfo
 from ..framework import Arguments, Plugin
+from ..framework.events import EventHandler
+from ..ops.resources import (SCORE_GRID_K, grid_fraction_int, quantize_value,
+                             score_shift_for)
 
 # Argument keys (nodeorder.go:41-66).
 NODE_AFFINITY_WEIGHT = "nodeaffinity.weight"
@@ -21,52 +32,97 @@ LEAST_REQUESTED_WEIGHT = "leastrequested.weight"
 BALANCED_RESOURCE_WEIGHT = "balancedresource.weight"
 MOST_REQUESTED_WEIGHT = "mostrequested.weight"
 
-MAX_PRIORITY = 10.0
+MAX_PRIORITY = 10
 
 
-def _fractions(task: TaskInfo, node: NodeInfo):
-    """Projected cpu/memory utilization fractions if task lands on node."""
-    cpu_alloc = node.allocatable.milli_cpu
-    mem_alloc = node.allocatable.memory
-    cpu_req = node.used.milli_cpu + task.resreq.milli_cpu
-    mem_req = node.used.memory + task.resreq.memory
-    cpu_frac = 1.0 if cpu_alloc == 0 else min(cpu_req / cpu_alloc, 1.0)
-    mem_frac = 1.0 if mem_alloc == 0 else min(mem_req / mem_alloc, 1.0)
-    return cpu_frac, mem_frac
+class GridUsage:
+    """Quantized per-node (cpu, mem) usage mirror for grid scoring.
+
+    Must accumulate the same int quanta the device adds (q(a)+q(b), not
+    q(a+b)) or sub-quantum requests would round differently on the two
+    paths."""
+
+    def __init__(self, ssn):
+        max_cpu = max_mem = 0
+        self.cap: Dict[str, Tuple[int, int]] = {}
+        self.used: Dict[str, Tuple[int, int]] = {}
+        for name, node in ssn.nodes.items():
+            cap = (quantize_value(node.allocatable.milli_cpu, 0),
+                   quantize_value(node.allocatable.memory, 1))
+            self.cap[name] = cap
+            self.used[name] = (quantize_value(node.used.milli_cpu, 0),
+                               quantize_value(node.used.memory, 1))
+            max_cpu = max(max_cpu, cap[0])
+            max_mem = max(max_mem, cap[1])
+        self.shift = (score_shift_for(max_cpu), score_shift_for(max_mem))
+
+    def task_quanta(self, task: TaskInfo) -> Tuple[int, int]:
+        return (quantize_value(task.resreq.milli_cpu, 0),
+                quantize_value(task.resreq.memory, 1))
+
+    def add(self, task: TaskInfo) -> None:
+        if task.node_name in self.used:
+            uc, um = self.used[task.node_name]
+            dc, dm = self.task_quanta(task)
+            self.used[task.node_name] = (uc + dc, um + dm)
+
+    def sub(self, task: TaskInfo) -> None:
+        if task.node_name in self.used:
+            uc, um = self.used[task.node_name]
+            dc, dm = self.task_quanta(task)
+            self.used[task.node_name] = (uc - dc, um - dm)
+
+    def fractions(self, task: TaskInfo, node: NodeInfo) -> Tuple[int, int]:
+        """Projected cpu/mem grid fractions if task lands on node."""
+        cap = self.cap.get(node.name)
+        if cap is None:  # node unknown to the session snapshot
+            cap = (quantize_value(node.allocatable.milli_cpu, 0),
+                   quantize_value(node.allocatable.memory, 1))
+            self.cap[node.name] = cap
+            self.used[node.name] = (quantize_value(node.used.milli_cpu, 0),
+                                    quantize_value(node.used.memory, 1))
+        uc, um = self.used[node.name]
+        dc, dm = self.task_quanta(task)
+        return (grid_fraction_int(uc + dc, cap[0], self.shift[0]),
+                grid_fraction_int(um + dm, cap[1], self.shift[1]))
 
 
-def least_requested_score(task: TaskInfo, node: NodeInfo) -> float:
-    """Mean over cpu/mem of (free after placement) * 10 / allocatable
-    (upstream least_requested.go semantics)."""
-    cpu_frac, mem_frac = _fractions(task, node)
-    return ((1.0 - cpu_frac) * MAX_PRIORITY + (1.0 - mem_frac) * MAX_PRIORITY) / 2.0
+def least_requested_score(grid: GridUsage, task: TaskInfo,
+                          node: NodeInfo) -> int:
+    """Mean over cpu/mem of (free after placement) * 10 / allocatable,
+    scaled by the grid (upstream least_requested.go semantics)."""
+    gc, gm = grid.fractions(task, node)
+    return 5 * (2 * SCORE_GRID_K - gc - gm)
 
 
-def most_requested_score(task: TaskInfo, node: NodeInfo) -> float:
-    cpu_frac, mem_frac = _fractions(task, node)
-    return (cpu_frac * MAX_PRIORITY + mem_frac * MAX_PRIORITY) / 2.0
+def most_requested_score(grid: GridUsage, task: TaskInfo,
+                         node: NodeInfo) -> int:
+    gc, gm = grid.fractions(task, node)
+    return 5 * (gc + gm)
 
 
-def balanced_resource_score(task: TaskInfo, node: NodeInfo) -> float:
-    """10 - |cpuFraction - memFraction| * 10 (upstream
+def balanced_resource_score(grid: GridUsage, task: TaskInfo,
+                            node: NodeInfo) -> int:
+    """10 - |cpuFraction - memFraction| * 10, grid-scaled (upstream
     balanced_resource_allocation.go)."""
-    cpu_frac, mem_frac = _fractions(task, node)
-    return MAX_PRIORITY - abs(cpu_frac - mem_frac) * MAX_PRIORITY
+    gc, gm = grid.fractions(task, node)
+    return 10 * SCORE_GRID_K - 10 * abs(gc - gm)
 
 
-def node_affinity_score(task: TaskInfo, node: NodeInfo) -> float:
+def node_affinity_score(task: TaskInfo, node: NodeInfo) -> int:
     """Sum of matching preferred-node-affinity term weights (upstream
     node_affinity.go map phase; we skip the max-normalizing reduce so the
-    score stays a pure per-(task,node) function — weights act directly)."""
+    score stays a pure per-(task,node) function — weights act directly),
+    grid-scaled to combine with the fraction scores."""
     affinity = task.pod.spec.affinity
     if affinity is None or not affinity.preferred_node_terms:
-        return 0.0
+        return 0
     labels = node.node.metadata.labels if node.node else {}
-    score = 0.0
+    score = 0
     for weight, term in affinity.preferred_node_terms:
         if all(labels.get(k) == v for k, v in term.items()):
             score += weight
-    return score
+    return score * SCORE_GRID_K
 
 
 class NodeOrderPlugin(Plugin):
@@ -88,13 +144,19 @@ class NodeOrderPlugin(Plugin):
 
     def on_session_open(self, ssn) -> None:
         w = self.weights()
+        grid = GridUsage(ssn)
+        ssn.add_event_handler(EventHandler(allocate_func=lambda e: grid.add(e.task),
+                                           deallocate_func=lambda e: grid.sub(e.task)))
         prioritizers = []
         if w["leastrequested"]:
-            prioritizers.append((w["leastrequested"], least_requested_score))
+            prioritizers.append((w["leastrequested"],
+                                 lambda t, n: least_requested_score(grid, t, n)))
         if w["mostrequested"]:
-            prioritizers.append((w["mostrequested"], most_requested_score))
+            prioritizers.append((w["mostrequested"],
+                                 lambda t, n: most_requested_score(grid, t, n)))
         if w["balancedresource"]:
-            prioritizers.append((w["balancedresource"], balanced_resource_score))
+            prioritizers.append((w["balancedresource"],
+                                 lambda t, n: balanced_resource_score(grid, t, n)))
         if w["nodeaffinity"]:
             prioritizers.append((w["nodeaffinity"], node_affinity_score))
         ssn.add_node_order_fns(self.name(), prioritizers)

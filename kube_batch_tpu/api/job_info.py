@@ -49,13 +49,24 @@ class TaskInfo:
         self.pod: Pod = pod
 
     def clone(self) -> "TaskInfo":
+        ti = self.clone_lite()
+        ti.resreq = self.resreq.clone()
+        ti.init_resreq = self.init_resreq.clone()
+        return ti
+
+    def clone_lite(self) -> "TaskInfo":
+        """Clone sharing the resreq/init_resreq vectors.  They are never
+        mutated in place anywhere in the framework (pod updates replace
+        them wholesale), so the snapshot and batch-apply hot paths — which
+        clone every task every session — use this form; ``clone`` keeps the
+        reference's deep-copy contract (job_info.go TaskInfo.Clone)."""
         ti = TaskInfo.__new__(TaskInfo)
         ti.uid = self.uid
         ti.job = self.job
         ti.name = self.name
         ti.namespace = self.namespace
-        ti.resreq = self.resreq.clone()
-        ti.init_resreq = self.init_resreq.clone()
+        ti.resreq = self.resreq
+        ti.init_resreq = self.init_resreq
         ti.node_name = self.node_name
         ti.status = self.status
         ti.priority = self.priority
@@ -147,6 +158,32 @@ class JobInfo:
         task.status = status
         self.add_task_info(task)
 
+    def move_task_index(self, task: TaskInfo, status: TaskStatus) -> None:
+        """Move only the status index (callers settle the allocated vector
+        themselves — the batch-apply path adds one per-job aggregate
+        instead of one vector op per task)."""
+        index = self.task_status_index.get(task.status)
+        if index is not None:
+            index.pop(task.uid, None)
+            if not index:
+                del self.task_status_index[task.status]
+        task.status = status
+        self.task_status_index[status][task.uid] = task
+        self.tasks[task.uid] = task
+
+    def move_task_status(self, task: TaskInfo, status: TaskStatus) -> None:
+        """update_task_status fast path for a task already tracked by this
+        job: moves only the status index and the allocated vector
+        (total_request is invariant), skipping the delete/re-add Resource
+        churn.  Same end state as update_task_status."""
+        was_alloc = allocated_status(task.status)
+        self.move_task_index(task, status)
+        now_alloc = allocated_status(status)
+        if now_alloc and not was_alloc:
+            self.allocated.add(task.resreq)
+        elif was_alloc and not now_alloc:
+            self.allocated.sub(task.resreq)
+
     def get_tasks(self, *statuses: TaskStatus) -> List[TaskInfo]:
         out: List[TaskInfo] = []
         for status in statuses:
@@ -199,6 +236,17 @@ class JobInfo:
                 f"{', '.join(parts)}.")
 
     def clone(self) -> "JobInfo":
+        """Deep clone (job_info.go JobInfo.Clone contract)."""
+        info = self.snapshot_clone()
+        for task in info.tasks.values():
+            task.resreq = task.resreq.clone()
+            task.init_resreq = task.init_resreq.clone()
+        return info
+
+    def snapshot_clone(self) -> "JobInfo":
+        """Session-snapshot clone: task resreq/init_resreq vectors are
+        shared (framework code never mutates them in place), halving the
+        allocation cost of cloning every job every cycle."""
         info = JobInfo(self.uid)
         info.name = self.name
         info.namespace = self.namespace
@@ -207,10 +255,19 @@ class JobInfo:
         info.min_available = self.min_available
         info.node_selector = dict(self.node_selector)
         info.creation_timestamp = self.creation_timestamp
-        info.pod_group = copy.deepcopy(self.pod_group)
+        info.pod_group = (self.pod_group.clone()
+                          if self.pod_group is not None else None)
         info.pdb = self.pdb
-        for task in self.tasks.values():
-            info.add_task_info(task.clone())
+        # Copy the aggregates instead of re-deriving them per task through
+        # add_task_info: they are invariants of the task set.
+        info.total_request = self.total_request.clone()
+        info.allocated = self.allocated.clone()
+        tasks = info.tasks
+        index = info.task_status_index
+        for uid, task in self.tasks.items():
+            t = task.clone_lite()
+            tasks[uid] = t
+            index[t.status][uid] = t
         return info
 
     def __repr__(self) -> str:

@@ -130,9 +130,30 @@ class NodeInfo:
         return [t.pod for t in self.tasks.values()]
 
     def clone(self) -> "NodeInfo":
-        res = NodeInfo(self.node)
-        for task in self.tasks.values():
-            res.add_task(task.clone())
+        """Deep clone (node_info.go NodeInfo.Clone contract)."""
+        res = self.snapshot_clone()
+        for task in res.tasks.values():
+            task.resreq = task.resreq.clone()
+            task.init_resreq = task.init_resreq.clone()
+        return res
+
+    def snapshot_clone(self) -> "NodeInfo":
+        """Field-wise session-snapshot clone: copies the accounting vectors
+        directly instead of re-parsing resource lists and replaying
+        add_task per resident task, and shares the (never mutated in place)
+        task resreq vectors — the snapshot path clones every node every
+        session."""
+        res = NodeInfo.__new__(NodeInfo)
+        res.name = self.name
+        res.node = self.node
+        res.state = self.state
+        res.releasing = self.releasing.clone()
+        res.idle = self.idle.clone()
+        res.used = self.used.clone()
+        res.allocatable = self.allocatable.clone()
+        res.capability = self.capability.clone()
+        res.tasks = {key: task.clone_lite()
+                     for key, task in self.tasks.items()}
         return res
 
     def __repr__(self) -> str:

@@ -124,8 +124,12 @@ class Resource:
         return r
 
     def clone(self) -> "Resource":
-        return Resource(self.milli_cpu, self.memory, dict(self.scalar_resources),
-                        self.max_task_num)
+        r = Resource.__new__(Resource)  # skip __init__ float coercions
+        r.milli_cpu = self.milli_cpu
+        r.memory = self.memory
+        r.scalar_resources = dict(self.scalar_resources)
+        r.max_task_num = self.max_task_num
+        return r
 
     # -- predicates ---------------------------------------------------------
 
@@ -164,6 +168,17 @@ class Resource:
         self.memory -= rr.memory
         if not self.scalar_resources:
             return self
+        for name, q in rr.scalar_resources.items():
+            self.scalar_resources[name] = self.scalar_resources.get(name, 0.0) - q
+        return self
+
+    def sub_lenient(self, rr: "Resource") -> "Resource":
+        """Subtract without the sufficiency check.  Batch apply uses this:
+        the per-task sequential path tolerates epsilon-sized overdraft at
+        every step, so the batched equivalent must reproduce the same final
+        vector (idle - sum) rather than re-checking the aggregate."""
+        self.milli_cpu -= rr.milli_cpu
+        self.memory -= rr.memory
         for name, q in rr.scalar_resources.items():
             self.scalar_resources[name] = self.scalar_resources.get(name, 0.0) - q
         return self

@@ -287,7 +287,7 @@ class SchedulerCache(Cache):
             for name, node in self.nodes.items():
                 if not node.ready():
                     continue  # OutOfSync/NotReady nodes excluded (cache.go:638-643)
-                info.nodes[name] = node.clone()
+                info.nodes[name] = node.snapshot_clone()
             for name, queue in self.queues.items():
                 info.queues[name] = QueueInfo(queue)
             for uid, job in self.jobs.items():
@@ -300,7 +300,7 @@ class SchedulerCache(Cache):
                 # Jobs whose queue is missing are skipped (cache.go:658-662).
                 if job.queue not in info.queues:
                     continue
-                clone = job.clone()
+                clone = job.snapshot_clone()
                 if clone.pod_group is not None:
                     # Resolve priority from PriorityClass (cache.go:664-674).
                     pc_name = clone.pod_group.spec.priority_class_name
@@ -326,6 +326,24 @@ class SchedulerCache(Cache):
         except Exception:
             self._resync_task(task)
             raise
+
+    def bind_batch(self, tasks: List[TaskInfo]) -> None:
+        """Bulk bind with per-task failure isolation: failed tasks queue a
+        resync exactly as bind() does; the rest proceed (the reference's
+        per-bind goroutines give the same isolation)."""
+        if self.binder is None:
+            raise RuntimeError("no binder configured")
+        failures = self.binder.bind_many(
+            [(t.pod, t.node_name) for t in tasks])
+        failed_uids = set()
+        for pod, hostname, _exc in failures:
+            failed_uids.add(pod.metadata.uid)
+        for t in tasks:
+            if t.uid in failed_uids:
+                self._resync_task(t)
+            else:
+                self.events.append(("Scheduled", pod_key(t.pod),
+                                    t.node_name))
 
     def evict(self, task: TaskInfo, reason: str) -> None:
         """Delegate to the Evictor (cache.go:425-488)."""

@@ -27,6 +27,14 @@ class FakeBinder(Binder):
             self.binds[key] = hostname
             self.channel.append(key)
 
+    def bind_many(self, pairs) -> list:
+        with self.lock:  # one lock round-trip for the whole batch
+            for pod, hostname in pairs:
+                key = pod_key(pod)
+                self.binds[key] = hostname
+                self.channel.append(key)
+        return []
+
 
 class FakeEvictor(Evictor):
     def __init__(self):

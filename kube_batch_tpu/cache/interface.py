@@ -25,6 +25,15 @@ class Cache(abc.ABC):
     @abc.abstractmethod
     def bind(self, task: TaskInfo, hostname: str) -> None: ...
 
+    def bind_batch(self, tasks) -> None:
+        """Bulk bind (tasks carry node_name); default loops bind() with the
+        same per-task failure isolation the old dispatch loop had."""
+        for t in tasks:
+            try:
+                self.bind(t, t.node_name)
+            except Exception:
+                continue  # bind() already queued the resync
+
     @abc.abstractmethod
     def evict(self, task: TaskInfo, reason: str) -> None: ...
 
@@ -41,6 +50,19 @@ class Cache(abc.ABC):
 class Binder(abc.ABC):
     @abc.abstractmethod
     def bind(self, pod, hostname: str) -> None: ...
+
+    def bind_many(self, pairs) -> list:
+        """Bind [(pod, hostname)] in bulk; returns [(pod, hostname, exc)]
+        failures.  Default loops bind(); implementations override to
+        amortize locking/round-trips (the reference fires one goroutine per
+        bind — this is the batched equivalent)."""
+        failures = []
+        for pod, hostname in pairs:
+            try:
+                self.bind(pod, hostname)
+            except Exception as exc:  # per-task failure isolation
+                failures.append((pod, hostname, exc))
+        return failures
 
 
 class Evictor(abc.ABC):

@@ -20,7 +20,7 @@ from ..api.pod_group_info import (PodGroupCondition, PodGroupPending,
                                   PodGroupRunning, PodGroupUnknown,
                                   PodGroupUnschedulableType)
 from ..metrics import metrics
-from .events import Event, EventHandler
+from .events import AllocateBatch, Event, EventHandler
 from .interface import Plugin
 
 
@@ -303,9 +303,180 @@ class Session:
         job = self.jobs.get(task.job)
         if job is None:
             raise KeyError(f"failed to find job {task.job}")
-        job.update_task_status(task, TaskStatus.Binding)
+        job.move_task_status(task, TaskStatus.Binding)
         metrics.observe_task_schedule_latency(
             time.time() - task.pod.metadata.creation_timestamp)
+
+    def _fire_allocate_batch(self, batch) -> None:
+        for eh in self.event_handlers:
+            if eh.batch_allocate_func is not None:
+                eh.batch_allocate_func(batch)
+            elif eh.allocate_func is not None:
+                for t in batch.tasks:
+                    eh.allocate_func(Event(t))
+
+    def _apply_sequential(self, placements) -> None:
+        """Exact per-task replay (the pre-batch apply path): used when the
+        batch feasibility pre-check trips, so infeasible placements are
+        rejected individually exactly as allocate()/pipeline() would."""
+        for task, hostname, kind in placements:
+            try:
+                if kind == 1:
+                    self.allocate(task, hostname)
+                else:
+                    self.pipeline(task, hostname)
+            except (KeyError, ValueError):
+                # Mirror the reference's log-and-continue on bind errors
+                # (allocate.go:162-166); cache resync repairs divergence.
+                continue
+
+    def batch_apply(self, placements, agg=None) -> None:
+        """Apply a solved placement sequence in bulk.
+
+        ``placements``: iterable of (task, hostname, kind) with kind
+        1=allocate, 2=pipeline, in solve order.  Final state is identical
+        to calling allocate()/pipeline() per task in that order: status
+        moves, node accounting, and plugin event state are all linear in
+        the placed tasks, and the gang dispatch barrier depends only on
+        final readiness (ready_task_num never decreases while allocating),
+        so per-node/per-job aggregation commutes (f64 sums may reassociate;
+        the <=1e-10 relative drift is far inside every epsilon).
+
+        ``agg``: optional BatchAggregates precomputed from the solver's own
+        arrays (models/tensor_snapshot.build_apply_aggregates); with it the
+        per-task loop is only index moves + node-clone inserts."""
+        from ..api.resource import Resource
+
+        placements = list(placements)
+        # Feasibility pre-check: the sequential path rejects a placement
+        # whose request exceeds idle beyond epsilon (node_info.go AddTask)
+        # and the action skips it.  Summed aggregates can't reproduce that
+        # per-task skip, so if any node's total looks overdrawn (solver bug
+        # or stale snapshot), replay the whole batch through the exact
+        # per-task path instead.
+        check_alloc: dict = {}
+        check_pipe: dict = {}
+        for task, hostname, kind in placements:
+            accs = check_alloc if kind == 1 else check_pipe
+            acc = accs.get(hostname)
+            if acc is None:
+                acc = accs[hostname] = Resource.empty()
+            acc.add(task.resreq)
+        for accs, pool in ((check_alloc, "idle"), (check_pipe, "releasing")):
+            for hostname, acc in accs.items():
+                node = self.nodes.get(hostname)
+                if node is not None and not acc.less_equal(
+                        getattr(node, pool)):
+                    self._apply_sequential(placements)
+                    return
+
+        node_alloc: dict = check_alloc if agg is None else agg.node_alloc
+        node_pipe: dict = check_pipe if agg is None else agg.node_pipe
+        touched_jobs: dict = {}
+        applied: List[TaskInfo] = []
+        skipped = []
+        jobs_get = self.jobs.get
+        nodes_get = self.nodes.get
+        allocate_volumes = self.cache.allocate_volumes
+        applied_append = applied.append
+        allocated_st, pipelined_st = TaskStatus.Allocated, TaskStatus.Pipelined
+        for task, hostname, kind in placements:
+            job = jobs_get(task.job)
+            node = nodes_get(hostname)
+            if job is None or node is None:
+                skipped.append((task, hostname, kind))
+                continue
+            # pod_key(task.pod) == f"{namespace}/{name}" by construction.
+            key = f"{task.namespace}/{task.name}"
+            if key in node.tasks:  # add_task would raise; mirror log-and-skip
+                skipped.append((task, hostname, kind))
+                continue
+            if kind == 1:
+                allocate_volumes(task, hostname)
+                if agg is None:
+                    job.move_task_status(task, allocated_st)
+                else:
+                    job.move_task_index(task, allocated_st)
+            else:
+                if agg is None:
+                    job.move_task_status(task, pipelined_st)
+                else:
+                    job.move_task_index(task, pipelined_st)
+            task.node_name = node.name
+            node.tasks[key] = task.clone_lite()
+            touched_jobs[task.job] = job
+            applied_append(task)
+
+        # Remove contributions of skipped placements so the (pre)computed
+        # sums describe exactly what was applied.
+        for task, hostname, kind in skipped:
+            if kind == 1 and hostname in node_alloc:
+                node_alloc[hostname].sub_lenient(task.resreq)
+            elif hostname in node_pipe:
+                node_pipe[hostname].sub_lenient(task.resreq)
+            if agg is not None:
+                if task.job in agg.job_alloc and kind == 1:
+                    agg.job_alloc[task.job].sub_lenient(task.resreq)
+                if agg.job_sums and task.job in agg.job_sums:
+                    agg.job_sums[task.job].sub_lenient(task.resreq)
+                if agg.node_quanta and hostname in agg.node_quanta:
+                    from ..ops.resources import quantize_value
+                    qc, qm = agg.node_quanta[hostname]
+                    agg.node_quanta[hostname] = (
+                        qc - quantize_value(task.resreq.milli_cpu, 0),
+                        qm - quantize_value(task.resreq.memory, 1))
+
+        if agg is not None:
+            # Settle job.allocated with one aggregate per job (only
+            # Allocated counts: Pipelined is not an allocated status).
+            for uid, res in agg.job_alloc.items():
+                job = self.jobs.get(uid)
+                if job is not None:
+                    job.allocated.add(res)
+
+        # Node accounting, one vector op per touched node (node_info.go
+        # AddTask semantics summed; sub_lenient reproduces the sequential
+        # path's epsilon-tolerant end state).
+        for hostname, acc in node_alloc.items():
+            node = self.nodes.get(hostname)
+            if node is not None:
+                node.idle.sub_lenient(acc)
+                node.used.add(acc)
+        for hostname, acc in node_pipe.items():
+            node = self.nodes.get(hostname)
+            if node is not None:
+                node.releasing.sub_lenient(acc)
+                node.used.add(acc)
+
+        self._fire_allocate_batch(AllocateBatch(
+            tasks=applied,
+            job_sums=None if agg is None else agg.job_sums,
+            node_quanta=None if agg is None else agg.node_quanta))
+
+        # Gang barrier: dispatch every Allocated task of each now-ready job
+        # (session.go:277-285; end state matches the interleaved loop).
+        # Bulk form of dispatch(): Allocated and Binding are both
+        # allocated_status, so job.allocated is invariant and the whole
+        # status bucket moves at once; binds and latency metrics batch.
+        now = time.time()
+        dispatching: List[TaskInfo] = []
+        for job in touched_jobs.values():
+            if not self.job_ready(job):
+                continue
+            moving = job.task_status_index.pop(TaskStatus.Allocated, None)
+            if not moving:
+                continue
+            binding = job.task_status_index[TaskStatus.Binding]
+            for uid, t in moving.items():
+                self.cache.bind_volumes(t)
+                t.status = TaskStatus.Binding
+                binding[uid] = t
+            dispatching.extend(moving.values())
+        if dispatching:
+            self.cache.bind_batch(dispatching)
+            metrics.observe_task_schedule_latencies(
+                [now - t.pod.metadata.creation_timestamp
+                 for t in dispatching])
 
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
         """Evict through the cache, then mirror in-session (session.go:317-345)."""

@@ -50,6 +50,23 @@ class Histogram:
             self._sums[labels] += value
             self._totals[labels] += 1
 
+    def observe_many(self, values, *labels: str) -> None:
+        """Bulk observation (one lock + vectorized bucketing): the batched
+        dispatch path records 50k task latencies per session."""
+        import numpy as np
+        arr = np.asarray(values, dtype=np.float64)
+        if arr.size == 0:
+            return
+        idx = np.searchsorted(np.asarray(self.buckets), arr, side="left")
+        bincounts = np.bincount(idx, minlength=len(self.buckets) + 1)
+        with self._lock:
+            counts = self._counts[labels]
+            for i, c in enumerate(bincounts):
+                if c:
+                    counts[i] += int(c)
+            self._sums[labels] += float(arr.sum())
+            self._totals[labels] += int(arr.size)
+
     def expose(self) -> str:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} histogram"]
@@ -168,6 +185,10 @@ tpu_solve_latency = registry.register(Histogram(
 tpu_transfer_latency = registry.register(Histogram(
     f"{SUBSYSTEM}_tpu_transfer_latency_milliseconds",
     "Host<->device snapshot transfer latency in milliseconds", _MS_BUCKETS))
+tpu_apply_latency = registry.register(Histogram(
+    f"{SUBSYSTEM}_tpu_apply_latency_milliseconds",
+    "Host-side batched placement apply latency in milliseconds",
+    _MS_BUCKETS))
 
 
 # Helper API (metrics.go:123-191).
@@ -186,6 +207,13 @@ def observe_action_latency(action: str, seconds: float) -> None:
 
 def observe_task_schedule_latency(seconds: float) -> None:
     task_scheduling_latency.observe(seconds * 1e6)
+
+
+def observe_task_schedule_latencies(seconds_array) -> None:
+    """Bulk form for the batched dispatch path."""
+    import numpy as np
+    task_scheduling_latency.observe_many(
+        np.asarray(seconds_array, dtype=np.float64) * 1e6)
 
 
 def register_schedule_attempt(result: str) -> None:
@@ -218,3 +246,7 @@ def observe_tpu_solve_latency(seconds: float) -> None:
 
 def observe_tpu_transfer_latency(seconds: float) -> None:
     tpu_transfer_latency.observe(seconds * 1e3)
+
+
+def observe_tpu_apply_latency(seconds: float) -> None:
+    tpu_apply_latency.observe(seconds * 1e3)

@@ -141,3 +141,51 @@ def _waterfill(total, weight, request, active):
         if np.all(remaining < 10.0):  # eps = 10 quanta on every dim
             break
     return deserved
+
+
+def make_synthetic_cache(n_tasks, n_nodes, n_jobs, n_queues):
+    """SchedulerCache at kubemark scale, fed through the normal ingestion
+    path — the object-model analog of make_synthetic_inputs, used by the
+    end-to-end session benches (tools/session_bench.py, bench.py)."""
+    from ..api import (Container, Node, NodeSpec, NodeStatus,
+                                    ObjectMeta, Pod, PodSpec, PodStatus)
+    from ..api.queue_info import Queue
+    from ..apis.scheduling import v1alpha1
+    from ..cache import (FakeBinder, FakeEvictor,
+                                      FakeStatusUpdater, FakeVolumeBinder,
+                                      SchedulerCache)
+    from ..apis.scheduling.v1alpha1 import GroupNameAnnotationKey
+
+    binder = FakeBinder()
+    cache = SchedulerCache(binder=binder, evictor=FakeEvictor(),
+                           status_updater=FakeStatusUpdater(),
+                           volume_binder=FakeVolumeBinder())
+    for q in range(n_queues):
+        cache.add_queue(Queue(metadata=ObjectMeta(name=f"q{q}",
+                                                  creation_timestamp=float(q)),
+                              weight=1 + q % 4))
+    alloc = {"cpu": "16", "memory": "64Gi", "pods": 110}
+    for i in range(n_nodes):
+        cache.add_node(Node(metadata=ObjectMeta(name=f"n{i:05d}", uid=f"n{i}"),
+                            spec=NodeSpec(),
+                            status=NodeStatus(allocatable=dict(alloc),
+                                              capacity=dict(alloc))))
+    per_job = max(1, n_tasks // n_jobs)
+    cpus = ["250m", "500m", "1", "2"]
+    mems = ["512Mi", "1Gi", "2Gi", "4Gi"]
+    for j in range(n_jobs):
+        cache.add_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name=f"pg{j}", namespace="bench"),
+            spec=v1alpha1.PodGroupSpec(min_member=max(1, per_job * 4 // 5),
+                                       queue=f"q{j % n_queues}")))
+    for i in range(n_tasks):
+        j = min(i // per_job, n_jobs - 1)
+        cache.add_pod(Pod(
+            metadata=ObjectMeta(
+                name=f"p{i:06d}", namespace="bench", uid=f"p{i}",
+                annotations={GroupNameAnnotationKey: f"pg{j}"},
+                creation_timestamp=float(i)),
+            spec=PodSpec(containers=[Container(
+                requests={"cpu": cpus[i % 4], "memory": mems[(i // 2) % 4]})]),
+            status=PodStatus(phase="Pending")))
+    return cache, binder

@@ -41,10 +41,83 @@ class TensorSnapshot:
     queue_ids: List[str] = field(default_factory=list)
     resource_names: List[str] = field(default_factory=list)
     fallback_reason: str = ""       # non-empty -> host path required
+    task_job: Optional[np.ndarray] = None    # [P_real] i32 job index
+    task_res_f64: Optional[np.ndarray] = None  # [P_pad, R] f64 staging
 
     @property
     def needs_fallback(self) -> bool:
         return bool(self.fallback_reason)
+
+
+@dataclass
+class BatchAggregates:
+    """Vectorized sums for Session.batch_apply (see
+    build_apply_aggregates)."""
+    node_alloc: Dict[str, object]   # node -> Resource (kind==1)
+    node_pipe: Dict[str, object]    # node -> Resource (kind==2)
+    job_alloc: Dict[str, object]    # job uid -> Resource (kind==1)
+    job_sums: Dict[str, object]     # job uid -> Resource (all placed)
+    node_quanta: Dict[str, Tuple[int, int]]  # node -> (cpu, mem) int quanta
+
+
+def _res_from_vec(vec, axis) -> object:
+    from ..api.resource import Resource
+    r = Resource.__new__(Resource)
+    r.milli_cpu = float(vec[0])
+    r.memory = float(vec[1])
+    r.scalar_resources = {axis[i]: float(vec[i])
+                          for i in range(2, len(axis)) if vec[i]}
+    r.max_task_num = 0
+    return r
+
+
+def build_apply_aggregates(snap: "TensorSnapshot", assignment, kind,
+                           ordered) -> BatchAggregates:
+    """Per-node/per-job sums of the solve result, computed with numpy from
+    the f64 staging and int-quanta arrays instead of 50k Resource ops.
+
+    f64 segment sums may associate differently than the sequential per-task
+    adds (<= 1e-10 relative — far below every epsilon); the int grid quanta
+    sums are exact and order-independent."""
+    axis = snap.resource_names
+    r = len(axis)
+    res_f = snap.task_res_f64
+    res_q = np.asarray(snap.inputs.task_res)
+    jobix = snap.task_job
+
+    alloc_idx = ordered[kind[ordered] == 1]
+    pipe_idx = ordered[kind[ordered] == 2]
+
+    def node_sums(idx, arr, dtype):
+        out = np.zeros((len(snap.node_names), arr.shape[1]), dtype)
+        np.add.at(out, assignment[idx], arr[idx])
+        return out
+
+    def to_res_dict(vec2d, names, touched):
+        return {names[i]: _res_from_vec(vec2d[i], axis) for i in touched}
+
+    n_alloc_vec = node_sums(alloc_idx, res_f, np.float64)
+    n_pipe_vec = node_sums(pipe_idx, res_f, np.float64)
+    n_quanta = node_sums(np.concatenate([alloc_idx, pipe_idx]),
+                         res_q, np.int64)
+
+    j_alloc_vec = np.zeros((len(snap.job_uids), r), np.float64)
+    np.add.at(j_alloc_vec, jobix[alloc_idx], res_f[alloc_idx])
+    j_sum_vec = j_alloc_vec.copy()
+    np.add.at(j_sum_vec, jobix[pipe_idx], res_f[pipe_idx])
+
+    nodes_alloc = set(np.unique(assignment[alloc_idx]).tolist())
+    nodes_pipe = set(np.unique(assignment[pipe_idx]).tolist())
+    jobs_alloc = set(np.unique(jobix[alloc_idx]).tolist())
+    jobs_all = jobs_alloc | set(np.unique(jobix[pipe_idx]).tolist())
+    return BatchAggregates(
+        node_alloc=to_res_dict(n_alloc_vec, snap.node_names, nodes_alloc),
+        node_pipe=to_res_dict(n_pipe_vec, snap.node_names, nodes_pipe),
+        job_alloc=to_res_dict(j_alloc_vec, snap.job_uids, jobs_alloc),
+        job_sums=to_res_dict(j_sum_vec, snap.job_uids, jobs_all),
+        node_quanta={snap.node_names[i]: (int(n_quanta[i, 0]),
+                                          int(n_quanta[i, 1]))
+                     for i in nodes_alloc | nodes_pipe})
 
 
 def _resource_axis(ssn) -> List[str]:
@@ -309,6 +382,8 @@ def tensorize_session(ssn) -> TensorSnapshot:
             tasks.append(t)
 
     snap.tasks = tasks
+    snap.task_job = np.repeat(np.arange(j_real, dtype=np.int32),
+                              job_count[:j_real])
     p_real = len(tasks)
     p_pad = bucket(max(p_real, 1))
     task_req = np.zeros((p_pad, r), _F)
@@ -383,6 +458,7 @@ def tensorize_session(ssn) -> TensorSnapshot:
     (task_req_q, task_res_q, node_idle_q, node_rel_q, node_used_q,
      node_alloc_q, job_init_alloc_q, queue_deserved_q, queue_alloc_q) = (
         np.ascontiguousarray(a, dtype=np.int32) for a in quantized)
+    snap.task_res_f64 = task_res  # f64 staging, reused by apply aggregates
     total_res_q = node_alloc_q[:n_real].sum(axis=0, dtype=np.int64) \
         if n_real else np.zeros((r,), np.int64)
 

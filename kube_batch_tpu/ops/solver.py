@@ -318,6 +318,21 @@ class SolveResult(NamedTuple):
     step: jnp.ndarray        # scalar i32 total placements
 
 
+@jax.jit
+def _pack_result(assignment, kind, order):
+    return jnp.stack([assignment, kind, order])
+
+
+def fetch_result(result: "SolveResult"):
+    """Device->host readback of (assignment, kind, order) as ONE transfer:
+    the TPU tunnel charges fixed latency per transfer, so three np.asarray
+    calls cost 3x (models/shipping.py is the mirror-image on the way in)."""
+    import numpy as np
+    packed = np.asarray(_pack_result(result.assignment, result.kind,
+                                     result.order))
+    return packed[0], packed[1], packed[2]
+
+
 def best_solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
     """Pick the fastest correct solver for the current backend: the
     single-kernel Pallas solve on TPU (ops/pallas_solver.py), the two-level

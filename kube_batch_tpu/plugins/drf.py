@@ -104,8 +104,26 @@ class DrfPlugin(Plugin):
             attr.allocated.sub(event.task.resreq)
             self._update_share(attr)
 
+        def on_batch_allocate(batch):
+            # Linear in tasks: one aggregate add + share update per job.
+            if batch.job_sums is not None:
+                for uid, res in batch.job_sums.items():
+                    attr = self.job_attrs.get(uid)
+                    if attr is not None:
+                        attr.allocated.add(res)
+                        self._update_share(attr)
+                return
+            touched = set()
+            for task in batch.tasks:
+                attr = self.job_attrs[task.job]
+                attr.allocated.add(task.resreq)
+                touched.add(task.job)
+            for uid in touched:
+                self._update_share(self.job_attrs[uid])
+
         ssn.add_event_handler(EventHandler(allocate_func=on_allocate,
-                                           deallocate_func=on_deallocate))
+                                           deallocate_func=on_deallocate,
+                                           batch_allocate_func=on_batch_allocate))
 
     def on_session_close(self, ssn) -> None:
         self.total_resource = Resource.empty()

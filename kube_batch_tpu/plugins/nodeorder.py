@@ -66,6 +66,17 @@ class GridUsage:
             dc, dm = self.task_quanta(task)
             self.used[task.node_name] = (uc + dc, um + dm)
 
+    def batch_add(self, batch) -> None:
+        if batch.node_quanta is not None:
+            # Exact: int sums of the same per-task quanta the device adds.
+            for name, (dc, dm) in batch.node_quanta.items():
+                if name in self.used:
+                    uc, um = self.used[name]
+                    self.used[name] = (uc + dc, um + dm)
+            return
+        for task in batch.tasks:
+            self.add(task)
+
     def sub(self, task: TaskInfo) -> None:
         if task.node_name in self.used:
             uc, um = self.used[task.node_name]
@@ -146,7 +157,8 @@ class NodeOrderPlugin(Plugin):
         w = self.weights()
         grid = GridUsage(ssn)
         ssn.add_event_handler(EventHandler(allocate_func=lambda e: grid.add(e.task),
-                                           deallocate_func=lambda e: grid.sub(e.task)))
+                                           deallocate_func=lambda e: grid.sub(e.task),
+                                           batch_allocate_func=grid.batch_add))
         prioritizers = []
         if w["leastrequested"]:
             prioritizers.append((w["leastrequested"],

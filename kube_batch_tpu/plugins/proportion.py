@@ -151,8 +151,30 @@ class ProportionPlugin(Plugin):
             attr.allocated.sub(event.task.resreq)
             self._update_share(attr)
 
+        def on_batch_allocate(batch):
+            # Linear in tasks: one aggregate add + share update per queue.
+            touched = set()
+            if batch.job_sums is not None:
+                for uid, res in batch.job_sums.items():
+                    job = ssn.jobs.get(uid)
+                    if job is None:
+                        continue
+                    attr = self.queue_attrs.get(job.queue)
+                    if attr is not None:
+                        attr.allocated.add(res)
+                        touched.add(job.queue)
+            else:
+                for task in batch.tasks:
+                    job = ssn.jobs[task.job]
+                    attr = self.queue_attrs[job.queue]
+                    attr.allocated.add(task.resreq)
+                    touched.add(job.queue)
+            for qid in touched:
+                self._update_share(self.queue_attrs[qid])
+
         ssn.add_event_handler(EventHandler(allocate_func=on_allocate,
-                                           deallocate_func=on_deallocate))
+                                           deallocate_func=on_deallocate,
+                                           batch_allocate_func=on_batch_allocate))
 
     def on_session_close(self, ssn) -> None:
         self.total_resource = Resource.empty()

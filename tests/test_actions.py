@@ -215,3 +215,72 @@ class TestReclaim:
         run_session(cache, ReclaimAction())
         assert len(evictor.evicts) == 1
         assert evictor.evicts[0].startswith("c1/owner")
+
+
+class TestBatchApply:
+    """The tpu-allocate batched apply path must end in exactly the state the
+    per-task allocate()/pipeline() loop produces."""
+
+    def _spec_session(self):
+        from kube_batch_tpu.scheduler import (DEFAULT_SCHEDULER_CONF,
+                                              load_scheduler_conf)
+        from kube_batch_tpu.framework import open_session
+        from tests.test_tpu_parity import build_cache
+        spec = dict(
+            queues=[("q1", 1), ("q2", 2)],
+            pod_groups=[("pg1", "ns", 2, "q1"), ("pg2", "ns", 1, "q2")],
+            pods=[("ns", f"a{i}", "", "Pending", "1", "1Gi", "pg1")
+                  for i in range(3)]
+            + [("ns", f"b{i}", "", "Pending", "2", "2Gi", "pg2")
+               for i in range(2)],
+            nodes=[("n1", "8", "16Gi"), ("n2", "4", "8Gi")])
+        cache, binder = build_cache(spec)
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        return open_session(cache, tiers), binder
+
+    def _placements(self, ssn):
+        out = []
+        for uid in sorted(ssn.jobs):
+            job = ssn.jobs[uid]
+            for t in sorted(job.tasks.values(), key=lambda t: t.uid):
+                node = "n1" if t.name.startswith("a") else "n2"
+                out.append((t, node, 1))
+        return out
+
+    def _state(self, ssn, binder):
+        from kube_batch_tpu.api import TaskStatus
+        return {
+            "binds": dict(binder.binds),
+            "idle": {n: (node.idle.milli_cpu, node.idle.memory)
+                     for n, node in ssn.nodes.items()},
+            "used": {n: (node.used.milli_cpu, node.used.memory)
+                     for n, node in ssn.nodes.items()},
+            "statuses": {uid: sorted((t.uid, t.status.name)
+                                     for t in job.tasks.values())
+                         for uid, job in ssn.jobs.items()},
+            "allocated": {uid: (job.allocated.milli_cpu,
+                                job.allocated.memory)
+                          for uid, job in ssn.jobs.items()},
+            "node_tasks": {n: sorted(node.tasks)
+                           for n, node in ssn.nodes.items()},
+        }
+
+    def test_batch_matches_sequential(self):
+        ssn1, b1 = self._spec_session()
+        ssn1._apply_sequential(self._placements(ssn1))
+        ssn2, b2 = self._spec_session()
+        ssn2.batch_apply(self._placements(ssn2))
+        assert self._state(ssn1, b1) == self._state(ssn2, b2)
+
+    def test_infeasible_batch_falls_back_to_sequential(self):
+        # Sum of placements overdraws n2 beyond epsilon: the pre-check must
+        # reject per task (sequential semantics), not drive idle negative.
+        ssn, binder = self._spec_session()
+        big = [p for p in self._placements(ssn)]
+        # Route everything onto the small node n2 (4 cpu): 3x1 + 2x2 = 7cpu.
+        big = [(t, "n2", 1) for t, _, _ in big]
+        ssn.batch_apply(big)
+        node = ssn.nodes["n2"]
+        assert node.idle.milli_cpu >= -10  # never beyond epsilon overdraft
+        # All tasks that DID apply are accounted; the overflow ones skipped.
+        assert node.used.milli_cpu <= 4000 + 10

@@ -5,9 +5,12 @@ snapshot (models/tensor_snapshot.py), run the batched solver on TPU
 (ops/solver.py), then apply the placements back through the session so
 plugins, gang dispatch, and binders observe exactly the same sequence of
 events as the host allocate action.  Selectable from the YAML conf as
-``actions: "tpu-allocate, backfill"`` with zero CRD changes; sessions using
-features the device path doesn't express yet (host ports, inter-pod
-affinity) fall back to the host allocate action transparently.
+``actions: "tpu-allocate, backfill"`` with zero CRD changes.  Host ports
+and required inter-pod (anti-)affinity run ON DEVICE via dynamic occupancy
+tensors; only the remaining gaps (preferred-node-affinity scoring,
+fractional/oversized score weights, int32-overflowing magnitudes, or
+pathological port/selector cardinality) fall back to the host allocate
+action transparently.
 """
 
 from __future__ import annotations

@@ -88,6 +88,10 @@ def make_synthetic_inputs(n_tasks: int = 1000, n_nodes: int = 100,
         task_req=jnp.asarray(task_req), task_res=jnp.asarray(task_req),
         task_sig=jnp.zeros((p_pad,), jnp.int32),
         task_sorted=jnp.arange(p_pad, dtype=jnp.int32),
+        task_ports=jnp.zeros((p_pad, 8), bool),
+        task_aff_req=jnp.zeros((p_pad, 8), bool),
+        task_anti=jnp.zeros((p_pad, 8), bool),
+        task_match=jnp.zeros((p_pad, 8), bool),
         job_start=jnp.asarray(job_start), job_count=jnp.asarray(job_count),
         job_queue=jnp.asarray(job_queue), job_minavail=jnp.asarray(job_minavail),
         job_prio=dev(np.zeros((j_pad,), f)),
@@ -107,6 +111,8 @@ def make_synthetic_inputs(n_tasks: int = 1000, n_nodes: int = 100,
         node_count=jnp.zeros((n_pad,), jnp.int32),
         node_max_tasks=jnp.full((n_pad,), 1 << 30, jnp.int32),
         node_exists=jnp.asarray(node_exists),
+        node_ports=jnp.zeros((n_pad, 8), bool),
+        node_selcnt=jnp.zeros((n_pad, 8), jnp.int32),
         sig_mask=jnp.asarray(np.ones((1, n_pad), bool) & node_exists[None, :]),
         total_res=jnp.asarray(total.astype(np.float64), dtype=dtype),
         eps=eps_vector(r),

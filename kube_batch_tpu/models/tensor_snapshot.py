@@ -155,17 +155,55 @@ def _task_signature(task) -> tuple:
 
 
 def _uses_dynamic_predicates(task) -> Optional[str]:
-    """Features the device mask can't express statically yet."""
-    for c in task.pod.spec.containers:
-        if any(p.host_port > 0 for p in c.ports):
-            return "host ports"
+    """Features the device path can't express yet.  Host ports and required
+    inter-pod (anti-)affinity are handled by dynamic occupancy tensors in
+    the solver loop; only soft scoring features still force the host path."""
     affinity = task.pod.spec.affinity
-    if affinity is not None and (affinity.required_pod_affinity
-                                 or affinity.required_pod_anti_affinity):
-        return "inter-pod affinity"
     if affinity is not None and affinity.preferred_node_terms:
         return "preferred node affinity scoring"
     return None
+
+
+def _task_port_keys(task) -> list:
+    """(host_port, protocol) keys, the conflict domain of the host's
+    host_ports_conflict (plugins/predicates.py, predicates.go:174)."""
+    return [(p.host_port, p.protocol)
+            for c in task.pod.spec.containers for p in c.ports
+            if p.host_port > 0]
+
+
+# Cardinality caps for the dynamic-predicate tensors; beyond these the
+# session falls back to the host path (both are generous for real clusters:
+# distinct host ports and distinct affinity selectors are small sets).
+_MAX_PORT_KEYS = 64
+_MAX_SELECTORS = 32
+
+
+def _static_example(task):
+    """Example task for the static signature mask with the dynamic features
+    (host ports, pod (anti-)affinity) stripped: those are re-evaluated
+    in-loop from occupancy tensors, and baking today's occupancy into the
+    static mask would wrongly freeze it (a pod placed later can satisfy a
+    required affinity)."""
+    from dataclasses import replace as dc_replace
+    spec = task.pod.spec
+    has_ports = any(p.host_port > 0 for c in spec.containers
+                    for p in c.ports)
+    affinity = spec.affinity
+    has_aff = affinity is not None and (affinity.required_pod_affinity
+                                        or affinity.required_pod_anti_affinity)
+    if not has_ports and not has_aff:
+        return task
+    containers = ([dc_replace(c, ports=[]) for c in spec.containers]
+                  if has_ports else spec.containers)
+    if has_aff:
+        affinity = dc_replace(affinity, required_pod_affinity=[],
+                              required_pod_anti_affinity=[])
+    stripped = task.clone_lite()
+    stripped.pod = dc_replace(
+        task.pod, spec=dc_replace(spec, containers=containers,
+                                  affinity=affinity))
+    return stripped
 
 
 _SUPPORTED_PLUGINS = {"priority", "gang", "drf", "proportion", "predicates",
@@ -322,6 +360,14 @@ def tensorize_session(ssn) -> TensorSnapshot:
     sig_of_task: List[int] = []
     signatures: Dict[tuple, int] = {}
     sig_examples: List = []
+    # Dynamic-predicate indexes: (host_port, protocol) -> id and
+    # selector-tuple -> id, filled while walking candidates.
+    from collections import defaultdict
+    port_index: Dict[tuple, int] = {}
+    sel_index: Dict[tuple, int] = {}
+    task_port_ids = defaultdict(list)
+    task_aff_ids = defaultdict(list)
+    task_anti_ids = defaultdict(list)
 
     for ji, uid in enumerate(job_uids):
         job = ssn.jobs[uid]
@@ -373,6 +419,24 @@ def tensorize_session(ssn) -> TensorSnapshot:
                     snap.fallback_reason = reason
                     return snap
                 sig = _task_signature(t)
+                # Dynamic predicates: collect this task's port keys and
+                # affinity selectors into the session-wide index.
+                for pk in _task_port_keys(t):
+                    if pk not in port_index:
+                        port_index[pk] = len(port_index)
+                    task_port_ids[len(tasks)].append(port_index[pk])
+                affinity = spec.affinity
+                if affinity is not None:
+                    for sel in affinity.required_pod_affinity:
+                        sk = tuple(sorted(sel.items()))
+                        if sk not in sel_index:
+                            sel_index[sk] = len(sel_index)
+                        task_aff_ids[len(tasks)].append(sel_index[sk])
+                    for sel in affinity.required_pod_anti_affinity:
+                        sk = tuple(sorted(sel.items()))
+                        if sk not in sel_index:
+                            sel_index[sk] = len(sel_index)
+                        task_anti_ids[len(tasks)].append(sel_index[sk])
             else:
                 sig = ((), (), ())  # the common unconstrained pod
             if sig not in signatures:
@@ -403,16 +467,72 @@ def tensorize_session(ssn) -> TensorSnapshot:
         task_sig[:p_real] = sig_of_task
     task_sorted = np.arange(p_pad, dtype=np.int32)  # already emitted in order
 
+    # ---- dynamic-predicate tensors ---------------------------------------
+    np_real, ns_real = len(port_index), len(sel_index)
+    if np_real > _MAX_PORT_KEYS:
+        snap.fallback_reason = f"{np_real} distinct host-port keys"
+        return snap
+    if ns_real > _MAX_SELECTORS:
+        snap.fallback_reason = f"{ns_real} distinct affinity selectors"
+        return snap
+    np_pad = bucket(max(np_real, 1))
+    ns_pad = bucket(max(ns_real, 1))
+    task_ports = np.zeros((p_pad, np_pad), bool)
+    task_aff_req = np.zeros((p_pad, ns_pad), bool)
+    task_anti = np.zeros((p_pad, ns_pad), bool)
+    task_match = np.zeros((p_pad, ns_pad), bool)
+    node_ports0 = np.zeros((n_pad, np_pad), bool)
+    node_selcnt0 = np.zeros((n_pad, ns_pad), np.int32)
+    if np_real:
+        for ti, ids in task_port_ids.items():
+            task_ports[ti, ids] = True
+        # Occupancy from resident tasks (only session-relevant keys matter).
+        for nix, node in enumerate(node_objs):
+            for rt in node.tasks.values():
+                for pk in _task_port_keys(rt):
+                    pid = port_index.get(pk)
+                    if pid is not None:
+                        node_ports0[nix, pid] = True
+    if ns_real:
+        selectors = [dict(sk) for sk, _ in
+                     sorted(sel_index.items(), key=lambda kv: kv[1])]
+        match_cache: Dict[tuple, np.ndarray] = {}
+
+        def matches(labels):
+            # Pods stamped from one template share identical label dicts;
+            # memoize per label-set so a 50k-task session does O(distinct
+            # label sets) selector evaluations, not O(tasks).
+            key = tuple(sorted(labels.items()))
+            row = match_cache.get(key)
+            if row is None:
+                row = np.asarray(
+                    [all(labels.get(k) == v for k, v in sel.items())
+                     for sel in selectors], bool)
+                match_cache[key] = row
+            return row
+
+        for ti, ids in task_aff_ids.items():
+            task_aff_req[ti, ids] = True
+        for ti, ids in task_anti_ids.items():
+            task_anti[ti, ids] = True
+        for ti, t in enumerate(tasks):
+            task_match[ti, :ns_real] = matches(t.pod.metadata.labels)
+        for nix, node in enumerate(node_objs):
+            for rt in node.tasks.values():
+                node_selcnt0[nix, :ns_real] += matches(
+                    rt.pod.metadata.labels)
+
     # ---- static predicate mask [S, N] ------------------------------------
     s_real = max(len(sig_examples), 1)
     sig_mask = np.zeros((s_real, n_pad), bool)
     # Static mask = the session's tiered predicate chain evaluated once per
-    # (signature, node).  Tasks with dynamic predicates (host ports,
-    # inter-pod affinity) already forced a fallback above, and the
-    # pod-count cap is re-checked dynamically on device, so the remaining
-    # checks (unschedulable, selector/affinity, taints, pressure) are
-    # static for the session.
+    # (signature, node) with the dynamic features (host ports, pod
+    # (anti-)affinity) stripped from the example — those re-evaluate every
+    # loop step from occupancy tensors, as does the pod-count cap; the
+    # remaining checks (unschedulable, selector/node-affinity, taints,
+    # pressure) are static for the session.
     for si, example in enumerate(sig_examples):
+        example = _static_example(example)
         for nix, node in enumerate(node_objs):
             try:
                 ssn.predicate_fn(example, node)
@@ -465,6 +585,8 @@ def tensorize_session(ssn) -> TensorSnapshot:
     snap.inputs = SolverInputs(
         task_req=task_req_q, task_res=task_res_q,
         task_sig=dev(task_sig, jnp.int32), task_sorted=dev(task_sorted, jnp.int32),
+        task_ports=dev(task_ports, bool), task_aff_req=dev(task_aff_req, bool),
+        task_anti=dev(task_anti, bool), task_match=dev(task_match, bool),
         job_start=dev(job_start, jnp.int32), job_count=dev(job_count, jnp.int32),
         job_queue=dev(job_queue, jnp.int32),
         job_minavail=dev(job_minavail, jnp.int32),
@@ -479,6 +601,8 @@ def tensorize_session(ssn) -> TensorSnapshot:
         node_count=dev(node_count, jnp.int32),
         node_max_tasks=dev(node_max, jnp.int32),
         node_exists=dev(node_exists, bool),
+        node_ports=dev(node_ports0, bool),
+        node_selcnt=dev(node_selcnt0, jnp.int32),
         sig_mask=dev(sig_mask, bool),
         total_res=np.ascontiguousarray(total_res_q, dtype=np_dtype),
         eps=np.full((r,), EPS_QUANTA, dtype=np.int32),
@@ -490,5 +614,7 @@ def tensorize_session(ssn) -> TensorSnapshot:
         job_key_order=tuple(enabled_job_order),
         queue_key_order=tuple(enabled_queue_order),
         has_gang=has_gang, has_proportion=has_proportion,
+        has_ports=bool(np_real) and has_predicates,
+        has_pod_affinity=bool(ns_real) and has_predicates,
         weights=weights)
     return snap

@@ -54,11 +54,12 @@ def _pad8(x: int) -> int:
     return ((x + 7) // 8) * 8
 
 
-def _solve_kernel(r: int, cfg: SolverConfig,
+def _solve_kernel(r: int, np_pad: int, ns_pad: int, cfg: SolverConfig,
                   scal_ref, total_ref, task_ref, sig_ref, sig_mask_ref,
                   nint_in, ncs_ref, out_in, jdyn_in, qdyn_in,
-                  jsta_ref, qsta_ref, qdes_ref,
-                  nint_ref, out_ref, jdyn_ref, qdyn_ref, scal_out_ref):
+                  nport_in, nsel_in, jsta_ref, qsta_ref, qdes_ref,
+                  nint_ref, out_ref, jdyn_ref, qdyn_ref, nport_ref,
+                  nsel_ref, scal_out_ref):
     """One kernel = one full session solve.  scal_ref (SMEM [1,8] i32):
     [0]=P, [2]=cpu grid shift, [3]=mem grid shift.  total_ref (SMEM [1,R]
     float): cluster totals (DRF denominator).  The *_in refs are aliased
@@ -79,6 +80,11 @@ def _solve_kernel(r: int, cfg: SolverConfig,
     CNT, CAP, EXISTS = 3 * r, 3 * r + 1, 3 * r + 2
     # node_cs rows: shifted cpu/mem capacities
     CS = 0
+    # task_ref column offsets: [req 0:r][res r:2r][ports][aff][anti][match]
+    PORTS_OFF = 2 * r
+    AFF_OFF = PORTS_OFF + np_pad
+    ANTI_OFF = AFF_OFF + ns_pad
+    MATCH_OFF = ANTI_OFF + ns_pad
     # job_sta rows
     JSTART, JCOUNT, JQUEUE, JMIN, JPRIO, JTS, JUID = 0, 1, 2, 3, 4, 5, 6
     # job_dyn rows: [0:r] alloc, then ptr, ready, active
@@ -216,6 +222,25 @@ def _solve_kernel(r: int, cfg: SolverConfig,
             cap_ok = nint_ref[CNT:CNT + 1, :] < nint_ref[CAP:CAP + 1, :]
             exists = nint_ref[EXISTS:EXISTS + 1, :] > 0
             feasible = sig_row & exists & cap_ok & (fit_idle | fit_rel)
+            # Dynamic predicates from occupancy rows (predicates.go:174,
+            # :249-262); padded rows are all-zero no-ops.
+            if cfg.has_ports:
+                conflict = jnp.zeros((1, n), bool)
+                for i in range(np_pad):
+                    tp = task_ref[t, PORTS_OFF + i]
+                    conflict = conflict | ((tp > 0)
+                                           & (nport_ref[i:i + 1, :] > 0))
+                feasible = feasible & ~conflict
+            if cfg.has_pod_affinity:
+                # Boolean algebra only: Mosaic can't legalize select on i1
+                # vectors, so (need ? have : True) becomes (~need | have).
+                aff_ok = jnp.ones((1, n), bool)
+                for s in range(ns_pad):
+                    have = nsel_ref[s:s + 1, :] > 0
+                    need = task_ref[t, AFF_OFF + s] > 0
+                    forbid = task_ref[t, ANTI_OFF + s] > 0
+                    aff_ok = aff_ok & (~need | have) & (~forbid | ~have)
+                feasible = feasible & aff_ok
 
             # Integer grid scoring (ops/scoring.py): exact ints, identical
             # to host and XLA paths on every platform.
@@ -276,6 +301,17 @@ def _solve_kernel(r: int, cfg: SolverConfig,
             @pl.when(placed)
             def _():
                 out_ref[pl.ds(t, 1), :] = row
+
+            if cfg.has_ports:
+                for i in range(np_pad):
+                    tp = task_ref[t, PORTS_OFF + i]
+                    nport_ref[i:i + 1, :] = nport_ref[i:i + 1, :] \
+                        | (onehot.astype(jnp.int32) * (pli * tp))
+            if cfg.has_pod_affinity:
+                for s in range(ns_pad):
+                    m = task_ref[t, MATCH_OFF + s]
+                    nsel_ref[s:s + 1, :] = nsel_ref[s:s + 1, :] \
+                        + onehot.astype(jnp.int32) * (pli * m)
 
             ptr = ptr + pli
             ready_cnt = ready_cnt + ai
@@ -384,8 +420,17 @@ def solve_allocate_pallas(inp: SolverInputs, cfg: SolverConfig,
     p = inp.task_req.shape[0]
     fdt = inp.job_ts.dtype
 
-    task_data = jnp.concatenate([inp.task_req, inp.task_res],
-                                axis=1).astype(jnp.int32)
+    i32c = lambda x: x.astype(jnp.int32)
+    task_data = jnp.concatenate(
+        [i32c(inp.task_req), i32c(inp.task_res), i32c(inp.task_ports),
+         i32c(inp.task_aff_req), i32c(inp.task_anti), i32c(inp.task_match)],
+        axis=1)
+    np_pad = inp.task_ports.shape[1]
+    ns_pad = inp.task_aff_req.shape[1]
+    # bucket() widths are powers of two >= 8, already sublane-aligned.
+    assert np_pad % 8 == 0 and ns_pad % 8 == 0
+    nport = i32c(inp.node_ports).T
+    nsel = i32c(inp.node_selcnt).T
     task_sig2 = inp.task_sig[:, None]
     sig_mask_f = inp.sig_mask.astype(fdt)
     (node_int, node_cs, jsta, jdyn, qdes, qsta,
@@ -399,38 +444,27 @@ def solve_allocate_pallas(inp: SolverInputs, cfg: SolverConfig,
          jnp.zeros((4,), jnp.int32)])[None, :]
     total = inp.total_res.astype(fdt)[None, :]
 
-    kernel = functools.partial(_solve_kernel, r, cfg)
+    kernel = functools.partial(_solve_kernel, r, np_pad, ns_pad, cfg)
     ni_rows, n = node_int.shape
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     outs = pl.pallas_call(
         kernel,
         out_shape=(jax.ShapeDtypeStruct((ni_rows, n), jnp.int32),
                    jax.ShapeDtypeStruct((p, 4), jnp.int32),
                    jax.ShapeDtypeStruct(jdyn.shape, jnp.int32),
                    jax.ShapeDtypeStruct(qdyn.shape, jnp.int32),
+                   jax.ShapeDtypeStruct(nport.shape, jnp.int32),
+                   jax.ShapeDtypeStruct(nsel.shape, jnp.int32),
                    jax.ShapeDtypeStruct((1, 8), jnp.int32)),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
-                  pl.BlockSpec(memory_space=pltpu.SMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=(pl.BlockSpec(memory_space=pltpu.VMEM),
-                   pl.BlockSpec(memory_space=pltpu.VMEM),
-                   pl.BlockSpec(memory_space=pltpu.VMEM),
-                   pl.BlockSpec(memory_space=pltpu.VMEM),
-                   pl.BlockSpec(memory_space=pltpu.SMEM)),
-        input_output_aliases={5: 0, 7: 1, 8: 2, 9: 3},
+        in_specs=[smem, smem] + [vmem] * 13,
+        out_specs=(vmem, vmem, vmem, vmem, vmem, vmem, smem),
+        input_output_aliases={5: 0, 7: 1, 8: 2, 9: 3, 10: 4, 11: 5},
         interpret=interpret,
     )(scal, total, task_data, task_sig2, sig_mask_f,
-      node_int, node_cs, out_buf0, jdyn, qdyn, jsta, qsta, qdes)
+      node_int, node_cs, out_buf0, jdyn, qdyn, nport, nsel,
+      jsta, qsta, qdes)
 
     out = outs[1]
     return SolveResult(assignment=out[:, 0], kind=out[:, 1],
-                       order=out[:, 2], step=outs[4][0, 0])
+                       order=out[:, 2], step=outs[6][0, 0])

@@ -58,6 +58,11 @@ class SolverInputs(NamedTuple):
     task_res: jnp.ndarray       # [P, R] i32 steady requirement (resreq)
     task_sig: jnp.ndarray       # [P] i32 index into sig_mask
     task_sorted: jnp.ndarray    # [P] i32 task ids in (job, task-order) order
+    # dynamic predicates (all-zero unless cfg.has_ports/has_pod_affinity)
+    task_ports: jnp.ndarray     # [P, NP] bool: task uses host-port key
+    task_aff_req: jnp.ndarray   # [P, NS] bool: requires selector matched
+    task_anti: jnp.ndarray      # [P, NS] bool: forbids selector matched
+    task_match: jnp.ndarray     # [P, NS] bool: task's labels match selector
     # jobs (J)
     job_start: jnp.ndarray      # [J] i32 offset into task_sorted
     job_count: jnp.ndarray      # [J] i32 number of candidate tasks
@@ -82,6 +87,8 @@ class SolverInputs(NamedTuple):
     node_count: jnp.ndarray     # [N] i32 resident task count
     node_max_tasks: jnp.ndarray  # [N] i32 pod-count cap
     node_exists: jnp.ndarray    # [N] bool (padding rows False)
+    node_ports: jnp.ndarray     # [N, NP] bool: host-port key in use
+    node_selcnt: jnp.ndarray    # [N, NS] i32: resident tasks matching sel
     sig_mask: jnp.ndarray       # [S, N] bool static predicate mask
     # cluster
     total_res: jnp.ndarray      # [R] sum of allocatable (drf denominator)
@@ -102,6 +109,8 @@ class SolverConfig(NamedTuple):
     queue_key_order: tuple = ("proportion",)
     has_gang: bool = True          # gang registers JobReady
     has_proportion: bool = True    # proportion registers Overused
+    has_ports: bool = False        # any candidate uses host ports
+    has_pod_affinity: bool = False  # any candidate uses pod (anti-)affinity
     weights: ScoreWeights = ScoreWeights()
 
 
@@ -110,6 +119,8 @@ class SolverState(NamedTuple):
     releasing: jnp.ndarray      # [N, R]
     used: jnp.ndarray           # [N, R]
     count: jnp.ndarray          # [N] i32
+    ports: jnp.ndarray          # [N, NP] bool host-port occupancy
+    selcnt: jnp.ndarray         # [N, NS] i32 selector match counts
     job_ptr: jnp.ndarray        # [J] i32 next task offset
     job_active: jnp.ndarray     # [J] bool still in rotation
     job_ready_cnt: jnp.ndarray  # [J] i32 dynamic ready_task_num
@@ -168,6 +179,26 @@ def _select_job(inp: SolverInputs, st: SolverState, q, cfg: SolverConfig):
     return _lex_argmin(mask, keys), mask
 
 
+def dynamic_predicate_mask(cfg: SolverConfig, t, task_ports, task_aff_req,
+                           task_anti, ports, selcnt):
+    """[N] bool: host-port conflicts (predicates.go:174) and required
+    inter-pod (anti-)affinity at hostname topology (predicates.go:249-262),
+    evaluated against the in-loop occupancy state (the reference re-reads
+    its session-view PodLister the same way).  Returns None when neither
+    feature is active (masks compile away)."""
+    ok = None
+    if cfg.has_ports:
+        conflict = (task_ports[t][None, :] & ports).any(axis=-1)
+        ok = ~conflict
+    if cfg.has_pod_affinity:
+        have = selcnt > 0
+        aff_ok = jnp.all(~task_aff_req[t][None, :] | have, axis=-1)
+        anti_ok = jnp.all(~task_anti[t][None, :] | ~have, axis=-1)
+        both = aff_ok & anti_ok
+        ok = both if ok is None else (ok & both)
+    return ok
+
+
 def _job_ready(inp: SolverInputs, st: SolverState, j, cfg: SolverConfig):
     """ssn.JobReady: gang's ready_task_num >= minAvailable; True when gang is
     absent (session_plugins.go:184-203)."""
@@ -208,6 +239,10 @@ def solver_step(inp: SolverInputs, cfg: SolverConfig,
                              inp.scalar_dims)
     feasible = (inp.sig_mask[inp.task_sig[t]] & inp.node_exists
                 & (st.count < inp.node_max_tasks) & (fit_idle | fit_rel))
+    dyn = dynamic_predicate_mask(cfg, t, inp.task_ports, inp.task_aff_req,
+                                 inp.task_anti, st.ports, st.selcnt)
+    if dyn is not None:
+        feasible = feasible & dyn
     any_feasible = feasible.any()
 
     placing = act & ~exhausted & any_feasible
@@ -228,6 +263,14 @@ def solver_step(inp: SolverInputs, cfg: SolverConfig,
     releasing = st.releasing.at[n].add(jnp.where(pipe_ok, -dres, 0))
     used = st.used.at[n].add(dres)
     count = st.count.at[n].add(placed.astype(st.count.dtype))
+    ports = st.ports
+    if cfg.has_ports:
+        ports = ports.at[n].set(
+            ports[n] | (placed & inp.task_ports[t]))
+    selcnt = st.selcnt
+    if cfg.has_pod_affinity:
+        selcnt = selcnt.at[n].add(
+            jnp.where(placed, inp.task_match[t].astype(selcnt.dtype), 0))
 
     # Event handlers fire for both allocate and pipeline (session.go:269-275):
     # DRF job share and proportion queue share grow by resreq.
@@ -268,6 +311,7 @@ def solver_step(inp: SolverInputs, cfg: SolverConfig,
 
     return SolverState(
         idle=idle, releasing=releasing, used=used, count=count,
+        ports=ports, selcnt=selcnt,
         job_ptr=job_ptr, job_active=job_active,
         job_ready_cnt=job_ready_cnt, job_alloc=job_alloc,
         queue_alloc=queue_alloc, queue_active=queue_active,
@@ -288,6 +332,7 @@ def initial_state(inp: SolverInputs) -> SolverState:
     return SolverState(
         idle=inp.node_idle, releasing=inp.node_releasing, used=inp.node_used,
         count=inp.node_count,
+        ports=inp.node_ports, selcnt=inp.node_selcnt,
         job_ptr=jnp.zeros((j,), jnp.int32), job_active=job_active,
         job_ready_cnt=inp.job_init_ready, job_alloc=inp.job_init_alloc,
         queue_alloc=inp.queue_init_alloc, queue_active=queue_active,
@@ -390,8 +435,8 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
     def drain_job(j, carry):
         """Inner loop: place tasks of job j until the reference's task loop
         would break.  Returns (carry', survive)."""
-        (idle, releasing, used, count, out_node, out_kind, out_order,
-         job_ptr, job_ready_cnt, step) = carry
+        (idle, releasing, used, count, ports, selcnt, out_node, out_kind,
+         out_order, job_ptr, job_ready_cnt, step) = carry
         start = inp.job_start[j]
         count_j = inp.job_count[j]
         minavail = inp.job_minavail[j]
@@ -403,7 +448,7 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
             """One placement of the reference inner task loop; a no-op once
             the done flag is set (lets UNROLL placements share one loop
             iteration's dispatch overhead)."""
-            (done, survive, idle, releasing, used, count,
+            (done, survive, idle, releasing, used, count, ports, selcnt,
              out_node, out_kind, out_order, ptr, ready_cnt, dstep, dres) = ic
             exhausted = ptr >= count_j
             t = inp.task_sorted[jnp.clip(start + ptr, 0, p - 1)]
@@ -414,6 +459,11 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
             fit_rel = _unrolled_le(req, releasing, r)
             feasible = (inp.sig_mask[inp.task_sig[t]] & inp.node_exists
                         & (count < inp.node_max_tasks) & (fit_idle | fit_rel))
+            dyn = dynamic_predicate_mask(cfg, t, inp.task_ports,
+                                         inp.task_aff_req, inp.task_anti,
+                                         ports, selcnt)
+            if dyn is not None:
+                feasible = feasible & dyn
 
             score = jnp.where(feasible, score_fn(res, used), neg_inf)
             nsel = jnp.argmax(score).astype(jnp.int32)
@@ -429,6 +479,13 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
             releasing = releasing.at[nsel].add(jnp.where(pipe_ok, -fres, 0))
             used = used.at[nsel].add(fres)
             count = count.at[nsel].add(placed.astype(count.dtype))
+            if cfg.has_ports:
+                ports = ports.at[nsel].set(
+                    ports[nsel] | (placed & inp.task_ports[t]))
+            if cfg.has_pod_affinity:
+                selcnt = selcnt.at[nsel].add(
+                    jnp.where(placed, inp.task_match[t].astype(selcnt.dtype),
+                              0))
 
             out_node = out_node.at[t].set(jnp.where(placed, nsel, out_node[t]))
             out_kind = out_kind.at[t].set(
@@ -450,7 +507,7 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
             new_survive = ~exhausted & feasible_any & ready & remaining
             return (done | new_done,
                     jnp.where(done, survive, new_survive),
-                    idle, releasing, used, count,
+                    idle, releasing, used, count, ports, selcnt,
                     out_node, out_kind, out_order, ptr, ready_cnt, dstep, dres)
 
         def inner_body(ic):
@@ -459,16 +516,17 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
             return ic
 
         init = (jnp.bool_(False), jnp.bool_(False), idle, releasing, used,
-                count, out_node, out_kind, out_order, job_ptr[j],
-                job_ready_cnt[j], step, jnp.zeros((r,), inp.task_res.dtype))
-        (done, survive, idle, releasing, used, count, out_node, out_kind,
-         out_order, ptr, ready_cnt, step, dres) = jax.lax.while_loop(
-            inner_cond, inner_body, init)
+                count, ports, selcnt, out_node, out_kind, out_order,
+                job_ptr[j], job_ready_cnt[j], step,
+                jnp.zeros((r,), inp.task_res.dtype))
+        (done, survive, idle, releasing, used, count, ports, selcnt,
+         out_node, out_kind, out_order, ptr, ready_cnt, step,
+         dres) = jax.lax.while_loop(inner_cond, inner_body, init)
 
         job_ptr = job_ptr.at[j].set(ptr)
         job_ready_cnt = job_ready_cnt.at[j].set(ready_cnt)
-        carry = (idle, releasing, used, count, out_node, out_kind, out_order,
-                 job_ptr, job_ready_cnt, step)
+        carry = (idle, releasing, used, count, ports, selcnt, out_node,
+                 out_kind, out_order, job_ptr, job_ready_cnt, step)
         return carry, survive, dres
 
     def outer_cond(oc):
@@ -476,8 +534,8 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
 
     def outer_body(oc):
         (queue_active, job_active, job_alloc, queue_alloc, idle, releasing,
-         used, count, out_node, out_kind, out_order, job_ptr, job_ready_cnt,
-         step) = oc
+         used, count, ports, selcnt, out_node, out_kind, out_order, job_ptr,
+         job_ready_cnt, step) = oc
 
         # -- queue selection (allocate.go:90-108) ---------------------------
         qkeys = []
@@ -510,8 +568,8 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
         retire_queue = overused | ~queue_has_job
 
         # -- drain the popped job ------------------------------------------
-        carry = (idle, releasing, used, count, out_node, out_kind, out_order,
-                 job_ptr, job_ready_cnt, step)
+        carry = (idle, releasing, used, count, ports, selcnt, out_node,
+                 out_kind, out_order, job_ptr, job_ready_cnt, step)
 
         def do_drain(args):
             carry, j = args
@@ -524,8 +582,8 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
 
         carry, survive, dres = jax.lax.cond(
             retire_queue, skip_drain, do_drain, (carry, j))
-        (idle, releasing, used, count, out_node, out_kind, out_order,
-         job_ptr, job_ready_cnt, step) = carry
+        (idle, releasing, used, count, ports, selcnt, out_node, out_kind,
+         out_order, job_ptr, job_ready_cnt, step) = carry
 
         processed = ~retire_queue
         # Deferred fairness events: one segment-add per pop boundary.
@@ -537,8 +595,8 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
             jnp.where(retire_queue, False, queue_active[q]))
 
         return (queue_active, job_active, job_alloc, queue_alloc, idle,
-                releasing, used, count, out_node, out_kind, out_order,
-                job_ptr, job_ready_cnt, step)
+                releasing, used, count, ports, selcnt, out_node, out_kind,
+                out_order, job_ptr, job_ready_cnt, step)
 
     jdim = inp.job_start.shape[0]
     qdim = inp.queue_deserved.shape[0]
@@ -547,10 +605,10 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
         True) & inp.queue_exists
     init = (queue_active0, job_active0, inp.job_init_alloc,
             inp.queue_init_alloc, inp.node_idle, inp.node_releasing,
-            inp.node_used, inp.node_count,
+            inp.node_used, inp.node_count, inp.node_ports, inp.node_selcnt,
             jnp.full((p,), -1, jnp.int32), jnp.zeros((p,), jnp.int32),
             jnp.full((p,), -1, jnp.int32),
             jnp.zeros((jdim,), jnp.int32), inp.job_init_ready, jnp.int32(0))
     final = jax.lax.while_loop(outer_cond, outer_body, init)
-    return SolveResult(assignment=final[8], kind=final[9], order=final[10],
-                       step=final[13])
+    return SolveResult(assignment=final[10], kind=final[11], order=final[12],
+                       step=final[15])

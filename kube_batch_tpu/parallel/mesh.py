@@ -38,6 +38,7 @@ def solver_input_shardings(mesh: Mesh):
     rep2 = NamedSharding(mesh, P(None, None))
     return SolverInputs(
         task_req=rep2, task_res=rep2, task_sig=rep, task_sorted=rep,
+        task_ports=rep2, task_aff_req=rep2, task_anti=rep2, task_match=rep2,
         job_start=rep, job_count=rep, job_queue=rep, job_minavail=rep,
         job_prio=rep, job_ts=rep, job_uid_rank=rep, job_init_ready=rep,
         job_init_alloc=rep2,
@@ -45,7 +46,8 @@ def solver_input_shardings(mesh: Mesh):
         queue_uid_rank=rep, queue_exists=rep,
         node_idle=node_2d, node_releasing=node_2d, node_used=node_2d,
         node_alloc=node_2d, node_count=node_1d, node_max_tasks=node_1d,
-        node_exists=node_1d, sig_mask=sig,
+        node_exists=node_1d, node_ports=node_2d, node_selcnt=node_2d,
+        sig_mask=sig,
         total_res=rep, eps=rep, scalar_dims=rep, score_shift=rep)
 
 

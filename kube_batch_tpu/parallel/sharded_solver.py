@@ -35,7 +35,7 @@ from ..ops.fairness import queue_shares, safe_share
 from ..ops.resources import less_equal_vec
 from ..ops.scoring import SCORE_NEG_INF, grid_score, shifted_caps
 from ..ops.solver import (SolveResult, SolverConfig, SolverInputs,
-                          _lex_argmin, _unrolled_le)
+                          _lex_argmin, _unrolled_le, dynamic_predicate_mask)
 from .mesh import NODE_AXIS
 
 
@@ -47,13 +47,15 @@ def _node_specs():
     rep, rep2 = P(), P(None, None)
     return SolverInputs(
         task_req=rep2, task_res=rep2, task_sig=P(None), task_sorted=P(None),
+        task_ports=rep2, task_aff_req=rep2, task_anti=rep2, task_match=rep2,
         job_start=P(None), job_count=P(None), job_queue=P(None),
         job_minavail=P(None), job_prio=P(None), job_ts=P(None),
         job_uid_rank=P(None), job_init_ready=P(None), job_init_alloc=rep2,
         queue_deserved=rep2, queue_init_alloc=rep2, queue_ts=P(None),
         queue_uid_rank=P(None), queue_exists=P(None),
         node_idle=n2, node_releasing=n2, node_used=n2, node_alloc=n2,
-        node_count=n1, node_max_tasks=n1, node_exists=n1, sig_mask=sig,
+        node_count=n1, node_max_tasks=n1, node_exists=n1,
+        node_ports=n2, node_selcnt=n2, sig_mask=sig,
         total_res=P(None), eps=P(None), scalar_dims=P(None),
         score_shift=P(None))
 
@@ -84,14 +86,14 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
                               cfg.weights)
 
         def drain_job(j, carry):
-            (idle, releasing, used, count, out_node, out_kind, out_order,
-             job_ptr, job_ready_cnt, step) = carry
+            (idle, releasing, used, count, ports, selcnt, out_node,
+             out_kind, out_order, job_ptr, job_ready_cnt, step) = carry
             start = inp.job_start[j]
             count_j = inp.job_count[j]
             minavail = inp.job_minavail[j]
 
             def inner_body(ic):
-                (done, survive, idle, releasing, used, count,
+                (done, survive, idle, releasing, used, count, ports, selcnt,
                  out_node, out_kind, out_order, ptr, ready_cnt, dstep,
                  dres) = ic
                 exhausted = ptr >= count_j
@@ -104,6 +106,11 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
                 feasible = (inp.sig_mask[inp.task_sig[t]] & inp.node_exists
                             & (count < inp.node_max_tasks)
                             & (fit_idle | fit_rel))
+                dyn = dynamic_predicate_mask(cfg, t, inp.task_ports,
+                                             inp.task_aff_req, inp.task_anti,
+                                             ports, selcnt)
+                if dyn is not None:
+                    feasible = feasible & dyn
                 local_score = jnp.where(feasible, score_fn(res, used),
                                         neg_inf)
 
@@ -142,6 +149,12 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
                     jnp.where(pipe_ok & mine, -fres, 0))
                 used = used.at[nsel].add(fres)
                 count = count.at[nsel].add(upd.astype(count.dtype))
+                if cfg.has_ports:
+                    ports = ports.at[nsel].set(
+                        ports[nsel] | (upd & inp.task_ports[t]))
+                if cfg.has_pod_affinity:
+                    selcnt = selcnt.at[nsel].add(jnp.where(
+                        upd, inp.task_match[t].astype(selcnt.dtype), 0))
 
                 # Outputs are replicated: every device records them.
                 out_node = out_node.at[t].set(
@@ -165,26 +178,27 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
                 done = exhausted | ~feasible_any | ready | ~remaining
                 survive = ~exhausted & feasible_any & ready & remaining
                 return (done, survive, idle, releasing, used, count,
-                        out_node, out_kind, out_order, ptr, ready_cnt,
-                        dstep, dres)
+                        ports, selcnt, out_node, out_kind, out_order, ptr,
+                        ready_cnt, dstep, dres)
 
             init = (jnp.bool_(False), jnp.bool_(False), idle, releasing,
-                    used, count, out_node, out_kind, out_order, job_ptr[j],
-                    job_ready_cnt[j], step, jnp.zeros((r,), inp.task_res.dtype))
-            (done, survive, idle, releasing, used, count, out_node,
-             out_kind, out_order, ptr, ready_cnt, step, dres) = \
+                    used, count, ports, selcnt, out_node, out_kind,
+                    out_order, job_ptr[j], job_ready_cnt[j], step,
+                    jnp.zeros((r,), inp.task_res.dtype))
+            (done, survive, idle, releasing, used, count, ports, selcnt,
+             out_node, out_kind, out_order, ptr, ready_cnt, step, dres) = \
                 jax.lax.while_loop(lambda c: ~c[0], inner_body, init)
 
             job_ptr = job_ptr.at[j].set(ptr)
             job_ready_cnt = job_ready_cnt.at[j].set(ready_cnt)
-            carry = (idle, releasing, used, count, out_node, out_kind,
-                     out_order, job_ptr, job_ready_cnt, step)
+            carry = (idle, releasing, used, count, ports, selcnt, out_node,
+                     out_kind, out_order, job_ptr, job_ready_cnt, step)
             return carry, survive, dres
 
         def outer_body(oc):
             (queue_active, job_active, job_alloc, queue_alloc, idle,
-             releasing, used, count, out_node, out_kind, out_order,
-             job_ptr, job_ready_cnt, step) = oc
+             releasing, used, count, ports, selcnt, out_node, out_kind,
+             out_order, job_ptr, job_ready_cnt, step) = oc
 
             qkeys = []
             for name in cfg.queue_key_order:
@@ -217,8 +231,9 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
             j = _lex_argmin(jmask, jkeys)
             retire_queue = overused | ~jmask.any()
 
-            carry = (idle, releasing, used, count, out_node, out_kind,
-                     out_order, job_ptr, job_ready_cnt, step)
+            carry = (idle, releasing, used, count, ports, selcnt,
+                     out_node, out_kind, out_order, job_ptr, job_ready_cnt,
+                     step)
 
             def do_drain(args):
                 carry, j = args
@@ -230,8 +245,8 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
 
             carry, survive, dres = jax.lax.cond(
                 retire_queue, skip_drain, do_drain, (carry, j))
-            (idle, releasing, used, count, out_node, out_kind, out_order,
-             job_ptr, job_ready_cnt, step) = carry
+            (idle, releasing, used, count, ports, selcnt, out_node,
+             out_kind, out_order, job_ptr, job_ready_cnt, step) = carry
 
             processed = ~retire_queue
             job_alloc = job_alloc.at[j].add(jnp.where(processed, dres, 0))
@@ -242,8 +257,8 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
             queue_active = queue_active.at[q].set(
                 jnp.where(retire_queue, False, queue_active[q]))
             return (queue_active, job_active, job_alloc, queue_alloc, idle,
-                    releasing, used, count, out_node, out_kind, out_order,
-                    job_ptr, job_ready_cnt, step)
+                    releasing, used, count, ports, selcnt, out_node,
+                    out_kind, out_order, job_ptr, job_ready_cnt, step)
 
         jdim = inp.job_start.shape[0]
         qdim = inp.queue_deserved.shape[0]
@@ -252,13 +267,14 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
             True) & inp.queue_exists
         init = (queue_active0, job_active0, inp.job_init_alloc,
                 inp.queue_init_alloc, inp.node_idle, inp.node_releasing,
-                inp.node_used, inp.node_count,
+                inp.node_used, inp.node_count, inp.node_ports,
+                inp.node_selcnt,
                 jnp.full((p,), -1, jnp.int32), jnp.zeros((p,), jnp.int32),
                 jnp.full((p,), -1, jnp.int32),
                 jnp.zeros((jdim,), jnp.int32), inp.job_init_ready,
                 jnp.int32(0))
         final = jax.lax.while_loop(lambda oc: oc[0].any(), outer_body, init)
-        return final[8], final[9], final[10], final[13]
+        return final[10], final[11], final[12], final[15]
 
     in_specs = _node_specs()
     out_specs = (P(None), P(None), P(None), P())

@@ -83,6 +83,10 @@ class Affinity:
     # Pod (anti-)affinity on a topology label, matched against pod labels.
     required_pod_affinity: List[Dict[str, str]] = field(default_factory=list)
     required_pod_anti_affinity: List[Dict[str, str]] = field(default_factory=list)
+    # Soft pod (anti-)affinity: (weight, {label: value}) preferences scored
+    # by nodeorder's InterPodAffinity priority (nodeorder.go:107-131).
+    preferred_pod_affinity: List = field(default_factory=list)
+    preferred_pod_anti_affinity: List = field(default_factory=list)
     topology_key: str = "kubernetes.io/hostname"
 
 

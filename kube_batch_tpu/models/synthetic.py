@@ -92,6 +92,8 @@ def make_synthetic_inputs(n_tasks: int = 1000, n_nodes: int = 100,
         task_aff_req=jnp.zeros((p_pad, 8), bool),
         task_anti=jnp.zeros((p_pad, 8), bool),
         task_match=jnp.zeros((p_pad, 8), bool),
+        task_paff_w=jnp.zeros((p_pad, 8), jnp.int32),
+        task_panti_w=jnp.zeros((p_pad, 8), jnp.int32),
         job_start=jnp.asarray(job_start), job_count=jnp.asarray(job_count),
         job_queue=jnp.asarray(job_queue), job_minavail=jnp.asarray(job_minavail),
         job_prio=dev(np.zeros((j_pad,), f)),

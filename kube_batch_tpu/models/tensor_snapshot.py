@@ -234,7 +234,7 @@ def tensorize_session(ssn) -> TensorSnapshot:
     # (session_plugins.go:354-369), so nodeorder + tpu-score both enabled
     # means their weights add.  No scoring plugin -> all-zero scores and the
     # first feasible node wins on both paths.
-    w_least = w_most = w_balanced = 0.0
+    w_least = w_most = w_balanced = w_podaff = 0.0
     for tier in ssn.tiers:
         for option in tier.plugins:
             if option.name not in _SUPPORTED_PLUGINS:
@@ -257,7 +257,8 @@ def tensorize_session(ssn) -> TensorSnapshot:
                 w_least += w["leastrequested"]
                 w_most += w["mostrequested"]
                 w_balanced += w["balancedresource"]
-    if any(w != int(w) for w in (w_least, w_most, w_balanced)):
+                w_podaff += w["podaffinity"]
+    if any(w != int(w) for w in (w_least, w_most, w_balanced, w_podaff)):
         # Grid scoring combines integer weights exactly; fractional weights
         # would need float score sums with platform-dependent rounding.
         snap.fallback_reason = "fractional nodeorder weights"
@@ -368,6 +369,9 @@ def tensorize_session(ssn) -> TensorSnapshot:
     task_port_ids = defaultdict(list)
     task_aff_ids = defaultdict(list)
     task_anti_ids = defaultdict(list)
+    task_paff = defaultdict(list)   # task -> [(sel id, weight)]
+    task_panti = defaultdict(list)
+    w_podaff = int(w_podaff)
 
     for ji, uid in enumerate(job_uids):
         job = ssn.jobs[uid]
@@ -437,6 +441,31 @@ def tensorize_session(ssn) -> TensorSnapshot:
                         if sk not in sel_index:
                             sel_index[sk] = len(sel_index)
                         task_anti_ids[len(tasks)].append(sel_index[sk])
+                    # Preferred (soft) pod affinity feeds the device
+                    # InterPodAffinity score via the same selector counts;
+                    # plugin weight folds into the per-term weights.
+                    if w_podaff:
+                        for weight, sel in affinity.preferred_pod_affinity:
+                            if weight != int(weight):
+                                snap.fallback_reason = \
+                                    "fractional pod-affinity term weight"
+                                return snap
+                            sk = tuple(sorted(sel.items()))
+                            if sk not in sel_index:
+                                sel_index[sk] = len(sel_index)
+                            task_paff[len(tasks)].append(
+                                (sel_index[sk], int(weight) * w_podaff))
+                        for weight, sel in \
+                                affinity.preferred_pod_anti_affinity:
+                            if weight != int(weight):
+                                snap.fallback_reason = \
+                                    "fractional pod-affinity term weight"
+                                return snap
+                            sk = tuple(sorted(sel.items()))
+                            if sk not in sel_index:
+                                sel_index[sk] = len(sel_index)
+                            task_panti[len(tasks)].append(
+                                (sel_index[sk], int(weight) * w_podaff))
             else:
                 sig = ((), (), ())  # the common unconstrained pod
             if sig not in signatures:
@@ -481,6 +510,14 @@ def tensorize_session(ssn) -> TensorSnapshot:
     task_aff_req = np.zeros((p_pad, ns_pad), bool)
     task_anti = np.zeros((p_pad, ns_pad), bool)
     task_match = np.zeros((p_pad, ns_pad), bool)
+    task_paff_w = np.zeros((p_pad, ns_pad), np.int32)
+    task_panti_w = np.zeros((p_pad, ns_pad), np.int32)
+    for ti, pairs in task_paff.items():
+        for sid, wt in pairs:
+            task_paff_w[ti, sid] += wt
+    for ti, pairs in task_panti.items():
+        for sid, wt in pairs:
+            task_panti_w[ti, sid] += wt
     node_ports0 = np.zeros((n_pad, np_pad), bool)
     node_selcnt0 = np.zeros((n_pad, ns_pad), np.int32)
     if np_real:
@@ -521,6 +558,21 @@ def tensorize_session(ssn) -> TensorSnapshot:
             for rt in node.tasks.values():
                 node_selcnt0[nix, :ns_real] += matches(
                     rt.pod.metadata.labels)
+
+    if task_paff or task_panti:
+        # int32 guard for the device score: the pod-affinity term adds
+        # SCORE_GRID_K * sum_s(w_s * selcnt) with selcnt bounded by the
+        # worst-case matching-pod count on one node (residents + every
+        # candidate).  The host computes in Python ints and cannot wrap,
+        # so a wrapping device score would break parity — fall back.
+        from ..ops.resources import SCORE_GRID_K as _K
+        from ..ops.scoring import max_weight_sum as _mws
+        row_w = int((task_paff_w + task_panti_w).sum(axis=1).max())
+        cnt_bound = p_real + int(node_selcnt0.max())
+        if (_mws(weights) * 10 + row_w * cnt_bound) * _K \
+                > np.iinfo(np.int32).max:
+            snap.fallback_reason = "pod-affinity score overflows int32"
+            return snap
 
     # ---- static predicate mask [S, N] ------------------------------------
     s_real = max(len(sig_examples), 1)
@@ -587,6 +639,8 @@ def tensorize_session(ssn) -> TensorSnapshot:
         task_sig=dev(task_sig, jnp.int32), task_sorted=dev(task_sorted, jnp.int32),
         task_ports=dev(task_ports, bool), task_aff_req=dev(task_aff_req, bool),
         task_anti=dev(task_anti, bool), task_match=dev(task_match, bool),
+        task_paff_w=dev(task_paff_w, jnp.int32),
+        task_panti_w=dev(task_panti_w, jnp.int32),
         job_start=dev(job_start, jnp.int32), job_count=dev(job_count, jnp.int32),
         job_queue=dev(job_queue, jnp.int32),
         job_minavail=dev(job_minavail, jnp.int32),
@@ -615,6 +669,7 @@ def tensorize_session(ssn) -> TensorSnapshot:
         queue_key_order=tuple(enabled_queue_order),
         has_gang=has_gang, has_proportion=has_proportion,
         has_ports=bool(np_real) and has_predicates,
-        has_pod_affinity=bool(ns_real) and has_predicates,
+        has_pod_affinity=bool(task_aff_ids or task_anti_ids) and has_predicates,
+        has_pod_affinity_score=bool(task_paff or task_panti),
         weights=weights)
     return snap

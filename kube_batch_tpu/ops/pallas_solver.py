@@ -85,6 +85,8 @@ def _solve_kernel(r: int, np_pad: int, ns_pad: int, cfg: SolverConfig,
     AFF_OFF = PORTS_OFF + np_pad
     ANTI_OFF = AFF_OFF + ns_pad
     MATCH_OFF = ANTI_OFF + ns_pad
+    PAFFW_OFF = MATCH_OFF + ns_pad
+    PANTIW_OFF = PAFFW_OFF + ns_pad
     # job_sta rows
     JSTART, JCOUNT, JQUEUE, JMIN, JPRIO, JTS, JUID = 0, 1, 2, 3, 4, 5, 6
     # job_dyn rows: [0:r] alloc, then ptr, ready, active
@@ -263,6 +265,12 @@ def _solve_kernel(r: int, np_pad: int, ns_pad: int, cfg: SolverConfig,
             if w_bal:
                 score = score + w_bal * (10 * SCORE_GRID_K
                                          - 10 * jnp.abs(gc - gm))
+            if cfg.has_pod_affinity_score:
+                # InterPodAffinity priority (nodeorder.go:107-131 analog).
+                for s in range(ns_pad):
+                    wd = task_ref[t, PAFFW_OFF + s] \
+                        - task_ref[t, PANTIW_OFF + s]
+                    score = score + SCORE_GRID_K * wd * nsel_ref[s:s + 1, :]
             score = jnp.where(feasible, score, neg_score)
 
             best = jnp.max(score)
@@ -307,7 +315,7 @@ def _solve_kernel(r: int, np_pad: int, ns_pad: int, cfg: SolverConfig,
                     tp = task_ref[t, PORTS_OFF + i]
                     nport_ref[i:i + 1, :] = nport_ref[i:i + 1, :] \
                         | (onehot.astype(jnp.int32) * (pli * tp))
-            if cfg.has_pod_affinity:
+            if cfg.has_pod_affinity or cfg.has_pod_affinity_score:
                 for s in range(ns_pad):
                     m = task_ref[t, MATCH_OFF + s]
                     nsel_ref[s:s + 1, :] = nsel_ref[s:s + 1, :] \
@@ -423,7 +431,8 @@ def solve_allocate_pallas(inp: SolverInputs, cfg: SolverConfig,
     i32c = lambda x: x.astype(jnp.int32)
     task_data = jnp.concatenate(
         [i32c(inp.task_req), i32c(inp.task_res), i32c(inp.task_ports),
-         i32c(inp.task_aff_req), i32c(inp.task_anti), i32c(inp.task_match)],
+         i32c(inp.task_aff_req), i32c(inp.task_anti), i32c(inp.task_match),
+         i32c(inp.task_paff_w), i32c(inp.task_panti_w)],
         axis=1)
     np_pad = inp.task_ports.shape[1]
     ns_pad = inp.task_aff_req.shape[1]

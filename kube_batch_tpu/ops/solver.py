@@ -63,6 +63,8 @@ class SolverInputs(NamedTuple):
     task_aff_req: jnp.ndarray   # [P, NS] bool: requires selector matched
     task_anti: jnp.ndarray      # [P, NS] bool: forbids selector matched
     task_match: jnp.ndarray     # [P, NS] bool: task's labels match selector
+    task_paff_w: jnp.ndarray    # [P, NS] i32 preferred-affinity weights
+    task_panti_w: jnp.ndarray   # [P, NS] i32 preferred-anti weights
     # jobs (J)
     job_start: jnp.ndarray      # [J] i32 offset into task_sorted
     job_count: jnp.ndarray      # [J] i32 number of candidate tasks
@@ -111,6 +113,7 @@ class SolverConfig(NamedTuple):
     has_proportion: bool = True    # proportion registers Overused
     has_ports: bool = False        # any candidate uses host ports
     has_pod_affinity: bool = False  # any candidate uses pod (anti-)affinity
+    has_pod_affinity_score: bool = False  # preferred pod-affinity scoring
     weights: ScoreWeights = ScoreWeights()
 
 
@@ -199,6 +202,23 @@ def dynamic_predicate_mask(cfg: SolverConfig, t, task_ports, task_aff_req,
     return ok
 
 
+def interpod_score_term(cfg: SolverConfig, t, task_paff_w, task_panti_w,
+                        selcnt):
+    """[N] i32 InterPodAffinity priority term (nodeorder.go:107-131 analog;
+    see plugins/nodeorder.interpod_affinity_score): grid-scaled sum of
+    preferred term weights times selector match counts.  None when the
+    feature is inactive."""
+    from .resources import SCORE_GRID_K
+    if not cfg.has_pod_affinity_score:
+        return None
+    wdiff = (task_paff_w[t] - task_panti_w[t])[None, :]
+    return SCORE_GRID_K * jnp.sum(wdiff * selcnt, axis=-1)
+
+
+def _needs_selcnt(cfg: SolverConfig) -> bool:
+    return cfg.has_pod_affinity or cfg.has_pod_affinity_score
+
+
 def _job_ready(inp: SolverInputs, st: SolverState, j, cfg: SolverConfig):
     """ssn.JobReady: gang's ready_task_num >= minAvailable; True when gang is
     absent (session_plugins.go:184-203)."""
@@ -249,6 +269,10 @@ def solver_step(inp: SolverInputs, cfg: SolverConfig,
 
     score = score_nodes(res, st.used, inp.node_alloc, inp.score_shift,
                         cfg.weights)
+    pa = interpod_score_term(cfg, t, inp.task_paff_w, inp.task_panti_w,
+                             st.selcnt)
+    if pa is not None:
+        score = score + pa
     score = jnp.where(feasible, score, SCORE_NEG_INF)
     # first max = deterministic tie-break
     n = jnp.argmax(score).astype(jnp.int32)
@@ -268,7 +292,7 @@ def solver_step(inp: SolverInputs, cfg: SolverConfig,
         ports = ports.at[n].set(
             ports[n] | (placed & inp.task_ports[t]))
     selcnt = st.selcnt
-    if cfg.has_pod_affinity:
+    if _needs_selcnt(cfg):
         selcnt = selcnt.at[n].add(
             jnp.where(placed, inp.task_match[t].astype(selcnt.dtype), 0))
 
@@ -465,7 +489,12 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
             if dyn is not None:
                 feasible = feasible & dyn
 
-            score = jnp.where(feasible, score_fn(res, used), neg_inf)
+            score = score_fn(res, used)
+            pa = interpod_score_term(cfg, t, inp.task_paff_w,
+                                     inp.task_panti_w, selcnt)
+            if pa is not None:
+                score = score + pa
+            score = jnp.where(feasible, score, neg_inf)
             nsel = jnp.argmax(score).astype(jnp.int32)
             feasible_any = score[nsel] > neg_inf
 
@@ -482,7 +511,7 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
             if cfg.has_ports:
                 ports = ports.at[nsel].set(
                     ports[nsel] | (placed & inp.task_ports[t]))
-            if cfg.has_pod_affinity:
+            if _needs_selcnt(cfg):
                 selcnt = selcnt.at[nsel].add(
                     jnp.where(placed, inp.task_match[t].astype(selcnt.dtype),
                               0))

@@ -39,6 +39,7 @@ def solver_input_shardings(mesh: Mesh):
     return SolverInputs(
         task_req=rep2, task_res=rep2, task_sig=rep, task_sorted=rep,
         task_ports=rep2, task_aff_req=rep2, task_anti=rep2, task_match=rep2,
+        task_paff_w=rep2, task_panti_w=rep2,
         job_start=rep, job_count=rep, job_queue=rep, job_minavail=rep,
         job_prio=rep, job_ts=rep, job_uid_rank=rep, job_init_ready=rep,
         job_init_alloc=rep2,

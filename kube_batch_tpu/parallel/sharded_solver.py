@@ -35,7 +35,8 @@ from ..ops.fairness import queue_shares, safe_share
 from ..ops.resources import less_equal_vec
 from ..ops.scoring import SCORE_NEG_INF, grid_score, shifted_caps
 from ..ops.solver import (SolveResult, SolverConfig, SolverInputs,
-                          _lex_argmin, _unrolled_le, dynamic_predicate_mask)
+                          _lex_argmin, _needs_selcnt, _unrolled_le,
+                          dynamic_predicate_mask, interpod_score_term)
 from .mesh import NODE_AXIS
 
 
@@ -48,6 +49,7 @@ def _node_specs():
     return SolverInputs(
         task_req=rep2, task_res=rep2, task_sig=P(None), task_sorted=P(None),
         task_ports=rep2, task_aff_req=rep2, task_anti=rep2, task_match=rep2,
+        task_paff_w=rep2, task_panti_w=rep2,
         job_start=P(None), job_count=P(None), job_queue=P(None),
         job_minavail=P(None), job_prio=P(None), job_ts=P(None),
         job_uid_rank=P(None), job_init_ready=P(None), job_init_alloc=rep2,
@@ -111,8 +113,12 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
                                              ports, selcnt)
                 if dyn is not None:
                     feasible = feasible & dyn
-                local_score = jnp.where(feasible, score_fn(res, used),
-                                        neg_inf)
+                local_score = score_fn(res, used)
+                pa = interpod_score_term(cfg, t, inp.task_paff_w,
+                                         inp.task_panti_w, selcnt)
+                if pa is not None:
+                    local_score = local_score + pa
+                local_score = jnp.where(feasible, local_score, neg_inf)
 
                 # Local first-max, then global first-max over ICI: one
                 # pmax for the score, one pmin for the owning global index.
@@ -152,7 +158,7 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
                 if cfg.has_ports:
                     ports = ports.at[nsel].set(
                         ports[nsel] | (upd & inp.task_ports[t]))
-                if cfg.has_pod_affinity:
+                if _needs_selcnt(cfg):
                     selcnt = selcnt.at[nsel].add(jnp.where(
                         upd, inp.task_match[t].astype(selcnt.dtype), 0))
 

@@ -120,6 +120,31 @@ def balanced_resource_score(grid: GridUsage, task: TaskInfo,
     return 10 * SCORE_GRID_K - 10 * abs(gc - gm)
 
 
+def interpod_affinity_score(task: TaskInfo, node: NodeInfo) -> int:
+    """InterPodAffinity priority (the reference registers upstream
+    CalculateInterPodAffinityPriority, nodeorder.go:107-131): sum of
+    preferred pod-affinity term weights times matching-pod counts on the
+    node (hostname topology), minus the anti-affinity terms.  Like the
+    node-affinity scorer we skip upstream's max-normalizing reduce so the
+    score stays a pure per-(task, node) integer, grid-scaled to combine
+    with the fraction scores.  The session view of ``node.tasks`` includes
+    in-flight placements, mirroring the reference's session PodLister."""
+    affinity = task.pod.spec.affinity
+    if affinity is None or not (affinity.preferred_pod_affinity
+                                or affinity.preferred_pod_anti_affinity):
+        return 0
+    score = 0
+    for weight, sel in affinity.preferred_pod_affinity:
+        score += weight * sum(
+            1 for o in node.tasks.values()
+            if all(o.pod.metadata.labels.get(k) == v for k, v in sel.items()))
+    for weight, sel in affinity.preferred_pod_anti_affinity:
+        score -= weight * sum(
+            1 for o in node.tasks.values()
+            if all(o.pod.metadata.labels.get(k) == v for k, v in sel.items()))
+    return score * SCORE_GRID_K
+
+
 def node_affinity_score(task: TaskInfo, node: NodeInfo) -> int:
     """Sum of matching preferred-node-affinity term weights (upstream
     node_affinity.go map phase; we skip the max-normalizing reduce so the
@@ -151,6 +176,7 @@ class NodeOrderPlugin(Plugin):
             "mostrequested": a.get_float(MOST_REQUESTED_WEIGHT, 0.0),
             "balancedresource": a.get_float(BALANCED_RESOURCE_WEIGHT, 1.0),
             "nodeaffinity": a.get_float(NODE_AFFINITY_WEIGHT, 1.0),
+            "podaffinity": a.get_float(POD_AFFINITY_WEIGHT, 1.0),
         }
 
     def on_session_open(self, ssn) -> None:
@@ -171,6 +197,8 @@ class NodeOrderPlugin(Plugin):
                                  lambda t, n: balanced_resource_score(grid, t, n)))
         if w["nodeaffinity"]:
             prioritizers.append((w["nodeaffinity"], node_affinity_score))
+        if w["podaffinity"]:
+            prioritizers.append((w["podaffinity"], interpod_affinity_score))
         ssn.add_node_order_fns(self.name(), prioritizers)
 
     def on_session_close(self, ssn) -> None:

@@ -64,6 +64,24 @@ def run_action(spec, action, conf=DEFAULT_SCHEDULER_CONF):
     return binder.binds
 
 
+def run_both_mutated(mutate, spec):
+    """Run host and device allocate on a mutated cache; assert bind parity."""
+    results = []
+    for action_cls in (AllocateAction, TpuAllocateAction):
+        cache, binder = build_cache(spec)
+        mutate(cache)
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        try:
+            action_cls().execute(ssn)
+        finally:
+            close_session(ssn)
+        results.append(binder.binds)
+    host, tpu = results
+    assert tpu == host
+    return host
+
+
 def assert_parity(spec, conf=DEFAULT_SCHEDULER_CONF):
     host = run_action(spec, AllocateAction(), conf)
     tpu = run_action(spec, TpuAllocateAction(), conf)
@@ -403,22 +421,6 @@ class TestDynamicPredicatesOnDevice:
     """Host ports and required pod (anti-)affinity ride the device path via
     occupancy tensors (VERDICT r1 item 3) — no session fallback."""
 
-    def _run_both(self, mutate, spec):
-        results = []
-        for action_cls in (AllocateAction, TpuAllocateAction):
-            cache, binder = build_cache(spec)
-            mutate(cache)
-            _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
-            ssn = open_session(cache, tiers)
-            try:
-                action_cls().execute(ssn)
-            finally:
-                close_session(ssn)
-            results.append(binder.binds)
-        host, tpu = results
-        assert tpu == host
-        return host
-
     def test_no_fallback_for_ports_and_affinity(self):
         from kube_batch_tpu.api.objects import Affinity, ContainerPort
         from kube_batch_tpu.models.tensor_snapshot import tensorize_session
@@ -457,7 +459,7 @@ class TestDynamicPredicatesOnDevice:
                   for i in range(3)],
             nodes=[("n1", "8", "16Gi"), ("n2", "8", "16Gi"),
                    ("n3", "8", "16Gi")])
-        binds = self._run_both(mutate, spec)
+        binds = run_both_mutated(mutate, spec)
         # Port 80 conflicts: exactly one pod per node.
         assert len(binds) == 3
         assert len(set(binds.values())) == 3
@@ -480,7 +482,7 @@ class TestDynamicPredicatesOnDevice:
             pods=[("ns", "r0", "n1", "Running", "1", "1Gi", "run"),
                   ("ns", "p0", "", "Pending", "1", "1Gi", "pg1")],
             nodes=[("n1", "8", "16Gi"), ("n2", "8", "16Gi")])
-        binds = self._run_both(mutate, spec)
+        binds = run_both_mutated(mutate, spec)
         assert binds == {"ns/p0": "n2"}  # n1's port already taken
 
     def test_anti_affinity_spreads(self):
@@ -498,7 +500,7 @@ class TestDynamicPredicatesOnDevice:
             pods=[("ns", f"p{i}", "", "Pending", "1", "1Gi", "pg1")
                   for i in range(2)],
             nodes=[("n1", "8", "16Gi"), ("n2", "8", "16Gi")])
-        binds = self._run_both(mutate, spec)
+        binds = run_both_mutated(mutate, spec)
         assert len(binds) == 2 and len(set(binds.values())) == 2
 
     def test_required_affinity_follows_placed_pod(self):
@@ -521,7 +523,7 @@ class TestDynamicPredicatesOnDevice:
             pods=[("ns", "a0", "", "Pending", "1", "1Gi", "anchor"),
                   ("ns", "f0", "", "Pending", "1", "1Gi", "follow")],
             nodes=[("n1", "8", "16Gi"), ("n2", "8", "16Gi")])
-        binds = self._run_both(mutate, spec)
+        binds = run_both_mutated(mutate, spec)
         assert len(binds) == 2
         assert binds["ns/f0"] == binds["ns/a0"]  # co-located
 
@@ -556,4 +558,107 @@ class TestDynamicPredicatesOnDevice:
                             required_pod_anti_affinity=[
                                 {"grp": t.job.split("/")[-1]}])
 
-        self._run_both(mutate, spec)
+        run_both_mutated(mutate, spec)
+
+
+class TestInterPodAffinityPriority:
+    """Soft pod (anti-)affinity scoring (nodeorder.go:107-131) — host and
+    device agree, and the preference steers placement."""
+
+    def test_preferred_affinity_attracts(self):
+        from kube_batch_tpu.api.objects import Affinity
+
+        def mutate(cache):
+            for t in cache.jobs["ns/anchor"].tasks.values():
+                t.pod.metadata.labels["app"] = "db"
+                t.priority = 100
+            for t in cache.jobs["ns/follow"].tasks.values():
+                t.pod.spec.affinity = Affinity(
+                    preferred_pod_affinity=[(50, {"app": "db"})])
+
+        # Without the preference, least-requested would spread the
+        # follower to the emptier node; the 50-weight term overrides.
+        spec = dict(
+            queues=[("q1", 1)],
+            pod_groups=[("anchor", "ns", 1, "q1"), ("follow", "ns", 1, "q1")],
+            pods=[("ns", "a0", "", "Pending", "2", "2Gi", "anchor"),
+                  ("ns", "f0", "", "Pending", "1", "1Gi", "follow")],
+            nodes=[("n1", "8", "16Gi"), ("n2", "8", "16Gi")])
+        binds = run_both_mutated(mutate, spec)
+        assert binds["ns/f0"] == binds["ns/a0"]
+
+    def test_preferred_anti_affinity_repels(self):
+        from kube_batch_tpu.api.objects import Affinity
+
+        def mutate(cache):
+            for t in cache.jobs["ns/pg1"].tasks.values():
+                t.pod.metadata.labels["app"] = "web"
+                t.pod.spec.affinity = Affinity(
+                    preferred_pod_anti_affinity=[(50, {"app": "web"})])
+
+        spec = dict(
+            queues=[("q1", 1)],
+            pod_groups=[("pg1", "ns", 2, "q1")],
+            pods=[("ns", f"p{i}", "", "Pending", "1", "1Gi", "pg1")
+                  for i in range(2)],
+            nodes=[("n1", "8", "16Gi"), ("n2", "8", "16Gi")])
+        binds = run_both_mutated(mutate, spec)
+        assert len(set(binds.values())) == 2
+
+    def test_device_path_active_for_soft_affinity(self):
+        from kube_batch_tpu.api.objects import Affinity
+        from kube_batch_tpu.models.tensor_snapshot import tensorize_session
+        spec = dict(
+            queues=[("q1", 1)],
+            pod_groups=[("pg1", "ns", 1, "q1")],
+            pods=[("ns", "p0", "", "Pending", "1", "1Gi", "pg1")],
+            nodes=[("n1", "8", "16Gi")])
+        cache, _ = build_cache(spec)
+        for t in cache.jobs["ns/pg1"].tasks.values():
+            t.pod.spec.affinity = Affinity(
+                preferred_pod_affinity=[(10, {"tier": "cache"})])
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        try:
+            snap = tensorize_session(ssn)
+            assert not snap.needs_fallback, snap.fallback_reason
+            assert snap.config.has_pod_affinity_score
+            assert not snap.config.has_pod_affinity  # no required terms
+        finally:
+            close_session(ssn)
+
+    @pytest.mark.parametrize("seed", [40, 41, 42])
+    def test_random_with_soft_affinity(self, seed):
+        from kube_batch_tpu.api.objects import Affinity
+        rng = random.Random(seed)
+        spec = dict(
+            queues=[("q0", 1), ("q1", 2)],
+            pod_groups=[], pods=[],
+            nodes=[(f"n{i}", "8", "16Gi") for i in range(4)])
+        for j in range(5):
+            size = rng.randint(1, 4)
+            spec["pod_groups"].append(
+                (f"pg{j}", "ns", rng.randint(1, size), f"q{j % 2}"))
+            for i in range(size):
+                spec["pods"].append(("ns", f"j{j}-p{i}", "", "Pending",
+                                     str(rng.choice([1, 2])),
+                                     f"{rng.choice([1, 2])}Gi", f"pg{j}"))
+
+        def mutate(cache):
+            rng2 = random.Random(seed + 900)
+            for job in list(cache.jobs.values()):
+                for t in list(job.tasks.values()):
+                    t.pod.metadata.labels["grp"] = t.job.split("/")[-1]
+                    roll = rng2.random()
+                    if roll < 0.4:
+                        t.pod.spec.affinity = Affinity(
+                            preferred_pod_anti_affinity=[
+                                (rng2.choice([10, 50]),
+                                 {"grp": t.job.split("/")[-1]})])
+                    elif roll < 0.6:
+                        t.pod.spec.affinity = Affinity(
+                            preferred_pod_affinity=[
+                                (rng2.choice([10, 50]),
+                                 {"grp": f"pg{rng2.randrange(5)}"})])
+
+        run_both_mutated(mutate, spec)

@@ -402,11 +402,60 @@ def fetch_result(result: "SolveResult"):
     return packed[0], packed[1], packed[2]
 
 
-def best_solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
-    """Pick the fastest correct solver for the current backend: the
-    single-kernel Pallas solve on TPU (ops/pallas_solver.py), the two-level
-    XLA solve elsewhere.  Both are placement-identical (parity suite)."""
+# A single chip solves comfortably until node-major state approaches its
+# VMEM/HBM working-set budget; past this the session shards over the mesh.
+# Overridable for ops tuning; FORCE_SHARD exists for tests and drills.
+SHARD_BYTES_ENV = "KUBE_BATCH_TPU_SHARD_BYTES"
+FORCE_SHARD_ENV = "KUBE_BATCH_TPU_FORCE_SHARD"
+DEFAULT_SHARD_BYTES = 256 * 1024 * 1024
+
+
+def _node_state_bytes(inp: SolverInputs) -> int:
+    """Approximate node-major working set: the only state that scales with
+    the cluster's node count (everything else is replicated)."""
+    n = inp.node_idle.shape[0]
+    r = inp.node_idle.shape[1]
+    per_node = (4 * r * 4                       # idle/releasing/used/alloc
+                + inp.sig_mask.shape[0]          # static mask rows (bool)
+                + inp.task_ports.shape[1]        # port occupancy (bool)
+                + 4 * inp.task_aff_req.shape[1]  # selector counts (i32)
+                + 16)                            # count/cap/exists/cs rows
+    return n * per_node
+
+
+def choose_solver_mesh(inp: SolverInputs):
+    """('sharded'|'pallas'|'xla', mesh) — one production chokepoint, chosen
+    by shape and environment (SURVEY.md §7 stage 7: pjit-shard [P, N] when
+    it outgrows one chip).  The returned mesh is the one the precondition
+    validated (non-None, node bucket divisible)."""
+    import os
+
+    from ..parallel.mesh import default_mesh
+    mesh = default_mesh()
+    if mesh is not None and inp.node_idle.shape[0] % mesh.size == 0:
+        limit = int(os.environ.get(SHARD_BYTES_ENV, DEFAULT_SHARD_BYTES))
+        if os.environ.get(FORCE_SHARD_ENV) == "1" \
+                or _node_state_bytes(inp) > limit:
+            return "sharded", mesh
     if jax.default_backend() == "tpu":
+        return "pallas", None
+    return "xla", None
+
+
+def choose_solver(inp: SolverInputs) -> str:
+    return choose_solver_mesh(inp)[0]
+
+
+def best_solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
+    """Pick the fastest correct solver for the current shape and backend:
+    the node-sharded mesh solve when the node bucket outgrows one chip, the
+    single-kernel Pallas solve on TPU (ops/pallas_solver.py), the two-level
+    XLA solve elsewhere.  All are placement-identical (parity suite)."""
+    choice, mesh = choose_solver_mesh(inp)
+    if choice == "sharded":
+        from ..parallel.sharded_solver import solve_allocate_sharded
+        return solve_allocate_sharded(inp, cfg, mesh)
+    if choice == "pallas":
         from .pallas_solver import solve_allocate_pallas
         return solve_allocate_pallas(inp, cfg)
     return solve_allocate(inp, cfg)

@@ -26,6 +26,19 @@ def make_mesh(n_devices: Optional[int] = None) -> Mesh:
     return Mesh(devices, (NODE_AXIS,))
 
 
+_default_mesh: Optional[Mesh] = None
+
+
+def default_mesh() -> Optional[Mesh]:
+    """The production mesh over every visible device, or None on a single
+    chip.  Built lazily once; ops.solver.best_solve_allocate routes
+    oversized node buckets through it (SURVEY.md §7 stage 7)."""
+    global _default_mesh
+    if _default_mesh is None and len(jax.devices()) > 1:
+        _default_mesh = make_mesh()
+    return _default_mesh
+
+
 def solver_input_shardings(mesh: Mesh):
     """NamedShardings for ops.solver.SolverInputs: node-major tensors split
     over the mesh, everything else replicated."""

@@ -28,3 +28,62 @@ def test_sharded_runs_on_two_devices():
     mesh = make_mesh(2)
     result = solve_allocate_sharded(inputs, config, mesh)
     assert (np.asarray(result.assignment) >= 0).sum() > 0
+
+
+class TestProductionRouting:
+    """best_solve_allocate routes oversized node buckets to the mesh solve
+    (VERDICT r1 item 5: the sharded path must not be dead code)."""
+
+    def test_force_shard_branch(self, monkeypatch):
+        import numpy as np
+        from kube_batch_tpu.ops.solver import (FORCE_SHARD_ENV,
+                                               best_solve_allocate,
+                                               choose_solver, solve_allocate)
+        inputs, config = make_synthetic_inputs(
+            n_tasks=128, n_nodes=64, n_jobs=16, n_queues=4, seed=3)
+        monkeypatch.setenv(FORCE_SHARD_ENV, "1")
+        assert choose_solver(inputs) == "sharded"
+        sharded = best_solve_allocate(inputs, config)
+        single = solve_allocate(inputs, config)
+        assert np.array_equal(np.asarray(sharded.assignment),
+                              np.asarray(single.assignment))
+
+    def test_size_gate_threshold(self, monkeypatch):
+        from kube_batch_tpu.ops.solver import (SHARD_BYTES_ENV,
+                                               _node_state_bytes,
+                                               choose_solver)
+        inputs, _ = make_synthetic_inputs(
+            n_tasks=64, n_nodes=64, n_jobs=8, n_queues=2, seed=0)
+        monkeypatch.delenv("KUBE_BATCH_TPU_FORCE_SHARD", raising=False)
+        # Tiny bucket on a big threshold: stays single-chip.
+        monkeypatch.setenv(SHARD_BYTES_ENV, str(1 << 40))
+        assert choose_solver(inputs) in ("pallas", "xla")
+        # Threshold below the bucket's footprint: shards.
+        monkeypatch.setenv(SHARD_BYTES_ENV,
+                           str(_node_state_bytes(inputs) - 1))
+        assert choose_solver(inputs) == "sharded"
+
+    def test_action_path_with_forced_shard(self, monkeypatch):
+        # The full tpu-allocate action stays parity-correct through the
+        # sharded branch on the 8-device CPU mesh.
+        from tests.test_tpu_parity import assert_parity
+        from kube_batch_tpu.actions.factory import register_default_actions
+        from kube_batch_tpu.ops.solver import choose_solver
+        from kube_batch_tpu.plugins.factory import register_default_plugins
+        register_default_actions()
+        register_default_plugins()
+        monkeypatch.setenv("KUBE_BATCH_TPU_FORCE_SHARD", "1")
+        # The routing must actually take the sharded branch for this shape,
+        # or the parity assert below silently re-tests the XLA path.
+        probe, _ = make_synthetic_inputs(n_tasks=16, n_nodes=8, n_jobs=4,
+                                         n_queues=2, seed=0)
+        assert choose_solver(probe) == "sharded"
+        spec = dict(
+            queues=[("q1", 1), ("q2", 2)],
+            pod_groups=[(f"pg{j}", "ns", 2, f"q{1 + j % 2}")
+                        for j in range(4)],
+            pods=[("ns", f"j{j}-p{i}", "", "Pending", "1", "1Gi", f"pg{j}")
+                  for j in range(4) for i in range(3)],
+            nodes=[(f"n{i}", "4", "8Gi") for i in range(8)])
+        binds = assert_parity(spec)
+        assert len(binds) == 12

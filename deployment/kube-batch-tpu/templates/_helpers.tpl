@@ -1,0 +1,11 @@
+{{/* vim: set filetype=mustache: */}}
+{{/* Expand the name of the chart (reference templates/_helpers.tpl). */}}
+{{- define "name" -}}
+{{- default .Chart.Name .Values.nameOverride | trunc 63 | trimSuffix "-" -}}
+{{- end -}}
+
+{{/* Fully qualified app name, truncated to the 63-char DNS limit. */}}
+{{- define "fullname" -}}
+{{- $name := default .Chart.Name .Values.nameOverride -}}
+{{- printf "%s-%s" .Release.Name $name | trunc 63 | trimSuffix "-" -}}
+{{- end -}}

@@ -232,3 +232,36 @@ class TestIngestRobustness:
         assert all("ns-bad" != uid for j in cache.jobs.values()
                    for uid in j.tasks)
         assert any(e[0] == "FailedParsePod" for e in cache.events)
+
+
+class TestDeploymentAssets:
+    """The install story must stay in lockstep with the Python API model."""
+
+    def test_crd_manifests_match_api_groups(self):
+        import glob
+        import yaml
+        from kube_batch_tpu.apis.scheduling import v1alpha1, v1alpha2
+        files = sorted(glob.glob("config/crds/*.yaml"))
+        assert len(files) == 4  # PodGroup/Queue x v1alpha1/v1alpha2
+        groups = {v1alpha1.VERSION: v1alpha1.GROUP,
+                  v1alpha2.VERSION: v1alpha2.GROUP}
+        seen = set()
+        for f in files:
+            crd = yaml.safe_load(open(f))
+            version = crd["spec"]["version"]
+            assert crd["spec"]["group"] == groups[version], f
+            kind = crd["spec"]["names"]["kind"]
+            assert kind in ("PodGroup", "Queue")
+            # Queue cluster-scoped, PodGroup namespaced (types.go:89,169).
+            expected_scope = "Cluster" if kind == "Queue" else "Namespaced"
+            assert crd["spec"]["scope"] == expected_scope, f
+            seen.add((version, kind))
+        assert len(seen) == 4
+
+    def test_chart_ships_crds_and_rbac(self):
+        import os
+        base = "deployment/kube-batch-tpu"
+        for path in ("Chart.yaml", "values.yaml", "templates/deployment.yaml",
+                     "templates/rbac.yaml", "templates/default.yaml",
+                     "crds/scheduling_v1alpha1_podgroup.yaml"):
+            assert os.path.exists(os.path.join(base, path)), path

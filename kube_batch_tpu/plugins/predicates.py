@@ -109,8 +109,22 @@ class PredicatesPlugin(Plugin):
         def predicate_fn(task: TaskInfo, node: NodeInfo) -> None:
             if node.node is None:
                 raise FitError(task, node, "node not initialized")
-            # Node pressure conditions (predicates.go:201-247).
             conditions = node.node.status.conditions
+            # NodeCondition predicate (predicates.go:132-146; upstream
+            # CheckNodeConditionPredicate, vendored predicates.go:1675-1698):
+            # schedulable only when a REPORTED Ready condition is "True" and
+            # a reported NetworkUnavailable is "False" (absent conditions
+            # pass — upstream iterates only present ones).  The snapshot
+            # usually excludes such nodes already; this is the
+            # per-predicate form with its distinct messages.
+            ready = conditions.get("Ready")
+            if ready is not None and ready != "True":
+                raise FitError(task, node, "node(s) were not ready")
+            net = conditions.get("NetworkUnavailable")
+            if net is not None and net != "False":
+                raise FitError(task, node,
+                               "node(s) had unavailable network")
+            # Node pressure conditions (predicates.go:201-247).
             if self.check_memory and conditions.get("MemoryPressure") == "True":
                 raise FitError(task, node, "node has memory pressure")
             if self.check_disk and conditions.get("DiskPressure") == "True":

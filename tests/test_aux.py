@@ -265,3 +265,51 @@ class TestDeploymentAssets:
                      "templates/rbac.yaml", "templates/default.yaml",
                      "crds/scheduling_v1alpha1_podgroup.yaml"):
             assert os.path.exists(os.path.join(base, path)), path
+
+
+class TestNodeConditionPredicate:
+    def test_not_ready_node_rejects_with_message(self):
+        from kube_batch_tpu.api import FitError
+        cache, binder, _ = fresh_cache()
+        good = build_node("good", build_resource_list("8", "8Gi", pods=10))
+        bad = build_node("bad", build_resource_list("8", "8Gi", pods=10))
+        bad.status.conditions = {"Ready": "False"}
+        cache.add_node(good)
+        cache.add_node(bad)
+        cache.add_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name="pg", namespace="ns"),
+            spec=v1alpha1.PodGroupSpec(min_member=1, queue="default")))
+        cache.add_pod(build_pod("ns", "p0", "", "Pending",
+                                build_resource_list("1", "1Gi"), "pg"))
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        try:
+            task = list(ssn.jobs["ns/pg"].tasks.values())[0]
+            with pytest.raises(FitError, match="not ready"):
+                ssn.predicate_fn(task, ssn.nodes["bad"])
+            ssn.predicate_fn(task, ssn.nodes["good"])  # no raise
+            AllocateAction().execute(ssn)
+        finally:
+            close_session(ssn)
+        assert binder.binds == {"ns/p0": "good"}
+
+    def test_network_unavailable_rejects(self):
+        from kube_batch_tpu.api import FitError
+        cache, _, _ = fresh_cache()
+        node = build_node("n1", build_resource_list("8", "8Gi", pods=10))
+        # upstream rejects any reported status != "False", incl. Unknown
+        node.status.conditions = {"NetworkUnavailable": "Unknown"}
+        cache.add_node(node)
+        cache.add_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name="pg", namespace="ns"),
+            spec=v1alpha1.PodGroupSpec(min_member=1, queue="default")))
+        cache.add_pod(build_pod("ns", "p0", "", "Pending",
+                                build_resource_list("1", "1Gi"), "pg"))
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        try:
+            task = list(ssn.jobs["ns/pg"].tasks.values())[0]
+            with pytest.raises(FitError, match="unavailable network"):
+                ssn.predicate_fn(task, ssn.nodes["n1"])
+        finally:
+            close_session(ssn)

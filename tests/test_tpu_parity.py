@@ -169,13 +169,6 @@ class TestParitySimple:
             pods=[("ns", "lo", "", "Pending", "2", "2Gi", "pg1"),
                   ("ns", "hi", "", "Pending", "2", "2Gi", "pg1")],
             nodes=[("n1", "3", "8Gi")])
-        # Give hi greater pod priority via rebuild
-        host_cache, host_binder = build_cache(spec)
-        host_cache.jobs["ns/pg1"].tasks  # touch
-        # simpler: priorities through pod spec in a fresh spec
-        spec["pods"] = [("ns", "lo", "", "Pending", "2", "2Gi", "pg1"),
-                        ("ns", "hi", "", "Pending", "2", "2Gi", "pg1")]
-        # patch priority by building pods manually
         cache1, b1 = build_cache(spec)
         cache2, b2 = build_cache(spec)
         for cache in (cache1, cache2):

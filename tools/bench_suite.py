@@ -27,6 +27,8 @@ import numpy as np
 
 
 def report(name, ms, target_ms=1000.0):
+    # vs_baseline is TARGET-relative (BASELINE.json goals): the reference
+    # publishes no measured numbers to compare against (BASELINE.md §6).
     print(json.dumps({"metric": name, "value": round(ms, 2), "unit": "ms",
                       "vs_baseline": round(target_ms / ms, 3)}))
 

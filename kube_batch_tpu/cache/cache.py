@@ -94,6 +94,14 @@ class SchedulerCache(Cache):
     def _add_task(self, ti: _TaskInfo) -> None:
         job = self._get_or_create_job(ti)
         if job is not None:
+            # Watch streams can redeliver an ADDED on relist (the network
+            # edge's reflector, or the replay/live-event overlap at
+            # connect): treat a duplicate as an update so job aggregates
+            # don't double-count (the reference logs 'pod already exists'
+            # and skips; replacing is the resync-friendly form).
+            if ti.uid in job.tasks:
+                self._delete_task(job.tasks[ti.uid])
+                job = self._get_or_create_job(ti)
             job.add_task_info(ti)
         # Terminated pods no longer hold node resources: the reference's
         # addTask only does node accounting for live tasks

@@ -28,12 +28,23 @@ class Informer:
         self.handlers: List[dict] = []
 
     def add_handlers(self, on_add=None, on_update=None, on_delete=None,
-                     filter_fn=None):
-        self.handlers.append(dict(add=on_add, update=on_update,
-                                  delete=on_delete, filter=filter_fn))
+                     filter_fn=None) -> dict:
+        handle = dict(add=on_add, update=on_update,
+                      delete=on_delete, filter=filter_fn)
+        self.handlers.append(handle)
+        return handle
+
+    def remove_handlers(self, handle: dict) -> None:
+        """Unregister (watch connections come and go at the network edge)."""
+        try:
+            self.handlers.remove(handle)
+        except ValueError:
+            pass
 
     def _fire(self, kind: str, *args):
-        for h in self.handlers:
+        # Snapshot: watch connections unregister concurrently (remove_handlers
+        # from a dying stream thread must not shift live iteration indices).
+        for h in list(self.handlers):
             if h["filter"] is not None and not h["filter"](args[-1]):
                 continue
             fn = h[kind]
@@ -186,6 +197,15 @@ class Cluster:
             if pg is not None:
                 self.pod_group_informer.fire_delete(pg)
 
+    def put_pod_group_status(self, pg) -> object:
+        """Status-subresource write (no informer echo back to the writer's
+        own cache, matching the reference's UpdateStatus usage)."""
+        with self.lock:
+            key = f"{pg.metadata.namespace}/{pg.metadata.name}"
+            if key in self.pod_groups:
+                self.pod_groups[key] = pg
+            return pg
+
     def create_queue(self, queue) -> object:
         with self.lock:
             self.queues[queue.metadata.name] = queue
@@ -291,10 +311,7 @@ class ClusterStatusUpdater(StatusUpdater):
     def update_pod_group(self, pg) -> None:
         from ..api.pod_group_info import PodGroup, to_versioned
         obj = to_versioned(pg) if isinstance(pg, PodGroup) else pg
-        key = f"{obj.metadata.namespace}/{obj.metadata.name}"
-        with self.cluster.lock:
-            if key in self.cluster.pod_groups:
-                self.cluster.pod_groups[key] = obj
+        self.cluster.put_pod_group_status(obj)
 
 
 def connect_cache_to_cluster(cache, cluster: Cluster) -> None:

@@ -97,8 +97,19 @@ class ServerRuntime:
 
     def __init__(self, opt: ServerOption, cluster: Optional[Cluster] = None):
         self.opt = opt
-        self.cluster = cluster if cluster is not None else Cluster()
+        if cluster is not None:
+            self.cluster = cluster
+        elif opt.master:
+            # The network edge (reference server.go:55-60 buildConfig):
+            # --master points at an edge.server.ApiServer; ingest and
+            # effectors ride HTTP instead of the in-process store.
+            from ..edge import RemoteCluster
+            self.cluster = RemoteCluster(opt.master).start()
+        else:
+            self.cluster = Cluster()
         if opt.cluster_state:
+            # Works against both edges: RemoteCluster exposes the same
+            # create verbs over REST, so a seed file submits remotely too.
             load_cluster_state(self.cluster, opt.cluster_state)
         self.cache = new_scheduler_cache(
             self.cluster, scheduler_name=opt.scheduler_name,

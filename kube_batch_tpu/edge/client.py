@@ -1,0 +1,239 @@
+"""RemoteCluster: the client-go analog over the REST edge.
+
+A reflector per watched resource streams list+watch events from
+edge.server.ApiServer into local mirror stores and Informer fan-outs, so
+``cache.cluster.new_scheduler_cache(RemoteCluster(url).start())`` wires a
+SchedulerCache to a REMOTE cluster exactly as it wires to the in-process
+simulator — same informers in (cache.go:255-352), and the effector verbs
+(bind/evict/status, cache.go:425-535) become REST calls out.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import threading
+import urllib.error
+import urllib.request
+from typing import Dict
+
+from ..cache.cluster import Informer
+from . import codec
+
+_WATCHED = ("pods", "nodes", "podgroups", "queues", "priorityclasses",
+            "pdbs")
+
+
+class _PvcStore(dict):
+    """PVC mirror that refetches the remote list on a miss (PVCs have no
+    watch stream; volume binding must still see late-created claims)."""
+
+    def __init__(self, remote: "RemoteCluster"):
+        super().__init__()
+        self._remote = remote
+
+    def replace(self, items) -> None:
+        self.clear()
+        self.update(items)
+
+    def get(self, key, default=None):
+        value = dict.get(self, key)
+        if value is None:
+            try:
+                self._remote._refresh_pvcs()
+            except OSError:
+                return default
+            value = dict.get(self, key, default)
+        return value
+
+
+def _key_fn(resource: str):
+    if resource in ("pods", "podgroups", "pdbs", "pvcs"):
+        return lambda o: f"{o.metadata.namespace}/{o.metadata.name}"
+    if resource == "nodes":
+        return lambda o: o.name
+    return lambda o: o.metadata.name
+
+
+class RemoteCluster:
+    """Duck-types the Cluster surface the scheduler wiring consumes:
+    ``*_informer`` fan-outs + mirror stores (ingest) and the effector
+    verbs (egress), all over HTTP."""
+
+    def __init__(self, base_url: str, timeout: float = 10.0):
+        self.base_url = base_url.rstrip("/")
+        self.timeout = timeout
+        self.lock = threading.RLock()
+        self.pods: Dict[str, object] = {}
+        self.nodes: Dict[str, object] = {}
+        self.pod_groups: Dict[str, object] = {}
+        self.queues: Dict[str, object] = {}
+        self.priority_classes: Dict[str, object] = {}
+        self.pdbs: Dict[str, object] = {}
+        self.pvcs = _PvcStore(self)
+        self.pod_informer = Informer()
+        self.node_informer = Informer()
+        self.pod_group_informer = Informer()
+        self.queue_informer = Informer()
+        self.priority_class_informer = Informer()
+        self.pdb_informer = Informer()
+        self._stop = threading.Event()
+        self._threads = []
+        self._synced: Dict[str, threading.Event] = {}
+
+    # -- ingest: reflectors -------------------------------------------------
+
+    def _store(self, resource: str) -> Dict[str, object]:
+        return {"pods": self.pods, "nodes": self.nodes,
+                "podgroups": self.pod_groups, "queues": self.queues,
+                "priorityclasses": self.priority_classes,
+                "pdbs": self.pdbs, "pvcs": self.pvcs}[resource]
+
+    def _informer(self, resource: str) -> Informer:
+        return {"pods": self.pod_informer, "nodes": self.node_informer,
+                "podgroups": self.pod_group_informer,
+                "queues": self.queue_informer,
+                "priorityclasses": self.priority_class_informer,
+                "pdbs": self.pdb_informer}[resource]
+
+    def _reflect(self, resource: str) -> None:
+        """One reflector: stream watch events into the mirror + informer.
+        Every (re)connect replays the server's current state as ADDED
+        events ending in SYNC; objects deleted during a disconnect are
+        reconciled out of the mirror at that point (client-go's relist)."""
+        store = self._store(resource)
+        informer = self._informer(resource)
+        key_of = _key_fn(resource)
+        url = f"{self.base_url}/v1/{resource}?watch=1"
+        while not self._stop.is_set():
+            replay_seen = set()
+            replaying = True
+            try:
+                with urllib.request.urlopen(url) as resp:
+                    for raw in resp:
+                        if self._stop.is_set():
+                            return
+                        event = json.loads(raw)
+                        etype = event["type"]
+                        if etype == "SYNC":
+                            with self.lock:
+                                for stale in [k for k in store
+                                              if k not in replay_seen]:
+                                    informer.fire_delete(store.pop(stale))
+                            replaying = False
+                            self._synced[resource].set()
+                            continue
+                        if etype == "PING":
+                            continue
+                        obj = codec.decode(event["object"])
+                        key = key_of(obj)
+                        with self.lock:
+                            if etype == "ADDED":
+                                if replaying:
+                                    replay_seen.add(key)
+                                old = store.get(key)
+                                store[key] = obj
+                                if old is None:
+                                    informer.fire_add(obj)
+                                else:  # relist upsert of a known object
+                                    informer.fire_update(old, obj)
+                            elif etype == "MODIFIED":
+                                old = store.get(key)
+                                store[key] = obj
+                                if old is None:
+                                    informer.fire_add(obj)
+                                else:
+                                    informer.fire_update(old, obj)
+                            elif etype == "DELETED":
+                                store.pop(key, None)
+                                informer.fire_delete(obj)
+            except (OSError, http.client.HTTPException, ValueError):
+                # Connection loss (incl. IncompleteRead mid-chunk) or a
+                # malformed frame: reconnect and relist.
+                if self._stop.is_set():
+                    return
+                self._stop.wait(0.5)
+
+    def start(self, timeout: float = 30.0) -> "RemoteCluster":
+        for resource in _WATCHED:
+            self._synced[resource] = threading.Event()
+            t = threading.Thread(target=self._reflect, args=(resource,),
+                                 daemon=True,
+                                 name=f"reflector-{resource}")
+            t.start()
+            self._threads.append(t)
+        for resource in _WATCHED:
+            if not self._synced[resource].wait(timeout):
+                raise TimeoutError(f"watch sync timeout for {resource}")
+        self._refresh_pvcs()
+        return self
+
+    def _refresh_pvcs(self) -> None:
+        """PVCs are list-only; _PvcStore refetches on a miss so claims
+        created after start() are still found at allocate time."""
+        items = {}
+        for doc in self._get("pvcs")["items"]:
+            pvc = codec.decode(doc)
+            items[f"{pvc.metadata.namespace}/{pvc.metadata.name}"] = pvc
+        self.pvcs.replace(items)
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    # -- egress: REST verbs -------------------------------------------------
+
+    def _request(self, method: str, path: str, payload=None):
+        body = json.dumps(payload).encode() if payload is not None else None
+        req = urllib.request.Request(
+            f"{self.base_url}{path}", data=body, method=method,
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as resp:
+                return json.loads(resp.read())
+        except urllib.error.HTTPError as exc:
+            detail = exc.read().decode(errors="replace")
+            raise KeyError(f"{method} {path}: {exc.code} {detail}") from exc
+
+    def _get(self, resource: str):
+        return self._request("GET", f"/v1/{resource}")
+
+    # effectors the SchedulerCache wiring uses (cluster.py effectors):
+    def bind_pod(self, namespace: str, name: str, hostname: str) -> None:
+        self._request("POST", f"/v1/pods/{namespace}/{name}/bind",
+                      {"node": hostname})
+
+    def delete_pod(self, namespace: str, name: str) -> None:
+        self._request("DELETE", f"/v1/pods/{namespace}/{name}")
+
+    def put_pod_group_status(self, pg) -> None:
+        self._request(
+            "PUT",
+            f"/v1/podgroups/{pg.metadata.namespace}/{pg.metadata.name}/status",
+            codec.encode(pg))
+
+    def bind_pvc(self, namespace: str, name: str, volume_name: str) -> None:
+        self._request("POST", f"/v1/pvcs/{namespace}/{name}/bind",
+                      {"volume": volume_name})
+
+    def get_pod(self, namespace: str, name: str):
+        with self.lock:
+            return self.pods.get(f"{namespace}/{name}")
+
+    # creation verbs (tests / workload submission clients):
+    def create_pod(self, pod) -> None:
+        self._request("POST", "/v1/pods", codec.encode(pod))
+
+    def create_node(self, node) -> None:
+        self._request("POST", "/v1/nodes", codec.encode(node))
+
+    def create_pod_group(self, pg) -> None:
+        self._request("POST", "/v1/podgroups", codec.encode(pg))
+
+    def create_queue(self, queue) -> None:
+        self._request("POST", "/v1/queues", codec.encode(queue))
+
+    def create_priority_class(self, pc) -> None:
+        self._request("POST", "/v1/priorityclasses", codec.encode(pc))
+
+    def create_pvc(self, pvc) -> None:
+        self._request("POST", "/v1/pvcs", codec.encode(pvc))

@@ -1,0 +1,77 @@
+"""Wire codec: the framework's API objects <-> JSON.
+
+The reference rides Kubernetes' generated JSON marshalling; this standalone
+framework encodes its dataclass object model reflectively.  Every wire
+document carries a ``__kind__`` tag (module-qualified for the CRD versions,
+whose class names collide across v1alpha1/v1alpha2); decoding rebuilds the
+dataclass tree from type hints.  Tuples flatten to JSON lists — all
+consumers unpack positionally, so round-tripping preserves semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import typing
+from typing import Any, Dict
+
+from ..api import objects as _objects
+from ..apis.scheduling import v1alpha1, v1alpha2
+
+
+def _kind_of(cls) -> str:
+    module = cls.__module__.rsplit(".", 1)[-1]
+    if module in ("v1alpha1", "v1alpha2"):
+        return f"{module}.{cls.__name__}"
+    return cls.__name__
+
+
+_TOP_LEVEL = [
+    _objects.Pod, _objects.Node, _objects.PriorityClass,
+    _objects.PodDisruptionBudget, _objects.PersistentVolumeClaim,
+    v1alpha1.PodGroup, v1alpha1.Queue,
+    v1alpha2.PodGroup, v1alpha2.Queue,
+]
+_BY_KIND = {_kind_of(cls): cls for cls in _TOP_LEVEL}
+
+
+def encode(obj) -> Dict[str, Any]:
+    doc = dataclasses.asdict(obj)
+    doc["__kind__"] = _kind_of(type(obj))
+    return doc
+
+
+def _decode_value(typ, value):
+    if value is None:
+        return None
+    origin = typing.get_origin(typ)
+    if origin is typing.Union:  # Optional[T]
+        args = [a for a in typing.get_args(typ) if a is not type(None)]
+        return _decode_value(args[0], value) if args else value
+    if origin in (list, tuple) or typ is list:
+        args = typing.get_args(typ)
+        inner = args[0] if args else Any
+        return [_decode_value(inner, v) for v in value]
+    if origin is dict or typ is dict:
+        return dict(value)
+    if dataclasses.is_dataclass(typ) and isinstance(value, dict):
+        return _decode_dataclass(typ, value)
+    return value
+
+
+def _decode_dataclass(cls, data: Dict[str, Any]):
+    hints = typing.get_type_hints(cls)
+    kwargs = {}
+    for f in dataclasses.fields(cls):
+        if f.name in data:
+            kwargs[f.name] = _decode_value(hints.get(f.name, Any),
+                                           data[f.name])
+    return cls(**kwargs)
+
+
+def decode(doc: Dict[str, Any]):
+    kind = doc.get("__kind__")
+    cls = _BY_KIND.get(kind)
+    if cls is None:
+        raise ValueError(f"unknown wire kind {kind!r}")
+    data = {k: v for k, v in doc.items() if k != "__kind__"}
+    return _decode_dataclass(cls, data)

@@ -1,0 +1,246 @@
+"""REST API server: the Cluster store over HTTP with list+watch.
+
+The standalone analog of the Kubernetes API server surface the reference
+consumes (SURVEY.md §2.2): LIST (GET), CREATE (POST), UPDATE (PUT),
+DELETE, the pod ``bind`` and PodGroup ``status`` subresources
+(cache.go:119-131, :763-775), and WATCH — a chunked stream of JSON-line
+events ``{"type": ADDED|MODIFIED|DELETED|SYNC, "object": ...}`` where the
+stream opens with ADDED events for current state and a SYNC marker (the
+list+watch contract client-go's reflector relies on).
+
+Run standalone:  python -m kube_batch_tpu.edge.server --port 8090 \
+                     [--cluster-state example/job.json]
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlparse
+
+from ..cache.cluster import Cluster
+from . import codec
+
+_RESOURCES = ("pods", "nodes", "podgroups", "queues", "priorityclasses",
+              "pdbs", "pvcs")
+
+
+def _store_of(cluster: Cluster, resource: str):
+    return {"pods": cluster.pods, "nodes": cluster.nodes,
+            "podgroups": cluster.pod_groups, "queues": cluster.queues,
+            "priorityclasses": cluster.priority_classes,
+            "pdbs": cluster.pdbs, "pvcs": cluster.pvcs}[resource]
+
+
+def _informer_of(cluster: Cluster, resource: str):
+    return {"pods": cluster.pod_informer, "nodes": cluster.node_informer,
+            "podgroups": cluster.pod_group_informer,
+            "queues": cluster.queue_informer,
+            "priorityclasses": cluster.priority_class_informer,
+            "pdbs": cluster.pdb_informer}.get(resource)
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+    cluster: Cluster = None  # set by ApiServer subclassing
+
+    def log_message(self, *args):  # quiet; the scheduler has its own logs
+        pass
+
+    # -- helpers -----------------------------------------------------------
+
+    def _json(self, status: int, payload) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _body(self):
+        length = int(self.headers.get("Content-Length", 0))
+        return json.loads(self.rfile.read(length)) if length else None
+
+    def _route(self):
+        parsed = urlparse(self.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        query = parse_qs(parsed.query)
+        if len(parts) < 2 or parts[0] != "v1" or parts[1] not in _RESOURCES:
+            return None, None, None
+        return parts[1], parts[2:], query
+
+    # -- verbs -------------------------------------------------------------
+
+    def do_GET(self):
+        resource, rest, query = self._route()
+        if resource is None:
+            return self._json(404, {"error": "not found"})
+        if query.get("watch"):
+            return self._watch(resource)
+        with self.cluster.lock:
+            items = [codec.encode(o)
+                     for o in _store_of(self.cluster, resource).values()]
+        self._json(200, {"items": items})
+
+    def do_POST(self):
+        resource, rest, _ = self._route()
+        if resource is None:
+            return self._json(404, {"error": "not found"})
+        try:
+            if resource == "pods" and len(rest) == 3 and rest[2] == "bind":
+                body = self._body()
+                self.cluster.bind_pod(rest[0], rest[1], body["node"])
+                return self._json(200, {"status": "bound"})
+            if resource == "pvcs" and len(rest) == 3 and rest[2] == "bind":
+                body = self._body()
+                self.cluster.bind_pvc(rest[0], rest[1], body["volume"])
+                return self._json(200, {"status": "bound"})
+            obj = codec.decode(self._body())
+            create = {"pods": self.cluster.create_pod,
+                      "nodes": self.cluster.create_node,
+                      "podgroups": self.cluster.create_pod_group,
+                      "queues": self.cluster.create_queue,
+                      "priorityclasses": self.cluster.create_priority_class,
+                      "pdbs": self.cluster.create_pdb,
+                      "pvcs": self.cluster.create_pvc}[resource]
+            create(obj)
+            return self._json(201, {"status": "created"})
+        except (KeyError, ValueError) as exc:
+            return self._json(409, {"error": str(exc)})
+
+    def do_PUT(self):
+        resource, rest, _ = self._route()
+        if resource is None:
+            return self._json(404, {"error": "not found"})
+        try:
+            obj = codec.decode(self._body())
+            if resource == "podgroups" and rest and rest[-1] == "status":
+                self.cluster.put_pod_group_status(obj)
+                return self._json(200, {"status": "updated"})
+            update = {"pods": self.cluster.update_pod,
+                      "nodes": self.cluster.update_node,
+                      "podgroups": self.cluster.update_pod_group}.get(resource)
+            if update is None:
+                return self._json(405, {"error": "update not supported"})
+            update(obj)
+            return self._json(200, {"status": "updated"})
+        except KeyError as exc:
+            return self._json(404, {"error": str(exc)})
+
+    def do_DELETE(self):
+        resource, rest, _ = self._route()
+        if resource is None or not rest:
+            return self._json(404, {"error": "not found"})
+        try:
+            if resource == "pods":
+                self.cluster.delete_pod(rest[0], rest[1])
+            elif resource == "nodes":
+                self.cluster.delete_node(rest[0])
+            elif resource == "podgroups":
+                self.cluster.delete_pod_group(rest[0], rest[1])
+            elif resource == "queues":
+                self.cluster.delete_queue(rest[0])
+            elif resource == "pdbs":
+                self.cluster.delete_pdb(rest[0], rest[1])
+            else:
+                return self._json(405, {"error": "delete not supported"})
+            return self._json(200, {"status": "deleted"})
+        except KeyError as exc:
+            return self._json(404, {"error": str(exc)})
+
+    # -- watch -------------------------------------------------------------
+
+    def _watch(self, resource: str) -> None:
+        informer = _informer_of(self.cluster, resource)
+        if informer is None:
+            return self._json(405, {"error": f"{resource} not watchable"})
+        events: "queue.Queue" = queue.Queue()
+        handle = None
+        # Register BEFORE snapshotting, under the store lock, so no event
+        # can fall between the initial list and the live stream.
+        with self.cluster.lock:
+            handle = informer.add_handlers(
+                on_add=lambda o: events.put(("ADDED", o)),
+                on_update=lambda old, new: events.put(("MODIFIED", new)),
+                on_delete=lambda o: events.put(("DELETED", o)))
+            initial = list(_store_of(self.cluster, resource).values())
+
+        self.send_response(200)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def emit(etype, obj):
+            line = json.dumps(
+                {"type": etype,
+                 "object": codec.encode(obj) if obj is not None else None}
+            ).encode() + b"\n"
+            self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
+            self.wfile.flush()
+
+        try:
+            for obj in initial:
+                emit("ADDED", obj)
+            emit("SYNC", None)
+            while True:
+                try:
+                    etype, obj = events.get(timeout=5.0)
+                except queue.Empty:
+                    emit("PING", None)  # keep-alive; detects dead peers
+                    continue
+                emit(etype, obj)
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            informer.remove_handlers(handle)
+
+
+class ApiServer:
+    """Serve a Cluster store over HTTP (threaded; one thread per watch)."""
+
+    def __init__(self, cluster: Cluster, host: str = "127.0.0.1",
+                 port: int = 0):
+        self.cluster = cluster
+        handler = type("BoundHandler", (_Handler,), {"cluster": cluster})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._httpd.daemon_threads = True
+        self._thread = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ApiServer":
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(description="kube-batch-tpu API server")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8090)
+    parser.add_argument("--cluster-state", default="",
+                        help="JSON state file to preload (cli/server.py)")
+    ns = parser.parse_args(argv)
+    cluster = Cluster()
+    if ns.cluster_state:
+        from ..cli.server import load_cluster_state
+        load_cluster_state(cluster, ns.cluster_state)
+    server = ApiServer(cluster, ns.host, ns.port)
+    print(f"kube-batch-tpu apiserver listening on {server.url}")
+    server._httpd.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

@@ -198,12 +198,16 @@ class Cluster:
                 self.pod_group_informer.fire_delete(pg)
 
     def put_pod_group_status(self, pg) -> object:
-        """Status-subresource write (no informer echo back to the writer's
-        own cache, matching the reference's UpdateStatus usage)."""
+        """Status-subresource write.  Fires MODIFIED like a real
+        apiserver's UpdateStatus: other watchers (second schedulers,
+        monitors) must see condition writes without waiting for a relist;
+        the writer's own cache handling of the echo is idempotent."""
         with self.lock:
             key = f"{pg.metadata.namespace}/{pg.metadata.name}"
-            if key in self.pod_groups:
+            old = self.pod_groups.get(key)
+            if old is not None:
                 self.pod_groups[key] = pg
+                self.pod_group_informer.fire_update(old, pg)
             return pg
 
     def create_queue(self, queue) -> object:

@@ -26,24 +26,37 @@ _WATCHED = ("pods", "nodes", "podgroups", "queues", "priorityclasses",
 
 class _PvcStore(dict):
     """PVC mirror that refetches the remote list on a miss (PVCs have no
-    watch stream; volume binding must still see late-created claims)."""
+    watch stream; volume binding must still see late-created claims).
+    Misses are negative-cached for a few seconds: the refetch can run
+    while the caller holds RemoteCluster.lock, so a pod referencing a
+    genuinely absent PVC must not stall reflector ingest every cycle."""
+
+    _NEG_TTL = 5.0
 
     def __init__(self, remote: "RemoteCluster"):
         super().__init__()
         self._remote = remote
+        self._neg: Dict[str, float] = {}
 
     def replace(self, items) -> None:
         self.clear()
         self.update(items)
+        self._neg.clear()
 
     def get(self, key, default=None):
+        import time as _time
         value = dict.get(self, key)
         if value is None:
+            now = _time.monotonic()
+            if self._neg.get(key, 0.0) > now:
+                return default
             try:
                 self._remote._refresh_pvcs()
             except OSError:
                 return default
             value = dict.get(self, key, default)
+            if value is default:
+                self._neg[key] = now + self._NEG_TTL
         return value
 
 
@@ -109,7 +122,10 @@ class RemoteCluster:
             replay_seen = set()
             replaying = True
             try:
-                with urllib.request.urlopen(url) as resp:
+                # Read timeout >> the server's 5s keep-alive ping: a
+                # half-open connection surfaces as socket.timeout (OSError)
+                # and reconnects instead of freezing the mirror forever.
+                with urllib.request.urlopen(url, timeout=30) as resp:
                     for raw in resp:
                         if self._stop.is_set():
                             return

@@ -128,6 +128,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(200, {"status": "updated"})
         except KeyError as exc:
             return self._json(404, {"error": str(exc)})
+        except ValueError as exc:  # malformed JSON / unknown kind
+            return self._json(400, {"error": str(exc)})
 
     def do_DELETE(self):
         resource, rest, _ = self._route()

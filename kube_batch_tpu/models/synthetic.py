@@ -116,6 +116,7 @@ def make_synthetic_inputs(n_tasks: int = 1000, n_nodes: int = 100,
         node_ports=jnp.zeros((n_pad, 8), bool),
         node_selcnt=jnp.zeros((n_pad, 8), jnp.int32),
         sig_mask=jnp.asarray(np.ones((1, n_pad), bool) & node_exists[None, :]),
+        sig_bonus=jnp.zeros((1, n_pad), jnp.int32),
         total_res=jnp.asarray(total.astype(np.float64), dtype=dtype),
         eps=eps_vector(r),
         scalar_dims=scalar_dims_mask(r),

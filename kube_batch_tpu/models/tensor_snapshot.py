@@ -147,21 +147,17 @@ def _task_signature(task) -> tuple:
     tol = tuple(sorted((t.key, t.operator, t.value, t.effect)
                        for t in task.pod.spec.tolerations))
     aff = ()
+    pref = ()
     affinity = task.pod.spec.affinity
     if affinity is not None and affinity.required_node_terms:
         aff = tuple(tuple(sorted(t.items()))
                     for t in affinity.required_node_terms)
-    return sel, tol, aff
-
-
-def _uses_dynamic_predicates(task) -> Optional[str]:
-    """Features the device path can't express yet.  Host ports and required
-    inter-pod (anti-)affinity are handled by dynamic occupancy tensors in
-    the solver loop; only soft scoring features still force the host path."""
-    affinity = task.pod.spec.affinity
     if affinity is not None and affinity.preferred_node_terms:
-        return "preferred node affinity scoring"
-    return None
+        # Preferred node affinity contributes a per-signature static score
+        # bonus, so tasks with different preferences must not share a row.
+        pref = tuple((w, tuple(sorted(term.items())))
+                     for w, term in affinity.preferred_node_terms)
+    return sel, tol, aff, pref
 
 
 def _task_port_keys(task) -> list:
@@ -234,7 +230,7 @@ def tensorize_session(ssn) -> TensorSnapshot:
     # (session_plugins.go:354-369), so nodeorder + tpu-score both enabled
     # means their weights add.  No scoring plugin -> all-zero scores and the
     # first feasible node wins on both paths.
-    w_least = w_most = w_balanced = w_podaff = 0.0
+    w_least = w_most = w_balanced = w_podaff = w_nodeaff = 0.0
     for tier in ssn.tiers:
         for option in tier.plugins:
             if option.name not in _SUPPORTED_PLUGINS:
@@ -258,7 +254,9 @@ def tensorize_session(ssn) -> TensorSnapshot:
                 w_most += w["mostrequested"]
                 w_balanced += w["balancedresource"]
                 w_podaff += w["podaffinity"]
-    if any(w != int(w) for w in (w_least, w_most, w_balanced, w_podaff)):
+                w_nodeaff += w["nodeaffinity"]
+    if any(w != int(w) for w in (w_least, w_most, w_balanced, w_podaff,
+                                 w_nodeaff)):
         # Grid scoring combines integer weights exactly; fractional weights
         # would need float score sums with platform-dependent rounding.
         snap.fallback_reason = "fractional nodeorder weights"
@@ -418,10 +416,6 @@ def tensorize_session(ssn) -> TensorSnapshot:
                     or spec.affinity is not None
                     or any(p.host_port > 0 for c in spec.containers
                            for p in c.ports)):
-                reason = _uses_dynamic_predicates(t)
-                if reason is not None:
-                    snap.fallback_reason = reason
-                    return snap
                 sig = _task_signature(t)
                 # Dynamic predicates: collect this task's port keys and
                 # affinity selectors into the session-wide index.
@@ -467,7 +461,7 @@ def tensorize_session(ssn) -> TensorSnapshot:
                             task_panti[len(tasks)].append(
                                 (sel_index[sk], int(weight) * w_podaff))
             else:
-                sig = ((), (), ())  # the common unconstrained pod
+                sig = ((), (), (), ())  # the common unconstrained pod
             if sig not in signatures:
                 signatures[sig] = len(signatures)
                 sig_examples.append(t)
@@ -569,14 +563,19 @@ def tensorize_session(ssn) -> TensorSnapshot:
         from ..ops.scoring import max_weight_sum as _mws
         row_w = int((task_paff_w + task_panti_w).sum(axis=1).max())
         cnt_bound = p_real + int(node_selcnt0.max())
+        # Half budget: the node-affinity bonus guard gets the other half,
+        # so fraction + pod-affinity + bonus can never jointly wrap int32.
         if (_mws(weights) * 10 + row_w * cnt_bound) * _K \
-                > np.iinfo(np.int32).max:
+                > np.iinfo(np.int32).max // 2:
             snap.fallback_reason = "pod-affinity score overflows int32"
             return snap
 
-    # ---- static predicate mask [S, N] ------------------------------------
+    # ---- static predicate mask [S, N] + static score bonus ----------------
     s_real = max(len(sig_examples), 1)
     sig_mask = np.zeros((s_real, n_pad), bool)
+    sig_bonus = np.zeros((s_real, n_pad), np.int64)  # guard before i32
+    from ..plugins.nodeorder import node_affinity_score
+    w_nodeaff = int(w_nodeaff)
     # Static mask = the session's tiered predicate chain evaluated once per
     # (signature, node) with the dynamic features (host ports, pod
     # (anti-)affinity) stripped from the example — those re-evaluate every
@@ -584,15 +583,33 @@ def tensorize_session(ssn) -> TensorSnapshot:
     # remaining checks (unschedulable, selector/node-affinity, taints,
     # pressure) are static for the session.
     for si, example in enumerate(sig_examples):
-        example = _static_example(example)
+        stripped = _static_example(example)
+        affinity = example.pod.spec.affinity
+        has_pref = (w_nodeaff and affinity is not None
+                    and affinity.preferred_node_terms)
         for nix, node in enumerate(node_objs):
+            if has_pref:
+                # Preferred node affinity is static per (signature, node):
+                # bake the grid-scaled weighted bonus the host scorer adds
+                # (plugins/nodeorder.node_affinity_score x plugin weight).
+                sig_bonus[si, nix] = w_nodeaff * node_affinity_score(
+                    example, node)
             try:
-                ssn.predicate_fn(example, node)
+                ssn.predicate_fn(stripped, node)
             except Exception:
                 continue
             sig_mask[si, nix] = True
     if not sig_examples:
         sig_mask[:, :n_real] = True
+    if sig_bonus.any():
+        # Combined-score headroom: bonus + fraction scores (+ a possible
+        # pod-affinity term, hence the halved budget) must stay in int32.
+        from ..ops.scoring import max_weight_sum as _mws_b
+        from ..ops.resources import SCORE_GRID_K as _K_b
+        if (_mws_b(weights) * 10 * _K_b + int(np.abs(sig_bonus).max())
+                > np.iinfo(np.int32).max // 2):
+            snap.fallback_reason = "node-affinity score overflows int32"
+            return snap
 
     # Resource tensors quantize to int32 fixed point (ops/resources.py:
     # milli-cpu / MiB / milli-scalar, every epsilon exactly 10 quanta) so
@@ -658,6 +675,7 @@ def tensorize_session(ssn) -> TensorSnapshot:
         node_ports=dev(node_ports0, bool),
         node_selcnt=dev(node_selcnt0, jnp.int32),
         sig_mask=dev(sig_mask, bool),
+        sig_bonus=dev(sig_bonus, jnp.int32),
         total_res=np.ascontiguousarray(total_res_q, dtype=np_dtype),
         eps=np.full((r,), EPS_QUANTA, dtype=np.int32),
         scalar_dims=np.asarray([False, False] + [True] * (r - 2)),

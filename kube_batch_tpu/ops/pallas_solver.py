@@ -56,7 +56,7 @@ def _pad8(x: int) -> int:
 
 def _solve_kernel(r: int, np_pad: int, ns_pad: int, cfg: SolverConfig,
                   scal_ref, total_ref, task_ref, sig_ref, sig_mask_ref,
-                  nint_in, ncs_ref, out_in, jdyn_in, qdyn_in,
+                  sig_bonus_ref, nint_in, ncs_ref, out_in, jdyn_in, qdyn_in,
                   nport_in, nsel_in, jsta_ref, qsta_ref, qdes_ref,
                   nint_ref, out_ref, jdyn_ref, qdyn_ref, nport_ref,
                   nsel_ref, scal_out_ref):
@@ -271,6 +271,7 @@ def _solve_kernel(r: int, np_pad: int, ns_pad: int, cfg: SolverConfig,
                     wd = task_ref[t, PAFFW_OFF + s] \
                         - task_ref[t, PANTIW_OFF + s]
                     score = score + SCORE_GRID_K * wd * nsel_ref[s:s + 1, :]
+            score = score + sig_bonus_ref[pl.ds(sig, 1), :]
             score = jnp.where(feasible, score, neg_score)
 
             best = jnp.max(score)
@@ -442,6 +443,7 @@ def solve_allocate_pallas(inp: SolverInputs, cfg: SolverConfig,
     nsel = i32c(inp.node_selcnt).T
     task_sig2 = inp.task_sig[:, None]
     sig_mask_f = inp.sig_mask.astype(fdt)
+    sig_bonus = inp.sig_bonus.astype(jnp.int32)
     (node_int, node_cs, jsta, jdyn, qdes, qsta,
      qdyn) = _build_buffers(inp)
     out_buf0 = jnp.concatenate(
@@ -466,11 +468,11 @@ def solve_allocate_pallas(inp: SolverInputs, cfg: SolverConfig,
                    jax.ShapeDtypeStruct(nport.shape, jnp.int32),
                    jax.ShapeDtypeStruct(nsel.shape, jnp.int32),
                    jax.ShapeDtypeStruct((1, 8), jnp.int32)),
-        in_specs=[smem, smem] + [vmem] * 13,
+        in_specs=[smem, smem] + [vmem] * 14,
         out_specs=(vmem, vmem, vmem, vmem, vmem, vmem, smem),
-        input_output_aliases={5: 0, 7: 1, 8: 2, 9: 3, 10: 4, 11: 5},
+        input_output_aliases={6: 0, 8: 1, 9: 2, 10: 3, 11: 4, 12: 5},
         interpret=interpret,
-    )(scal, total, task_data, task_sig2, sig_mask_f,
+    )(scal, total, task_data, task_sig2, sig_mask_f, sig_bonus,
       node_int, node_cs, out_buf0, jdyn, qdyn, nport, nsel,
       jsta, qsta, qdes)
 

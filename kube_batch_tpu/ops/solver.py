@@ -92,6 +92,8 @@ class SolverInputs(NamedTuple):
     node_ports: jnp.ndarray     # [N, NP] bool: host-port key in use
     node_selcnt: jnp.ndarray    # [N, NS] i32: resident tasks matching sel
     sig_mask: jnp.ndarray       # [S, N] bool static predicate mask
+    sig_bonus: jnp.ndarray      # [S, N] i32 static score bonus (preferred
+                                # node affinity, grid-scaled and weighted)
     # cluster
     total_res: jnp.ndarray      # [R] sum of allocatable (drf denominator)
     eps: jnp.ndarray            # [R] epsilon vector
@@ -273,6 +275,7 @@ def solver_step(inp: SolverInputs, cfg: SolverConfig,
                              st.selcnt)
     if pa is not None:
         score = score + pa
+    score = score + inp.sig_bonus[inp.task_sig[t]]
     score = jnp.where(feasible, score, SCORE_NEG_INF)
     # first max = deterministic tie-break
     n = jnp.argmax(score).astype(jnp.int32)
@@ -543,6 +546,7 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
                                      inp.task_panti_w, selcnt)
             if pa is not None:
                 score = score + pa
+            score = score + inp.sig_bonus[inp.task_sig[t]]
             score = jnp.where(feasible, score, neg_inf)
             nsel = jnp.argmax(score).astype(jnp.int32)
             feasible_any = score[nsel] > neg_inf

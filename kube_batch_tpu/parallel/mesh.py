@@ -61,7 +61,7 @@ def solver_input_shardings(mesh: Mesh):
         node_idle=node_2d, node_releasing=node_2d, node_used=node_2d,
         node_alloc=node_2d, node_count=node_1d, node_max_tasks=node_1d,
         node_exists=node_1d, node_ports=node_2d, node_selcnt=node_2d,
-        sig_mask=sig,
+        sig_mask=sig, sig_bonus=sig,
         total_res=rep, eps=rep, scalar_dims=rep, score_shift=rep)
 
 

@@ -57,7 +57,7 @@ def _node_specs():
         queue_uid_rank=P(None), queue_exists=P(None),
         node_idle=n2, node_releasing=n2, node_used=n2, node_alloc=n2,
         node_count=n1, node_max_tasks=n1, node_exists=n1,
-        node_ports=n2, node_selcnt=n2, sig_mask=sig,
+        node_ports=n2, node_selcnt=n2, sig_mask=sig, sig_bonus=sig,
         total_res=P(None), eps=P(None), scalar_dims=P(None),
         score_shift=P(None))
 
@@ -118,6 +118,7 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
                                          inp.task_panti_w, selcnt)
                 if pa is not None:
                     local_score = local_score + pa
+                local_score = local_score + inp.sig_bonus[inp.task_sig[t]]
                 local_score = jnp.where(feasible, local_score, neg_inf)
 
                 # Local first-max, then global first-max over ICI: one

@@ -655,3 +655,91 @@ class TestInterPodAffinityPriority:
                                  {"grp": f"pg{rng2.randrange(5)}"})])
 
         run_both_mutated(mutate, spec)
+
+
+class TestPreferredNodeAffinityOnDevice:
+    """Soft node affinity scores ride the device path as a static
+    per-signature bonus — the last fallback trigger is gone."""
+
+    def test_no_fallback_and_preference_wins(self):
+        from kube_batch_tpu.api.objects import Affinity
+        from kube_batch_tpu.models.tensor_snapshot import tensorize_session
+
+        def mutate(cache):
+            for t in cache.jobs["ns/pg1"].tasks.values():
+                t.pod.spec.affinity = Affinity(
+                    preferred_node_terms=[(50, {"disk": "ssd"})])
+
+        spec = dict(
+            queues=[("q1", 1)],
+            pod_groups=[("pg1", "ns", 1, "q1")],
+            pods=[("ns", "p0", "", "Pending", "1", "1Gi", "pg1")],
+            nodes=[])
+        cache, binder = build_cache(spec)
+        cache.add_node(build_node("big", build_resource_list(
+            "64", "128Gi", pods=110)))
+        cache.add_node(build_node("ssd", build_resource_list(
+            "8", "16Gi", pods=110), labels={"disk": "ssd"}))
+        mutate(cache)
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        try:
+            snap = tensorize_session(ssn)
+            assert not snap.needs_fallback, snap.fallback_reason
+            TpuAllocateAction().execute(ssn)
+        finally:
+            close_session(ssn)
+        # least-requested alone prefers the empty 64-cpu node; the
+        # 50-weight preference overrides it.
+        assert binder.binds == {"ns/p0": "ssd"}
+
+    @pytest.mark.parametrize("seed", [50, 51, 52])
+    def test_random_with_preferred_node_affinity(self, seed):
+        from kube_batch_tpu.api.objects import Affinity
+        rng = random.Random(seed)
+        spec = dict(
+            queues=[("q0", 1), ("q1", 2)],
+            pod_groups=[], pods=[], nodes=[])
+        labels_pool = [{"zone": "a"}, {"zone": "b"}, {"disk": "ssd"}, {}]
+        for j in range(5):
+            size = rng.randint(1, 4)
+            spec["pod_groups"].append(
+                (f"pg{j}", "ns", rng.randint(1, size), f"q{j % 2}"))
+            for i in range(size):
+                spec["pods"].append(("ns", f"j{j}-p{i}", "", "Pending",
+                                     str(rng.choice([1, 2])),
+                                     f"{rng.choice([1, 2])}Gi", f"pg{j}"))
+
+        def mutate(cache):
+            rng2 = random.Random(seed + 700)
+            for job in list(cache.jobs.values()):
+                for t in list(job.tasks.values()):
+                    if rng2.random() < 0.5:
+                        terms = [(rng2.choice([5, 20, 80]),
+                                  rng2.choice(labels_pool[:3]))]
+                        t.pod.spec.affinity = Affinity(
+                            preferred_node_terms=terms)
+
+        cache, _ = build_cache(spec)
+        # nodes with assorted labels
+        for i in range(4):
+            cache.add_node(build_node(
+                f"n{i}", build_resource_list("8", "16Gi", pods=110),
+                labels=labels_pool[i % len(labels_pool)]))
+        # run both actions on separately built caches
+        results = []
+        for action_cls in (AllocateAction, TpuAllocateAction):
+            cache, binder = build_cache(spec)
+            for i in range(4):
+                cache.add_node(build_node(
+                    f"n{i}", build_resource_list("8", "16Gi", pods=110),
+                    labels=labels_pool[i % len(labels_pool)]))
+            mutate(cache)
+            _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+            ssn = open_session(cache, tiers)
+            try:
+                action_cls().execute(ssn)
+            finally:
+                close_session(ssn)
+            results.append(binder.binds)
+        assert results[1] == results[0]

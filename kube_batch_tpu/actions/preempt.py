@@ -3,6 +3,12 @@
 Mirrors /root/reference/pkg/scheduler/actions/preempt/preempt.go: inter-job
 preemption within each queue (commit only if the preemptor job reaches
 JobPipelined, else discard), then intra-job preemption.
+
+The per-preemptor candidate-node walk (predicates + scores over every
+node — the reference's 16-goroutine fan-out, preempt.go:180-189) runs as
+one device call per preemptor on big clusters (models/scanner.py), with
+checkpoint/restore mirroring the Statement transaction; victim selection
+and commit semantics stay on the host.
 """
 
 from __future__ import annotations
@@ -22,6 +28,8 @@ class PreemptAction(Action):
         return "preempt"
 
     def execute(self, ssn) -> None:
+        from ..models.scanner import maybe_scanner
+        scanner = maybe_scanner(ssn)
         preemptors_map: Dict[str, PriorityQueue] = {}
         preemptor_tasks: Dict[str, PriorityQueue] = {}
         under_request: List = []
@@ -50,6 +58,8 @@ class PreemptAction(Action):
                 preemptor_job = preemptors.pop()
 
                 stmt = ssn.statement()
+                if scanner is not None:
+                    scanner.checkpoint()
                 assigned = False
                 while True:
                     if preemptor_tasks[preemptor_job.uid].empty():
@@ -65,14 +75,19 @@ class PreemptAction(Action):
                         return (job.queue == preemptor_job.queue
                                 and preemptor.job != task.job)
 
-                    if _preempt(ssn, stmt, preemptor, ssn.nodes, job_filter):
+                    if _preempt(ssn, stmt, preemptor, ssn.nodes, job_filter,
+                                scanner):
                         assigned = True
                     if ssn.job_pipelined(preemptor_job):
                         stmt.commit()
+                        if scanner is not None:
+                            scanner.commit()
                         break
 
                 if not ssn.job_pipelined(preemptor_job):
                     stmt.discard()
+                    if scanner is not None:
+                        scanner.restore()
                     continue
                 if assigned:
                     preemptors.push(preemptor_job)
@@ -88,19 +103,28 @@ class PreemptAction(Action):
                     assigned = _preempt(
                         ssn, stmt, preemptor, ssn.nodes,
                         lambda task: (task.status == TaskStatus.Running
-                                      and preemptor.job == task.job))
+                                      and preemptor.job == task.job),
+                        scanner)
                     stmt.commit()
                     if not assigned:
                         break
 
 
-def _preempt(ssn, stmt, preemptor: TaskInfo, nodes, filter_fn) -> bool:
+def _preempt(ssn, stmt, preemptor: TaskInfo, nodes, filter_fn,
+             scanner=None) -> bool:
     """Try to free room for preemptor on some node (preempt.go:171-254)."""
-    all_nodes = get_node_list(nodes)
-    candidates = predicate_nodes(preemptor, all_nodes, ssn.predicate_fn)
-    priority_list = prioritize_nodes(preemptor, candidates,
-                                     ssn.node_prioritizers())
-    selected_nodes = sort_nodes(priority_list, ssn.nodes)
+    scored = None
+    if scanner is not None:
+        scored = scanner.candidate_nodes(preemptor, scored=True)
+    if scored is not None:
+        selected_nodes = [ssn.nodes[name] for name, _ in scored
+                          if name in ssn.nodes]
+    else:
+        all_nodes = get_node_list(nodes)
+        candidates = predicate_nodes(preemptor, all_nodes, ssn.predicate_fn)
+        priority_list = prioritize_nodes(preemptor, candidates,
+                                         ssn.node_prioritizers())
+        selected_nodes = sort_nodes(priority_list, ssn.nodes)
 
     assigned = False
     for node in selected_nodes:
@@ -130,6 +154,8 @@ def _preempt(ssn, stmt, preemptor: TaskInfo, nodes, filter_fn) -> bool:
         metrics.register_preemption_attempt()
         if preemptor.init_resreq.less_equal(preempted):
             stmt.pipeline(preemptor, node.name)
+            if scanner is not None:
+                scanner.apply_pipeline(preemptor, node.name)
             assigned = True
             break
 

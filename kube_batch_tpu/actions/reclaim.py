@@ -20,6 +20,8 @@ class ReclaimAction(Action):
         return "reclaim"
 
     def execute(self, ssn) -> None:
+        from ..models.scanner import maybe_scanner
+        scanner = maybe_scanner(ssn)
         queues = PriorityQueue(ssn.queue_order_fn)
         queue_map: Dict[str, object] = {}
         preemptors_map: Dict[str, PriorityQueue] = {}
@@ -56,11 +58,24 @@ class ReclaimAction(Action):
             task = tasks.pop()
 
             assigned = False
-            for node in get_node_list(ssn.nodes):
-                try:
-                    ssn.predicate_fn(task, node)
-                except FitError:
-                    continue
+            # Candidate walk in node order; the device scan answers the
+            # predicate chain for all nodes at once (reclaim.go:115).
+            if scanner is not None:
+                names = scanner.candidate_nodes(task, scored=False)
+            else:
+                names = None
+            if names is not None:
+                node_walk = [ssn.nodes[n] for n, _ in names
+                             if n in ssn.nodes]
+            else:
+                node_walk = []
+                for node in get_node_list(ssn.nodes):
+                    try:
+                        ssn.predicate_fn(task, node)
+                    except FitError:
+                        continue
+                    node_walk.append(node)
+            for node in node_walk:
 
                 resreq = task.init_resreq.clone()
                 reclaimed = Resource.empty()
@@ -96,6 +111,8 @@ class ReclaimAction(Action):
 
                 if task.init_resreq.less_equal(reclaimed):
                     ssn.pipeline(task, node.name)
+                    if scanner is not None:
+                        scanner.apply_pipeline(task, node.name)
                     assigned = True
                     break
 

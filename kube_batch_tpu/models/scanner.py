@@ -1,0 +1,253 @@
+"""DeviceNodeScanner: device-accelerated node walks for preempt/reclaim.
+
+Tensorizes the session once at action start, then answers each pending
+task's candidate-node question (predicates + scores over ALL nodes) with a
+single device call (ops/scan.py), replacing the per-node Python predicate/
+prioritizer loops (reference util/scheduler_helper.go's 16-goroutine
+fan-out).  Mutable node state lives in numpy mirrors updated per
+evict/pipeline — O(1) row updates — with checkpoint/restore mirroring the
+Statement's commit/discard transaction.
+
+The scanner only accelerates; decisions (victim chains, Statement
+semantics, gang commit conditions) stay on the host action.  Sessions the
+tensorizer can't express fall back to the pure-host walk transparently.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..api import TaskInfo
+from ..ops.resources import quantize_value
+from ..ops.scan import ScanStatics, scan_nodes
+from ..ops.scoring import SCORE_NEG_INF
+
+# Node counts below this are cheaper as the plain per-node object walk
+# than tensorizing at all; tests set 0 to force the scanner.
+SCAN_MIN_NODES_ENV = "KUBE_BATCH_TPU_SCAN_MIN_NODES"
+DEFAULT_SCAN_MIN_NODES = 64
+# The scan math is exact int32 either way; numpy wins whenever host<->device
+# transfer latency exceeds the ~N*40 integer ops (always true on the
+# tunneled dev chip), the jitted kernel when node state is huge or the TPU
+# is local.  Set =1 to run the scan on device.
+SCAN_DEVICE_ENV = "KUBE_BATCH_TPU_SCAN_DEVICE"
+
+
+def maybe_scanner(ssn) -> Optional["DeviceNodeScanner"]:
+    """Build a scanner for this session, or None (fallback to host walk).
+    Registers session event handlers so the scoring mirror tracks every
+    allocate/deallocate — including Statement rollback and the
+    commit-failure unevict path — exactly as nodeorder's GridUsage does."""
+    import os
+
+    from .tensor_snapshot import tensorize_session
+    min_nodes = int(os.environ.get(SCAN_MIN_NODES_ENV,
+                                   DEFAULT_SCAN_MIN_NODES))
+    if len(ssn.nodes) < min_nodes:
+        return None
+    snap = tensorize_session(ssn)
+    if snap.needs_fallback or not snap.tasks:
+        return None
+    scanner = DeviceNodeScanner(snap)
+    from ..framework.events import EventHandler
+    ssn.add_event_handler(EventHandler(
+        allocate_func=lambda e: scanner._used_delta(e.task, +1),
+        deallocate_func=lambda e: scanner._used_delta(e.task, -1)))
+    return scanner
+
+
+class DeviceNodeScanner:
+
+    def __init__(self, snap):
+        import jax.numpy as jnp
+
+        self.snap = snap
+        inp = snap.inputs
+        self.r = inp.task_req.shape[1]
+        self.np_pad = inp.task_ports.shape[1]
+        self.ns_pad = inp.task_aff_req.shape[1]
+        self.cfg = snap.config
+        self.statics = ScanStatics(
+            sig_mask=jnp.asarray(inp.sig_mask),
+            sig_bonus=jnp.asarray(inp.sig_bonus),
+            node_alloc=jnp.asarray(inp.node_alloc),
+            node_max_tasks=jnp.asarray(inp.node_max_tasks),
+            node_exists=jnp.asarray(inp.node_exists),
+            score_shift=jnp.asarray(inp.score_shift))
+        n_pad = inp.node_idle.shape[0]
+        # Packed mutable state: used | count | ports | selcnt (scan.py).
+        self.dyn = np.concatenate(
+            [np.asarray(inp.node_used),
+             np.asarray(inp.node_count)[:, None],
+             np.asarray(inp.node_ports).astype(np.int32),
+             np.asarray(inp.node_selcnt)], axis=1).astype(np.int32)
+        assert self.dyn.shape == (n_pad,
+                                  self.r + 1 + self.np_pad + self.ns_pad)
+        self.node_index: Dict[str, int] = {
+            name: i for i, name in enumerate(snap.node_names)}
+        self.task_index: Dict[str, int] = {
+            t.uid: i for i, t in enumerate(snap.tasks)}
+        self._task_ports = np.asarray(inp.task_ports).astype(np.int32)
+        self._task_aff = np.asarray(inp.task_aff_req).astype(np.int32)
+        self._task_anti = np.asarray(inp.task_anti).astype(np.int32)
+        self._task_match = np.asarray(inp.task_match).astype(np.int32)
+        self._task_paffw = np.asarray(inp.task_paff_w)
+        self._task_pantiw = np.asarray(inp.task_panti_w)
+        self._task_res = np.asarray(inp.task_res)
+        self._task_sig = np.asarray(inp.task_sig)
+        self._checkpoints: List[np.ndarray] = []
+
+    # -- transaction mirror (Statement commit/discard) ----------------------
+
+    def checkpoint(self) -> None:
+        self._checkpoints.append(self.dyn.copy())
+
+    def commit(self) -> None:
+        if self._checkpoints:
+            self._checkpoints.pop()
+
+    def restore(self) -> None:
+        if self._checkpoints:
+            self.dyn = self._checkpoints.pop()
+
+    # -- state updates ------------------------------------------------------
+    # ``used`` (the scoring dimension) tracks session allocate/deallocate
+    # EVENTS — fired by Session/Statement for pipeline, evict, and both
+    # rollback paths — mirroring nodeorder's GridUsage bit for bit.
+    # Membership-derived state (count/ports/selcnt) changes only when a
+    # pod joins a node, which the actions signal via apply_pipeline;
+    # discard rollback restores it wholesale from the checkpoint.
+
+    def _used_delta(self, task: TaskInfo, sign: int) -> None:
+        nix = self.node_index.get(task.node_name)
+        if nix is None:
+            return
+        self.dyn[nix, 0] += sign * quantize_value(task.resreq.milli_cpu, 0)
+        self.dyn[nix, 1] += sign * quantize_value(task.resreq.memory, 1)
+
+    def apply_pipeline(self, task: TaskInfo, hostname: str) -> None:
+        nix = self.node_index.get(hostname)
+        if nix is None:
+            return
+        row = self.dyn[nix]
+        ti = self.task_index.get(task.uid)
+        r = self.r
+        if ti is not None:
+            row[r + 1:r + 1 + self.np_pad] |= self._task_ports[ti]
+            row[r + 1 + self.np_pad:] += self._task_match[ti]
+        else:
+            # Task outside the snapshot's candidate set (e.g. BestEffort,
+            # filtered by the is_empty gate): derive its port keys and
+            # selector matches directly so occupancy stays truthful.
+            from .tensor_snapshot import _task_port_keys
+            for pk in _task_port_keys(task):
+                pid = self.snap.port_index.get(pk)
+                if pid is not None:
+                    row[r + 1 + pid] = 1
+            labels = task.pod.metadata.labels
+            for si, sel in enumerate(self.snap.selectors):
+                if all(labels.get(k) == v for k, v in sel.items()):
+                    row[r + 1 + self.np_pad + si] += 1
+        row[r] += 1  # pod count
+
+    # -- the scan -----------------------------------------------------------
+
+    def scores(self, task: TaskInfo) -> Optional[np.ndarray]:
+        """[N_real] int scores (SCORE_NEG_INF = predicate-rejected), or None
+        when the task is outside the snapshot's candidate set."""
+        import os
+
+        ti = self.task_index.get(task.uid)
+        if ti is None:
+            return None
+        if os.environ.get(SCAN_DEVICE_ENV) == "1":
+            trow = np.concatenate(
+                [np.asarray([self._task_sig[ti]], np.int32),
+                 self._task_res[ti],
+                 self._task_ports[ti], self._task_aff[ti],
+                 self._task_anti[ti],
+                 self._task_paffw[ti], self._task_pantiw[ti]]
+            ).astype(np.int32)
+            out = np.asarray(scan_nodes(self.cfg, self.r, self.np_pad,
+                                        self.ns_pad, self.statics, self.dyn,
+                                        trow))
+        else:
+            out = self._scores_numpy(ti)
+        return out[:len(self.snap.node_names)]
+
+    def _scores_numpy(self, ti: int) -> np.ndarray:
+        """The exact integer math of ops/scan.py in numpy: the grid floor
+        divisions and weighted sums are plain int ops, so both engines
+        produce identical score integers."""
+        from ..ops.resources import SCORE_GRID_K
+        inp = self.snap.inputs
+        cfg = self.cfg
+        r = self.r
+        dyn = self.dyn
+        used = dyn[:, :r]
+        count = dyn[:, r]
+        sig = int(self._task_sig[ti])
+        alloc = np.asarray(inp.node_alloc)
+        shift = np.asarray(inp.score_shift)
+        feasible = (np.asarray(inp.sig_mask)[sig]
+                    & np.asarray(inp.node_exists)
+                    & (count < np.asarray(inp.node_max_tasks)))
+        if cfg.has_ports:
+            ports = dyn[:, r + 1:r + 1 + self.np_pad]
+            conflict = ((self._task_ports[ti][None, :] > 0)
+                        & (ports > 0)).any(axis=-1)
+            feasible = feasible & ~conflict
+        if cfg.has_pod_affinity:
+            selcnt = dyn[:, r + 1 + self.np_pad:]
+            have = selcnt > 0
+            aff_ok = np.all((self._task_aff[ti][None, :] == 0) | have,
+                            axis=-1)
+            anti_ok = np.all((self._task_anti[ti][None, :] == 0) | ~have,
+                             axis=-1)
+            feasible = feasible & aff_ok & anti_ok
+        res = self._task_res[ti]
+        g = []
+        for d in range(2):
+            cs = alloc[:, d].astype(np.int64) >> shift[d]
+            xs = np.minimum((used[:, d].astype(np.int64) + int(res[d]))
+                            >> shift[d], cs)
+            q = np.where(cs > 0, (xs * SCORE_GRID_K) // np.maximum(cs, 1),
+                         SCORE_GRID_K)
+            g.append(q)
+        gc, gm = g
+        w = cfg.weights
+        score = np.zeros(used.shape[0], np.int64)
+        if w.least_requested:
+            score += int(w.least_requested) * 5 * (2 * SCORE_GRID_K - gc - gm)
+        if w.most_requested:
+            score += int(w.most_requested) * 5 * (gc + gm)
+        if w.balanced_resource:
+            score += int(w.balanced_resource) * (
+                10 * SCORE_GRID_K - 10 * np.abs(gc - gm))
+        if cfg.has_pod_affinity_score:
+            selcnt = dyn[:, r + 1 + self.np_pad:]
+            wdiff = (self._task_paffw[ti].astype(np.int64)
+                     - self._task_pantiw[ti])[None, :]
+            score += SCORE_GRID_K * (wdiff * selcnt).sum(axis=-1)
+        score += np.asarray(inp.sig_bonus)[sig]
+        return np.where(feasible, score,
+                        np.int64(SCORE_NEG_INF)).astype(np.int64)
+
+    def candidate_nodes(self, task: TaskInfo,
+                        scored: bool) -> Optional[List[Tuple[str, int]]]:
+        """Feasible (node_name, score) pairs; score-descending with
+        name-ascending tie-break when ``scored`` (SortNodes semantics,
+        scheduler_helper.go:174-185), name-ascending otherwise (the
+        reclaim walk order)."""
+        s = self.scores(task)
+        if s is None:
+            return None
+        feasible = np.nonzero(s > SCORE_NEG_INF)[0]
+        if scored:
+            order = feasible[np.argsort(-s[feasible], kind="stable")]
+        else:
+            order = feasible
+        names = self.snap.node_names
+        return [(names[i], int(s[i])) for i in order]

@@ -43,6 +43,8 @@ class TensorSnapshot:
     fallback_reason: str = ""       # non-empty -> host path required
     task_job: Optional[np.ndarray] = None    # [P_real] i32 job index
     task_res_f64: Optional[np.ndarray] = None  # [P_pad, R] f64 staging
+    port_index: Dict[tuple, int] = field(default_factory=dict)
+    selectors: List[dict] = field(default_factory=list)
 
     @property
     def needs_fallback(self) -> bool:
@@ -524,9 +526,11 @@ def tensorize_session(ssn) -> TensorSnapshot:
                     pid = port_index.get(pk)
                     if pid is not None:
                         node_ports0[nix, pid] = True
+    snap.port_index = dict(port_index)
     if ns_real:
         selectors = [dict(sk) for sk, _ in
                      sorted(sel_index.items(), key=lambda kv: kv[1])]
+        snap.selectors = selectors
         match_cache: Dict[tuple, np.ndarray] = {}
 
         def matches(labels):

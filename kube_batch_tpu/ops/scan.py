@@ -1,0 +1,91 @@
+"""Batched node scan for preempt/reclaim: predicates + scores over all
+nodes in one device call.
+
+The reference's preempt/reclaim walk every node per pending preemptor with
+per-node predicate and prioritizer calls (preempt.go:171-254 via
+util.PredicateNodes/PrioritizeNodes 16-goroutine fan-out;
+reclaim.go:115-170).  This kernel vectorizes one preemptor's walk: the
+session-static tensors (signature mask, score bonus, capacities) live on
+device for the whole action, the dynamic node state (idle/releasing/used/
+count/ports/selcnt) ships as ONE packed int32 buffer per call, and the
+result is a single [N] int32 score vector — SCORE_NEG_INF marks nodes that
+fail the predicate chain, so feasibility and ordering come back in one
+transfer.
+
+NOTE: unlike the allocate solver, the scan deliberately has NO resource-fit
+check — preempt/reclaim predicate candidate nodes before any eviction frees
+room (allocate.go's fit closure is allocate-only; preempt.go:180 uses the
+plugin chain alone).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .resources import SCORE_GRID_K
+from .scoring import SCORE_NEG_INF, grid_score, shifted_caps
+
+
+class ScanStatics(NamedTuple):
+    """Device-resident per-session constants for the scan."""
+    sig_mask: jnp.ndarray     # [S, N] bool
+    sig_bonus: jnp.ndarray    # [S, N] i32
+    node_alloc: jnp.ndarray   # [N, R] i32
+    node_max_tasks: jnp.ndarray  # [N] i32
+    node_exists: jnp.ndarray  # [N] bool
+    score_shift: jnp.ndarray  # [2] i32
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "r", "np_pad", "ns_pad"))
+def scan_nodes(cfg, r: int, np_pad: int, ns_pad: int, statics: ScanStatics,
+               dyn: jnp.ndarray, trow: jnp.ndarray) -> jnp.ndarray:
+    """[N] i32 scores; SCORE_NEG_INF where the predicate chain rejects.
+
+    ``dyn`` packs the mutable node state column-wise:
+        [0:r] used | [r] count | [r+1 : r+1+np_pad] ports |
+        [r+1+np_pad : r+1+np_pad+ns_pad] selcnt
+    (idle/releasing are irrelevant here — no fit check, and scoring reads
+    used only).  ``trow`` packs the preemptor:
+        [0] sig | [1:1+r] res | ports | aff | anti | match(paffw) | pantiw
+    """
+    used = dyn[:, :r]
+    count = dyn[:, r]
+    ports = dyn[:, r + 1:r + 1 + np_pad]
+    selcnt = dyn[:, r + 1 + np_pad:r + 1 + np_pad + ns_pad]
+
+    sig = trow[0]
+    res = trow[1:1 + r]
+    off = 1 + r
+    t_ports = trow[off:off + np_pad]
+    off += np_pad
+    t_aff = trow[off:off + ns_pad]
+    off += ns_pad
+    t_anti = trow[off:off + ns_pad]
+    off += ns_pad
+    t_paffw = trow[off:off + ns_pad]
+    off += ns_pad
+    t_pantiw = trow[off:off + ns_pad]
+
+    feasible = (statics.sig_mask[sig] & statics.node_exists
+                & (count < statics.node_max_tasks))
+    if cfg.has_ports:
+        conflict = ((t_ports[None, :] > 0) & (ports > 0)).any(axis=-1)
+        feasible = feasible & ~conflict
+    if cfg.has_pod_affinity:
+        have = selcnt > 0
+        aff_ok = jnp.all((t_aff[None, :] == 0) | have, axis=-1)
+        anti_ok = jnp.all((t_anti[None, :] == 0) | ~have, axis=-1)
+        feasible = feasible & aff_ok & anti_ok
+
+    cs, den = shifted_caps(statics.node_alloc, statics.score_shift)
+    score = grid_score(res, used, statics.score_shift, cs, den, cfg.weights)
+    if cfg.has_pod_affinity_score:
+        wdiff = (t_paffw - t_pantiw)[None, :]
+        score = score + SCORE_GRID_K * jnp.sum(wdiff * selcnt, axis=-1)
+    score = score + statics.sig_bonus[sig]
+    return jnp.where(feasible, score, SCORE_NEG_INF)

@@ -2,9 +2,10 @@
 
 Counterpart of the reference's generated clients
 (/root/reference/pkg/client/clientset): typed CRUD for PodGroup and Queue in
-both API versions against a cluster-state store, plus fakes.  The store is
-the in-memory Cluster simulator here; a real cluster edge implements the
-same verbs.
+both API versions against a cluster-state store, plus fakes.  The store may
+be the in-memory Cluster simulator OR an edge.client.RemoteCluster — both
+expose the same verbs and mirror dicts, so the typed clients work over the
+network edge unchanged (``new_for_cluster(RemoteCluster(url).start())``).
 """
 
 from __future__ import annotations

@@ -235,6 +235,16 @@ class RemoteCluster:
         with self.lock:
             return self.pods.get(f"{namespace}/{name}")
 
+    # mutation verbs (typed clientsets / workload submission clients):
+    def update_pod_group(self, pg) -> None:
+        self._request("PUT", "/v1/podgroups", codec.encode(pg))
+
+    def delete_pod_group(self, namespace: str, name: str) -> None:
+        self._request("DELETE", f"/v1/podgroups/{namespace}/{name}")
+
+    def delete_queue(self, name: str) -> None:
+        self._request("DELETE", f"/v1/queues/{name}")
+
     # creation verbs (tests / workload submission clients):
     def create_pod(self, pod) -> None:
         self._request("POST", "/v1/pods", codec.encode(pod))

@@ -28,8 +28,6 @@ class PreemptAction(Action):
         return "preempt"
 
     def execute(self, ssn) -> None:
-        from ..models.scanner import maybe_scanner
-        scanner = maybe_scanner(ssn)
         preemptors_map: Dict[str, PriorityQueue] = {}
         preemptor_tasks: Dict[str, PriorityQueue] = {}
         under_request: List = []
@@ -48,6 +46,13 @@ class PreemptAction(Action):
                 preemptor_tasks[job.uid] = PriorityQueue(ssn.task_order_fn)
                 for task in job.task_status_index[TaskStatus.Pending].values():
                     preemptor_tasks[job.uid].push(task)
+
+        if not preemptors_map:
+            return
+        # Tensorize only when there is work: the scanner costs a session
+        # flatten, pure overhead on healthy clusters.
+        from ..models.scanner import maybe_scanner
+        scanner = maybe_scanner(ssn)
 
         # Preemption between jobs within a queue (preempt.go:76-134).
         for queue in queues.values():

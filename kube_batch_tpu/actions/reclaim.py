@@ -20,8 +20,8 @@ class ReclaimAction(Action):
         return "reclaim"
 
     def execute(self, ssn) -> None:
-        from ..models.scanner import maybe_scanner
-        scanner = maybe_scanner(ssn)
+        scanner = None
+        scanner_built = False
         queues = PriorityQueue(ssn.queue_order_fn)
         queue_map: Dict[str, object] = {}
         preemptors_map: Dict[str, PriorityQueue] = {}
@@ -58,6 +58,12 @@ class ReclaimAction(Action):
             task = tasks.pop()
 
             assigned = False
+            if not scanner_built:
+                # Tensorize lazily: only when a starving task actually
+                # needs a node walk.
+                from ..models.scanner import maybe_scanner
+                scanner = maybe_scanner(ssn)
+                scanner_built = True
             # Candidate walk in node order; the device scan answers the
             # predicate chain for all nodes at once (reclaim.go:115).
             if scanner is not None:

@@ -353,15 +353,18 @@ class Session:
         # and the action skips it.  Summed aggregates can't reproduce that
         # per-task skip, so if any node's total looks overdrawn (solver bug
         # or stale snapshot), replay the whole batch through the exact
-        # per-task path instead.
-        check_alloc: dict = {}
-        check_pipe: dict = {}
-        for task, hostname, kind in placements:
-            accs = check_alloc if kind == 1 else check_pipe
-            acc = accs.get(hostname)
-            if acc is None:
-                acc = accs[hostname] = Resource.empty()
-            acc.add(task.resreq)
+        # per-task path instead.  With agg the sums already exist
+        # (vectorized); without it, build them once and reuse below.
+        if agg is not None:
+            check_alloc, check_pipe = agg.node_alloc, agg.node_pipe
+        else:
+            check_alloc, check_pipe = {}, {}
+            for task, hostname, kind in placements:
+                accs = check_alloc if kind == 1 else check_pipe
+                acc = accs.get(hostname)
+                if acc is None:
+                    acc = accs[hostname] = Resource.empty()
+                acc.add(task.resreq)
         for accs, pool in ((check_alloc, "idle"), (check_pipe, "releasing")):
             for hostname, acc in accs.items():
                 node = self.nodes.get(hostname)
@@ -370,8 +373,8 @@ class Session:
                     self._apply_sequential(placements)
                     return
 
-        node_alloc: dict = check_alloc if agg is None else agg.node_alloc
-        node_pipe: dict = check_pipe if agg is None else agg.node_pipe
+        node_alloc: dict = check_alloc
+        node_pipe: dict = check_pipe
         touched_jobs: dict = {}
         applied: List[TaskInfo] = []
         skipped = []
@@ -392,7 +395,13 @@ class Session:
                 skipped.append((task, hostname, kind))
                 continue
             if kind == 1:
-                allocate_volumes(task, hostname)
+                try:
+                    allocate_volumes(task, hostname)
+                except (KeyError, ValueError):
+                    # e.g. a missing PVC: skip this placement exactly as
+                    # the sequential path's per-task catch would.
+                    skipped.append((task, hostname, kind))
+                    continue
                 if agg is None:
                     job.move_task_status(task, allocated_st)
                 else:
@@ -468,10 +477,16 @@ class Session:
                 continue
             binding = job.task_status_index[TaskStatus.Binding]
             for uid, t in moving.items():
-                self.cache.bind_volumes(t)
+                try:
+                    self.cache.bind_volumes(t)
+                except (KeyError, ValueError):
+                    # leave the task Allocated (old dispatch semantics:
+                    # the per-task error was caught and the task skipped)
+                    job.task_status_index[TaskStatus.Allocated][uid] = t
+                    continue
                 t.status = TaskStatus.Binding
                 binding[uid] = t
-            dispatching.extend(moving.values())
+                dispatching.append(t)
         if dispatching:
             self.cache.bind_batch(dispatching)
             metrics.observe_task_schedule_latencies(

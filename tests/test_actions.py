@@ -417,3 +417,47 @@ class TestScanEngines:
                                   np.asarray(host, np.int64))
         finally:
             close_session(ssn)
+
+
+class TestBatchApplyVolumeFailure:
+    def test_bad_volume_skips_only_that_task(self):
+        """A placement whose volume allocation fails must be skipped
+        per-task (old sequential semantics), not abort the batch."""
+        from kube_batch_tpu.api import TaskStatus
+        from kube_batch_tpu.cache import Cluster, new_scheduler_cache
+        from kube_batch_tpu.scheduler import load_scheduler_conf
+        cluster = Cluster()
+        cluster.create_node(build_node("n1", build_resource_list(
+            "8", "16Gi", pods=110)))
+        from kube_batch_tpu.api.queue_info import Queue as _Q
+        cluster.create_queue(v1alpha1.Queue(
+            metadata=ObjectMeta(name="default"),
+            spec=v1alpha1.QueueSpec(weight=1)))
+        cluster.create_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name="pg", namespace="ns"),
+            spec=v1alpha1.PodGroupSpec(min_member=1, queue="default")))
+        cache = new_scheduler_cache(cluster)
+        pods = []
+        for i, vols in enumerate(([], ["missing-pvc"], [])):
+            pod = build_pod("ns", f"p{i}", "", "Pending",
+                            build_resource_list("1", "1Gi"), "pg")
+            pod.spec.volumes = list(vols)
+            pods.append(pod)
+            cluster.create_pod(pod)
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        try:
+            placements = [(t, "n1", 1) for uid, t in
+                          sorted(ssn.jobs["ns/pg"].tasks.items())]
+            ssn.batch_apply(placements)
+            node = ssn.nodes["n1"]
+            # p0 and p2 applied + accounted; p1 skipped cleanly.
+            assert "ns/p0" in node.tasks and "ns/p2" in node.tasks
+            assert "ns/p1" not in node.tasks
+            assert node.used.milli_cpu == 2000.0
+            statuses = {t.name: t.status for t in
+                        ssn.jobs["ns/pg"].tasks.values()}
+            assert statuses["p1"] == TaskStatus.Pending
+            assert statuses["p0"] != TaskStatus.Pending
+        finally:
+            close_session(ssn)

@@ -78,9 +78,10 @@ def make_synthetic_inputs(n_tasks: int = 1000, n_nodes: int = 100,
     # Clip before narrowing: at extreme scales a queue's deserved approaches
     # the cluster total, which can exceed int32 (the real tensorize path
     # falls back instead; a saturated synthetic bench stays well-formed).
-    deserved = np.clip(np.rint(_waterfill(total.astype(f), queue_weight,
-                                          request, queue_exists)),
-                       0, np.iinfo(np.int32).max).astype(np.int32)
+    deserved_f = _waterfill(total.astype(f), queue_weight, request,
+                            queue_exists)
+    deserved = np.clip(np.rint(deserved_f), 0,
+                       np.iinfo(np.int32).max).astype(np.int32)
 
     dev = lambda x, dt=None: jnp.asarray(x, dtype=dt or (dtype if x.dtype == f
                                                          else None))
@@ -102,6 +103,7 @@ def make_synthetic_inputs(n_tasks: int = 1000, n_nodes: int = 100,
         job_init_ready=jnp.zeros((j_pad,), jnp.int32),
         job_init_alloc=jnp.zeros((j_pad, r), jnp.int32),
         queue_deserved=jnp.asarray(deserved),
+        queue_deserved_f=dev(deserved_f),
         queue_init_alloc=jnp.zeros((q_pad, r), jnp.int32),
         queue_ts=dev(np.arange(q_pad, dtype=f)),
         queue_uid_rank=dev(np.arange(q_pad, dtype=f)),

@@ -655,6 +655,14 @@ def tensorize_session(ssn) -> TensorSnapshot:
     total_res_q = node_alloc_q[:n_real].sum(axis=0, dtype=np.int64) \
         if n_real else np.zeros((r,), np.int64)
 
+    # deserved, exactly scaled to quanta but NOT rounded (see SolverInputs
+    # docstring): the water-fill's fractional values must not round in the
+    # share denominator.  The numerator (queue alloc) is still integer
+    # quanta, so share ratios equal the host's exactly for quantum-multiple
+    # requests and within one quantum otherwise.
+    from ..ops.resources import scale_columns
+    queue_deserved_f = scale_columns(queue_deserved.copy())
+
     snap.inputs = SolverInputs(
         task_req=task_req_q, task_res=task_res_q,
         task_sig=dev(task_sig, jnp.int32), task_sorted=dev(task_sorted, jnp.int32),
@@ -668,7 +676,9 @@ def tensorize_session(ssn) -> TensorSnapshot:
         job_prio=dev(job_prio), job_ts=dev(job_ts), job_uid_rank=dev(job_rank),
         job_init_ready=dev(job_init_ready, jnp.int32),
         job_init_alloc=job_init_alloc_q,
-        queue_deserved=queue_deserved_q, queue_init_alloc=queue_alloc_q,
+        queue_deserved=queue_deserved_q,
+        queue_deserved_f=dev(queue_deserved_f),
+        queue_init_alloc=queue_alloc_q,
         queue_ts=dev(queue_ts), queue_uid_rank=dev(queue_rank),
         queue_exists=dev(queue_exists, bool),
         node_idle=node_idle_q, node_releasing=node_rel_q,

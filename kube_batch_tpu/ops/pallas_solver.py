@@ -22,7 +22,9 @@ State layout (rows padded to sublane multiples of 8):
   job_dyn  [R+3 -> pad8, J] i32: drf alloc rows, ptr, ready_cnt, active
   que_des  [R -> pad8, Q] i32: proportion deserved (exact for the
       epsilon-overused compare)
-  que_sta  [8, Q] float: ts, uid_rank, exists
+  que_sta  [3+R -> pad8, Q] float: ts, uid_rank, exists, then UNrounded
+      deserved rows (share denominators; the int que_des rows serve the
+      epsilon overused compare)
   que_dyn  [R+1 -> pad8, Q] i32: alloc rows, active
 
 Placement updates are rank-1 (delta-column ⊗ one-hot) adds.  Ties break
@@ -91,8 +93,9 @@ def _solve_kernel(r: int, np_pad: int, ns_pad: int, cfg: SolverConfig,
     JSTART, JCOUNT, JQUEUE, JMIN, JPRIO, JTS, JUID = 0, 1, 2, 3, 4, 5, 6
     # job_dyn rows: [0:r] alloc, then ptr, ready, active
     JPTR, JREADY, JACT = r, r + 1, r + 2
-    # que_sta rows
+    # que_sta rows: ts, uid_rank, exists, then float deserved rows
     QTS, QUID = 0, 1
+    QDESF = 3
     # que_dyn rows: [0:r] alloc, active
     QACT = r
 
@@ -117,14 +120,16 @@ def _solve_kernel(r: int, np_pad: int, ns_pad: int, cfg: SolverConfig,
         return m
 
     def queue_share_row():
-        """[1, Q] proportion shares: max_r safe_share(alloc_r, deserved_r)."""
+        """[1, Q] proportion shares: max_r safe_share(alloc_r, deserved_r)
+        over the UNrounded float deserved rows (the int rows serve only the
+        epsilon overused compare; rounding would flip near-tied shares)."""
         share = jnp.zeros((1, qdim), dtype)
         for i in range(r):
             alloc = qdyn_ref[i:i + 1, :]
-            des = qdes_ref[i:i + 1, :]
+            des = qsta_ref[QDESF + i:QDESF + i + 1, :]
             s = jnp.where(des == 0, jnp.where(alloc == 0, 0.0, 1.0),
                           alloc.astype(dtype)
-                          / jnp.where(des == 0, 1, des).astype(dtype))
+                          / jnp.where(des == 0, 1.0, des))
             share = jnp.maximum(share, s)
         return share
 
@@ -410,9 +415,11 @@ def _build_buffers(inp: SolverInputs):
     qdes = jnp.concatenate(
         [i32(inp.queue_deserved).T,
          jnp.zeros((_pad8(r) - r, qdim), jnp.int32)], axis=0)
+    qs_rows = _pad8(3 + r)
     qsta = jnp.concatenate([
         f(inp.queue_ts), f(inp.queue_uid_rank), f(inp.queue_exists),
-        jnp.zeros((8 - 3, qdim), fdt)], axis=0)
+        inp.queue_deserved_f.T.astype(fdt),
+        jnp.zeros((qs_rows - 3 - r, qdim), fdt)], axis=0)
     qd_rows = _pad8(r + 1)
     qdyn = jnp.concatenate([
         i32(inp.queue_init_alloc).T,

@@ -82,14 +82,21 @@ def quantize_value(value: float, dim: int) -> int:
     return int(round(value / quantum_for_dim(dim)))
 
 
+def scale_columns(arr: np.ndarray) -> np.ndarray:
+    """Host-side: [..., R] float64 resource array -> float quanta, exactly
+    scaled but NOT rounded (power-of-two division is exact in binary
+    floating point)."""
+    out = arr / MEMORY_QUANTUM
+    out[..., 0] = arr[..., 0] / CPU_QUANTUM
+    if arr.shape[-1] > 2:
+        out[..., 2:] = arr[..., 2:] / SCALAR_QUANTUM
+    return out
+
+
 def quantize_columns(arr: np.ndarray) -> np.ndarray:
     """Host-side: [..., R] float64 resource array -> int64 quanta (callers
     range-check before narrowing to int32)."""
-    out = np.rint(arr / MEMORY_QUANTUM).astype(np.int64)
-    out[..., 0] = np.rint(arr[..., 0] / CPU_QUANTUM).astype(np.int64)
-    if arr.shape[-1] > 2:
-        out[..., 2:] = np.rint(arr[..., 2:] / SCALAR_QUANTUM).astype(np.int64)
-    return out
+    return np.rint(scale_columns(arr)).astype(np.int64)
 
 
 def eps_vector(r: int, dtype=jnp.int32) -> jnp.ndarray:

@@ -76,7 +76,14 @@ class SolverInputs(NamedTuple):
     job_init_ready: jnp.ndarray  # [J] i32 ready_task_num at session open
     job_init_alloc: jnp.ndarray  # [J, R] allocated at session open (drf)
     # queues (Q)
-    queue_deserved: jnp.ndarray  # [Q, R] proportion water-fill result
+    queue_deserved: jnp.ndarray  # [Q, R] i32 water-fill (overused compare)
+    queue_deserved_f: jnp.ndarray  # [Q, R] float, UNrounded scaled quanta:
+                                 # deserved is inherently fractional (weight
+                                 # splits), and rounding it flips near-tied
+                                 # share orderings.  The alloc numerator is
+                                 # still integer quanta, so share ratios are
+                                 # host-exact for quantum-multiple requests
+                                 # and within one quantum otherwise
     queue_init_alloc: jnp.ndarray  # [Q, R]
     queue_ts: jnp.ndarray       # [Q] f
     queue_uid_rank: jnp.ndarray  # [Q] f
@@ -153,7 +160,7 @@ def _select_queue(inp: SolverInputs, st: SolverState, cfg: SolverConfig):
     keys = []
     for name in cfg.queue_key_order:
         if name == "proportion":
-            keys.append(queue_shares(st.queue_alloc, inp.queue_deserved))
+            keys.append(queue_shares(st.queue_alloc, inp.queue_deserved_f))
     keys.extend([inp.queue_ts, inp.queue_uid_rank])
     return _lex_argmin(st.queue_active, keys)
 
@@ -623,7 +630,8 @@ def solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
         qkeys = []
         for name in cfg.queue_key_order:
             if name == "proportion":
-                qkeys.append(queue_shares(queue_alloc, inp.queue_deserved))
+                qkeys.append(queue_shares(queue_alloc,
+                                          inp.queue_deserved_f))
         qkeys.extend([inp.queue_ts, inp.queue_uid_rank])
         q = _lex_argmin(queue_active, qkeys)
 

@@ -56,7 +56,8 @@ def solver_input_shardings(mesh: Mesh):
         job_start=rep, job_count=rep, job_queue=rep, job_minavail=rep,
         job_prio=rep, job_ts=rep, job_uid_rank=rep, job_init_ready=rep,
         job_init_alloc=rep2,
-        queue_deserved=rep2, queue_init_alloc=rep2, queue_ts=rep,
+        queue_deserved=rep2, queue_deserved_f=rep2,
+        queue_init_alloc=rep2, queue_ts=rep,
         queue_uid_rank=rep, queue_exists=rep,
         node_idle=node_2d, node_releasing=node_2d, node_used=node_2d,
         node_alloc=node_2d, node_count=node_1d, node_max_tasks=node_1d,

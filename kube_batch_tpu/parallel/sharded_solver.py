@@ -53,7 +53,8 @@ def _node_specs():
         job_start=P(None), job_count=P(None), job_queue=P(None),
         job_minavail=P(None), job_prio=P(None), job_ts=P(None),
         job_uid_rank=P(None), job_init_ready=P(None), job_init_alloc=rep2,
-        queue_deserved=rep2, queue_init_alloc=rep2, queue_ts=P(None),
+        queue_deserved=rep2, queue_deserved_f=rep2,
+        queue_init_alloc=rep2, queue_ts=P(None),
         queue_uid_rank=P(None), queue_exists=P(None),
         node_idle=n2, node_releasing=n2, node_used=n2, node_alloc=n2,
         node_count=n1, node_max_tasks=n1, node_exists=n1,
@@ -211,7 +212,7 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
             for name in cfg.queue_key_order:
                 if name == "proportion":
                     qkeys.append(queue_shares(queue_alloc,
-                                              inp.queue_deserved))
+                                              inp.queue_deserved_f))
             qkeys.extend([inp.queue_ts, inp.queue_uid_rank])
             q = _lex_argmin(queue_active, qkeys)
 

@@ -743,3 +743,53 @@ class TestPreferredNodeAffinityOnDevice:
                 close_session(ssn)
             results.append(binder.binds)
         assert results[1] == results[0]
+
+
+class TestMixedFeatureFuzz:
+    """Mixed ports + required anti-affinity + soft affinity + running pods.
+    Seeds 227/237 caught a real round-2 bug: rounding the water-fill's
+    fractional deserved values flipped near-tied queue-share orderings —
+    shares now divide the UNrounded power-of-two-scaled deserved."""
+
+    @pytest.mark.parametrize("seed", [227, 237, 210, 233])
+    def test_mixed_features(self, seed):
+        from kube_batch_tpu.api.objects import Affinity, ContainerPort
+        rng = random.Random(seed)
+        nq = rng.randint(1, 4)
+        spec = dict(queues=[(f"q{i}", rng.randint(1, 4)) for i in range(nq)],
+                    pod_groups=[], pods=[],
+                    nodes=[(f"n{i}", str(rng.choice([4, 8, 16])),
+                            f"{rng.choice([8, 16, 32])}Gi")
+                           for i in range(rng.randint(2, 6))])
+        for j in range(rng.randint(2, 7)):
+            size = rng.randint(1, 5)
+            spec["pod_groups"].append((f"pg{j}", "ns", rng.randint(1, size),
+                                       f"q{rng.randrange(nq)}"))
+            for i in range(size):
+                running = rng.random() < 0.2
+                spec["pods"].append(("ns", f"j{j}-p{i}",
+                                     "n0" if running else "",
+                                     "Running" if running else "Pending",
+                                     str(rng.choice([1, 2, 3])),
+                                     f"{rng.choice([1, 2, 4])}Gi", f"pg{j}"))
+
+        def mutate(cache):
+            r2 = random.Random(seed + 5000)
+            for job in list(cache.jobs.values()):
+                for t in list(job.tasks.values()):
+                    t.pod.metadata.labels["grp"] = t.job.split("/")[-1]
+                    roll = r2.random()
+                    if roll < 0.15:
+                        t.pod.spec.containers[0].ports = [
+                            ContainerPort(host_port=r2.choice([80, 443]))]
+                    elif roll < 0.3:
+                        t.pod.spec.affinity = Affinity(
+                            required_pod_anti_affinity=[
+                                {"grp": t.job.split("/")[-1]}])
+                    elif roll < 0.45:
+                        t.pod.spec.affinity = Affinity(
+                            preferred_pod_affinity=[
+                                (r2.choice([10, 50]),
+                                 {"grp": f"pg{r2.randrange(7)}"})])
+
+        run_both_mutated(mutate, spec)

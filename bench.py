@@ -77,7 +77,7 @@ def main():
 
 
 def measure_full_session(n_tasks, n_nodes, n_jobs, n_queues,
-                         repeat: int = 2) -> float:
+                         repeat: int = 4) -> float:
     """End-to-end session wall-clock (best of ``repeat``), ms."""
     import gc
 

@@ -400,9 +400,14 @@ class SchedulerCache(Cache):
 
     def update_job_status(self, job: JobInfo) -> JobInfo:
         """Push PodGroup status to the cluster (cache.go:763-775)."""
-        if self.status_updater is not None and not shadow_pod_group(job.pod_group):
-            self.status_updater.update_pod_group(job.pod_group)
-        self.record_job_status_event(job)
+        try:
+            if self.status_updater is not None and not shadow_pod_group(job.pod_group):
+                self.status_updater.update_pod_group(job.pod_group)
+        finally:
+            # Events + pod conditions must survive a failed status write
+            # (e.g. the PodGroup was deleted mid-session): the reference
+            # records them regardless of the UpdatePodGroup outcome.
+            self.record_job_status_event(job)
         return job
 
     def record_job_status_event(self, job: JobInfo) -> None:

@@ -205,9 +205,12 @@ class Cluster:
         with self.lock:
             key = f"{pg.metadata.namespace}/{pg.metadata.name}"
             old = self.pod_groups.get(key)
-            if old is not None:
-                self.pod_groups[key] = pg
-                self.pod_group_informer.fire_update(old, pg)
+            if old is None:
+                # A status write racing a delete must surface as 404 at
+                # the edge, not a silent 200 (real apiserver semantics).
+                raise KeyError(f"podgroups \"{key}\" not found")
+            self.pod_groups[key] = pg
+            self.pod_group_informer.fire_update(old, pg)
             return pg
 
     def create_queue(self, queue) -> object:

@@ -23,6 +23,8 @@ from . import codec
 _WATCHED = ("pods", "nodes", "podgroups", "queues", "priorityclasses",
             "pdbs")
 
+_MISSING = object()
+
 
 class _PvcStore(dict):
     """PVC mirror that refetches the remote list on a miss (PVCs have no
@@ -52,12 +54,23 @@ class _PvcStore(dict):
                 return default
             try:
                 self._remote._refresh_pvcs()
-            except OSError:
+            except (OSError, KeyError):  # _request maps HTTPError→KeyError
                 return default
             value = dict.get(self, key, default)
             if value is default:
                 self._neg[key] = now + self._NEG_TTL
         return value
+
+    # Mapping syntax must see the same on-miss refetch + negative cache
+    # as .get(), so future callers can't silently read a stale miss.
+    def __getitem__(self, key):
+        value = self.get(key, _MISSING)
+        if value is _MISSING:
+            raise KeyError(key)
+        return value
+
+    def __contains__(self, key):
+        return self.get(key, _MISSING) is not _MISSING
 
 
 def _key_fn(resource: str):

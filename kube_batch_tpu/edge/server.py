@@ -88,27 +88,34 @@ class _Handler(BaseHTTPRequestHandler):
         resource, rest, _ = self._route()
         if resource is None:
             return self._json(404, {"error": "not found"})
+        if resource in ("pods", "pvcs") and len(rest) == 3 and rest[2] == "bind":
+            try:
+                body = self._body()
+                if resource == "pods":
+                    self.cluster.bind_pod(rest[0], rest[1], body["node"])
+                else:
+                    self.cluster.bind_pvc(rest[0], rest[1], body["volume"])
+            except (KeyError, ValueError) as exc:
+                return self._json(409, {"error": str(exc)})
+            return self._json(200, {"status": "bound"})
+        if rest:  # create routes take no path suffix
+            return self._json(404, {"error": "not found"})
         try:
-            if resource == "pods" and len(rest) == 3 and rest[2] == "bind":
-                body = self._body()
-                self.cluster.bind_pod(rest[0], rest[1], body["node"])
-                return self._json(200, {"status": "bound"})
-            if resource == "pvcs" and len(rest) == 3 and rest[2] == "bind":
-                body = self._body()
-                self.cluster.bind_pvc(rest[0], rest[1], body["volume"])
-                return self._json(200, {"status": "bound"})
             obj = codec.decode(self._body())
-            create = {"pods": self.cluster.create_pod,
-                      "nodes": self.cluster.create_node,
-                      "podgroups": self.cluster.create_pod_group,
-                      "queues": self.cluster.create_queue,
-                      "priorityclasses": self.cluster.create_priority_class,
-                      "pdbs": self.cluster.create_pdb,
-                      "pvcs": self.cluster.create_pvc}[resource]
+        except (ValueError, KeyError) as exc:  # malformed JSON / unknown kind
+            return self._json(400, {"error": str(exc)})
+        create = {"pods": self.cluster.create_pod,
+                  "nodes": self.cluster.create_node,
+                  "podgroups": self.cluster.create_pod_group,
+                  "queues": self.cluster.create_queue,
+                  "priorityclasses": self.cluster.create_priority_class,
+                  "pdbs": self.cluster.create_pdb,
+                  "pvcs": self.cluster.create_pvc}[resource]
+        try:
             create(obj)
-            return self._json(201, {"status": "created"})
-        except (KeyError, ValueError) as exc:
+        except (KeyError, ValueError) as exc:  # store conflict
             return self._json(409, {"error": str(exc)})
+        return self._json(201, {"status": "created"})
 
     def do_PUT(self):
         resource, rest, _ = self._route()

@@ -476,14 +476,21 @@ class Session:
             if not moving:
                 continue
             binding = job.task_status_index[TaskStatus.Binding]
-            for uid, t in moving.items():
+            moving_items = list(moving.items())
+            for i, (uid, t) in enumerate(moving_items):
                 try:
                     self.cache.bind_volumes(t)
                 except (KeyError, ValueError):
-                    # leave the task Allocated (old dispatch semantics:
-                    # the per-task error was caught and the task skipped)
-                    job.task_status_index[TaskStatus.Allocated][uid] = t
-                    continue
+                    # Sequential-path semantics: dispatch() propagates the
+                    # error out of allocate(), so this and the job's
+                    # remaining Allocated tasks stay Allocated this cycle
+                    # (session.go:290-314 error return; allocate.go:164
+                    # logs and moves on).  Already-dispatched tasks keep
+                    # their Binding status, as in the interleaved loop.
+                    alloc = job.task_status_index[TaskStatus.Allocated]
+                    for ruid, rt in moving_items[i:]:
+                        alloc[ruid] = rt
+                    break
                 t.status = TaskStatus.Binding
                 binding[uid] = t
                 dispatching.append(t)

@@ -92,6 +92,13 @@ class Affinity:
 
 @dataclass
 class PodSpec:
+    """Pod spec (the scheduling-relevant subset of core/v1 PodSpec).
+
+    Treat as immutable once attached to a Pod: update paths must replace
+    the Pod/spec object rather than mutate fields in place — derived
+    per-pod caches (models/tensor_snapshot._pod_static) invalidate on
+    spec identity, matching apiserver semantics (pod specs are immutable
+    after creation apart from a few non-scheduling fields)."""
     node_name: str = ""
     node_selector: Dict[str, str] = field(default_factory=dict)
     priority: Optional[int] = None

@@ -89,12 +89,16 @@ class _Handler(BaseHTTPRequestHandler):
         if resource is None:
             return self._json(404, {"error": "not found"})
         if resource in ("pods", "pvcs") and len(rest) == 3 and rest[2] == "bind":
+            want = "node" if resource == "pods" else "volume"
+            try:  # malformed body -> 400, distinct from store conflicts
+                target = self._body()[want]
+            except (KeyError, ValueError, TypeError) as exc:
+                return self._json(400, {"error": f"bad bind body: {exc}"})
             try:
-                body = self._body()
                 if resource == "pods":
-                    self.cluster.bind_pod(rest[0], rest[1], body["node"])
+                    self.cluster.bind_pod(rest[0], rest[1], target)
                 else:
-                    self.cluster.bind_pvc(rest[0], rest[1], body["volume"])
+                    self.cluster.bind_pvc(rest[0], rest[1], target)
             except (KeyError, ValueError) as exc:
                 return self._json(409, {"error": str(exc)})
             return self._json(200, {"status": "bound"})

@@ -127,11 +127,16 @@ def _resource_axis(ssn) -> List[str]:
     anywhere in the snapshot."""
     scalars = set()
     for node in ssn.nodes.values():
-        scalars.update(node.allocatable.scalar_resources)
+        if node.allocatable.scalar_resources:
+            scalars.update(node.allocatable.scalar_resources)
     for job in ssn.jobs.values():
         for task in job.tasks.values():
-            scalars.update(task.resreq.scalar_resources)
-            scalars.update(task.init_resreq.scalar_resources)
+            # Empty-dict guard: 100k no-op set.update calls cost ~30 ms
+            # at 50k tasks; scalar resources are rare.
+            if task.resreq.scalar_resources:
+                scalars.update(task.resreq.scalar_resources)
+            if task.init_resreq.scalar_resources:
+                scalars.update(task.init_resreq.scalar_resources)
     return ["cpu", "memory", *sorted(scalars)]
 
 
@@ -145,29 +150,62 @@ def _vec(resource, axis: List[str]) -> np.ndarray:
 
 
 def _task_signature(task) -> tuple:
-    sel = tuple(sorted(task.pod.spec.node_selector.items()))
-    tol = tuple(sorted((t.key, t.operator, t.value, t.effect)
-                       for t in task.pod.spec.tolerations))
-    aff = ()
-    pref = ()
-    affinity = task.pod.spec.affinity
-    if affinity is not None and affinity.required_node_terms:
-        aff = tuple(tuple(sorted(t.items()))
-                    for t in affinity.required_node_terms)
-    if affinity is not None and affinity.preferred_node_terms:
-        # Preferred node affinity contributes a per-signature static score
-        # bonus, so tasks with different preferences must not share a row.
-        pref = tuple((w, tuple(sorted(term.items())))
-                     for w, term in affinity.preferred_node_terms)
-    return sel, tol, aff, pref
+    """Static-predicate signature (selector, tolerations, required node
+    affinity, preferred node affinity); tasks sharing one share a sig_mask
+    row.  Delegates to the cached per-pod derivation."""
+    return _pod_static(task.pod)[2]
 
 
-def _task_port_keys(task) -> list:
+def _task_port_keys(task) -> tuple:
     """(host_port, protocol) keys, the conflict domain of the host's
     host_ports_conflict (plugins/predicates.py, predicates.go:174)."""
-    return [(p.host_port, p.protocol)
-            for c in task.pod.spec.containers for p in c.ports
-            if p.host_port > 0]
+    return _pod_static(task.pod)[3]
+
+
+def _pod_static(pod) -> tuple:
+    """(spec, has_features, signature, port_keys) for a pod, cached on the
+    pod object keyed by spec IDENTITY.
+
+    Contract: a PodSpec is immutable once attached to a Pod — every update
+    path (informers, edge codec, tests) replaces the Pod or spec object,
+    which invalidates this cache via the ``is`` check.  Mutating spec
+    fields in place on a pod that has already been tensorized would serve
+    a stale signature; don't do that (api/objects.py PodSpec docstring).
+    The cache lets 50k-task steady-state sessions skip re-deriving 50k
+    signature tuples per cycle."""
+    spec = pod.spec
+    cached = pod.__dict__.get("_tensor_static")
+    if cached is not None and cached[0] is spec:
+        return cached
+    has_features = bool(
+        spec.node_selector or spec.tolerations or spec.affinity is not None
+        or any(p.host_port > 0 for c in spec.containers for p in c.ports))
+    if has_features:
+        sel = tuple(sorted(spec.node_selector.items()))
+        tol = tuple(sorted((t.key, t.operator, t.value, t.effect)
+                           for t in spec.tolerations))
+        aff = ()
+        pref = ()
+        affinity = spec.affinity
+        if affinity is not None and affinity.required_node_terms:
+            aff = tuple(tuple(sorted(t.items()))
+                        for t in affinity.required_node_terms)
+        if affinity is not None and affinity.preferred_node_terms:
+            # Preferred node affinity contributes a per-signature static
+            # score bonus, so tasks with different preferences must not
+            # share a row.
+            pref = tuple((w, tuple(sorted(term.items())))
+                         for w, term in affinity.preferred_node_terms)
+        sig = (sel, tol, aff, pref)
+        ports = tuple((p.host_port, p.protocol)
+                      for c in spec.containers for p in c.ports
+                      if p.host_port > 0)
+    else:
+        sig = ((), (), (), ())
+        ports = ()
+    cached = (spec, has_features, sig, ports)
+    pod.__dict__["_tensor_static"] = cached
+    return cached
 
 
 # Cardinality caps for the dynamic-predicate tensors; beyond these the
@@ -413,15 +451,12 @@ def tensorize_session(ssn) -> TensorSnapshot:
         job_start[ji] = len(tasks)
         job_count[ji] = len(pending)
         for t in pending:
-            spec = t.pod.spec
-            if (spec.node_selector or spec.tolerations
-                    or spec.affinity is not None
-                    or any(p.host_port > 0 for c in spec.containers
-                           for p in c.ports)):
-                sig = _task_signature(t)
+            _spec, has_features, sig, pkeys = _pod_static(t.pod)
+            if has_features:
                 # Dynamic predicates: collect this task's port keys and
                 # affinity selectors into the session-wide index.
-                for pk in _task_port_keys(t):
+                spec = t.pod.spec
+                for pk in pkeys:
                     if pk not in port_index:
                         port_index[pk] = len(port_index)
                     task_port_ids[len(tasks)].append(port_index[pk])
@@ -462,8 +497,6 @@ def tensorize_session(ssn) -> TensorSnapshot:
                                 sel_index[sk] = len(sel_index)
                             task_panti[len(tasks)].append(
                                 (sel_index[sk], int(weight) * w_podaff))
-            else:
-                sig = ((), (), (), ())  # the common unconstrained pod
             if sig not in signatures:
                 signatures[sig] = len(signatures)
                 sig_examples.append(t)
@@ -578,32 +611,87 @@ def tensorize_session(ssn) -> TensorSnapshot:
     s_real = max(len(sig_examples), 1)
     sig_mask = np.zeros((s_real, n_pad), bool)
     sig_bonus = np.zeros((s_real, n_pad), np.int64)  # guard before i32
-    from ..plugins.nodeorder import node_affinity_score
     w_nodeaff = int(w_nodeaff)
-    # Static mask = the session's tiered predicate chain evaluated once per
-    # (signature, node) with the dynamic features (host ports, pod
-    # (anti-)affinity) stripped from the example — those re-evaluate every
-    # loop step from occupancy tensors, as does the pod-count cap; the
-    # remaining checks (unschedulable, selector/node-affinity, taints,
-    # pressure) are static for the session.
-    for si, example in enumerate(sig_examples):
-        stripped = _static_example(example)
-        affinity = example.pod.spec.affinity
-        has_pref = (w_nodeaff and affinity is not None
-                    and affinity.preferred_node_terms)
+    # Static mask = the session's tiered predicate chain evaluated with the
+    # dynamic features (host ports, pod (anti-)affinity) stripped from the
+    # example — those re-evaluate every loop step from occupancy tensors;
+    # the remaining checks (unschedulable, selector/node-affinity, taints,
+    # pressure, pod-count-at-open) are static for the session.
+    #
+    # Nodes collapse into STATIC PROFILES first: a predicate/bonus outcome
+    # can only depend on the label keys some signature references, the
+    # node's schedulable-affecting taints, its five condition values, the
+    # unschedulable flag, and whether the pod-count cap is already hit
+    # (counts only grow during allocate, so at-open fullness is the static
+    # truth).  predicate_fn then runs once per (signature, profile), not
+    # per (signature, node) — O(S x profiles) instead of the O(S x N)
+    # cliff a heterogeneous 64-signature x 10k-node session would hit,
+    # while unique per-node labels (kubernetes.io/hostname) drop out
+    # unless a signature actually selects on them.
+    if sig_examples:
+        from ..plugins.nodeorder import node_affinity_score
+        label_keys = set()
+        for sel, _tol, aff, pref in signatures:
+            label_keys.update(k for k, _ in sel)
+            for term in aff:
+                label_keys.update(k for k, _ in term)
+            for _w, term in pref:
+                label_keys.update(k for k, _ in term)
+        label_keys = sorted(label_keys)
+        cond_keys = ("Ready", "NetworkUnavailable", "MemoryPressure",
+                     "DiskPressure", "PIDPressure")
+        profile_index: Dict[tuple, int] = {}
+        profile_reps: List = []
+        profile_of = np.zeros((max(n_real, 1),), np.int32)
         for nix, node in enumerate(node_objs):
-            if has_pref:
-                # Preferred node affinity is static per (signature, node):
-                # bake the grid-scaled weighted bonus the host scorer adds
-                # (plugins/nodeorder.node_affinity_score x plugin weight).
-                sig_bonus[si, nix] = w_nodeaff * node_affinity_score(
-                    example, node)
-            try:
-                ssn.predicate_fn(stripped, node)
-            except Exception:
-                continue
-            sig_mask[si, nix] = True
-    if not sig_examples:
+            nd = node.node
+            if nd is None:
+                key = None
+            else:
+                labels = nd.metadata.labels
+                conds = nd.status.conditions
+                key = (
+                    bool(nd.spec.unschedulable),
+                    node.allocatable.max_task_num <= len(node.tasks),
+                    tuple(conds.get(c) for c in cond_keys),
+                    # PreferNoSchedule taints are skipped by the
+                    # toleration check and read nowhere else.
+                    tuple((t.key, t.value, t.effect)
+                          for t in nd.spec.taints
+                          if t.effect != "PreferNoSchedule"),
+                    tuple(labels.get(k) for k in label_keys),
+                )
+            pid = profile_index.get(key)
+            if pid is None:
+                pid = len(profile_reps)
+                profile_index[key] = pid
+                profile_reps.append(node)
+            profile_of[nix] = pid
+        n_prof = len(profile_reps)
+        prof_mask = np.zeros((s_real, n_prof), bool)
+        prof_bonus = np.zeros((s_real, n_prof), np.int64)
+        for si, example in enumerate(sig_examples):
+            stripped = _static_example(example)
+            affinity = example.pod.spec.affinity
+            has_pref = (w_nodeaff and affinity is not None
+                        and affinity.preferred_node_terms)
+            for pi, node in enumerate(profile_reps):
+                if has_pref:
+                    # Preferred node affinity is static per (signature,
+                    # profile): bake the grid-scaled weighted bonus the
+                    # host scorer adds (nodeorder.node_affinity_score x
+                    # plugin weight).
+                    prof_bonus[si, pi] = w_nodeaff * node_affinity_score(
+                        example, node)
+                try:
+                    ssn.predicate_fn(stripped, node)
+                except Exception:
+                    continue
+                prof_mask[si, pi] = True
+        if n_real:
+            sig_mask[:, :n_real] = prof_mask[:, profile_of]
+            sig_bonus[:, :n_real] = prof_bonus[:, profile_of]
+    else:
         sig_mask[:, :n_real] = True
     if sig_bonus.any():
         # Combined-score headroom: bonus + fraction scores (+ a possible

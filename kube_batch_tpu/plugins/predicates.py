@@ -106,6 +106,17 @@ class PredicatesPlugin(Plugin):
         return "predicates"
 
     def on_session_open(self, ssn) -> None:
+        # NODE READ-SET CONTRACT: the static checks below read, per node,
+        # exactly {the five named conditions, allocatable.max_task_num vs
+        # len(node.tasks), spec.unschedulable, spec.taints (non-
+        # PreferNoSchedule), labels at keys the task references} — plus
+        # the dynamic ports/pod-affinity occupancy re-evaluated in-loop.
+        # models/tensor_snapshot.py collapses nodes into static profiles
+        # keyed on THIS read-set before evaluating the chain; if a new
+        # node-dependent check is added here, the profile key there MUST
+        # gain the field or nodes differing only in it will silently share
+        # a verdict (tests/test_tensorize_hetero.py pins exactness only
+        # over fields the key already covers).
         def predicate_fn(task: TaskInfo, node: NodeInfo) -> None:
             if node.node is None:
                 raise FitError(task, node, "node not initialized")

@@ -1,0 +1,115 @@
+"""Heterogeneous-snapshot tensorization: the static [S, N] predicate mask
+must be exact AND cheap when signatures x nodes is large (VERDICT r2
+weak #1: the O(S x N) Python cliff).
+
+The mask is built by collapsing nodes into static profiles; these tests
+pin (a) exactness against brute-force per-(signature, node) predicate
+evaluation, (b) the invocation count staying O(S x profiles) even when
+every node carries a unique label, and (c) end-to-end device/host parity
+on a many-signature snapshot.
+"""
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu.actions.factory import register_default_actions
+from kube_batch_tpu.framework import close_session, open_session
+from kube_batch_tpu.models.synthetic import make_synthetic_cache
+from kube_batch_tpu.models.tensor_snapshot import (_static_example,
+                                                   tensorize_session)
+from kube_batch_tpu.plugins.factory import register_default_plugins
+from kube_batch_tpu.scheduler import DEFAULT_SCHEDULER_CONF, load_scheduler_conf
+
+register_default_actions()
+register_default_plugins()
+
+S = 64
+
+
+def _open_hetero(n_tasks=256, n_nodes=96, n_jobs=S, n_queues=4):
+    cache, binder = make_synthetic_cache(n_tasks, n_nodes, n_jobs, n_queues,
+                                         n_signatures=S)
+    _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+    return open_session(cache, tiers), binder
+
+
+def test_mask_matches_bruteforce():
+    """Profile-collapsed mask == predicate_fn evaluated per (sig, node)."""
+    ssn, _ = _open_hetero()
+    try:
+        snap = tensorize_session(ssn)
+        assert not snap.needs_fallback, snap.fallback_reason
+        sig_mask = np.asarray(snap.inputs.sig_mask)
+        sig_bonus = np.asarray(snap.inputs.sig_bonus)
+        # Reconstruct per-signature examples the way tensorize groups them.
+        from kube_batch_tpu.models.tensor_snapshot import _task_signature
+        seen = {}
+        examples = []
+        for t in snap.tasks:
+            sig = _task_signature(t)
+            if sig not in seen:
+                seen[sig] = len(examples)
+                examples.append(t)
+        assert len(examples) >= S  # unconstrained sig may or may not appear
+        from kube_batch_tpu.plugins.nodeorder import node_affinity_score
+        node_objs = [ssn.nodes[name] for name in snap.node_names]
+        for si, example in enumerate(examples):
+            stripped = _static_example(example)
+            for nix, node in enumerate(node_objs):
+                try:
+                    ssn.predicate_fn(stripped, node)
+                    expect = True
+                except Exception:
+                    expect = False
+                assert sig_mask[si, nix] == expect, (si, nix)
+                affinity = example.pod.spec.affinity
+                if affinity is not None and affinity.preferred_node_terms:
+                    assert sig_bonus[si, nix] == node_affinity_score(
+                        example, node), (si, nix)
+    finally:
+        close_session(ssn)
+
+
+def test_predicate_calls_scale_with_profiles_not_nodes():
+    """With unique per-node hostname labels, predicate_fn must still run
+    O(S x profiles) times: hostname isn't referenced by any signature, so
+    nodes collapse into the pool x zone label grid (<= 8 profiles here)."""
+    ssn, _ = _open_hetero(n_nodes=96)
+    try:
+        calls = [0]
+        inner = ssn.predicate_fn
+
+        def counting(task, node):
+            calls[0] += 1
+            return inner(task, node)
+
+        ssn.predicate_fn = counting
+        snap = tensorize_session(ssn)
+        assert not snap.needs_fallback, snap.fallback_reason
+        n_sigs = int(np.asarray(snap.inputs.sig_mask).shape[0])
+        # pool (4) x zone (8) = at most 8 distinct profiles (labels are
+        # assigned i%4 / i%8, which collide on i%8 cycles).
+        assert calls[0] <= n_sigs * 8, calls[0]
+        assert calls[0] < n_sigs * 96  # and far below S x N
+    finally:
+        close_session(ssn)
+
+
+def test_hetero_device_host_parity():
+    """Full device solve on the heterogeneous snapshot places exactly like
+    the host allocate oracle."""
+    from kube_batch_tpu.actions.allocate import AllocateAction
+    from kube_batch_tpu.actions.tpu_allocate import TpuAllocateAction
+
+    results = []
+    for action_cls in (AllocateAction, TpuAllocateAction):
+        cache, binder = make_synthetic_cache(128, 24, 16, 2, n_signatures=16)
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        try:
+            action_cls().execute(ssn)
+        finally:
+            close_session(ssn)
+        results.append(binder.binds)
+    host, dev = results
+    assert dev == host and host

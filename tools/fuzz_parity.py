@@ -1,0 +1,143 @@
+"""Extended host/device parity fuzz (run after ANY solver or tensorize
+change; CI's seed set is small).
+
+Random clusters mixing every feature the device path supports — node
+labels/taints, pod selectors/tolerations, required+preferred node
+affinity, host ports, required/preferred pod (anti-)affinity, running
+pods, gangs, multi-queue weights — asserting bind-map equality between
+the host allocate oracle and tpu-allocate per seed.
+
+Usage:  python tools/fuzz_parity.py [--seeds 40] [--x64 0|1|both]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import random
+import subprocess
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def run_seed(seed: int) -> None:
+    from kube_batch_tpu.api.objects import Affinity, ContainerPort, Taint, Toleration
+    from tests.test_tpu_parity import run_both_mutated
+
+    rng = random.Random(seed)
+    nq = rng.randint(1, 4)
+    n_nodes = rng.randint(2, 8)
+    spec = dict(queues=[(f"q{i}", rng.randint(1, 4)) for i in range(nq)],
+                pod_groups=[], pods=[],
+                nodes=[(f"n{i}", str(rng.choice([4, 8, 16])),
+                        f"{rng.choice([8, 16, 32])}Gi")
+                       for i in range(n_nodes)])
+    for j in range(rng.randint(2, 8)):
+        size = rng.randint(1, 6)
+        spec["pod_groups"].append((f"pg{j}", "ns", rng.randint(1, size),
+                                   f"q{rng.randrange(nq)}"))
+        for i in range(size):
+            running = rng.random() < 0.2
+            spec["pods"].append(("ns", f"j{j}-p{i}",
+                                 "n0" if running else "",
+                                 "Running" if running else "Pending",
+                                 str(rng.choice([1, 2, 3])),
+                                 f"{rng.choice([1, 2, 4])}Gi", f"pg{j}"))
+
+    def mutate(cache):
+        r2 = random.Random(seed + 5000)
+        # Node statics: labels on every node (one unique), taints on some.
+        for node in cache.nodes.values():
+            if node.node is None:
+                continue
+            node.node.metadata.labels.update({
+                "kubernetes.io/hostname": node.name,
+                "zone": f"z{r2.randrange(3)}",
+                "pool": f"pool{r2.randrange(2)}"})
+            if r2.random() < 0.25:
+                node.node.spec.taints.append(Taint(
+                    key="dedicated", value=f"t{r2.randrange(2)}",
+                    effect=r2.choice(["NoSchedule", "PreferNoSchedule"])))
+        for job in list(cache.jobs.values()):
+            for t in list(job.tasks.values()):
+                t.pod.metadata.labels["grp"] = t.job.split("/")[-1]
+                # Static features (signature-splitting).
+                roll = r2.random()
+                if roll < 0.2:
+                    t.pod.spec.node_selector = {"zone": f"z{r2.randrange(3)}"}
+                elif roll < 0.3:
+                    t.pod.spec.affinity = Affinity(required_node_terms=[
+                        {"pool": f"pool{r2.randrange(2)}"}])
+                elif roll < 0.4:
+                    t.pod.spec.affinity = Affinity(preferred_node_terms=[
+                        (r2.choice([1, 5, 10]),
+                         {"zone": f"z{r2.randrange(3)}"})])
+                if r2.random() < 0.3:
+                    t.pod.spec.tolerations = [Toleration(
+                        key="dedicated", operator="Equal",
+                        value=f"t{r2.randrange(2)}", effect="")]
+                # Dynamic features on top.
+                roll = r2.random()
+                if roll < 0.12:
+                    t.pod.spec.containers[0].ports = [
+                        ContainerPort(host_port=r2.choice([80, 443]))]
+                elif roll < 0.22:
+                    aff = t.pod.spec.affinity or Affinity()
+                    aff.required_pod_anti_affinity = [
+                        {"grp": t.job.split("/")[-1]}]
+                    t.pod.spec.affinity = aff
+                elif roll < 0.32:
+                    aff = t.pod.spec.affinity or Affinity()
+                    aff.preferred_pod_affinity = [
+                        (r2.choice([10, 50]), {"grp": f"pg{r2.randrange(7)}"})]
+                    t.pod.spec.affinity = aff
+
+    run_both_mutated(mutate, spec)
+
+
+def main_child(seeds, x64: bool) -> None:
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", x64)
+    from kube_batch_tpu.actions.factory import register_default_actions
+    from kube_batch_tpu.plugins.factory import register_default_plugins
+    register_default_actions()
+    register_default_plugins()
+    failures = []
+    for seed in seeds:
+        try:
+            run_seed(seed)
+        except AssertionError:
+            failures.append(seed)
+            print(f"  FAIL seed {seed}", flush=True)
+    mode = "x64" if x64 else "f32"
+    if failures:
+        print(f"[{mode}] FAILURES: {failures}")
+        sys.exit(1)
+    print(f"[{mode}] {len(seeds)} seeds OK")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seeds", type=int, default=40)
+    ap.add_argument("--start", type=int, default=300)
+    ap.add_argument("--x64", default="both", choices=["0", "1", "both"])
+    ap.add_argument("--child", default=None, help=argparse.SUPPRESS)
+    ns = ap.parse_args()
+    seeds = list(range(ns.start, ns.start + ns.seeds))
+    if ns.child is not None:
+        main_child(seeds, ns.child == "1")
+        return
+    modes = {"0": ["0"], "1": ["1"], "both": ["1", "0"]}[ns.x64]
+    for mode in modes:  # subprocess per mode: x64 is fixed at backend init
+        rc = subprocess.call([sys.executable, __file__,
+                              "--seeds", str(ns.seeds),
+                              "--start", str(ns.start),
+                              "--child", mode])
+        if rc:
+            sys.exit(rc)
+
+
+if __name__ == "__main__":
+    main()

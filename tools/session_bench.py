@@ -10,7 +10,8 @@ the object model at kubemark scale —
 — and prints one JSON line per stage plus the end-to-end total, so host-side
 regressions can't hide behind the device number (VERDICT r1, weak #2).
 
-Env: SESSION_TASKS / SESSION_NODES / SESSION_JOBS / SESSION_QUEUES / REPEAT.
+Env: SESSION_TASKS / SESSION_NODES / SESSION_JOBS / SESSION_QUEUES /
+SESSION_SIGS (heterogeneous signatures, default 1) / REPEAT.
 """
 
 from __future__ import annotations
@@ -28,6 +29,7 @@ def main():
     n_nodes = int(os.environ.get("SESSION_NODES", 10_000))
     n_jobs = int(os.environ.get("SESSION_JOBS", 2_000))
     n_queues = int(os.environ.get("SESSION_QUEUES", 4))
+    n_sigs = int(os.environ.get("SESSION_SIGS", 1))
     repeat = int(os.environ.get("REPEAT", 2))
 
     import numpy as np
@@ -44,7 +46,8 @@ def main():
     register_default_plugins()
     t0 = time.perf_counter()
     from kube_batch_tpu.models.synthetic import make_synthetic_cache
-    cache, binder = make_synthetic_cache(n_tasks, n_nodes, n_jobs, n_queues)
+    cache, binder = make_synthetic_cache(n_tasks, n_nodes, n_jobs, n_queues,
+                                         n_signatures=n_sigs)
     build_s = time.perf_counter() - t0
     _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
 

@@ -64,6 +64,12 @@ def main():
     hetero_ms = measure_full_session(n_tasks, n_nodes, n_jobs, n_queues,
                                      n_signatures=64, repeat=3)
 
+    # Steady-state: long-lived cache, 1% pod churn per cycle, placed pods
+    # echoed back as Running — the production shape the incremental
+    # snapshot/tensorize path (clone pool + tensor blocks) is built for.
+    steady_cold_ms, steady_ms = measure_steady_session(
+        n_tasks, n_nodes, n_jobs, n_queues)
+
     baseline_ms = 1000.0  # north-star TARGET per session (BASELINE.md
     # publishes no measured reference numbers, so vs_baseline is
     # target-relative, not reference-relative)
@@ -81,6 +87,10 @@ def main():
         # Same, on a 64-signature heterogeneous snapshot (north star also
         # applies: < 1000 ms).
         "session_hetero_ms": hetero_ms,
+        # Steady state at 1% churn (long-lived cache, informer-echoed
+        # binds) vs the cold first session on the same cache.
+        "session_steady_ms": steady_ms,
+        "session_cold_ms": steady_cold_ms,
     }))
 
 
@@ -121,8 +131,135 @@ def measure_full_session(n_tasks, n_nodes, n_jobs, n_queues,
             binder.binds.clear()
             best = elapsed if best is None else min(best, elapsed)
     finally:
+        gc.unfreeze()
         gc.enable()
     return round(best, 1)
+
+
+def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
+                           churn: float = 0.01, rounds: int = 5,
+                           n_signatures: int = 1):
+    """(cold_ms, steady_ms).
+
+    Cold: first full session on a fresh cache.  Steady: sessions on the
+    long-lived cache with ``churn`` x n_tasks new pending pods per round
+    (in fresh podgroups), pods placed two rounds ago retired, and every
+    bind echoed back as a Running pod — the informer-delta steady state
+    the incremental snapshot/tensorize path serves.  Returns the best
+    steady round (round 1 re-absorbs the mass echo of the cold session)."""
+    import dataclasses as dc
+    import gc
+
+    from kube_batch_tpu.actions.factory import register_default_actions
+    from kube_batch_tpu.actions.tpu_allocate import TpuAllocateAction
+    from kube_batch_tpu.api import (Container, ObjectMeta, Pod, PodSpec,
+                                    PodStatus, pod_key)
+    from kube_batch_tpu.apis.scheduling import v1alpha1
+    from kube_batch_tpu.apis.scheduling.v1alpha1 import GroupNameAnnotationKey
+    from kube_batch_tpu.framework import close_session, open_session
+    from kube_batch_tpu.models.synthetic import make_synthetic_cache
+    from kube_batch_tpu.plugins.factory import register_default_plugins
+    from kube_batch_tpu.scheduler import (DEFAULT_SCHEDULER_CONF,
+                                          load_scheduler_conf)
+
+    register_default_actions()
+    register_default_plugins()
+    cache, binder = make_synthetic_cache(n_tasks, n_nodes, n_jobs, n_queues,
+                                         n_signatures=n_signatures)
+    _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+    action = TpuAllocateAction()
+    podmap = {}
+    for job in cache.jobs.values():
+        for t in job.tasks.values():
+            podmap[pod_key(t.pod)] = t.pod
+
+    def session_ms():
+        start = time.perf_counter()
+        ssn = open_session(cache, tiers)
+        try:
+            action.execute(ssn)
+        finally:
+            close_session(ssn)
+        return (time.perf_counter() - start) * 1e3
+
+    def echo():
+        binds = dict(binder.binds)
+        binder.binds.clear()
+        for key, node in binds.items():
+            old = podmap.get(key)
+            if old is None:
+                continue
+            new = dc.replace(old, spec=dc.replace(old.spec, node_name=node),
+                             status=PodStatus(phase="Running"))
+            podmap[key] = new
+            cache.update_pod(old, new)
+        # PodGroup status writes also echo back through the informer on a
+        # real cluster; replaying the Fake updater's record reproduces
+        # that, letting job statuses (and the clone pool) settle.
+        updater = cache.status_updater
+        if getattr(updater, "pod_groups", None):
+            for pg in updater.pod_groups:
+                cache.add_pod_group(pg)
+            updater.pod_groups.clear()
+        return len(binds)
+
+    gc.collect()
+    gc.freeze()
+    gc.disable()
+    try:
+        cold = session_ms()
+        assert echo() > 0, "cold session bound nothing"
+        k = max(1, int(n_tasks * churn))
+        per_group = 25
+        next_uid = n_tasks
+        retire = []
+        steady = []
+        for rnd in range(rounds):
+            new_keys, pgs = [], []
+            remaining = k
+            g = 0
+            while remaining > 0:
+                size = min(per_group, remaining)
+                pg_name = f"churn-{rnd}-{g}"
+                pgs.append(pg_name)
+                cache.add_pod_group(v1alpha1.PodGroup(
+                    metadata=ObjectMeta(name=pg_name, namespace="bench"),
+                    spec=v1alpha1.PodGroupSpec(
+                        min_member=max(1, size * 4 // 5),
+                        queue=f"q{g % n_queues}")))
+                for _ in range(size):
+                    uid = next_uid
+                    next_uid += 1
+                    pod = Pod(
+                        metadata=ObjectMeta(
+                            name=f"c{uid}", namespace="bench", uid=f"c{uid}",
+                            annotations={GroupNameAnnotationKey: pg_name},
+                            creation_timestamp=float(uid)),
+                        spec=PodSpec(containers=[Container(
+                            requests={"cpu": "500m", "memory": "1Gi"})]),
+                        status=PodStatus(phase="Pending"))
+                    podmap[pod_key(pod)] = pod
+                    new_keys.append(pod_key(pod))
+                    cache.add_pod(pod)
+                remaining -= size
+                g += 1
+            if len(retire) >= 2:
+                old_pgs, old_keys = retire.pop(0)
+                for key in old_keys:
+                    pod = podmap.pop(key, None)
+                    if pod is not None:
+                        cache.delete_pod(pod)
+                for pg_name in old_pgs:
+                    cache.delete_pod_group(v1alpha1.PodGroup(
+                        metadata=ObjectMeta(name=pg_name, namespace="bench"),
+                        spec=v1alpha1.PodGroupSpec(min_member=1)))
+            steady.append(session_ms())
+            echo()
+            retire.append((pgs, new_keys))
+        return round(cold, 1), round(min(steady), 1)
+    finally:
+        gc.unfreeze()
+        gc.enable()
 
 
 if __name__ == "__main__":

@@ -73,6 +73,7 @@ class AllocateAction(Action):
                 # Stale fit deltas are for tasks that eventually fit
                 # (allocate.go:134-141).
                 if job.nodes_fit_delta:
+                    ssn._dirty_job(job.uid)
                     job.nodes_fit_delta = {}
 
                 candidates = predicate_nodes(task, all_nodes, predicate_fn)
@@ -98,6 +99,7 @@ class AllocateAction(Action):
                     # Record why the best node did not fit idle.
                     delta = node.idle.clone()
                     delta.fit_delta(task.init_resreq)
+                    ssn._dirty_job(job.uid)
                     job.nodes_fit_delta[node.name] = delta
                     # Speculate onto releasing resources (allocate.go:175-182).
                     if task.init_resreq.less_equal(node.releasing):

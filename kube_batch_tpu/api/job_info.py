@@ -84,6 +84,9 @@ class JobInfo:
 
     def __init__(self, uid: str, *tasks: TaskInfo):
         self.uid: str = uid
+        # Cache-mutation stamp (SchedulerCache.epoch at last informer
+        # touch); drives snapshot-clone and tensor-block reuse.
+        self.mod_epoch: int = 0
         self.name: str = ""
         self.namespace: str = ""
         self.queue: str = ""

@@ -21,6 +21,8 @@ class NodeInfo:
 
     def __init__(self, node: Optional[Node] = None):
         self.name: str = ""
+        # Cache-mutation stamp (see JobInfo.mod_epoch).
+        self.mod_epoch: int = 0
         self.node: Optional[Node] = None
         self.state: NodeState = NodeState()
         self.releasing: Resource = Resource.empty()

@@ -37,6 +37,12 @@ class Session:
         self.queues: Dict[str, QueueInfo] = {}
         self.tiers: List[Tier] = []
 
+        # Clones this session has mutated: their pooled copies must not be
+        # reused by the next snapshot, and tensorization must not serve
+        # cached blocks for them (cache.py snapshot / tensor_snapshot.py).
+        self.mutated_jobs: set = set()
+        self.mutated_nodes: set = set()
+
         self.plugins: Dict[str, Plugin] = {}
         self.event_handlers: List[EventHandler] = []
         self.job_order_fns: Dict[str, Callable] = {}
@@ -255,6 +261,24 @@ class Session:
         from .statement import Statement
         return Statement(self)
 
+    def _dirty_job(self, uid: str) -> None:
+        """Record that this session mutated job ``uid``'s clone (and evict
+        it from the cache's snapshot pool).  Every session-side mutation
+        path MUST route through here or _dirty_node — a missed call means
+        the next cycle schedules on a stale clone."""
+        if uid not in self.mutated_jobs:
+            self.mutated_jobs.add(uid)
+            discard = getattr(self.cache, "discard_pooled_job", None)
+            if discard is not None:
+                discard(uid)
+
+    def _dirty_node(self, name: str) -> None:
+        if name not in self.mutated_nodes:
+            self.mutated_nodes.add(name)
+            discard = getattr(self.cache, "discard_pooled_node", None)
+            if discard is not None:
+                discard(name)
+
     def _fire_allocate(self, task: TaskInfo):
         for eh in self.event_handlers:
             if eh.allocate_func is not None:
@@ -270,10 +294,12 @@ class Session:
         job = self.jobs.get(task.job)
         if job is None:
             raise KeyError(f"failed to find job {task.job} when pipelining")
+        self._dirty_job(task.job)
         job.update_task_status(task, TaskStatus.Pipelined)
         node = self.nodes.get(hostname)
         if node is None:
             raise KeyError(f"failed to find node {hostname}")
+        self._dirty_node(hostname)
         node.add_task(task)
         self._fire_allocate(task)
 
@@ -284,10 +310,12 @@ class Session:
         job = self.jobs.get(task.job)
         if job is None:
             raise KeyError(f"failed to find job {task.job}")
+        self._dirty_job(task.job)
         job.update_task_status(task, TaskStatus.Allocated)
         node = self.nodes.get(hostname)
         if node is None:
             raise KeyError(f"failed to find node {hostname}")
+        self._dirty_node(hostname)
         node.add_task(task)
         self._fire_allocate(task)
 
@@ -303,6 +331,7 @@ class Session:
         job = self.jobs.get(task.job)
         if job is None:
             raise KeyError(f"failed to find job {task.job}")
+        self._dirty_job(task.job)
         job.move_task_status(task, TaskStatus.Binding)
         metrics.observe_task_schedule_latency(
             time.time() - task.pod.metadata.creation_timestamp)
@@ -416,6 +445,12 @@ class Session:
             touched_jobs[task.job] = job
             applied_append(task)
 
+        for uid in touched_jobs:
+            self._dirty_job(uid)
+        for accs in (node_alloc, node_pipe):
+            for hostname in accs:
+                self._dirty_node(hostname)
+
         # Remove contributions of skipped placements so the (pre)computed
         # sums describe exactly what was applied.
         for task, hostname, kind in skipped:
@@ -506,9 +541,11 @@ class Session:
         job = self.jobs.get(reclaimee.job)
         if job is None:
             raise KeyError(f"failed to find job {reclaimee.job}")
+        self._dirty_job(reclaimee.job)
         job.update_task_status(reclaimee, TaskStatus.Releasing)
         node = self.nodes.get(reclaimee.node_name)
         if node is not None:
+            self._dirty_node(reclaimee.node_name)
             node.update_task(reclaimee)
         self._fire_deallocate(reclaimee)
 
@@ -517,6 +554,7 @@ class Session:
         job = self.jobs.get(job_info.uid)
         if job is None:
             raise KeyError(f"failed to find job {job_info.namespace}/{job_info.name}")
+        self._dirty_job(job.uid)
         conditions = job.pod_group.status.conditions
         for i, c in enumerate(conditions):
             if c.type == cond.type:
@@ -586,16 +624,36 @@ def close_session(ssn: Session) -> None:
         metrics.observe_plugin_latency(plugin.name(), "OnSessionClose",
                                        time.time() - start)
 
-    # PodGroup status writeback (session.go:119-144).
+    # PodGroup status writeback (session.go:119-144).  The status write is
+    # gated on an actual change: a no-op UpdatePodGroup would differ from
+    # the derived state by nothing, and skipping it keeps pristine job
+    # clones reusable by the snapshot pool (events and pod conditions are
+    # still recorded every cycle, as the reference does).
     for job in ssn.jobs.values():
         if job.pod_group is None:
             ssn.cache.record_job_status_event(job)
             continue
-        job.pod_group.status = job_status(ssn, job)
-        try:
-            ssn.cache.update_job_status(job)
-        except Exception:
-            pass
+        status = job.pod_group.status
+        phase, running, failed, succeeded = _derive_job_status(ssn, job)
+        if (job.uid in ssn.mutated_jobs
+                or (status.phase, status.running, status.failed,
+                    status.succeeded) != (phase, running, failed,
+                                          succeeded)):
+            # The session touched the job (placements, conditions) or the
+            # derived status moved: push it.  mutated_jobs matters for
+            # condition-only changes (e.g. gang Unschedulable), which the
+            # phase/count compare cannot see.
+            ssn._dirty_job(job.uid)
+            status.phase = phase
+            status.running = running
+            status.failed = failed
+            status.succeeded = succeeded
+            try:
+                ssn.cache.update_job_status(job)
+            except Exception:
+                pass
+        else:
+            ssn.cache.record_job_status_event(job)
 
     ssn.jobs = {}
     ssn.nodes = {}
@@ -604,8 +662,9 @@ def close_session(ssn: Session) -> None:
     ssn.event_handlers = []
 
 
-def job_status(ssn: Session, job_info: JobInfo):
-    """Derive the PodGroup phase from session state (session.go:146-184)."""
+def _derive_job_status(ssn: Session, job_info: JobInfo):
+    """(phase, running, failed, succeeded) from session state, without
+    mutating (session.go:146-184)."""
     status = job_info.pod_group.status
     unschedulable = any(
         c.type == PodGroupUnschedulableType and c.status == "True"
@@ -613,18 +672,25 @@ def job_status(ssn: Session, job_info: JobInfo):
         for c in status.conditions)
 
     if job_info.task_status_index.get(TaskStatus.Running) and unschedulable:
-        status.phase = PodGroupUnknown
+        phase = PodGroupUnknown
     else:
         allocated = 0
         for st, tasks in job_info.task_status_index.items():
             if allocated_status(st):
                 allocated += len(tasks)
         if allocated >= job_info.pod_group.spec.min_member:
-            status.phase = PodGroupRunning
+            phase = PodGroupRunning
         else:
-            status.phase = PodGroupPending
+            phase = PodGroupPending
+    return (phase,
+            len(job_info.task_status_index.get(TaskStatus.Running, {})),
+            len(job_info.task_status_index.get(TaskStatus.Failed, {})),
+            len(job_info.task_status_index.get(TaskStatus.Succeeded, {})))
 
-    status.running = len(job_info.task_status_index.get(TaskStatus.Running, {}))
-    status.failed = len(job_info.task_status_index.get(TaskStatus.Failed, {}))
-    status.succeeded = len(job_info.task_status_index.get(TaskStatus.Succeeded, {}))
+
+def job_status(ssn: Session, job_info: JobInfo):
+    """Derive and apply the PodGroup phase (session.go:146-184)."""
+    status = job_info.pod_group.status
+    (status.phase, status.running, status.failed,
+     status.succeeded) = _derive_job_status(ssn, job_info)
     return status

@@ -25,9 +25,11 @@ class Statement:
         """Session-side eviction, logged for commit/rollback (go:36-76)."""
         job = self.ssn.jobs.get(reclaimee.job)
         if job is not None:
+            self.ssn._dirty_job(reclaimee.job)
             job.update_task_status(reclaimee, TaskStatus.Releasing)
         node = self.ssn.nodes.get(reclaimee.node_name)
         if node is not None:
+            self.ssn._dirty_node(reclaimee.node_name)
             node.update_task(reclaimee)
         self.ssn._fire_deallocate(reclaimee)
         self.operations.append(("evict", (reclaimee, reason)))
@@ -36,30 +38,39 @@ class Statement:
         """Session-side pipeline, logged for rollback (go:113-155)."""
         job = self.ssn.jobs.get(task.job)
         if job is not None:
+            self.ssn._dirty_job(task.job)
             job.update_task_status(task, TaskStatus.Pipelined)
         node = self.ssn.nodes.get(hostname)
         if node is not None:
+            self.ssn._dirty_node(hostname)
             node.add_task(task)
         self.ssn._fire_allocate(task)
         self.operations.append(("pipeline", (task, hostname)))
 
     # -- rollback helpers ---------------------------------------------------
+    # (rollback targets were dirtied by the forward op; a rollback restores
+    # scheduling state but not bit-identical dict order, so the clones stay
+    # out of the snapshot pool for this cycle)
 
     def _unevict(self, reclaimee: TaskInfo) -> None:
         job = self.ssn.jobs.get(reclaimee.job)
         if job is not None:
+            self.ssn._dirty_job(reclaimee.job)
             job.update_task_status(reclaimee, TaskStatus.Running)
         node = self.ssn.nodes.get(reclaimee.node_name)
         if node is not None:
+            self.ssn._dirty_node(reclaimee.node_name)
             node.update_task(reclaimee)
         self.ssn._fire_allocate(reclaimee)
 
     def _unpipeline(self, task: TaskInfo) -> None:
         job = self.ssn.jobs.get(task.job)
         if job is not None:
+            self.ssn._dirty_job(task.job)
             job.update_task_status(task, TaskStatus.Pending)
         node = self.ssn.nodes.get(task.node_name)
         if node is not None:
+            self.ssn._dirty_node(task.node_name)
             node.remove_task(task)
         task.node_name = ""
         self.ssn._fire_deallocate(task)

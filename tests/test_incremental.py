@@ -1,0 +1,185 @@
+"""Incremental snapshot/tensorize: clone-pool + tensor-block correctness.
+
+The heavy equivalence fuzz lives in tools/fuzz_incremental.py (30+ seeds);
+this file pins a few seeds in CI plus the unit-level reuse/invalidation
+contracts.
+"""
+
+import sys
+
+import pytest
+
+from kube_batch_tpu.actions.factory import register_default_actions
+from kube_batch_tpu.framework import close_session, open_session
+from kube_batch_tpu.models.synthetic import make_synthetic_cache
+from kube_batch_tpu.models.tensor_snapshot import tensorize_session
+from kube_batch_tpu.plugins.factory import register_default_plugins
+from kube_batch_tpu.scheduler import DEFAULT_SCHEDULER_CONF, load_scheduler_conf
+
+sys.path.insert(0, "tools")
+
+register_default_actions()
+register_default_plugins()
+
+
+@pytest.mark.parametrize("seed", [7001, 7007, 7013, 7021])
+def test_incremental_equivalence_fuzz(seed):
+    """Long-lived churning cache binds exactly like a fresh rebuild."""
+    import fuzz_incremental as fz
+    fz.run_seed(seed, cycles=6)
+
+
+def _open(cache):
+    _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+    return open_session(cache, tiers)
+
+
+def _echo_status_writes(cache):
+    """Replay PodGroup status writes back into the cache, as the informer
+    echo of a real (or simulated) apiserver would."""
+    updater = cache.status_updater
+    for pg in updater.pod_groups:
+        cache.add_pod_group(pg)
+    updater.pod_groups.clear()
+
+
+def _echo_binds(cache, binder):
+    """Informer echo of binds: bound pods become Running on their node."""
+    import dataclasses as dc
+    from kube_batch_tpu.api import PodStatus, pod_key
+
+    podmap = {}
+    for job in cache.jobs.values():
+        for t in job.tasks.values():
+            podmap[pod_key(t.pod)] = t.pod
+    for key, node in sorted(binder.binds.items()):
+        old = podmap.get(key)
+        if old is None:
+            continue
+        new = dc.replace(old, spec=dc.replace(old.spec, node_name=node),
+                         status=PodStatus(phase="Running"))
+        cache.update_pod(old, new)
+    binder.binds.clear()
+
+
+def test_clone_pool_reuses_untouched_and_invalidates_on_delta():
+    """Steady state: jobs Running after a placed+echoed cycle (gang skips
+    ready jobs, so no per-cycle condition writes) -> clones pool; an
+    informer delta invalidates exactly the touched objects."""
+    from kube_batch_tpu.actions.tpu_allocate import TpuAllocateAction
+
+    cache, binder = make_synthetic_cache(40, 4, 8, 2)
+    ssn0 = _open(cache)
+    TpuAllocateAction().execute(ssn0)
+    close_session(ssn0)
+    _echo_binds(cache, binder)
+    _echo_status_writes(cache)
+    # One settling session (status echo re-derives once more).
+    close_session(_open(cache))
+    _echo_status_writes(cache)
+
+    ssn = _open(cache)
+    # Tensorize only (no placements): clones stay pristine.
+    snap = tensorize_session(ssn)
+    assert not snap.needs_fallback
+    node_clone = ssn.nodes["n00000"]
+    job_uid = sorted(ssn.jobs)[0]
+    job_clone = ssn.jobs[job_uid]
+    close_session(ssn)
+    assert job_uid not in ssn.mutated_jobs
+
+    task = next(iter(cache.jobs[job_uid].tasks.values()))
+    touched_node = task.node_name
+    untouched = [n for n in sorted(cache.nodes) if n != touched_node][0]
+
+    ssn2 = _open(cache)
+    # Untouched objects: the very same clone objects are served again.
+    assert ssn2.nodes["n00000"] is node_clone
+    assert ssn2.jobs[job_uid] is job_clone
+    touched_clone = ssn2.nodes[touched_node]
+    other_clone = ssn2.nodes[untouched]
+    close_session(ssn2)
+
+    # An informer delta invalidates exactly the touched objects.
+    import dataclasses as dc
+    from kube_batch_tpu.api import PodStatus
+    new_pod = dc.replace(task.pod, status=PodStatus(phase="Succeeded"))
+    old_pod = task.pod
+    cache.update_pod(old_pod, new_pod)
+    ssn3 = _open(cache)
+    assert ssn3.jobs[job_uid] is not job_clone
+    # The pod's node re-clones (it released resources); others are reused.
+    assert ssn3.nodes[touched_node] is not touched_clone
+    assert ssn3.nodes[untouched] is other_clone
+    close_session(ssn3)
+
+
+def test_session_mutation_evicts_pooled_clone():
+    from kube_batch_tpu.actions.tpu_allocate import TpuAllocateAction
+
+    cache, binder = make_synthetic_cache(40, 4, 8, 2)
+    ssn = _open(cache)
+    TpuAllocateAction().execute(ssn)
+    assert binder.binds
+    placed_jobs = set(ssn.mutated_jobs)
+    assert placed_jobs
+    mutated_clone = ssn.jobs[sorted(placed_jobs)[0]]
+    close_session(ssn)
+
+    # The next session must NOT see the mutated clone.
+    ssn2 = _open(cache)
+    assert ssn2.jobs[sorted(placed_jobs)[0]] is not mutated_clone
+    close_session(ssn2)
+
+
+def test_cache_evict_bumps_epochs():
+    """cache.evict mutates truth (task -> Releasing, node re-accounting);
+    the epoch stamps must move or the next session's tensor blocks and
+    node rows would be served stale."""
+    from kube_batch_tpu.actions.tpu_allocate import TpuAllocateAction
+
+    cache, binder = make_synthetic_cache(40, 4, 8, 2)
+    ssn = _open(cache)
+    TpuAllocateAction().execute(ssn)
+    close_session(ssn)
+    _echo_binds(cache, binder)
+
+    job_uid = sorted(cache.jobs)[0]
+    job = cache.jobs[job_uid]
+    task = next(iter(job.tasks.values()))
+    assert task.node_name
+    node = cache.nodes[task.node_name]
+    job_epoch, node_epoch = job.mod_epoch, node.mod_epoch
+    cache.evict(task, "preempted")
+    assert job.mod_epoch > job_epoch
+    assert node.mod_epoch > node_epoch
+    assert job.tasks[task.uid].status.name == "Releasing"
+
+
+def test_tensor_blocks_reused_across_sessions():
+    cache, _binder = make_synthetic_cache(60, 6, 10, 2)
+    ssn = _open(cache)
+    snap1 = tensorize_session(ssn)
+    assert not snap1.needs_fallback
+    close_session(ssn)
+    tc = cache._tensor_cache
+    block_ids = {uid: id(b) for uid, b in tc.jobs.items()}
+    assert block_ids
+
+    ssn2 = _open(cache)
+    snap2 = tensorize_session(ssn2)
+    close_session(ssn2)
+    assert {uid: id(b) for uid, b in tc.jobs.items()} == block_ids
+
+    # Delta on one job rebuilds exactly that job's block.
+    job_uid = sorted(cache.jobs)[0]
+    task = next(iter(cache.jobs[job_uid].tasks.values()))
+    cache.delete_pod(task.pod)
+    ssn3 = _open(cache)
+    snap3 = tensorize_session(ssn3)
+    close_session(ssn3)
+    ids3 = {uid: id(b) for uid, b in tc.jobs.items()}
+    assert ids3[job_uid] != block_ids[job_uid]
+    for uid in ids3:
+        if uid != job_uid:
+            assert ids3[uid] == block_ids[uid]

@@ -11,7 +11,9 @@ the object model at kubemark scale —
 regressions can't hide behind the device number (VERDICT r1, weak #2).
 
 Env: SESSION_TASKS / SESSION_NODES / SESSION_JOBS / SESSION_QUEUES /
-SESSION_SIGS (heterogeneous signatures, default 1) / REPEAT.
+SESSION_SIGS (heterogeneous signatures, default 1) / REPEAT /
+SESSION_CHURN (e.g. 0.01: steady-state mode — long-lived cache, churn
+deltas, informer-echoed binds).
 """
 
 from __future__ import annotations
@@ -31,6 +33,21 @@ def main():
     n_queues = int(os.environ.get("SESSION_QUEUES", 4))
     n_sigs = int(os.environ.get("SESSION_SIGS", 1))
     repeat = int(os.environ.get("REPEAT", 2))
+    churn = float(os.environ.get("SESSION_CHURN", 0))
+
+    if churn:
+        # Steady-state protocol (long-lived cache + churn deltas + bind
+        # echo) lives in bench.measure_steady_session.
+        import bench
+        cold, steady = bench.measure_steady_session(
+            n_tasks, n_nodes, n_jobs, n_queues, churn=churn,
+            n_signatures=n_sigs)
+        print(json.dumps({
+            "metric": (f"steady-state session @ {n_tasks} tasks x "
+                       f"{n_nodes} nodes, {churn:.1%} churn"),
+            "value": steady, "unit": "ms", "cold_ms": cold,
+            "vs_baseline": round(1000.0 / steady, 3)}))
+        return
 
     import numpy as np
     from kube_batch_tpu.framework import close_session, open_session

@@ -62,7 +62,7 @@ def main():
     # signatures + unique per-node labels — the realistic worst case for
     # the static [S, N] predicate mask (VERDICT r2 weak #1).
     hetero_ms = measure_full_session(n_tasks, n_nodes, n_jobs, n_queues,
-                                     n_signatures=64, repeat=3)
+                                     n_signatures=64, repeat=4)
 
     # Steady-state: long-lived cache, 1% pod churn per cycle, placed pods
     # echoed back as Running — the production shape the incremental

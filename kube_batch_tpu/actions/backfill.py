@@ -1,6 +1,11 @@
 """Backfill action: place BestEffort tasks on any predicate-passing node.
 
-Mirrors /root/reference/pkg/scheduler/actions/backfill/backfill.go:44-68.
+Mirrors /root/reference/pkg/scheduler/actions/backfill/backfill.go:44-68
+(sequential first-fit, no scoring — the upstream TODO at backfill.go:50).
+The per-node Python predicate walk is answered by the DeviceNodeScanner
+(one vectorized scan per task over all nodes) when the session
+tensorizes; node order and outcomes are identical to the host walk
+(get_node_list name order == the scanner's node_names order).
 """
 
 from __future__ import annotations
@@ -16,12 +21,35 @@ class BackfillAction(Action):
         return "backfill"
 
     def execute(self, ssn) -> None:
+        from ..models.scanner import maybe_scanner
+        # Don't tensorize a second time in the common no-BestEffort cycle:
+        # the scanner only pays off when there is a sweep to answer.
+        has_best_effort = any(
+            t.init_resreq.is_empty()
+            for job in ssn.jobs.values()
+            for t in job.task_status_index.get(TaskStatus.Pending,
+                                               {}).values())
+        scanner = maybe_scanner(ssn) if has_best_effort else None
         for job in list(ssn.jobs.values()):
             pending = list(job.task_status_index.get(TaskStatus.Pending,
                                                      {}).values())
             for task in pending:
                 if not task.init_resreq.is_empty():
                     continue  # only BestEffort tasks backfill
+                if scanner is not None:
+                    candidates = scanner.candidate_nodes(task, scored=False)
+                    if candidates is not None:
+                        for name, _score in candidates:
+                            try:
+                                ssn.allocate(task, name)
+                            except Exception:
+                                continue
+                            # Membership occupancy (count/ports/selcnt)
+                            # for subsequent scans; resource `used` rides
+                            # the allocate event (empty here anyway).
+                            scanner.apply_pipeline(task, name)
+                            break
+                        continue
                 for node in get_node_list(ssn.nodes):
                     try:
                         ssn.predicate_fn(task, node)

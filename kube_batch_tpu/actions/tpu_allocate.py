@@ -89,7 +89,61 @@ class TpuAllocateAction(Action):
         ssn.batch_apply(
             zip((snap.tasks[i] for i in ordered.tolist()), hostnames, kinds),
             agg=agg)
+        self._record_fit_deltas(ssn, snap, kind, assignment, order)
         metrics.observe_tpu_apply_latency(time.time() - apply_start)
+
+    @staticmethod
+    def _record_fit_deltas(ssn, snap, kind, assignment, order) -> None:
+        """Fit-error diagnostics (allocate.go:139-141, job_info.go:348-380).
+
+        The host path records NodesFitDelta when the selected node fails
+        the idle fit (the task is then pipelined onto releasing), and the
+        entry SURVIVES the action only when that was the job's last
+        processed task — every subsequent task's iteration clears it
+        (allocate.go:134-141).  Mirror: per job, a delta survives iff the
+        final candidate task was pipelined (kind 2) and actually applied;
+        the node idle is reconstructed AT THE RECORD POINT by adding back
+        allocations that landed on the node later in solve order.
+        (Corner divergence: the host breaks the job loop at the first
+        no-candidate task, so a job whose last task pipelined after such
+        a break keeps no delta there; diagnostics only.)"""
+        import numpy as np
+
+        from ..api import TaskStatus
+        from ..models.tensor_snapshot import _res_from_vec
+
+        names = snap.node_names
+        inp = snap.inputs
+        job_start = np.asarray(inp.job_start)
+        job_count = np.asarray(inp.job_count)
+        for ji, uid in enumerate(snap.job_uids):
+            count = int(job_count[ji])
+            if not count:
+                continue
+            last = int(job_start[ji]) + count - 1
+            if kind[last] != 2:
+                continue
+            task = snap.tasks[last]
+            if task.status != TaskStatus.Pipelined:
+                continue  # batch_apply skipped this placement
+            job = ssn.jobs.get(uid)
+            nix = int(assignment[last])
+            node = ssn.nodes.get(names[nix])
+            if job is None or node is None:
+                continue
+            # Idle at the record point: the node's post-batch idle plus
+            # the requests of kind-1 placements that happened AFTER this
+            # task in solve order (the host records mid-sequence).
+            later = ((kind == 1) & (assignment == nix)
+                     & (order > order[last]))
+            delta = node.idle.clone()
+            if later.any():
+                delta.add(_res_from_vec(
+                    snap.task_res_f64[np.nonzero(later)[0]].sum(axis=0),
+                    snap.resource_names))
+            delta.fit_delta(task.init_resreq)
+            ssn._dirty_job(job.uid)
+            job.nodes_fit_delta[node.name] = delta
 
 
 def new() -> TpuAllocateAction:

@@ -362,6 +362,11 @@ class SchedulerCache(Cache):
                     info.nodes[name] = entry[1]
                 else:
                     clone = node.snapshot_clone()
+                    # Epoch captured HERE, under the mutex: tensorization
+                    # must key its caches on the truth state this clone
+                    # reflects, not on live truth a reflector thread may
+                    # have already moved past (TOCTOU).
+                    clone.snap_epoch = node.mod_epoch
                     pooled_n[name] = (node.mod_epoch, clone)
                     info.nodes[name] = clone
             for name, queue in self.queues.items():
@@ -382,6 +387,7 @@ class SchedulerCache(Cache):
                     clone = entry[1]
                 else:
                     clone = job.snapshot_clone()
+                    clone.snap_epoch = job.mod_epoch  # see node note above
                     pooled_j[uid] = (job.mod_epoch, clone)
                 if clone.pod_group is not None:
                     # Resolve priority from PriorityClass (cache.go:664-674)

@@ -327,11 +327,15 @@ def connect_cache_to_cluster(cache, cluster: Cluster) -> None:
     (pods filtered by scheduler name and phase)."""
 
     def pod_filter(pod) -> bool:
-        # cache.go:286-304: either already scheduled (has node) or pending
-        # for our scheduler.
-        if pod.spec.node_name:
+        # cache.go:286-304, exactly: (Pending AND ours) OR (any phase
+        # other than Pending, regardless of scheduler).  A non-Pending
+        # pod of another scheduler is mirrored for resource accounting;
+        # another scheduler's Pending pod is not — even if it already
+        # carries a nodeName.
+        if (pod.spec.scheduler_name == cache.scheduler_name
+                and pod.status.phase == "Pending"):
             return True
-        return pod.spec.scheduler_name == cache.scheduler_name
+        return pod.status.phase != "Pending"
 
     cluster.pod_informer.add_handlers(
         on_add=cache.add_pod, on_update=cache.update_pod,

@@ -48,7 +48,7 @@ def maybe_scanner(ssn) -> Optional["DeviceNodeScanner"]:
     if len(ssn.nodes) < min_nodes:
         return None
     snap = tensorize_session(ssn)
-    if snap.needs_fallback or not snap.tasks:
+    if snap.needs_fallback or not (snap.tasks or snap.tasks_extra):
         return None
     scanner = DeviceNodeScanner(snap)
     from ..framework.events import EventHandler
@@ -89,6 +89,10 @@ class DeviceNodeScanner:
             name: i for i, name in enumerate(snap.node_names)}
         self.task_index: Dict[str, int] = {
             t.uid: i for i, t in enumerate(snap.tasks)}
+        # BestEffort rows sit after the candidate range (tensor_snapshot
+        # extras): scanner-visible for backfill's predicate sweep.
+        for k, t in enumerate(snap.tasks_extra):
+            self.task_index[t.uid] = len(snap.tasks) + k
         self._task_ports = np.asarray(inp.task_ports).astype(np.int32)
         self._task_aff = np.asarray(inp.task_aff_req).astype(np.int32)
         self._task_anti = np.asarray(inp.task_anti).astype(np.int32)

@@ -36,6 +36,9 @@ class TensorSnapshot:
     inputs: object                  # ops.solver.SolverInputs
     config: object                  # ops.solver.SolverConfig
     tasks: List = field(default_factory=list)       # index -> TaskInfo
+    # BestEffort pending tasks: rows [len(tasks), len(tasks)+len(extra))
+    # in the task tensors, solver-invisible, scanner-visible (backfill).
+    tasks_extra: List = field(default_factory=list)
     node_names: List[str] = field(default_factory=list)
     job_uids: List[str] = field(default_factory=list)
     queue_ids: List[str] = field(default_factory=list)
@@ -219,10 +222,14 @@ _MAX_GLOBAL_IDS = 4096
 
 class _JobBlock:
     """One job's O(tasks) tensor slice, cached across sessions keyed by
-    the cache-truth job's ``mod_epoch``."""
+    the cache-truth job's ``mod_epoch``.  ``be_*`` fields describe the
+    job's BestEffort pending tasks (empty init_resreq): excluded from the
+    solver's candidate range but given rows after it so the scanner can
+    answer backfill's predicate sweep (backfill.go:44-68)."""
     __slots__ = ("epoch", "count", "uids", "res_f", "req_q", "res_q",
-                 "res_abs_colsum", "sig_g", "ports", "aff", "anti",
-                 "paff", "panti", "init_f", "init_q", "hi")
+                 "sig_g", "ports", "aff", "anti",
+                 "paff", "panti", "init_f", "init_q",
+                 "be_uids", "be_sig", "be_ports", "be_aff", "be_anti")
 
 
 class _NodePack:
@@ -329,9 +336,10 @@ def _build_job_block(tc: TensorCache, job, axis, stock_order: bool,
     from ..ops.resources import quantize_columns
 
     r = len(axis)
-    pending = [t for t in job.task_status_index.get(TaskStatus.Pending,
-                                                    {}).values()
-               if not t.resreq.is_empty()]
+    bucket_tasks = list(job.task_status_index.get(TaskStatus.Pending,
+                                                  {}).values())
+    pending = [t for t in bucket_tasks if not t.resreq.is_empty()]
+    best_effort = [t for t in bucket_tasks if t.init_resreq.is_empty()]
     if stock_order:
         # With only stock plugins the task order is exactly
         # (priority desc, creation ts, uid) — a key sort.
@@ -362,10 +370,6 @@ def _build_job_block(tc: TensorCache, job, axis, stock_order: bool,
     b.res_f = res_f
     b.req_q = quantize_columns(req_f)
     b.res_q = quantize_columns(res_f)
-    b.res_abs_colsum = (np.abs(b.res_q).sum(axis=0, dtype=np.int64)
-                        if c else np.zeros((r,), np.int64))
-    hi = (max(int(np.abs(b.req_q).max()), int(np.abs(b.res_q).max()))
-          if c else 0)
     b.sig_g = np.zeros((c,), np.int32)
     b.ports = []
     b.aff = []
@@ -395,6 +399,27 @@ def _build_job_block(tc: TensorCache, job, axis, stock_order: bool,
                 for weight, sel in affinity.preferred_pod_anti_affinity:
                     b.panti.append(
                         (off, tc.sel_id(tuple(sorted(sel.items()))), weight))
+    # BestEffort rows: signature + dynamic-feature ids only (their
+    # resource vectors are empty by definition).
+    b.be_uids = [t.uid for t in best_effort]
+    b.be_sig = np.zeros((len(best_effort),), np.int32)
+    b.be_ports = []
+    b.be_aff = []
+    b.be_anti = []
+    for off, t in enumerate(best_effort):
+        _spec, has_features, sig, pkeys = _pod_static(t.pod)
+        b.be_sig[off] = tc.sig_id(sig)
+        if has_features:
+            for pk in pkeys:
+                b.be_ports.append((off, tc.port_id(pk)))
+            affinity = t.pod.spec.affinity
+            if affinity is not None:
+                for sel in affinity.required_pod_affinity:
+                    b.be_aff.append(
+                        (off, tc.sel_id(tuple(sorted(sel.items())))))
+                for sel in affinity.required_pod_anti_affinity:
+                    b.be_anti.append(
+                        (off, tc.sel_id(tuple(sorted(sel.items())))))
     # DRF initial allocation: same accumulation order as the drf plugin
     # (task_status_index iteration) so device shares match the host's
     # floats exactly; plain scalar adds, no per-task array allocation.
@@ -409,7 +434,6 @@ def _build_job_block(tc: TensorCache, job, axis, stock_order: bool,
                         acc[i] += t.resreq.scalar_resources.get(name, 0.0)
     b.init_f = np.asarray(acc, dtype=_F)
     b.init_q = quantize_columns(b.init_f)
-    b.hi = max(hi, int(np.abs(b.init_q).max()))
     return b
 
 
@@ -590,47 +614,49 @@ def tensorize_session(ssn) -> TensorSnapshot:
     n_real = len(node_names)
     n_pad = bucket(max(n_real, 1))
     node_objs = [ssn.nodes[name] for name in node_names]
-    truth_nodes = getattr(ssn.cache, "nodes", None) if tc.persistent else None
+
+    def _node_epoch(ix: int, name: str):
+        """The snapshot-time epoch this clone reflects (stamped under the
+        cache mutex in snapshot(); never re-read from live truth — a
+        reflector thread may have moved it past what the clone holds).
+        None = unkeyable (session-mutated or non-pooled clone)."""
+        if name in mutated_nodes:
+            return None
+        return getattr(node_objs[ix], "snap_epoch", None)
+
     pack = tc.pack
     if pack is None or pack.names != node_names:
         # Membership changed (or first session): vectorized full build.
         pack = _build_node_pack(node_objs, node_names, axis)
-        if truth_nodes is not None:
-            for ix, name in enumerate(node_names):
-                truth = truth_nodes.get(name)
-                if truth is not None and name not in mutated_nodes:
-                    pack.epochs[ix] = truth.mod_epoch
+        for ix, name in enumerate(node_names):
+            ep = _node_epoch(ix, name)
+            if ep is not None:
+                pack.epochs[ix] = ep
         if tc.persistent:
             tc.pack = pack
     else:
-        # Same membership: refresh only rows whose truth epoch moved (or
-        # whose session clone was already mutated this cycle).  When a
+        # Same membership: refresh only rows whose snapshot epoch moved
+        # (or whose session clone was already mutated this cycle).  When a
         # large fraction is dirty (e.g. the informer echo of a mass bind),
         # the vectorized full build beats per-row numpy calls.
         dirty = []
         for ix, name in enumerate(node_names):
-            truth = (truth_nodes.get(name)
-                     if truth_nodes is not None else None)
-            if (truth is not None and name not in mutated_nodes
-                    and pack.epochs[ix] == truth.mod_epoch):
+            ep = _node_epoch(ix, name)
+            if ep is not None and pack.epochs[ix] == ep:
                 continue
-            dirty.append((ix, name, truth))
+            dirty.append((ix, ep))
         if len(dirty) > max(64, n_real // 5):
             epochs = pack.epochs  # keep clean rows' stamps
             pack = _build_node_pack(node_objs, node_names, axis)
             pack.epochs[:] = epochs
-            for ix, name, truth in dirty:
-                pack.epochs[ix] = (truth.mod_epoch
-                                   if truth is not None
-                                   and name not in mutated_nodes else -1)
+            for ix, ep in dirty:
+                pack.epochs[ix] = ep if ep is not None else -1
             if tc.persistent:
                 tc.pack = pack
         else:
-            for ix, name, truth in dirty:
+            for ix, ep in dirty:
                 _fill_node_row(pack, ix, node_objs[ix], axis)
-                pack.epochs[ix] = (truth.mod_epoch
-                                   if truth is not None
-                                   and name not in mutated_nodes else -1)
+                pack.epochs[ix] = ep if ep is not None else -1
     node_count = np.zeros((n_pad,), np.int32)
     node_max = np.zeros((n_pad,), np.int32)
     node_exists = np.zeros((n_pad,), bool)
@@ -712,18 +738,20 @@ def tensorize_session(ssn) -> TensorSnapshot:
         job_init_ready[ji] = job.ready_task_num()
         # The O(tasks) slice comes from the per-job block cache when the
         # informers have not touched the job since the block was built.
+        # Keyed on the clone's SNAPSHOT-time epoch (stamped under the
+        # cache mutex), never on live truth (TOCTOU with reflectors).
         block = None
-        truth = truth_jobs.get(uid) if truth_jobs is not None else None
-        reusable = (stock_order and truth is not None
-                    and uid not in mutated_jobs)
+        snap_epoch = (getattr(job, "snap_epoch", None)
+                      if uid not in mutated_jobs else None)
+        reusable = stock_order and snap_epoch is not None
         if reusable:
             block = tc.jobs.get(uid)
-            if block is not None and block.epoch != truth.mod_epoch:
+            if block is not None and block.epoch != snap_epoch:
                 block = None
         if block is None:
             block = _build_job_block(tc, job, axis, stock_order, ssn)
             if reusable:
-                block.epoch = truth.mod_epoch
+                block.epoch = snap_epoch
                 tc.jobs[uid] = block
         blocks.append(block)
         job_start[ji] = cursor
@@ -742,7 +770,20 @@ def tensorize_session(ssn) -> TensorSnapshot:
     snap.task_job = np.repeat(np.arange(j_real, dtype=np.int32),
                               job_count[:j_real])
     p_real = cursor
-    p_pad = bucket(max(p_real, 1))
+    # BestEffort rows live AFTER the candidate range: outside every job's
+    # [start, start+count) so the solver never sees them, but tensorized
+    # (signature, ports, affinity) so the scanner answers backfill's
+    # predicate sweep in one call per task.
+    extras: List = []
+    extra_starts: List[int] = []
+    for ji, b in enumerate(blocks):
+        extra_starts.append(p_real + len(extras))
+        if b.be_uids:
+            jt = ssn.jobs[job_uids[ji]].tasks
+            extras.extend(jt[tuid] for tuid in b.be_uids)
+    snap.tasks_extra = extras
+    p_total = p_real + len(extras)
+    p_pad = bucket(max(p_total, 1))
     task_res = np.zeros((p_pad, r), _F)
     task_req_q64 = np.zeros((p_pad, r), np.int64)
     task_res_q64 = np.zeros((p_pad, r), np.int64)
@@ -753,10 +794,15 @@ def tensorize_session(ssn) -> TensorSnapshot:
         task_res[:p_real] = np.concatenate([b.res_f for b in live])
         task_req_q64[:p_real] = np.concatenate([b.req_q for b in live])
         task_res_q64[:p_real] = np.concatenate([b.res_q for b in live])
-        # Compact global signature ids to session-local mask rows.
-        present, inverse = np.unique(
-            np.concatenate([b.sig_g for b in live]), return_inverse=True)
-        task_sig[:p_real] = inverse.astype(np.int32)
+    if p_total:
+        # Compact global signature ids to session-local mask rows
+        # (candidate rows first, then the BestEffort rows, both in block
+        # order — matching their row layout).
+        sig_arrays = ([b.sig_g for b in blocks if b.count]
+                      + [b.be_sig for b in blocks if len(b.be_sig)])
+        present, inverse = np.unique(np.concatenate(sig_arrays),
+                                     return_inverse=True)
+        task_sig[:p_total] = inverse.astype(np.int32)
         sig_tuples = [tc.sig_list[int(g)] for g in present]
     task_sorted = np.arange(p_pad, dtype=np.int32)  # already emitted in order
 
@@ -768,12 +814,19 @@ def tensorize_session(ssn) -> TensorSnapshot:
     panti_rows: List[tuple] = []
     for ji, b in enumerate(blocks):
         s = int(job_start[ji])
+        es = extra_starts[ji]
         if b.ports:
             port_rows.extend((s + off, g) for off, g in b.ports)
         if b.aff:
             aff_rows.extend((s + off, g) for off, g in b.aff)
         if b.anti:
             anti_rows.extend((s + off, g) for off, g in b.anti)
+        if b.be_ports:
+            port_rows.extend((es + off, g) for off, g in b.be_ports)
+        if b.be_aff:
+            aff_rows.extend((es + off, g) for off, g in b.be_aff)
+        if b.be_anti:
+            anti_rows.extend((es + off, g) for off, g in b.be_anti)
         # Preferred (soft) pod affinity feeds the device InterPodAffinity
         # score via the same selector counts; only relevant when the
         # plugin weight is non-zero (matching the host prioritizer set).
@@ -856,6 +909,9 @@ def tensorize_session(ssn) -> TensorSnapshot:
 
         for ti, t in enumerate(tasks):
             task_match[ti, :ns_real] = matches(t.pod.metadata.labels)
+        for k, t in enumerate(extras):
+            task_match[p_real + k, :ns_real] = matches(
+                t.pod.metadata.labels)
         for nix, node in enumerate(node_objs):
             for rt in node.tasks.values():
                 node_selcnt0[nix, :ns_real] += matches(
@@ -870,7 +926,7 @@ def tensorize_session(ssn) -> TensorSnapshot:
         from ..ops.resources import SCORE_GRID_K as _K
         from ..ops.scoring import max_weight_sum as _mws
         row_w = int((task_paff_w + task_panti_w).sum(axis=1).max())
-        cnt_bound = p_real + int(node_selcnt0.max())
+        cnt_bound = p_total + int(node_selcnt0.max())
         # Half budget: the node-affinity bonus guard gets the other half,
         # so fraction + pod-affinity + bonus can never jointly wrap int32.
         if (_mws(weights) * 10 + row_w * cnt_bound) * _K \

@@ -16,8 +16,14 @@ from .conf import (SchedulerConfiguration, Tier, apply_plugin_conf_defaults,
 from .framework import (Action, close_session, get_action, open_session)
 from .metrics import metrics
 
+# The shipped default pipeline puts the flagship device action first:
+# tpu-allocate solves the allocate loop on TPU and falls back to the host
+# allocate path transparently whenever the session can't be tensorized
+# (actions/tpu_allocate.py).  The reference's default is the host pair
+# ``allocate, backfill`` (util.go:31-42); behavior is identical by the
+# parity suite — only the engine differs.
 DEFAULT_SCHEDULER_CONF = """
-actions: "allocate, backfill"
+actions: "tpu-allocate, backfill"
 tiers:
 - plugins:
   - name: priority

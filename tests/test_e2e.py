@@ -315,3 +315,57 @@ tiers:
         assert len(h.bound("high")) == 2
         assert len([k for k in h.cluster.pods
                     if k.startswith("test/low")]) < 4
+
+
+class TestPodInformerFilter:
+    """The exact reference pod filter (cache.go:286-304): keep a pod iff
+    (Pending AND ours) OR (phase != Pending, any scheduler)."""
+
+    def _harness_with_node(self):
+        h = Harness()
+        h.add_nodes(1, cpu="8")
+        return h
+
+    def _mk(self, name, phase, scheduler, node=""):
+        pod = mk_pod(name, "", phase=phase, node=node)
+        pod.spec.scheduler_name = scheduler
+        return pod
+
+    def test_our_pending_pod_ingested(self):
+        h = self._harness_with_node()
+        h.cluster.create_pod(self._mk("ours", "Pending", "kube-batch"))
+        assert any(t.name == "ours"
+                   for j in h.cache.jobs.values()
+                   for t in j.tasks.values())
+
+    def test_other_scheduler_pending_pod_dropped_even_with_node(self):
+        # Previously mirrored because it carried a nodeName; the reference
+        # drops any other-scheduler Pending pod.
+        h = self._harness_with_node()
+        h.cluster.create_pod(self._mk("other-pending", "Pending",
+                                      "default-scheduler", node="node-0"))
+        assert not any(t.name == "other-pending"
+                       for j in h.cache.jobs.values()
+                       for t in j.tasks.values())
+        assert "node-0/other-pending" not in getattr(
+            h.cache.nodes.get("node-0"), "tasks", {})
+
+    def test_other_scheduler_running_pod_accounted(self):
+        h = self._harness_with_node()
+        h.cluster.create_pod(self._mk("other-running", "Running",
+                                      "default-scheduler", node="node-0"))
+        node = h.cache.nodes["node-0"]
+        assert "test/other-running" in node.tasks
+
+    def test_other_scheduler_failed_unbound_pod_mirrored(self):
+        # The reference's divergent corner: a non-Pending, not-yet-bound
+        # pod of another scheduler passes the filter and lands in its
+        # job's accounting (jobless foreign pods are ignored by addTask,
+        # event_handlers.go:45-70, so give it a group).
+        h = self._harness_with_node()
+        pod = mk_pod("other-failed", "g1", phase="Failed")
+        pod.spec.scheduler_name = "default-scheduler"
+        h.cluster.create_pod(pod)
+        assert any(t.name == "other-failed"
+                   for j in h.cache.jobs.values()
+                   for t in j.tasks.values())

@@ -1,0 +1,68 @@
+"""Device-path fit-error diagnostics (VERDICT r2 next #7): unschedulable
+messages under tpu-allocate carry the host path's NodesFitDelta histogram
+(allocate.go:139-141, job_info.go:348-380) instead of staying empty."""
+
+import pytest
+
+from kube_batch_tpu.actions.allocate import AllocateAction
+from kube_batch_tpu.actions.tpu_allocate import TpuAllocateAction
+from kube_batch_tpu.framework import close_session, open_session
+from kube_batch_tpu.plugins.factory import register_default_plugins
+from kube_batch_tpu.scheduler import DEFAULT_SCHEDULER_CONF, load_scheduler_conf
+from tests.test_tpu_parity import build_cache
+
+
+@pytest.fixture(autouse=True)
+def _setup():
+    from kube_batch_tpu.actions.factory import register_default_actions
+    register_default_actions()
+    register_default_plugins()
+
+
+def _fit_error_after(action_cls, spec, job_uid, mark_dying=None):
+    cache, _binder = build_cache(spec)
+    if mark_dying:
+        job = cache.jobs[mark_dying]
+        task = list(job.tasks.values())[0]
+        task.pod.metadata.deletion_timestamp = 1.0
+        cache.update_pod(task.pod, task.pod)
+    _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+    ssn = open_session(cache, tiers)
+    try:
+        action_cls().execute(ssn)
+        return ssn.jobs[job_uid].fit_error()
+    finally:
+        close_session(ssn)
+
+
+def test_oversized_task_no_candidates_matches_host():
+    """No node passes the resource-fit closure (fits neither idle nor
+    releasing): the reference records no delta — '0 nodes are available'
+    on both paths (allocate.go:73-87 closure + :147 break)."""
+    spec = dict(
+        queues=[("q1", 1)],
+        pod_groups=[("pg1", "ns", 1, "q1")],
+        nodes=[("n0", "4", "8Gi")],
+        pods=[("ns", "big", "", "Pending", "8", "16Gi", "pg1")])
+    host = _fit_error_after(AllocateAction, spec, "ns/pg1")
+    dev = _fit_error_after(TpuAllocateAction, spec, "ns/pg1")
+    assert host == "0 nodes are available"
+    assert dev == host
+
+
+def test_pipelined_last_task_records_delta_like_host():
+    """Idle fails but releasing fits (the pipeline path): the host records
+    the selected node's idle shortfall and it survives as the job's final
+    task; the device path mirrors the histogram."""
+    spec = dict(
+        queues=[("q1", 1)],
+        pod_groups=[("old", "ns", 1, "q1"), ("new", "ns", 1, "q1")],
+        pods=[("ns", "dying", "n1", "Running", "3", "3G", "old"),
+              ("ns", "fresh", "", "Pending", "3", "3G", "new")],
+        nodes=[("n1", "4", "8G")])
+    host = _fit_error_after(AllocateAction, spec, "ns/new",
+                            mark_dying="ns/old")
+    dev = _fit_error_after(TpuAllocateAction, spec, "ns/new",
+                           mark_dying="ns/old")
+    assert "insufficient cpu" in host
+    assert dev == host

@@ -183,8 +183,9 @@ def run_session(cache, binder, evictor, conf_actions):
     from kube_batch_tpu.framework import close_session, open_session
     from kube_batch_tpu.scheduler import (DEFAULT_SCHEDULER_CONF,
                                           load_scheduler_conf)
-    conf = DEFAULT_SCHEDULER_CONF.replace('"allocate, backfill"',
+    conf = DEFAULT_SCHEDULER_CONF.replace('"tpu-allocate, backfill"',
                                           f'"{conf_actions}"')
+    assert f'"{conf_actions}"' in conf, "conf swap failed (default moved?)"
     actions, tiers = load_scheduler_conf(conf)
     ssn = open_session(cache, tiers)
     try:

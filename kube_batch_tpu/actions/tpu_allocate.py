@@ -109,7 +109,7 @@ class TpuAllocateAction(Action):
         a break keeps no delta there; diagnostics only.)"""
         import numpy as np
 
-        from ..api import TaskStatus
+        from ..api import TaskStatus, allocated_status
         from ..models.tensor_snapshot import _res_from_vec
 
         names = snap.node_names
@@ -133,13 +133,17 @@ class TpuAllocateAction(Action):
                 continue
             # Idle at the record point: the node's post-batch idle plus
             # the requests of kind-1 placements that happened AFTER this
-            # task in solve order (the host records mid-sequence).
+            # task in solve order (the host records mid-sequence).  Only
+            # placements batch_apply actually applied count — skipped
+            # ones (e.g. volume failure) never touched node.idle.
             later = ((kind == 1) & (assignment == nix)
                      & (order > order[last]))
+            rows = [int(i) for i in np.nonzero(later)[0]
+                    if allocated_status(snap.tasks[int(i)].status)]
             delta = node.idle.clone()
-            if later.any():
+            if rows:
                 delta.add(_res_from_vec(
-                    snap.task_res_f64[np.nonzero(later)[0]].sum(axis=0),
+                    snap.task_res_f64[rows].sum(axis=0),
                     snap.resource_names))
             delta.fit_delta(task.init_resreq)
             ssn._dirty_job(job.uid)

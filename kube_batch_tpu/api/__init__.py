@@ -8,7 +8,8 @@ from .resource import (Resource, parse_quantity, minimum, share,
                        GPU_RESOURCE_NAME, TPU_RESOURCE_NAME)
 from .types import (TaskStatus, allocated_status, get_task_status, NodePhase,
                     NodeState, ValidateResult, FitError)
-from .objects import (ObjectMeta, Pod, PodSpec, PodStatus, Node, NodeSpec,
+from .objects import (ObjectMeta, Pod, PodSpec, PodStatus, PodCondition,
+                      Event, Node, NodeSpec,
                       NodeStatus, Container, ContainerPort, Taint, Toleration,
                       Affinity, PriorityClass, pod_key,
                       get_pod_resource_request,
@@ -28,7 +29,8 @@ __all__ = [
     "GPU_RESOURCE_NAME", "TPU_RESOURCE_NAME",
     "TaskStatus", "allocated_status", "get_task_status", "NodePhase",
     "NodeState", "ValidateResult", "FitError",
-    "ObjectMeta", "Pod", "PodSpec", "PodStatus", "Node", "NodeSpec",
+    "ObjectMeta", "Pod", "PodSpec", "PodStatus", "PodCondition", "Event",
+    "Node", "NodeSpec",
     "NodeStatus", "Container", "ContainerPort", "Taint", "Toleration",
     "Affinity", "PriorityClass", "pod_key", "get_pod_resource_request",
     "get_pod_resource_without_init_containers",

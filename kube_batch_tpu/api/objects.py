@@ -121,8 +121,19 @@ class PersistentVolumeClaim:
 
 
 @dataclass
+class PodCondition:
+    """core/v1 PodCondition subset: what taskUnschedulable writes
+    (reference cache.go:548-568: PodScheduled=False/Unschedulable)."""
+    type: str = ""      # e.g. "PodScheduled"
+    status: str = ""    # "True" | "False" | "Unknown"
+    reason: str = ""
+    message: str = ""
+
+
+@dataclass
 class PodStatus:
     phase: str = "Pending"  # Pending|Running|Succeeded|Failed|Unknown
+    conditions: List[PodCondition] = field(default_factory=list)
 
 
 @dataclass
@@ -180,6 +191,19 @@ class PodDisruptionBudget:
     queue, event_handlers.go:676)."""
     metadata: ObjectMeta = field(default_factory=ObjectMeta)
     min_available: int = 0
+
+
+@dataclass
+class Event:
+    """core/v1 Event subset: the reference broadcasts Scheduled / Evict /
+    FailedScheduling / Unschedulable events to the cluster
+    (cache.go:238-240, :474-481, :530, :557)."""
+    metadata: ObjectMeta = field(default_factory=ObjectMeta)
+    involved_object: str = ""  # "namespace/name" (or job uid)
+    reason: str = ""           # e.g. "FailedScheduling"
+    message: str = ""
+    type: str = "Normal"       # Normal | Warning
+    timestamp: float = 0.0
 
 
 def pod_key(pod: Pod) -> str:

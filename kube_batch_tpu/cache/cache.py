@@ -24,6 +24,27 @@ from .interface import Binder, Cache, Evictor, StatusUpdater, VolumeBinder
 from .shadow import create_shadow_pod_group, shadow_group_key, shadow_pod_group
 
 
+from collections import deque as _deque
+
+
+class _EventDeque(_deque):
+    """The cache's local event deque, tee'd into the cluster event
+    recorder: every append (3-tuples of reason, object key, message)
+    also egresses asynchronously when a recorder is configured."""
+
+    def __init__(self, base, recorder=None):
+        super().__init__(base, maxlen=base.maxlen)
+        self._recorder = recorder
+
+    def append(self, item):
+        super().append(item)
+        if self._recorder is not None:
+            try:
+                self._recorder.record(*item)
+            except Exception:
+                pass  # events are best-effort diagnostics
+
+
 class SchedulerCache(Cache):
     """In-memory cluster mirror (cache.go:73-105)."""
 
@@ -33,7 +54,8 @@ class SchedulerCache(Cache):
                  evictor: Optional[Evictor] = None,
                  status_updater: Optional[StatusUpdater] = None,
                  volume_binder: Optional[VolumeBinder] = None,
-                 priority_class_enabled: bool = True):
+                 priority_class_enabled: bool = True,
+                 event_recorder=None):
         self.mutex = threading.RLock()
         self.scheduler_name = scheduler_name
         self.default_queue = default_queue
@@ -57,9 +79,14 @@ class SchedulerCache(Cache):
         self.err_tasks: List[TaskInfo] = []
         self.deleted_jobs: List[JobInfo] = []
         # Recorded cluster events (bounded; the reference emits to the k8s
-        # event stream which is similarly retention-limited).
+        # event stream which is similarly retention-limited).  When an
+        # event_recorder is configured (cluster.ClusterEventRecorder),
+        # every event ALSO egresses to the cluster's events resource
+        # (cache.go:238-240 recorder) — the local deque stays for tests
+        # and in-process observers.
         from collections import deque
-        self.events = deque(maxlen=10000)
+        self.events = _EventDeque(deque(maxlen=10000), event_recorder)
+        self.event_recorder = event_recorder
 
         # Incremental-snapshot support: a monotonically increasing epoch,
         # stamped onto each job/node at mutation time (``mod_epoch``), lets

@@ -17,8 +17,34 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-from ..api.objects import Node, PersistentVolumeClaim, Pod, PriorityClass
+from ..api.objects import (Event, Node, PersistentVolumeClaim, Pod,
+                           PodCondition, PriorityClass)
 from .interface import Binder, Evictor, StatusUpdater, VolumeBinder
+
+
+class EventLog:
+    """Bounded, listable event store (the apiserver's event retention
+    analog).  Events are append-only and best-effort: overflow drops the
+    oldest, exactly how a real cluster's TTL'd events age out."""
+
+    def __init__(self, maxlen: int = 10000):
+        from collections import deque
+        self._items = deque(maxlen=maxlen)
+        self._seq = itertools.count()
+
+    def append(self, event: Event) -> Event:
+        if not event.metadata.name:
+            event.metadata.name = f"ev-{next(self._seq)}"
+        if not event.timestamp:
+            event.timestamp = time.time()
+        self._items.append(event)
+        return event
+
+    def values(self):
+        return list(self._items)
+
+    def __len__(self) -> int:
+        return len(self._items)
 
 
 class Informer:
@@ -79,6 +105,14 @@ class Cluster:
         self.queue_informer = Informer()
         self.priority_class_informer = Informer()
         self.pdb_informer = Informer()
+        # Cluster event stream (list-only, like a real apiserver's
+        # TTL-bounded events; reference recorder cache.go:238-240).
+        self.events = EventLog()
+        # Leader-election leases: key -> (resource_version, record dict).
+        # The ConfigMap-lock analog (reference server.go:115-139): any
+        # standby anywhere coordinates through the store via CAS on the
+        # version, like resourceVersion-guarded ConfigMap updates.
+        self.leases: Dict[str, tuple] = {}
         # Kubelet stand-in: a bound pod starts Running immediately.
         self.auto_run_bound_pods = auto_run_bound_pods
         self._rv = itertools.count(1)
@@ -115,6 +149,58 @@ class Cluster:
             self.pods[key] = pod
             self.pod_informer.fire_update(old, pod)
             return pod
+
+    def update_pod_condition(self, namespace: str, name: str,
+                             condition: PodCondition) -> Pod:
+        """The pod ``status`` subresource write taskUnschedulable performs
+        (cache.go:548-568): upsert the condition by type and fire
+        MODIFIED so watchers see why the pod is stuck."""
+        with self.lock:
+            key = f"{namespace}/{name}"
+            pod = self.pods.get(key)
+            if pod is None:
+                raise KeyError(f"pod {key} not found")
+            old = copy.deepcopy(pod)
+            for i, cond in enumerate(pod.status.conditions):
+                if cond.type == condition.type:
+                    if (cond.status == condition.status
+                            and cond.reason == condition.reason
+                            and cond.message == condition.message):
+                        return pod  # no-op write, like UpdatePodCondition
+                    pod.status.conditions[i] = condition
+                    break
+            else:
+                pod.status.conditions.append(condition)
+            self.pod_informer.fire_update(old, pod)
+            return pod
+
+    def create_event(self, event: Event) -> Event:
+        with self.lock:
+            return self.events.append(event)
+
+    # -- leader-election lease verbs ----------------------------------------
+
+    def get_lease(self, namespace: str, name: str):
+        """(resource_version, record) or (0, None) when absent."""
+        with self.lock:
+            entry = self.leases.get(f"{namespace}/{name}")
+            return entry if entry is not None else (0, None)
+
+    def cas_lease(self, namespace: str, name: str, record: dict,
+                  expected_version: int) -> int:
+        """Compare-and-swap the lease record; returns the new version or
+        raises ValueError on a version conflict (the apiserver's
+        resourceVersion-guarded update)."""
+        with self.lock:
+            key = f"{namespace}/{name}"
+            current = self.leases.get(key, (0, None))[0]
+            if current != expected_version:
+                raise ValueError(
+                    f"lease {key} version conflict "
+                    f"(have {current}, expected {expected_version})")
+            version = next(self._rv)
+            self.leases[key] = (version, dict(record))
+            return version
 
     def delete_pod(self, namespace: str, name: str) -> None:
         """Pod deletion; mirrors the two-phase delete the scheduler sees:
@@ -313,12 +399,87 @@ class ClusterStatusUpdater(StatusUpdater):
         self.cluster = cluster
 
     def update_pod_condition(self, pod, condition) -> None:
-        pass  # conditions are not modeled on simulator pods yet
+        """Write PodScheduled=False/Unschedulable back to the cluster
+        (cache.go:548-568: how users see WHY a pod is stuck)."""
+        ctype, status, reason, message = condition
+        # Client-side pre-check (upstream UpdatePodCondition): the mirror
+        # pod carries the informer-echoed conditions, so an unchanged
+        # stuck pod costs zero round-trips per cycle instead of one
+        # blocking PUT over the edge.
+        for cond in pod.status.conditions:
+            if (cond.type == ctype and cond.status == status
+                    and cond.reason == reason and cond.message == message):
+                return
+        try:
+            self.cluster.update_pod_condition(
+                pod.metadata.namespace, pod.metadata.name,
+                PodCondition(type=ctype, status=status, reason=reason,
+                             message=message))
+        except (KeyError, OSError):
+            # Pod deleted meanwhile (404) or the edge is unreachable:
+            # log-and-continue semantics — a failed condition write must
+            # never abort the session close.
+            pass
 
     def update_pod_group(self, pg) -> None:
         from ..api.pod_group_info import PodGroup, to_versioned
         obj = to_versioned(pg) if isinstance(pg, PodGroup) else pg
         self.cluster.put_pod_group_status(obj)
+
+
+class ClusterEventRecorder:
+    """Event egress: the reference's record.EventBroadcaster analog.
+    Asynchronous and best-effort — a daemon thread drains a bounded queue
+    into the cluster's events resource, so a slow or unreachable edge
+    never stalls the scheduling loop (events are TTL'd diagnostics, not
+    state)."""
+
+    _NORMAL_REASONS = frozenset({"Scheduled"})
+
+    def __init__(self, cluster, maxlen: int = 10000):
+        from collections import deque
+        self.cluster = cluster
+        self._queue = deque(maxlen=maxlen)
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def record(self, reason: str, object_key: str, message: str) -> None:
+        self._queue.append(Event(
+            involved_object=object_key, reason=reason, message=message,
+            type=("Normal" if reason in self._NORMAL_REASONS
+                  else "Warning")))
+        if self._thread is None:
+            with self._lock:
+                if self._thread is None:
+                    self._thread = threading.Thread(
+                        target=self._drain, daemon=True,
+                        name="event-recorder")
+                    self._thread.start()
+        self._wake.set()
+
+    def _drain(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(1.0)
+            self._wake.clear()
+            while self._queue:
+                event = self._queue.popleft()
+                try:
+                    self.cluster.create_event(event)
+                except Exception:
+                    pass  # best-effort; dropped like an expired event
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._wake.set()
+
+    def flush(self, timeout: float = 5.0) -> None:
+        """Testing aid: wait until the queue drains."""
+        deadline = time.time() + timeout
+        while self._queue and time.time() < deadline:
+            self._wake.set()
+            time.sleep(0.01)
 
 
 def connect_cache_to_cluster(cache, cluster: Cluster) -> None:
@@ -382,6 +543,7 @@ def new_scheduler_cache(cluster: Cluster, scheduler_name: str = "kube-batch",
         binder=ClusterBinder(cluster), evictor=ClusterEvictor(cluster),
         status_updater=ClusterStatusUpdater(cluster),
         volume_binder=ClusterVolumeBinder(cluster),
-        priority_class_enabled=priority_class_enabled)
+        priority_class_enabled=priority_class_enabled,
+        event_recorder=ClusterEventRecorder(cluster))
     connect_cache_to_cluster(cache, cluster)
     return cache

@@ -1,12 +1,16 @@
 """Leader election over a shared lock object.
 
-The reference coordinates HA standbys with a ConfigMap-lock
-LeaderElector (lease 15s / renew 10s / retry 5s,
+The reference coordinates HA standbys with a ConfigMap-lock LeaderElector
+(lease 15s / renew 10s / retry 5s,
 /root/reference/cmd/kube-batch/app/server.go:48-53,115-139); loss of lease
-kills the process and a standby takes over.  Here the lock object lives in
-the cluster-state store's namespace — for the file-backed simulator that is
-a lock file with the same lease semantics, which gives identical failover
-behavior for multi-process deployments sharing a state directory.
+kills the scheduling loop and a standby takes over.  Two lock backends:
+
+- ``StoreLock``: a lease object in the cluster-state store, updated via
+  compare-and-swap on its resource version (the ConfigMap analog) — any
+  standby anywhere that can reach the store (in-process Cluster or the
+  HTTP edge) coordinates through it.
+- ``FileLock``: a lock file with the same lease semantics, for
+  multi-process deployments sharing a filesystem (no store required).
 """
 
 from __future__ import annotations
@@ -23,10 +27,59 @@ DEFAULT_LEASE_DURATION = 15.0
 DEFAULT_RENEW_DEADLINE = 10.0
 DEFAULT_RETRY_PERIOD = 5.0
 
+LOCK_NAME = "kube-batch-lock"
+
+
+class FileLock:
+    """Lock record in a file; atomic-replace writes (no CAS — last writer
+    wins, adequate for the shared-filesystem deployment it serves)."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def get(self):
+        try:
+            with open(self.path) as f:
+                return 0, json.load(f)
+        except (OSError, ValueError):
+            return 0, None
+
+    def cas(self, record: dict, expected_version: int) -> bool:
+        tmp = f"{self.path}.{os.getpid()}.tmp"
+        try:
+            with open(tmp, "w") as f:
+                json.dump(record, f)
+            os.replace(tmp, self.path)
+            return True
+        except OSError:
+            return False
+
+
+class StoreLock:
+    """Lease object in the cluster store (Cluster or RemoteCluster —
+    both expose get_lease/cas_lease; over the edge the CAS rides a
+    version-guarded PUT that 409s on conflict)."""
+
+    def __init__(self, cluster, namespace: str, name: str = LOCK_NAME):
+        self.cluster = cluster
+        self.namespace = namespace
+        self.name = name
+
+    def get(self):
+        return self.cluster.get_lease(self.namespace, self.name)
+
+    def cas(self, record: dict, expected_version: int) -> bool:
+        try:
+            self.cluster.cas_lease(self.namespace, self.name, record,
+                                   expected_version)
+            return True
+        except (ValueError, KeyError):
+            return False
+
 
 @dataclass
 class LeaderElectionConfig:
-    lock_path: str
+    lock_path: str = ""
     identity: str = ""
     lease_duration: float = DEFAULT_LEASE_DURATION
     renew_deadline: float = DEFAULT_RENEW_DEADLINE
@@ -38,55 +91,46 @@ class LeaderElectionConfig:
 
 
 class LeaderElector:
-    """Acquire-and-renew loop (client-go leaderelection semantics)."""
+    """Acquire-and-renew loop (client-go leaderelection semantics) over a
+    pluggable lock."""
 
     def __init__(self, config: LeaderElectionConfig,
                  on_started_leading: Callable[[], None],
-                 on_stopped_leading: Callable[[], None]):
+                 on_stopped_leading: Callable[[], None],
+                 lock=None):
         self.config = config
+        self.lock = lock if lock is not None else FileLock(config.lock_path)
         self.on_started_leading = on_started_leading
         self.on_stopped_leading = on_stopped_leading
         self._stop = threading.Event()
         self.is_leader = False
 
-    # -- lock record --------------------------------------------------------
-
-    def _read_record(self) -> Optional[dict]:
-        try:
-            with open(self.config.lock_path) as f:
-                return json.load(f)
-        except (OSError, ValueError):
-            return None
-
-    def _write_record(self) -> bool:
-        record = {"holderIdentity": self.config.identity,
-                  "renewTime": time.time(),
-                  "leaseDurationSeconds": self.config.lease_duration}
-        tmp = f"{self.config.lock_path}.{self.config.identity}.tmp"
-        try:
-            with open(tmp, "w") as f:
-                json.dump(record, f)
-            os.replace(tmp, self.config.lock_path)
-            return True
-        except OSError:
-            return False
-
     def try_acquire_or_renew(self) -> bool:
-        record = self._read_record()
+        try:
+            version, record = self.lock.get()
+        except Exception:
+            return False  # store unreachable: cannot prove the lease
         now = time.time()
-        if record is not None and record.get("holderIdentity") != self.config.identity:
+        if (record is not None
+                and record.get("holderIdentity") != self.config.identity):
             expires = record.get("renewTime", 0) + record.get(
                 "leaseDurationSeconds", self.config.lease_duration)
             if now < expires:
                 return False  # someone else holds a live lease
-        return self._write_record()
+        new_record = {"holderIdentity": self.config.identity,
+                      "renewTime": now,
+                      "leaseDurationSeconds": self.config.lease_duration}
+        try:
+            return self.lock.cas(new_record, version)
+        except Exception:
+            return False
 
     # -- loop ---------------------------------------------------------------
 
     def run(self) -> None:
         """Block until leadership is acquired, run the callback, then renew
-        until the lease is lost (then on_stopped_leading, like the
-        reference's fatal exit path)."""
+        until the lease is lost (then on_stopped_leading halts the loop,
+        like the reference's fatal exit path, server.go:135-137)."""
         while not self._stop.is_set():
             if self.try_acquire_or_renew():
                 break
@@ -95,11 +139,17 @@ class LeaderElector:
             return
         self.is_leader = True
         self.on_started_leading()
+        # client-go renewal semantics: retry every retry_period; abdicate
+        # only after renew_deadline of CONTINUOUS failure — one transient
+        # store hiccup must not fail over a healthy leader.
+        last_renew = time.time()
         while not self._stop.is_set():
-            self._stop.wait(self.config.renew_deadline / 2)
+            self._stop.wait(self.config.retry_period)
             if self._stop.is_set():
                 break
-            if not self.try_acquire_or_renew():
+            if self.try_acquire_or_renew():
+                last_renew = time.time()
+            elif time.time() - last_renew > self.config.renew_deadline:
                 self.is_leader = False
                 self.on_stopped_leading()
                 return

@@ -15,7 +15,8 @@ from typing import Optional
 from ..cache import Cluster, new_scheduler_cache
 from ..metrics.metrics import registry
 from ..scheduler import Scheduler
-from .leader_election import LeaderElectionConfig, LeaderElector
+from .leader_election import (LeaderElectionConfig, LeaderElector,
+                              StoreLock)
 from .options import ServerOption
 
 
@@ -95,8 +96,10 @@ def load_cluster_state(cluster: Cluster, path: str) -> None:
 class ServerRuntime:
     """The running process: cluster edge + scheduler + metrics endpoint."""
 
-    def __init__(self, opt: ServerOption, cluster: Optional[Cluster] = None):
+    def __init__(self, opt: ServerOption, cluster: Optional[Cluster] = None,
+                 lease_config: Optional[LeaderElectionConfig] = None):
         self.opt = opt
+        self._lease_config = lease_config
         if cluster is not None:
             self.cluster = cluster
         elif opt.master:
@@ -130,12 +133,25 @@ class ServerRuntime:
             self.metrics_server = start_metrics_server(self.opt.listen_address)
         if self.opt.enable_leader_election:
             self.opt.check_option_or_die()
-            config = LeaderElectionConfig(
-                lock_path=f"{self.opt.lock_object_namespace}/kube-batch-lock.json")
+            # The HA lock lives IN THE STORE whenever the cluster edge
+            # supports leases (in-process simulator or the HTTP edge) —
+            # the reference's ConfigMap lock (server.go:115-139): any
+            # standby pointing at the same store can take over.  The lock
+            # file remains the fallback for bare shared-filesystem runs.
+            if hasattr(self.cluster, "cas_lease"):
+                lock = StoreLock(self.cluster,
+                                 self.opt.lock_object_namespace)
+                config = self._lease_config or LeaderElectionConfig()
+            else:
+                config = self._lease_config or LeaderElectionConfig(
+                    lock_path=(f"{self.opt.lock_object_namespace}/"
+                               f"kube-batch-lock.json"))
+                lock = None
             self.elector = LeaderElector(
                 config,
                 on_started_leading=self.scheduler.run,
-                on_stopped_leading=self.scheduler.stop)
+                on_stopped_leading=self.scheduler.stop,
+                lock=lock)
             threading.Thread(target=self.elector.run, daemon=True).start()
         else:
             self.scheduler.run()
@@ -144,5 +160,8 @@ class ServerRuntime:
         if self.elector is not None:
             self.elector.stop()
         self.scheduler.stop()
+        recorder = getattr(self.cache, "event_recorder", None)
+        if recorder is not None and hasattr(recorder, "stop"):
+            recorder.stop()
         if self.metrics_server is not None:
             self.metrics_server.shutdown()

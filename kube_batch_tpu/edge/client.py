@@ -240,6 +240,31 @@ class RemoteCluster:
             f"/v1/podgroups/{pg.metadata.namespace}/{pg.metadata.name}/status",
             codec.encode(pg))
 
+    def update_pod_condition(self, namespace: str, name: str,
+                             condition) -> None:
+        """Pod status subresource: PodCondition upsert (the stuck-pod
+        writeback, cache.go:548-568)."""
+        self._request("PUT", f"/v1/pods/{namespace}/{name}/status",
+                      codec.encode(condition))
+
+    def create_event(self, event) -> None:
+        self._request("POST", "/v1/events", codec.encode(event))
+
+    # leader-election lease (ConfigMap-lock analog, server.go:115-139):
+    def get_lease(self, namespace: str, name: str):
+        doc = self._request("GET", f"/v1/leases/{namespace}/{name}")
+        return int(doc["version"]), doc["record"]
+
+    def cas_lease(self, namespace: str, name: str, record: dict,
+                  expected_version: int) -> int:
+        try:
+            doc = self._request(
+                "PUT", f"/v1/leases/{namespace}/{name}",
+                {"record": record, "expectedVersion": expected_version})
+        except KeyError as exc:  # 409 conflict surfaced by _request
+            raise ValueError(str(exc)) from exc
+        return int(doc["version"])
+
     def bind_pvc(self, namespace: str, name: str, volume_name: str) -> None:
         self._request("POST", f"/v1/pvcs/{namespace}/{name}/bind",
                       {"volume": volume_name})

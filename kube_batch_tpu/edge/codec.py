@@ -28,6 +28,7 @@ def _kind_of(cls) -> str:
 _TOP_LEVEL = [
     _objects.Pod, _objects.Node, _objects.PriorityClass,
     _objects.PodDisruptionBudget, _objects.PersistentVolumeClaim,
+    _objects.Event, _objects.PodCondition,
     v1alpha1.PodGroup, v1alpha1.Queue,
     v1alpha2.PodGroup, v1alpha2.Queue,
 ]

@@ -24,14 +24,15 @@ from ..cache.cluster import Cluster
 from . import codec
 
 _RESOURCES = ("pods", "nodes", "podgroups", "queues", "priorityclasses",
-              "pdbs", "pvcs")
+              "pdbs", "pvcs", "events", "leases")
 
 
 def _store_of(cluster: Cluster, resource: str):
     return {"pods": cluster.pods, "nodes": cluster.nodes,
             "podgroups": cluster.pod_groups, "queues": cluster.queues,
             "priorityclasses": cluster.priority_classes,
-            "pdbs": cluster.pdbs, "pvcs": cluster.pvcs}[resource]
+            "pdbs": cluster.pdbs, "pvcs": cluster.pvcs,
+            "events": cluster.events}[resource]
 
 
 def _informer_of(cluster: Cluster, resource: str):
@@ -77,6 +78,11 @@ class _Handler(BaseHTTPRequestHandler):
         resource, rest, query = self._route()
         if resource is None:
             return self._json(404, {"error": "not found"})
+        if resource == "leases":
+            if len(rest) != 2:
+                return self._json(404, {"error": "lease key required"})
+            version, record = self.cluster.get_lease(rest[0], rest[1])
+            return self._json(200, {"version": version, "record": record})
         if query.get("watch"):
             return self._watch(resource)
         with self.cluster.lock:
@@ -104,6 +110,8 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(200, {"status": "bound"})
         if rest:  # create routes take no path suffix
             return self._json(404, {"error": "not found"})
+        if resource == "leases":  # leases are PUT-CAS only
+            return self._json(405, {"error": "create not supported"})
         try:
             obj = codec.decode(self._body())
         except (ValueError, KeyError) as exc:  # malformed JSON / unknown kind
@@ -114,7 +122,8 @@ class _Handler(BaseHTTPRequestHandler):
                   "queues": self.cluster.create_queue,
                   "priorityclasses": self.cluster.create_priority_class,
                   "pdbs": self.cluster.create_pdb,
-                  "pvcs": self.cluster.create_pvc}[resource]
+                  "pvcs": self.cluster.create_pvc,
+                  "events": self.cluster.create_event}[resource]
         try:
             create(obj)
         except (KeyError, ValueError) as exc:  # store conflict
@@ -126,9 +135,26 @@ class _Handler(BaseHTTPRequestHandler):
         if resource is None:
             return self._json(404, {"error": "not found"})
         try:
+            if resource == "leases":
+                if len(rest) != 2:
+                    return self._json(404, {"error": "lease key required"})
+                body = self._body()
+                try:
+                    version = self.cluster.cas_lease(
+                        rest[0], rest[1], body["record"],
+                        int(body["expectedVersion"]))
+                except ValueError as exc:  # version conflict
+                    return self._json(409, {"error": str(exc)})
+                return self._json(200, {"version": version})
             obj = codec.decode(self._body())
             if resource == "podgroups" and rest and rest[-1] == "status":
                 self.cluster.put_pod_group_status(obj)
+                return self._json(200, {"status": "updated"})
+            if (resource == "pods" and len(rest) == 3
+                    and rest[2] == "status"):
+                # Pod status subresource: a PodCondition upsert
+                # (cache.go:548-568 taskUnschedulable writeback).
+                self.cluster.update_pod_condition(rest[0], rest[1], obj)
                 return self._json(200, {"status": "updated"})
             update = {"pods": self.cluster.update_pod,
                       "nodes": self.cluster.update_node,

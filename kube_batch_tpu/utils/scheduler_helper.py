@@ -14,26 +14,19 @@ sorted snapshot); parity tests rely on this.
 
 from __future__ import annotations
 
-from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Sequence, Tuple
 
 from ..api import FitError, NodeInfo, TaskInfo
 
-PARALLELISM = 16
-
 
 def predicate_nodes(task: TaskInfo, nodes: Sequence[NodeInfo],
-                    fn, parallel: bool = False) -> List[NodeInfo]:
-    """Nodes passing the predicate chain (scheduler_helper.go:63-86)."""
-    if parallel and len(nodes) > 64:
-        def check(node):
-            try:
-                fn(task, node)
-                return node
-            except FitError:
-                return None
-        with ThreadPoolExecutor(max_workers=PARALLELISM) as pool:
-            return [n for n in pool.map(check, nodes) if n is not None]
+                    fn) -> List[NodeInfo]:
+    """Nodes passing the predicate chain (scheduler_helper.go:63-86).
+
+    The reference fans this out over 16 goroutines; here the [tasks x
+    nodes] predicate work is vectorized on device (ops/solver,
+    models/scanner) and this host fallback stays sequential — Python
+    threads add GIL overhead, not parallelism, to a pure-Python chain."""
     out = []
     for node in nodes:
         try:

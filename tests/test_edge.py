@@ -255,3 +255,72 @@ class TestClientsetOverTheEdge:
             assert "q9" not in cluster.queues
         finally:
             remote.stop()
+
+
+class TestEgressChain:
+    """VERDICT r2 next #2: the observability egress completes the last
+    hop — pod conditions and events reach the REMOTE store over HTTP."""
+
+    def test_stuck_gang_pod_conditions_and_events_over_http(self, api):
+        cluster, server = api
+        cluster.create_node(build_node("n0", build_resource_list(
+            "2", "4Gi", pods=110)))
+        cluster.create_queue(v1alpha1.Queue(
+            metadata=ObjectMeta(name="default"),
+            spec=v1alpha1.QueueSpec(weight=1)))
+        cluster.create_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name="stuck", namespace="ns"),
+            spec=v1alpha1.PodGroupSpec(min_member=3, queue="default")))
+        remote = RemoteCluster(server.url).start()
+        cache = new_scheduler_cache(remote)
+        sched = Scheduler(cache, schedule_period=0.05)
+        sched.run()
+        try:
+            for i in range(3):
+                remote.create_pod(build_pod(
+                    "ns", f"p{i}", "", "Pending",
+                    build_resource_list("2", "4Gi"), "stuck"))
+            deadline = time.time() + 30
+            conds, events = [], []
+            while time.time() < deadline:
+                with cluster.lock:
+                    pod = cluster.pods.get("ns/p0")
+                    conds = list(pod.status.conditions) if pod else []
+                    events = cluster.events.values()
+                if conds and any(e.reason == "FailedScheduling"
+                                 for e in events):
+                    break
+                time.sleep(0.1)
+        finally:
+            sched.stop()
+            remote.stop()
+        # Pod condition written through the status subresource.
+        assert any(c.type == "PodScheduled" and c.status == "False"
+                   and c.reason == "Unschedulable" for c in conds), conds
+        # FailedScheduling events listable in the remote store, and over
+        # plain HTTP (GET /v1/events) as any operator tooling would.
+        failed = [e for e in events if e.reason == "FailedScheduling"]
+        assert failed and failed[0].type == "Warning"
+        import json as _json
+        import urllib.request
+        with urllib.request.urlopen(f"{server.url}/v1/events",
+                                    timeout=5) as resp:
+            listed = _json.loads(resp.read())["items"]
+        assert any(doc["reason"] == "FailedScheduling" for doc in listed)
+
+    def test_pod_status_subresource_direct(self, api):
+        from kube_batch_tpu.api import PodCondition
+        cluster, server = api
+        cluster.create_pod(build_pod("ns", "p0", "", "Pending",
+                                     build_resource_list("1", "1Gi"), "pg"))
+        remote = RemoteCluster(server.url)
+        remote.update_pod_condition("ns", "p0", PodCondition(
+            type="PodScheduled", status="False", reason="Unschedulable",
+            message="0 nodes"))
+        pod = cluster.get_pod("ns", "p0")
+        assert pod.status.conditions[0].reason == "Unschedulable"
+        # Missing pod -> 404 surfaced as KeyError.
+        import pytest as _pytest
+        with _pytest.raises(KeyError):
+            remote.update_pod_condition("ns", "ghost", PodCondition(
+                type="PodScheduled", status="False"))

@@ -32,8 +32,8 @@ class _EventDeque(_deque):
     recorder: every append (3-tuples of reason, object key, message)
     also egresses asynchronously when a recorder is configured."""
 
-    def __init__(self, base, recorder=None):
-        super().__init__(base, maxlen=base.maxlen)
+    def __init__(self, maxlen=10000, recorder=None):
+        super().__init__(maxlen=maxlen)
         self._recorder = recorder
 
     def append(self, item):
@@ -84,8 +84,7 @@ class SchedulerCache(Cache):
         # every event ALSO egresses to the cluster's events resource
         # (cache.go:238-240 recorder) — the local deque stays for tests
         # and in-process observers.
-        from collections import deque
-        self.events = _EventDeque(deque(maxlen=10000), event_recorder)
+        self.events = _EventDeque(maxlen=10000, recorder=event_recorder)
         self.event_recorder = event_recorder
 
         # Incremental-snapshot support: a monotonically increasing epoch,

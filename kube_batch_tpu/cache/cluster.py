@@ -434,7 +434,9 @@ class ClusterEventRecorder:
     never stalls the scheduling loop (events are TTL'd diagnostics, not
     state)."""
 
-    _NORMAL_REASONS = frozenset({"Scheduled"})
+    # Matching the reference's event types: Scheduled AND Evict are
+    # Normal (cache.go:474,481); scheduling failures are Warning.
+    _NORMAL_REASONS = frozenset({"Scheduled", "Evict"})
 
     def __init__(self, cluster, maxlen: int = 10000):
         from collections import deque

@@ -138,11 +138,15 @@ class _Handler(BaseHTTPRequestHandler):
             if resource == "leases":
                 if len(rest) != 2:
                     return self._json(404, {"error": "lease key required"})
-                body = self._body()
                 try:
-                    version = self.cluster.cas_lease(
-                        rest[0], rest[1], body["record"],
-                        int(body["expectedVersion"]))
+                    body = self._body()
+                    record = body["record"]
+                    expected = int(body["expectedVersion"])
+                except (KeyError, TypeError, ValueError) as exc:
+                    return self._json(400, {"error": f"bad lease body: {exc}"})
+                try:
+                    version = self.cluster.cas_lease(rest[0], rest[1],
+                                                     record, expected)
                 except ValueError as exc:  # version conflict
                     return self._json(409, {"error": str(exc)})
                 return self._json(200, {"version": version})

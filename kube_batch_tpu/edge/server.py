@@ -21,10 +21,20 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..cache.cluster import Cluster
-from . import codec
+from . import codec, codec_k8s
 
 _RESOURCES = ("pods", "nodes", "podgroups", "queues", "priorityclasses",
               "pdbs", "pvcs", "events", "leases")
+
+# Kubernetes-convention paths (/api/v1/..., /apis/{group}/{version}/...)
+# map onto the same stores; responses/bodies on these paths use the k8s
+# wire codec (camelCase, kind/apiVersion — edge/codec_k8s.py).
+_K8S_RESOURCES = {
+    "pods": "pods", "nodes": "nodes", "events": "events",
+    "persistentvolumeclaims": "pvcs", "priorityclasses": "priorityclasses",
+    "poddisruptionbudgets": "pdbs", "podgroups": "podgroups",
+    "queues": "queues",
+}
 
 
 def _store_of(cluster: Cluster, resource: str):
@@ -65,17 +75,38 @@ class _Handler(BaseHTTPRequestHandler):
         return json.loads(self.rfile.read(length)) if length else None
 
     def _route(self):
+        """(resource, rest, query, k8s, ns).  Native paths are
+        /v1/{resource}/...; Kubernetes-convention paths are
+        /api/v1/[namespaces/{ns}/]{resource}/... and
+        /apis/{group}/{version}/[namespaces/{ns}/]{resource}/... —
+        the latter select the k8s wire codec for bodies and responses."""
         parsed = urlparse(self.path)
         parts = [p for p in parsed.path.split("/") if p]
         query = parse_qs(parsed.query)
-        if len(parts) < 2 or parts[0] != "v1" or parts[1] not in _RESOURCES:
-            return None, None, None
-        return parts[1], parts[2:], query
+        if len(parts) >= 2 and parts[0] == "v1" and parts[1] in _RESOURCES:
+            return parts[1], parts[2:], query, False, None
+        if parts and parts[0] in ("api", "apis"):
+            skip = 2 if parts[0] == "api" else 3
+            tail = parts[skip:]
+            ns = None
+            if len(tail) >= 2 and tail[0] == "namespaces":
+                ns, tail = tail[1], tail[2:]
+            if tail:
+                resource = _K8S_RESOURCES.get(tail[0])
+                if resource is not None:
+                    rest = tail[1:]
+                    if ns is not None and rest:
+                        # Internal convention is namespace-first; a bare
+                        # namespaced collection path (create/list) keeps
+                        # rest empty, with ns carried separately.
+                        rest = [ns] + rest
+                    return resource, rest, query, True, ns
+        return None, None, None, False, None
 
     # -- verbs -------------------------------------------------------------
 
     def do_GET(self):
-        resource, rest, query = self._route()
+        resource, rest, query, k8s, ns = self._route()
         if resource is None:
             return self._json(404, {"error": "not found"})
         if resource == "leases":
@@ -84,20 +115,41 @@ class _Handler(BaseHTTPRequestHandler):
             version, record = self.cluster.get_lease(rest[0], rest[1])
             return self._json(200, {"version": version, "record": record})
         if query.get("watch"):
-            return self._watch(resource)
-        with self.cluster.lock:
-            items = [codec.encode(o)
-                     for o in _store_of(self.cluster, resource).values()]
+            return self._watch(resource, k8s, ns)
+        enc = codec_k8s.to_k8s if k8s else codec.encode
+        single = None
+        with self.cluster.lock:  # encode under the lock, send outside it
+            store = _store_of(self.cluster, resource)
+            if rest:  # single-object GET
+                obj = (store.get("/".join(rest))
+                       if hasattr(store, "get") else None)
+                if obj is not None:
+                    single = enc(obj)
+            else:
+                items = [enc(o) for o in store.values()
+                         if ns is None or o.metadata.namespace == ns]
+        if rest:
+            if single is None:
+                return self._json(404, {"error": "not found"})
+            return self._json(200, single)
+        if k8s:
+            return self._json(200, {"apiVersion": "v1", "kind": "List",
+                                    "items": items})
         self._json(200, {"items": items})
 
     def do_POST(self):
-        resource, rest, _ = self._route()
+        resource, rest, _query, k8s, _ns = self._route()
         if resource is None:
             return self._json(404, {"error": "not found"})
-        if resource in ("pods", "pvcs") and len(rest) == 3 and rest[2] == "bind":
-            want = "node" if resource == "pods" else "volume"
+        if (resource in ("pods", "pvcs") and len(rest) == 3
+                and rest[2] in ("bind", "binding")):
             try:  # malformed body -> 400, distinct from store conflicts
-                target = self._body()[want]
+                body = self._body()
+                if rest[2] == "binding":  # k8s Binding subresource shape
+                    target = (body.get("target") or {})["name"]
+                else:
+                    target = body["node" if resource == "pods"
+                                  else "volume"]
             except (KeyError, ValueError, TypeError) as exc:
                 return self._json(400, {"error": f"bad bind body: {exc}"})
             try:
@@ -113,8 +165,13 @@ class _Handler(BaseHTTPRequestHandler):
         if resource == "leases":  # leases are PUT-CAS only
             return self._json(405, {"error": "create not supported"})
         try:
-            obj = codec.decode(self._body())
-        except (ValueError, KeyError) as exc:  # malformed JSON / unknown kind
+            raw = self._body()
+            if k8s and _ns is not None and isinstance(raw, dict):
+                # kubectl convention: the path supplies the namespace when
+                # the manifest omits it.
+                raw.setdefault("metadata", {}).setdefault("namespace", _ns)
+            obj = codec_k8s.decode_any(raw)
+        except (ValueError, KeyError, TypeError) as exc:
             return self._json(400, {"error": str(exc)})
         create = {"pods": self.cluster.create_pod,
                   "nodes": self.cluster.create_node,
@@ -131,7 +188,7 @@ class _Handler(BaseHTTPRequestHandler):
         return self._json(201, {"status": "created"})
 
     def do_PUT(self):
-        resource, rest, _ = self._route()
+        resource, rest, _query, k8s, _ns = self._route()
         if resource is None:
             return self._json(404, {"error": "not found"})
         try:
@@ -150,15 +207,24 @@ class _Handler(BaseHTTPRequestHandler):
                 except ValueError as exc:  # version conflict
                     return self._json(409, {"error": str(exc)})
                 return self._json(200, {"version": version})
-            obj = codec.decode(self._body())
+            raw = self._body()
+            if k8s and _ns is not None and isinstance(raw, dict):
+                raw.setdefault("metadata", {}).setdefault("namespace", _ns)
+            obj = codec_k8s.decode_any(raw)
             if resource == "podgroups" and rest and rest[-1] == "status":
                 self.cluster.put_pod_group_status(obj)
                 return self._json(200, {"status": "updated"})
             if (resource == "pods" and len(rest) == 3
                     and rest[2] == "status"):
-                # Pod status subresource: a PodCondition upsert
+                # Pod status subresource: a PodCondition upsert (native)
+                # or a full k8s Pod whose conditions are applied
                 # (cache.go:548-568 taskUnschedulable writeback).
-                self.cluster.update_pod_condition(rest[0], rest[1], obj)
+                from ..api.objects import Pod
+                conds = (obj.status.conditions if isinstance(obj, Pod)
+                         else [obj])
+                for cond in conds:
+                    self.cluster.update_pod_condition(rest[0], rest[1],
+                                                      cond)
                 return self._json(200, {"status": "updated"})
             update = {"pods": self.cluster.update_pod,
                       "nodes": self.cluster.update_node,
@@ -169,11 +235,11 @@ class _Handler(BaseHTTPRequestHandler):
             return self._json(200, {"status": "updated"})
         except KeyError as exc:
             return self._json(404, {"error": str(exc)})
-        except ValueError as exc:  # malformed JSON / unknown kind
+        except (ValueError, TypeError) as exc:  # malformed/missing body
             return self._json(400, {"error": str(exc)})
 
     def do_DELETE(self):
-        resource, rest, _ = self._route()
+        resource, rest, _query, _k8s, _ns = self._route()
         if resource is None or not rest:
             return self._json(404, {"error": "not found"})
         try:
@@ -195,20 +261,31 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- watch -------------------------------------------------------------
 
-    def _watch(self, resource: str) -> None:
+    def _watch(self, resource: str, k8s: bool = False,
+               ns: "str | None" = None) -> None:
         informer = _informer_of(self.cluster, resource)
         if informer is None:
             return self._json(405, {"error": f"{resource} not watchable"})
+        enc = codec_k8s.to_k8s if k8s else codec.encode
+
+        def in_scope(obj) -> bool:
+            # Namespaced watch paths scope server-side, matching the
+            # corresponding LIST (the k8s list+watch contract).
+            return ns is None or obj.metadata.namespace == ns
+
         events: "queue.Queue" = queue.Queue()
         handle = None
         # Register BEFORE snapshotting, under the store lock, so no event
         # can fall between the initial list and the live stream.
         with self.cluster.lock:
             handle = informer.add_handlers(
-                on_add=lambda o: events.put(("ADDED", o)),
-                on_update=lambda old, new: events.put(("MODIFIED", new)),
-                on_delete=lambda o: events.put(("DELETED", o)))
-            initial = list(_store_of(self.cluster, resource).values())
+                on_add=lambda o: in_scope(o) and events.put(("ADDED", o)),
+                on_update=lambda old, new: in_scope(new)
+                and events.put(("MODIFIED", new)),
+                on_delete=lambda o: in_scope(o)
+                and events.put(("DELETED", o)))
+            initial = [o for o in _store_of(self.cluster, resource).values()
+                       if in_scope(o)]
 
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
@@ -218,7 +295,7 @@ class _Handler(BaseHTTPRequestHandler):
         def emit(etype, obj):
             line = json.dumps(
                 {"type": etype,
-                 "object": codec.encode(obj) if obj is not None else None}
+                 "object": enc(obj) if obj is not None else None}
             ).encode() + b"\n"
             self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
             self.wfile.flush()

@@ -14,6 +14,8 @@ from __future__ import annotations
 import re
 from typing import Dict, Iterable, Optional
 
+import numpy as _np
+
 # Epsilons under which two quantities are considered equal / a quantity is
 # considered zero (reference resource_info.go:68-70).
 MIN_MILLI_CPU = 10.0
@@ -324,7 +326,17 @@ def minimum(l: Resource, r: Resource) -> Resource:
 
 
 def share(l: float, r: float) -> float:
-    """Allocated/total with 0/0 -> 0 and x/0 -> 1 (helpers.go:47-59)."""
+    """Allocated/total with 0/0 -> 0 and x/0 -> 1 (helpers.go:47-59).
+
+    Computed as a correctly-rounded float32 division of float32-rounded
+    operands — the ONE operation every engine (host plugins, XLA solver,
+    Pallas kernel, sharded solver; with and without jax_enable_x64) can
+    reproduce bit-for-bit, so share-ordered decisions are identical on
+    every path.  Deviation from the reference's float64: shares within
+    ~2^-24 relative tie and fall to the deterministic creation-time/uid
+    tie-break slightly more often; resource quanta are power-of-two
+    scalings of the raw values, so host bytes and device quanta round to
+    the same float32 mantissa and the quotients agree exactly."""
     if r == 0:
         return 0.0 if l == 0 else 1.0
-    return l / r
+    return float(_np.float32(l) / _np.float32(r))

@@ -87,6 +87,20 @@ class Informer:
         self._fire("delete", obj)
 
 
+class _RvClock:
+    """next()-compatible resource-version source: max(prev+1, now_µs)."""
+
+    def __init__(self):
+        self._last = 0
+
+    def __next__(self) -> int:
+        self._last = max(self._last + 1, int(time.time() * 1e6))
+        return self._last
+
+    def __iter__(self):
+        return self
+
+
 class Cluster:
     """In-memory object store + informers; the simulated API server."""
 
@@ -115,7 +129,14 @@ class Cluster:
         self.leases: Dict[str, tuple] = {}
         # Kubelet stand-in: a bound pod starts Running immediately.
         self.auto_run_bound_pods = auto_run_bound_pods
-        self._rv = itertools.count(1)
+        # Resource-version clock (lease CAS versions, watch-resume rvs):
+        # strictly increasing AND never behind the wall clock in µs, so
+        # versions stay monotonic across a full process restart — a
+        # client resuming against a REBUILT cluster falls below the new
+        # watch watermark (410 -> relist), never silently "resumes"
+        # (etcd revisions give real apiservers the same property; only a
+        # sustained >1M events/s burst could outrun this clock).
+        self._rv = _RvClock()
 
     # -- helpers ------------------------------------------------------------
 

@@ -124,16 +124,22 @@ class RemoteCluster:
 
     def _reflect(self, resource: str) -> None:
         """One reflector: stream watch events into the mirror + informer.
-        Every (re)connect replays the server's current state as ADDED
-        events ending in SYNC; objects deleted during a disconnect are
-        reconciled out of the mirror at that point (client-go's relist)."""
+        A fresh connect replays the server's current state as ADDED
+        events ending in SYNC (objects deleted during a disconnect are
+        reconciled out of the mirror then — client-go's relist).  A
+        RECONNECT resumes from the last seen resourceVersion: the server
+        replays only the missed delta (RESUMED frame, no reconciliation),
+        or answers ERROR 410 when the client fell past its event buffer,
+        forcing a full relist — the k8s list+watch contract."""
         store = self._store(resource)
         informer = self._informer(resource)
         key_of = _key_fn(resource)
-        url = f"{self.base_url}/v1/{resource}?watch=1"
+        base = f"{self.base_url}/v1/{resource}?watch=1"
+        last_rv = 0
         while not self._stop.is_set():
             replay_seen = set()
             replaying = True
+            url = (f"{base}&resourceVersion={last_rv}" if last_rv else base)
             try:
                 # Read timeout >> the server's 5s keep-alive ping: a
                 # half-open connection surfaces as socket.timeout (OSError)
@@ -144,6 +150,8 @@ class RemoteCluster:
                             return
                         event = json.loads(raw)
                         etype = event["type"]
+                        if "rv" in event and event["rv"] is not None:
+                            last_rv = max(last_rv, int(event["rv"]))
                         if etype == "SYNC":
                             with self.lock:
                                 for stale in [k for k in store
@@ -152,6 +160,16 @@ class RemoteCluster:
                             replaying = False
                             self._synced[resource].set()
                             continue
+                        if etype == "RESUMED":
+                            # Continuous delta stream: mirror is already
+                            # current, no reconciliation needed.
+                            replaying = False
+                            self._synced[resource].set()
+                            continue
+                        if etype == "ERROR":
+                            # 410 Gone: fall back to a full relist.
+                            last_rv = 0
+                            break
                         if etype == "PING":
                             continue
                         obj = codec.decode(event["object"])

@@ -56,6 +56,7 @@ def _informer_of(cluster: Cluster, resource: str):
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
     cluster: Cluster = None  # set by ApiServer subclassing
+    history = None           # _EventHistory, set by ApiServer subclassing
 
     def log_message(self, *args):  # quiet; the scheduler has its own logs
         pass
@@ -115,7 +116,14 @@ class _Handler(BaseHTTPRequestHandler):
             version, record = self.cluster.get_lease(rest[0], rest[1])
             return self._json(200, {"version": version, "record": record})
         if query.get("watch"):
-            return self._watch(resource, k8s, ns)
+            since = None
+            if query.get("resourceVersion"):
+                try:
+                    since = int(query["resourceVersion"][0])
+                except ValueError:
+                    return self._json(400,
+                                      {"error": "bad resourceVersion"})
+            return self._watch(resource, k8s, ns, since)
         enc = codec_k8s.to_k8s if k8s else codec.encode
         single = None
         with self.cluster.lock:  # encode under the lock, send outside it
@@ -262,59 +270,164 @@ class _Handler(BaseHTTPRequestHandler):
     # -- watch -------------------------------------------------------------
 
     def _watch(self, resource: str, k8s: bool = False,
-               ns: "str | None" = None) -> None:
+               ns: "str | None" = None, since: "int | None" = None) -> None:
         informer = _informer_of(self.cluster, resource)
         if informer is None:
             return self._json(405, {"error": f"{resource} not watchable"})
         enc = codec_k8s.to_k8s if k8s else codec.encode
+        history = self.history
 
         def in_scope(obj) -> bool:
             # Namespaced watch paths scope server-side, matching the
             # corresponding LIST (the k8s list+watch contract).
             return ns is None or obj.metadata.namespace == ns
 
+        def last_rv() -> "int | None":
+            # The per-connection handler runs right after the history
+            # handler (registered first, same cluster lock), so the
+            # buffer tail IS this event's rv.
+            if history is None:
+                return None
+            buf = history.buffers.get(resource)
+            return buf[-1][0] if buf else None
+
         events: "queue.Queue" = queue.Queue()
         handle = None
+        initial: list = []
+        list_rv = None
         # Register BEFORE snapshotting, under the store lock, so no event
         # can fall between the initial list and the live stream.
         with self.cluster.lock:
             handle = informer.add_handlers(
-                on_add=lambda o: in_scope(o) and events.put(("ADDED", o)),
+                on_add=lambda o: in_scope(o)
+                and events.put(("ADDED", o, last_rv())),
                 on_update=lambda old, new: in_scope(new)
-                and events.put(("MODIFIED", new)),
+                and events.put(("MODIFIED", new, last_rv())),
                 on_delete=lambda o: in_scope(o)
-                and events.put(("DELETED", o)))
-            initial = [o for o in _store_of(self.cluster, resource).values()
-                       if in_scope(o)]
+                and events.put(("DELETED", o, last_rv())))
+            pending = (history.since(resource, since)
+                       if since is not None and history is not None
+                       else None)
+            resumed = since is not None and pending is not None
+            gone = since is not None and history is not None \
+                and pending is None
+            if not resumed and not gone:  # the 410 path needs no snapshot
+                initial = [o for o in
+                           _store_of(self.cluster, resource).values()
+                           if in_scope(o)]
+                list_rv = (history.current_rv()
+                           if history is not None else None)
 
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
 
-        def emit(etype, obj):
-            line = json.dumps(
-                {"type": etype,
-                 "object": enc(obj) if obj is not None else None}
-            ).encode() + b"\n"
+        def emit(etype, obj, rv=None, raw=None):
+            frame = {"type": etype,
+                     "object": (raw if raw is not None
+                                else enc(obj) if obj is not None else None)}
+            if rv is not None:
+                frame["rv"] = rv
+            line = json.dumps(frame).encode() + b"\n"
             self.wfile.write(f"{len(line):x}\r\n".encode() + line + b"\r\n")
             self.wfile.flush()
 
         try:
-            for obj in initial:
-                emit("ADDED", obj)
-            emit("SYNC", None)
+            if gone:
+                # The client fell past the event buffer: k8s 410 Gone
+                # semantics — relist (reconnect without resourceVersion).
+                emit("ERROR", None, raw={"kind": "Status", "code": 410,
+                                         "reason": "Expired"})
+                return
+            if resumed:
+                # Delta resume: no ADDED replay, no SYNC reconciliation.
+                emit("RESUMED", None)
+                for rv, etype, obj in pending:
+                    if in_scope(obj):
+                        emit(etype, obj, rv)
+            else:
+                for obj in initial:
+                    emit("ADDED", obj)
+                emit("SYNC", None, rv=list_rv)
             while True:
                 try:
-                    etype, obj = events.get(timeout=5.0)
+                    etype, obj, rv = events.get(timeout=5.0)
                 except queue.Empty:
                     emit("PING", None)  # keep-alive; detects dead peers
                     continue
-                emit(etype, obj)
+                emit(etype, obj, rv)
         except (BrokenPipeError, ConnectionResetError, OSError):
             pass
         finally:
             informer.remove_handlers(handle)
+
+
+class _EventHistory:
+    """Per-resource ring buffer of (rv, type, object) change events, the
+    backing store for resourceVersion watch resume (k8s list+watch
+    contract: a reconnecting client replays only the delta, or gets 410
+    Gone and relists when it has fallen past the buffer)."""
+
+    def __init__(self, cluster: Cluster, maxlen: int = 8192):
+        from collections import deque
+        self.cluster = cluster
+        self.maxlen = maxlen
+        self.buffers: dict = {}
+        # Watermark: the highest rv NOT covered by a resource's buffer —
+        # events at or below it were never recorded (before this history
+        # existed, e.g. a server restart) or have been evicted.  A client
+        # may resume iff its rv >= watermark.
+        self.start_rv = next(cluster._rv)
+        self.watermark: dict = {}
+        self._registrations: list = []
+        for resource in _RESOURCES:
+            informer = _informer_of(cluster, resource)
+            if informer is None:
+                continue
+            buf = deque(maxlen=maxlen)
+            self.buffers[resource] = buf
+            self.watermark[resource] = self.start_rv
+
+            def _rec(buf, resource):  # bind per resource
+                def record(etype):
+                    def fire(*args):
+                        if len(buf) == self.maxlen:  # about to evict
+                            self.watermark[resource] = buf[0][0]
+                        buf.append((next(cluster._rv), etype, args[-1]))
+                    return fire
+                return (record("ADDED"), record("MODIFIED"),
+                        record("DELETED"))
+
+            on_add, on_update, on_delete = _rec(buf, resource)
+            handle = informer.add_handlers(on_add=on_add,
+                                           on_update=on_update,
+                                           on_delete=on_delete)
+            self._registrations.append((informer, handle))
+
+    def close(self) -> None:
+        """Unregister from the cluster's informers (a stopped server must
+        not keep recording — or pinning objects — for the cluster's
+        lifetime)."""
+        for informer, handle in self._registrations:
+            informer.remove_handlers(handle)
+        self._registrations.clear()
+
+    def current_rv(self) -> int:
+        """The rv a fresh LIST/replay corresponds to: everything up to
+        the newest recorded event (or history birth when quiet)."""
+        return max((buf[-1][0] for buf in self.buffers.values() if buf),
+                   default=self.start_rv)
+
+    def since(self, resource: str, rv: int):
+        """Events with rv > given, or None when continuity can't be
+        proven (client must relist — 410 Gone).  A client of a PREVIOUS
+        server instance resumes with an rv below this history's
+        watermark and correctly falls into the relist path."""
+        buf = self.buffers.get(resource)
+        if buf is None or rv < self.watermark.get(resource, 0):
+            return None
+        return [e for e in buf if e[0] > rv]
 
 
 class ApiServer:
@@ -323,7 +436,10 @@ class ApiServer:
     def __init__(self, cluster: Cluster, host: str = "127.0.0.1",
                  port: int = 0):
         self.cluster = cluster
-        handler = type("BoundHandler", (_Handler,), {"cluster": cluster})
+        with cluster.lock:
+            self._history = _EventHistory(cluster)
+        handler = type("BoundHandler", (_Handler,),
+                       {"cluster": cluster, "history": self._history})
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._httpd.daemon_threads = True
         self._thread = None
@@ -340,6 +456,7 @@ class ApiServer:
         return self
 
     def stop(self) -> None:
+        self._history.close()
         self._httpd.shutdown()
         self._httpd.server_close()
 

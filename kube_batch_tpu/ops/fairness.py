@@ -17,11 +17,20 @@ from .resources import EPS_VEC_FN, is_empty_vec, less_vec, scalar_dims_mask
 def safe_share(alloc: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:
     """share() semantics per element: x/0 -> 1 (0/0 -> 0)
     (reference api/helpers/helpers.go:47-59).  Accepts int32 quanta (the
-    solver's exact fixed-point state): true division promotes to float, and
-    power-of-two quantization keeps the ratio equal to the unscaled one."""
+    solver's exact fixed-point state).
+
+    The division is ALWAYS float32 of float32-cast operands, matching
+    api.resource.share on the host bit-for-bit (see its docstring): a
+    share near-tie must resolve identically on the host plugins and on
+    every device engine in both x64 modes, or job/queue order — and with
+    it placements — diverges (fuzz seed 1088)."""
+    f32 = jnp.float32
+    alloc = alloc.astype(f32)
+    total = total.astype(f32)
     zero_total = total == 0
-    return jnp.where(zero_total, jnp.where(alloc == 0, 0.0, 1.0),
-                     alloc / jnp.where(zero_total, 1, total))
+    return jnp.where(zero_total,
+                     jnp.where(alloc == 0, f32(0.0), f32(1.0)),
+                     alloc / jnp.where(zero_total, f32(1), total))
 
 
 def drf_shares(job_alloc: jnp.ndarray, total: jnp.ndarray) -> jnp.ndarray:

@@ -47,6 +47,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .fairness import safe_share
 from .resources import EPS_QUANTA, SCORE_GRID_K
 from .scoring import SCORE_NEG_INF
 from .solver import SolveResult, SolverConfig, SolverInputs
@@ -122,26 +123,23 @@ def _solve_kernel(r: int, np_pad: int, ns_pad: int, cfg: SolverConfig,
     def queue_share_row():
         """[1, Q] proportion shares: max_r safe_share(alloc_r, deserved_r)
         over the UNrounded float deserved rows (the int rows serve only the
-        epsilon overused compare; rounding would flip near-tied shares)."""
-        share = jnp.zeros((1, qdim), dtype)
+        epsilon overused compare; rounding would flip near-tied shares).
+        The ONE share implementation (ops.fairness.safe_share — float32 of
+        float32 operands on every engine) runs on values loaded from the
+        refs, so near-tie ordering matches the host and the XLA paths
+        exactly."""
+        share = jnp.zeros((1, qdim), jnp.float32)
         for i in range(r):
-            alloc = qdyn_ref[i:i + 1, :]
-            des = qsta_ref[QDESF + i:QDESF + i + 1, :]
-            s = jnp.where(des == 0, jnp.where(alloc == 0, 0.0, 1.0),
-                          alloc.astype(dtype)
-                          / jnp.where(des == 0, 1.0, des))
-            share = jnp.maximum(share, s)
-        return share
+            share = jnp.maximum(share, safe_share(
+                qdyn_ref[i:i + 1, :], qsta_ref[QDESF + i:QDESF + i + 1, :]))
+        return share.astype(dtype)
 
     def drf_share_row():
-        share = jnp.zeros((1, jdim), dtype)
+        share = jnp.zeros((1, jdim), jnp.float32)
         for i in range(r):
-            alloc = jdyn_ref[i:i + 1, :]
-            t = total_ref[0, i]
-            s = jnp.where(t == 0, jnp.where(alloc == 0, 0.0, 1.0),
-                          alloc.astype(dtype) / jnp.where(t == 0, 1.0, t))
-            share = jnp.maximum(share, s)
-        return share
+            share = jnp.maximum(share, safe_share(jdyn_ref[i:i + 1, :],
+                                                  total_ref[0, i]))
+        return share.astype(dtype)
 
     def outer_body(carry):
         _, step = carry

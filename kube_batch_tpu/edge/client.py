@@ -150,8 +150,12 @@ class RemoteCluster:
                             return
                         event = json.loads(raw)
                         etype = event["type"]
-                        if "rv" in event and event["rv"] is not None:
-                            last_rv = max(last_rv, int(event["rv"]))
+                        # NOTE: last_rv advances only AFTER a frame is
+                        # fully applied — advancing first would make a
+                        # frame that fails to decode/apply permanently
+                        # invisible to the resume path (no relist ever
+                        # heals it).
+                        frame_rv = event.get("rv")
                         if etype == "SYNC":
                             with self.lock:
                                 for stale in [k for k in store
@@ -159,6 +163,8 @@ class RemoteCluster:
                                     informer.fire_delete(store.pop(stale))
                             replaying = False
                             self._synced[resource].set()
+                            if frame_rv is not None:
+                                last_rv = max(last_rv, int(frame_rv))
                             continue
                         if etype == "RESUMED":
                             # Continuous delta stream: mirror is already
@@ -194,6 +200,8 @@ class RemoteCluster:
                             elif etype == "DELETED":
                                 store.pop(key, None)
                                 informer.fire_delete(obj)
+                        if frame_rv is not None:  # applied successfully
+                            last_rv = max(last_rv, int(frame_rv))
             except (OSError, http.client.HTTPException, ValueError):
                 # Connection loss (incl. IncompleteRead mid-chunk) or a
                 # malformed frame: reconnect and relist.

@@ -6,6 +6,7 @@ another process.  These tests run the ApiServer in-process but talk to it
 exclusively through its HTTP surface.
 """
 
+import json
 import time
 
 import pytest
@@ -334,7 +335,7 @@ class TestWatchResume:
     def _read_frames(self, resp, until_types, limit=50):
         frames = []
         for raw in resp:
-            frame = __import__("json").loads(raw)
+            frame = json.loads(raw)
             frames.append(frame)
             if frame["type"] in until_types or len(frames) >= limit:
                 break

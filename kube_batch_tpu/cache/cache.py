@@ -97,6 +97,14 @@ class SchedulerCache(Cache):
         self._pooled_jobs: Dict[str, tuple] = {}   # uid -> (epoch, clone)
         self._pooled_nodes: Dict[str, tuple] = {}  # name -> (epoch, clone)
 
+        # Leadership write fence.  The reference fences by exiting the
+        # process on lost lease (server.go:135-137); here an in-flight
+        # run_once would otherwise finish its cycle and could still
+        # bind/evict after a standby acquired the lease.  When set (by
+        # ServerRuntime under leader election) every cluster write checks
+        # it first and refuses once leadership is gone.
+        self.write_fence = None  # Optional[Callable[[], bool]]
+
     # ------------------------------------------------------------------
     # epoch stamping + clone pool
 
@@ -431,11 +439,21 @@ class SchedulerCache(Cache):
     # ------------------------------------------------------------------
     # effectors (cache.go:425-535)
 
+    def _fence_lost(self) -> bool:
+        return self.write_fence is not None and not self.write_fence()
+
+    def _check_write_fence(self) -> None:
+        if self._fence_lost():
+            raise RuntimeError(
+                "leadership lost: refusing cluster write (a standby may "
+                "already be leading)")
+
     def bind(self, task: TaskInfo, hostname: str) -> None:
         """Delegate to the Binder; revert task status and queue a resync on
         failure (cache.go:491-535)."""
         if self.binder is None:
             raise RuntimeError("no binder configured")
+        self._check_write_fence()
         try:
             self.binder.bind(task.pod, hostname)
             self.events.append(("Scheduled", pod_key(task.pod), hostname))
@@ -449,6 +467,7 @@ class SchedulerCache(Cache):
         per-bind goroutines give the same isolation)."""
         if self.binder is None:
             raise RuntimeError("no binder configured")
+        self._check_write_fence()
         failures = self.binder.bind_many(
             [(t.pod, t.node_name) for t in tasks])
         failed_uids = set()
@@ -465,6 +484,7 @@ class SchedulerCache(Cache):
         """Delegate to the Evictor (cache.go:425-488)."""
         if self.evictor is None:
             raise RuntimeError("no evictor configured")
+        self._check_write_fence()
         job = self.jobs.get(task.job)
         try:
             self.evictor.evict(task.pod)
@@ -512,6 +532,10 @@ class SchedulerCache(Cache):
     def update_job_status(self, job: JobInfo) -> JobInfo:
         """Push PodGroup status to the cluster (cache.go:763-775)."""
         try:
+            # Fence check inside the try: a lost lease refuses the cluster
+            # write but the finally still records the (local, fence-aware)
+            # events — they must survive a failed status write.
+            self._check_write_fence()
             if self.status_updater is not None and not shadow_pod_group(job.pod_group):
                 self.status_updater.update_pod_group(job.pod_group)
         finally:
@@ -545,16 +569,22 @@ class SchedulerCache(Cache):
 
     def allocate_volumes(self, task: TaskInfo, hostname: str) -> None:
         if self.volume_binder is not None:
+            self._check_write_fence()
             self.volume_binder.allocate_volumes(task, hostname)
 
     def bind_volumes(self, task: TaskInfo) -> None:
         if self.volume_binder is not None:
+            self._check_write_fence()
             self.volume_binder.bind_volumes(task)
 
     def task_unschedulable(self, task: TaskInfo, message: str) -> None:
         """Record the pod condition for an unschedulable task
-        (cache.go:548-568)."""
-        if self.status_updater is not None:
+        (cache.go:548-568).
+
+        Never raises: callers (record_job_status_event → close_session)
+        treat it as non-failing, so a lost fence skips only the cluster
+        write — the local event still records."""
+        if self.status_updater is not None and not self._fence_lost():
             self.status_updater.update_pod_condition(
                 task.pod, ("PodScheduled", "False", "Unschedulable", message))
         self.events.append(("FailedScheduling", pod_key(task.pod), message))

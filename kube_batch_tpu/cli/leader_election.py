@@ -15,6 +15,7 @@ kills the scheduling loop and a standby takes over.  Two lock backends:
 
 from __future__ import annotations
 
+import fcntl  # FileLock is Unix-only; fail at import, not silently in cas()
 import json
 import os
 import socket
@@ -31,28 +32,60 @@ LOCK_NAME = "kube-batch-lock"
 
 
 class FileLock:
-    """Lock record in a file; atomic-replace writes (no CAS — last writer
-    wins, adequate for the shared-filesystem deployment it serves)."""
+    """Lock record in a file with true compare-and-swap semantics.
+
+    A version counter is stored inside the record; ``cas`` serializes the
+    re-read/compare/replace under an ``fcntl.flock`` on a sidecar file, so
+    two standbys that both observed an expired lease cannot both "acquire"
+    it (the loser sees the bumped version and fails).  flock is released by
+    the kernel when the holder dies — a crashed process cannot wedge the
+    mutex, and there is no stale-break heuristic to race on.
+
+    CAUTION: flock coherence is per-host on common network filesystems
+    (NFS with local_lock, SMB) — contenders on DIFFERENT hosts may each
+    take a host-local flock and race the read/compare/replace.  FileLock
+    is therefore for same-host multi-process deployments (or a
+    flock-coherent shared FS); multi-host HA must use StoreLock, whose
+    CAS is serialized by the store itself."""
 
     def __init__(self, path: str):
         self.path = path
+        self._sidecar = f"{path}.mutex"
 
-    def get(self):
+    def _read(self):
         try:
             with open(self.path) as f:
-                return 0, json.load(f)
+                record = json.load(f)
+            return int(record.get("version", 0)), record
         except (OSError, ValueError):
             return 0, None
 
+    def get(self):
+        return self._read()
+
     def cas(self, record: dict, expected_version: int) -> bool:
-        tmp = f"{self.path}.{os.getpid()}.tmp"
         try:
+            fd = os.open(self._sidecar, os.O_CREAT | os.O_RDWR)
+        except OSError:
+            return False
+        try:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            except OSError:
+                return False  # another contender is mid-CAS
+            current_version, _ = self._read()
+            if current_version != expected_version:
+                return False
+            record = dict(record, version=expected_version + 1)
+            tmp = f"{self.path}.{os.getpid()}.tmp"
             with open(tmp, "w") as f:
                 json.dump(record, f)
             os.replace(tmp, self.path)
             return True
         except OSError:
             return False
+        finally:
+            os.close(fd)  # releases the flock
 
 
 class StoreLock:
@@ -104,6 +137,17 @@ class LeaderElector:
         self.on_stopped_leading = on_stopped_leading
         self._stop = threading.Event()
         self.is_leader = False
+        self.last_renew = 0.0
+
+    def has_live_lease(self) -> bool:
+        """True while this elector holds a lease it has renewed within
+        renew_deadline.  Unlike ``is_leader`` (flipped by the elector
+        *thread*, which may not have run yet after a long process pause),
+        this is wall-clock-based: a zombie that slept past its deadline is
+        fenced immediately, regardless of thread scheduling."""
+        return (self.is_leader
+                and time.time() - self.last_renew
+                < self.config.renew_deadline)
 
     def try_acquire_or_renew(self) -> bool:
         try:
@@ -137,19 +181,19 @@ class LeaderElector:
             self._stop.wait(self.config.retry_period)
         if self._stop.is_set():
             return
+        self.last_renew = time.time()
         self.is_leader = True
         self.on_started_leading()
         # client-go renewal semantics: retry every retry_period; abdicate
         # only after renew_deadline of CONTINUOUS failure — one transient
         # store hiccup must not fail over a healthy leader.
-        last_renew = time.time()
         while not self._stop.is_set():
             self._stop.wait(self.config.retry_period)
             if self._stop.is_set():
                 break
             if self.try_acquire_or_renew():
-                last_renew = time.time()
-            elif time.time() - last_renew > self.config.renew_deadline:
+                self.last_renew = time.time()
+            elif time.time() - self.last_renew > self.config.renew_deadline:
                 self.is_leader = False
                 self.on_stopped_leading()
                 return

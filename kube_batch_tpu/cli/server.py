@@ -152,6 +152,14 @@ class ServerRuntime:
                 on_started_leading=self.scheduler.run,
                 on_stopped_leading=self.scheduler.stop,
                 lock=lock)
+            # Write fence: scheduler.stop() only signals the loop; an
+            # in-flight cycle would still bind/evict after a standby took
+            # the lease.  The cache refuses cluster writes the moment the
+            # lease is stale — wall-clock-based (has_live_lease), so a
+            # process pause past the deadline fences even before the
+            # elector thread wakes (the reference fences by process exit,
+            # server.go:135-137).
+            self.cache.write_fence = self.elector.has_live_lease
             threading.Thread(target=self.elector.run, daemon=True).start()
         else:
             self.scheduler.run()

@@ -60,18 +60,34 @@ def load_scheduler_conf(conf_str: str) -> Tuple[List[Action], List[Tier]]:
 
 
 def _mini_yaml(conf_str: str) -> dict:
-    """Tiny parser for the conf subset (actions + tiers/plugins/name)."""
+    """Tiny parser for the conf subset (actions + tiers/plugins/name).
+
+    Only the default conf shape is representable without PyYAML.  Any other
+    construct (``arguments:``, ``enabled*`` flags, nested maps...) would
+    silently degrade to bare plugin names — a scheduler quietly running a
+    different policy than configured — so anything unrecognized raises
+    instead (the reference always has yaml.v2; this fallback must never be
+    *less* strict than it)."""
     data: dict = {"actions": "", "tiers": []}
     tier = None
     for raw in conf_str.splitlines():
         line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
         if line.startswith("actions:"):
             data["actions"] = line.split(":", 1)[1].strip().strip('"')
+        elif line == "tiers:":
+            continue
         elif line.startswith("- plugins:"):
             tier = {"plugins": []}
             data["tiers"].append(tier)
         elif line.startswith("- name:") and tier is not None:
             tier["plugins"].append({"name": line.split(":", 1)[1].strip()})
+        else:
+            raise ValueError(
+                "scheduler conf uses constructs beyond the default shape "
+                f"(line {raw!r}); install PyYAML to parse it — refusing to "
+                "silently drop configuration")
     return data
 
 

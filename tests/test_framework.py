@@ -68,6 +68,38 @@ tiers:
         with pytest.raises(KeyError):
             load_scheduler_conf('actions: "nope"\n')
 
+    def test_mini_yaml_rejects_rich_conf(self):
+        # Without PyYAML a conf using arguments:/enabled* must error, not
+        # silently degrade to bare plugin names (different policy than
+        # configured).
+        from kube_batch_tpu.scheduler import _mini_yaml
+        rich = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: nodeorder
+    arguments:
+      leastrequested.weight: "2"
+"""
+        with pytest.raises(ValueError):
+            _mini_yaml(rich)
+        flagged = """
+actions: "allocate"
+tiers:
+- plugins:
+  - name: gang
+    enableJobOrder: false
+"""
+        with pytest.raises(ValueError):
+            _mini_yaml(flagged)
+
+    def test_mini_yaml_parses_default_shape(self):
+        from kube_batch_tpu.scheduler import DEFAULT_SCHEDULER_CONF, _mini_yaml
+        data = _mini_yaml(DEFAULT_SCHEDULER_CONF)
+        assert data["actions"] == "tpu-allocate, backfill"
+        assert [p["name"] for t in data["tiers"] for p in t["plugins"]] == [
+            "priority", "gang", "drf", "predicates", "proportion", "nodeorder"]
+
 
 class TestPriorityQueue:
     def test_order(self):

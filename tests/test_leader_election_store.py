@@ -65,6 +65,87 @@ class TestStoreLock:
         assert events[0] == "a-up" and "b-up" in events
 
 
+class TestFileLock:
+    def test_cas_conflict_rejected(self, tmp_path):
+        from kube_batch_tpu.cli.leader_election import FileLock
+        path = str(tmp_path / "lock.json")
+        # Two standbys both read version 0 of an absent/expired lease.
+        a, b = FileLock(path), FileLock(path)
+        va, _ = a.get()
+        vb, _ = b.get()
+        assert va == vb == 0
+        assert a.cas({"holderIdentity": "a"}, va)
+        # b's CAS against the stale version must LOSE (the r3 file backend
+        # was last-writer-wins here: both would have become leader).
+        assert not b.cas({"holderIdentity": "b"}, vb)
+        v1, rec = b.get()
+        assert rec["holderIdentity"] == "a"
+        assert b.cas({"holderIdentity": "b"}, v1)
+        assert b.get()[1]["holderIdentity"] == "b"
+
+    def test_crashed_holder_cannot_wedge_mutex(self, tmp_path):
+        """flock is kernel-released on process death: a contender killed
+        -9 mid-CAS must not block later acquisitions."""
+        import signal
+        import subprocess
+        import sys
+        from kube_batch_tpu.cli.leader_election import FileLock
+        path = str(tmp_path / "lock.json")
+        lock = FileLock(path)
+        # A child takes the sidecar flock and hangs (a crash mid-CAS).
+        child = subprocess.Popen(
+            [sys.executable, "-c",
+             "import fcntl, os, sys, time\n"
+             f"fd = os.open({lock._sidecar!r}, os.O_CREAT | os.O_RDWR)\n"
+             "fcntl.flock(fd, fcntl.LOCK_EX)\n"
+             "print('held', flush=True)\n"
+             "time.sleep(60)\n"],
+            stdout=subprocess.PIPE)
+        try:
+            assert child.stdout.readline().strip() == b"held"
+            v, _ = lock.get()
+            assert not lock.cas({"holderIdentity": "a"}, v)  # child holds it
+            child.send_signal(signal.SIGKILL)
+            child.wait()
+            assert lock.cas({"holderIdentity": "a"}, v)  # kernel released it
+            assert lock.get()[1]["holderIdentity"] == "a"
+        finally:
+            child.kill()
+            child.wait()
+
+
+class TestWriteFence:
+    def test_cache_refuses_writes_after_leadership_loss(self):
+        """ADVICE r3 #3: an in-flight cycle must not bind/evict once the
+        lease is gone (the reference fences by process exit)."""
+        from kube_batch_tpu.cache import new_scheduler_cache
+        cluster = Cluster()
+        cluster.create_node(build_node("n0", build_resource_list(
+            "8", "16Gi", pods=110)))
+        cluster.create_pod(build_pod("ns", "p0", "", "Pending",
+                                     build_resource_list("1", "1Gi")))
+        cache = new_scheduler_cache(cluster)
+        cache.run()
+        cache.wait_for_cache_sync()
+        leading = [True]
+        cache.write_fence = lambda: leading[0]
+        task = next(iter(next(iter(cache.jobs.values())).tasks.values()))
+        leading[0] = False
+        with pytest.raises(RuntimeError, match="leadership lost"):
+            cache.bind(task, "n0")
+        with pytest.raises(RuntimeError, match="leadership lost"):
+            cache.evict(task, "test")
+        with pytest.raises(RuntimeError, match="leadership lost"):
+            cache.bind_batch([task])
+        with pytest.raises(RuntimeError, match="leadership lost"):
+            cache.update_job_status(next(iter(cache.jobs.values())))
+        # Writes resume when leading again.
+        leading[0] = True
+        cache.bind(task, "n0")
+        with cluster.lock:
+            assert cluster.pods["ns/p0"].spec.node_name == "n0"
+
+
 class TestFailoverOverTheEdge:
     def test_standby_runtime_takes_over_and_zombie_stops(self):
         cluster = Cluster()

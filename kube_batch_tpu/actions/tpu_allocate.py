@@ -104,9 +104,12 @@ class TpuAllocateAction(Action):
         final candidate task was pipelined (kind 2) and actually applied;
         the node idle is reconstructed AT THE RECORD POINT by adding back
         allocations that landed on the node later in solve order.
-        (Corner divergence: the host breaks the job loop at the first
-        no-candidate task, so a job whose last task pipelined after such
-        a break keeps no delta there; diagnostics only.)"""
+        (The once-suspected no-candidate-break corner is unreachable:
+        both paths process tasks in block order, so a pipelined LAST task
+        implies every earlier task had candidates — no break happened —
+        and a break before the last task leaves it unprocessed (kind 0),
+        recording nothing on either path.  Pinned by
+        test_fit_deltas.py::test_fuzz_no_candidate_task_jobs.)"""
         import numpy as np
 
         from ..api import TaskStatus, allocated_status

@@ -183,7 +183,12 @@ class LeaderElector:
             return
         self.last_renew = time.time()
         self.is_leader = True
-        self.on_started_leading()
+        # client-go runs OnStartedLeading in its own goroutine
+        # (leaderelection.go): a slow leader startup (cache sync at scale)
+        # must not delay renewals, or the wall-clock fence would refuse the
+        # new leader's first writes and one transient store hiccup could
+        # abdicate it despite the continuous-failure deadline.
+        threading.Thread(target=self.on_started_leading, daemon=True).start()
         # client-go renewal semantics: retry every retry_period; abdicate
         # only after renew_deadline of CONTINUOUS failure — one transient
         # store hiccup must not fail over a healthy leader.

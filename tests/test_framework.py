@@ -98,7 +98,8 @@ tiers:
         data = _mini_yaml(DEFAULT_SCHEDULER_CONF)
         assert data["actions"] == "tpu-allocate, backfill"
         assert [p["name"] for t in data["tiers"] for p in t["plugins"]] == [
-            "priority", "gang", "drf", "predicates", "proportion", "nodeorder"]
+            "priority", "gang", "conformance",
+            "drf", "predicates", "proportion", "nodeorder"]
 
 
 class TestPriorityQueue:

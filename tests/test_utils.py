@@ -17,14 +17,17 @@ def build_resource_list(cpu, memory, **scalars):
 
 
 def build_pod(namespace, name, nodename, phase, req, groupname="",
-              labels=None, selector=None, priority=None, uid=None, ts=0.0):
+              labels=None, selector=None, priority=None, uid=None, ts=0.0,
+              priority_class_name=""):
     return Pod(
         metadata=ObjectMeta(
             name=name, namespace=namespace, uid=uid or f"{namespace}-{name}",
             annotations={GroupNameAnnotationKey: groupname} if groupname else {},
             labels=labels or {}, creation_timestamp=ts),
         spec=PodSpec(node_name=nodename, node_selector=selector or {},
-                     priority=priority, containers=[Container(requests=req)]),
+                     priority=priority,
+                     priority_class_name=priority_class_name,
+                     containers=[Container(requests=req)]),
         status=PodStatus(phase=phase),
     )
 

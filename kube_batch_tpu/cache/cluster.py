@@ -195,6 +195,24 @@ class Cluster:
             self.pod_informer.fire_update(old, pod)
             return pod
 
+    def put_pod_status(self, namespace: str, name: str, status) -> Pod:
+        """Full status-subresource replace (a real apiserver
+        UpdateStatus): phase AND conditions from the body take effect —
+        not just conditions, which silently dropped phase writes
+        (ADVICE r3 #4)."""
+        with self.lock:
+            key = f"{namespace}/{name}"
+            pod = self.pods.get(key)
+            if pod is None:
+                raise KeyError(f"pod {key} not found")
+            if (pod.status.phase == status.phase
+                    and pod.status.conditions == status.conditions):
+                return pod  # no-op write
+            old = copy.deepcopy(pod)
+            pod.status = status
+            self.pod_informer.fire_update(old, pod)
+            return pod
+
     def create_event(self, event: Event) -> Event:
         with self.lock:
             return self.events.append(event)

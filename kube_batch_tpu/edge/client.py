@@ -18,10 +18,23 @@ import urllib.request
 from typing import Dict
 
 from ..cache.cluster import Informer
-from . import codec
+from . import codec, codec_k8s
 
 _WATCHED = ("pods", "nodes", "podgroups", "queues", "priorityclasses",
             "pdbs")
+
+# Kubernetes-convention collection paths (wire="k8s"): the scheduler
+# speaks the same path grammar client-go does against a real apiserver.
+_K8S_PATHS = {
+    "pods": "/api/v1/pods",
+    "nodes": "/api/v1/nodes",
+    "events": "/api/v1/events",
+    "pvcs": "/api/v1/persistentvolumeclaims",
+    "priorityclasses": "/apis/scheduling.k8s.io/v1/priorityclasses",
+    "pdbs": "/apis/policy/v1beta1/poddisruptionbudgets",
+    "podgroups": "/apis/scheduling.incubator.k8s.io/v1alpha1/podgroups",
+    "queues": "/apis/scheduling.incubator.k8s.io/v1alpha1/queues",
+}
 
 _MISSING = object()
 
@@ -86,7 +99,16 @@ class RemoteCluster:
     ``*_informer`` fan-outs + mirror stores (ingest) and the effector
     verbs (egress), all over HTTP."""
 
-    def __init__(self, base_url: str, timeout: float = 10.0):
+    def __init__(self, base_url: str, timeout: float = 10.0,
+                 wire: str = "native"):
+        """``wire="k8s"`` speaks Kubernetes API conventions end to end:
+        /api + /apis paths, camelCase kind/apiVersion bodies
+        (codec_k8s), the Binding subresource for binds, and merge-patch
+        for the stuck-pod condition writeback — the full client-go
+        surface (SURVEY.md §2.2) instead of the native /v1 codec."""
+        if wire not in ("native", "k8s"):
+            raise ValueError(f"unknown wire mode {wire!r}")
+        self.wire = wire
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.lock = threading.RLock()
@@ -134,7 +156,7 @@ class RemoteCluster:
         store = self._store(resource)
         informer = self._informer(resource)
         key_of = _key_fn(resource)
-        base = f"{self.base_url}/v1/{resource}?watch=1"
+        base = f"{self.base_url}{self._collection(resource)}?watch=1"
         last_rv = 0
         while not self._stop.is_set():
             replay_seen = set()
@@ -178,7 +200,7 @@ class RemoteCluster:
                             break
                         if etype == "PING":
                             continue
-                        obj = codec.decode(event["object"])
+                        obj = self._decode(event["object"])
                         key = key_of(obj)
                         with self.lock:
                             if etype == "ADDED":
@@ -227,8 +249,8 @@ class RemoteCluster:
         """PVCs are list-only; _PvcStore refetches on a miss so claims
         created after start() are still found at allocate time."""
         items = {}
-        for doc in self._get("pvcs")["items"]:
-            pvc = codec.decode(doc)
+        for doc in self._request("GET", self._collection("pvcs"))["items"]:
+            pvc = self._decode(doc)
             items[f"{pvc.metadata.namespace}/{pvc.metadata.name}"] = pvc
         self.pvcs.replace(items)
 
@@ -237,11 +259,34 @@ class RemoteCluster:
 
     # -- egress: REST verbs -------------------------------------------------
 
-    def _request(self, method: str, path: str, payload=None):
+    def _collection(self, resource: str) -> str:
+        return (_K8S_PATHS[resource] if self.wire == "k8s"
+                else f"/v1/{resource}")
+
+    def _object_path(self, resource: str, namespace, name: str) -> str:
+        if self.wire != "k8s":
+            return (f"/v1/{resource}/{name}" if namespace is None
+                    else f"/v1/{resource}/{namespace}/{name}")
+        base = _K8S_PATHS[resource]
+        if namespace is None:
+            return f"{base}/{name}"
+        head, _, res = base.rpartition("/")
+        return f"{head}/namespaces/{namespace}/{res}/{name}"
+
+    def _encode(self, obj):
+        return (codec_k8s.to_k8s(obj) if self.wire == "k8s"
+                else codec.encode(obj))
+
+    def _decode(self, doc):
+        return (codec_k8s.from_k8s(doc) if self.wire == "k8s"
+                else codec.decode(doc))
+
+    def _request(self, method: str, path: str, payload=None,
+                 content_type: str = "application/json"):
         body = json.dumps(payload).encode() if payload is not None else None
         req = urllib.request.Request(
             f"{self.base_url}{path}", data=body, method=method,
-            headers={"Content-Type": "application/json"})
+            headers={"Content-Type": content_type})
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as resp:
                 return json.loads(resp.read())
@@ -249,32 +294,53 @@ class RemoteCluster:
             detail = exc.read().decode(errors="replace")
             raise KeyError(f"{method} {path}: {exc.code} {detail}") from exc
 
-    def _get(self, resource: str):
-        return self._request("GET", f"/v1/{resource}")
-
     # effectors the SchedulerCache wiring uses (cluster.py effectors):
     def bind_pod(self, namespace: str, name: str, hostname: str) -> None:
-        self._request("POST", f"/v1/pods/{namespace}/{name}/bind",
-                      {"node": hostname})
+        if self.wire == "k8s":  # the real Binding subresource
+            self._request(
+                "POST",
+                self._object_path("pods", namespace, name) + "/binding",
+                {"apiVersion": "v1", "kind": "Binding",
+                 "metadata": {"name": name, "namespace": namespace},
+                 "target": {"kind": "Node", "name": hostname}})
+        else:
+            self._request("POST", f"/v1/pods/{namespace}/{name}/bind",
+                          {"node": hostname})
 
     def delete_pod(self, namespace: str, name: str) -> None:
-        self._request("DELETE", f"/v1/pods/{namespace}/{name}")
+        self._request("DELETE",
+                      self._object_path("pods", namespace, name))
 
     def put_pod_group_status(self, pg) -> None:
         self._request(
             "PUT",
-            f"/v1/podgroups/{pg.metadata.namespace}/{pg.metadata.name}/status",
-            codec.encode(pg))
+            self._object_path("podgroups", pg.metadata.namespace,
+                              pg.metadata.name) + "/status",
+            self._encode(pg))
 
     def update_pod_condition(self, namespace: str, name: str,
                              condition) -> None:
         """Pod status subresource: PodCondition upsert (the stuck-pod
-        writeback, cache.go:548-568)."""
-        self._request("PUT", f"/v1/pods/{namespace}/{name}/status",
-                      codec.encode(condition))
+        writeback, cache.go:548-568).  Native wire PUTs the bare
+        condition; k8s wire strategic-merge-patches ONLY this condition
+        (merged by ``type`` server-side), so concurrent status writers'
+        conditions are never clobbered by a stale read-modify-write."""
+        if self.wire == "k8s":
+            self._request(
+                "PATCH",
+                self._object_path("pods", namespace, name) + "/status",
+                {"status": {"conditions": [
+                    {"type": condition.type, "status": condition.status,
+                     "reason": condition.reason,
+                     "message": condition.message}]}},
+                content_type="application/strategic-merge-patch+json")
+        else:
+            self._request("PUT", f"/v1/pods/{namespace}/{name}/status",
+                          codec.encode(condition))
 
     def create_event(self, event) -> None:
-        self._request("POST", "/v1/events", codec.encode(event))
+        self._request("POST", self._collection("events"),
+                      self._encode(event))
 
     # leader-election lease (ConfigMap-lock analog, server.go:115-139):
     def get_lease(self, namespace: str, name: str):
@@ -292,8 +358,10 @@ class RemoteCluster:
         return int(doc["version"])
 
     def bind_pvc(self, namespace: str, name: str, volume_name: str) -> None:
-        self._request("POST", f"/v1/pvcs/{namespace}/{name}/bind",
-                      {"volume": volume_name})
+        self._request(
+            "POST",
+            self._object_path("pvcs", namespace, name) + "/bind",
+            {"volume": volume_name})
 
     def get_pod(self, namespace: str, name: str):
         with self.lock:
@@ -301,29 +369,42 @@ class RemoteCluster:
 
     # mutation verbs (typed clientsets / workload submission clients):
     def update_pod_group(self, pg) -> None:
-        self._request("PUT", "/v1/podgroups", codec.encode(pg))
+        if self.wire == "k8s":
+            self._request(
+                "PUT",
+                self._object_path("podgroups", pg.metadata.namespace,
+                                  pg.metadata.name),
+                self._encode(pg))
+        else:
+            self._request("PUT", "/v1/podgroups", codec.encode(pg))
 
     def delete_pod_group(self, namespace: str, name: str) -> None:
-        self._request("DELETE", f"/v1/podgroups/{namespace}/{name}")
+        self._request("DELETE",
+                      self._object_path("podgroups", namespace, name))
 
     def delete_queue(self, name: str) -> None:
-        self._request("DELETE", f"/v1/queues/{name}")
+        self._request("DELETE", self._object_path("queues", None, name))
 
     # creation verbs (tests / workload submission clients):
     def create_pod(self, pod) -> None:
-        self._request("POST", "/v1/pods", codec.encode(pod))
+        self._request("POST", self._collection("pods"), self._encode(pod))
 
     def create_node(self, node) -> None:
-        self._request("POST", "/v1/nodes", codec.encode(node))
+        self._request("POST", self._collection("nodes"),
+                      self._encode(node))
 
     def create_pod_group(self, pg) -> None:
-        self._request("POST", "/v1/podgroups", codec.encode(pg))
+        self._request("POST", self._collection("podgroups"),
+                      self._encode(pg))
 
     def create_queue(self, queue) -> None:
-        self._request("POST", "/v1/queues", codec.encode(queue))
+        self._request("POST", self._collection("queues"),
+                      self._encode(queue))
 
     def create_priority_class(self, pc) -> None:
-        self._request("POST", "/v1/priorityclasses", codec.encode(pc))
+        self._request("POST", self._collection("priorityclasses"),
+                      self._encode(pc))
 
     def create_pvc(self, pvc) -> None:
-        self._request("POST", "/v1/pvcs", codec.encode(pvc))
+        self._request("POST", self._collection("pvcs"),
+                      self._encode(pvc))

@@ -21,7 +21,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlparse
 
 from ..cache.cluster import Cluster
-from . import codec, codec_k8s
+from . import codec, codec_k8s, selectors
 
 _RESOURCES = ("pods", "nodes", "podgroups", "queues", "priorityclasses",
               "pdbs", "pvcs", "events", "leases")
@@ -35,6 +35,63 @@ _K8S_RESOURCES = {
     "poddisruptionbudgets": "pdbs", "podgroups": "podgroups",
     "queues": "queues",
 }
+
+
+def _merge_patch(target, patch):
+    """RFC 7386 JSON merge-patch: dicts merge recursively, ``null``
+    deletes a key, everything else (including lists) replaces."""
+    if not isinstance(patch, dict):
+        return patch
+    if not isinstance(target, dict):
+        target = {}
+    out = dict(target)
+    for key, value in patch.items():
+        if value is None:
+            out.pop(key, None)
+        else:
+            out[key] = _merge_patch(out.get(key), value)
+    return out
+
+
+# Strategic-merge list keys — the subset real apiservers declare via
+# ``patchMergeKey``: these object lists merge by key; other lists
+# replace, as in RFC 7386.
+_MERGE_KEYS = {("status", "conditions"): "type"}
+
+
+def _strategic_merge(target, patch, path=()):
+    """Kubernetes strategic merge patch (the fragment the edge needs):
+    like merge-patch, but lists registered in _MERGE_KEYS upsert items
+    by their merge key instead of replacing the whole list — so a
+    writer can update ITS condition without clobbering concurrent
+    writers' conditions (no read-modify-write race)."""
+    if isinstance(patch, list):
+        key = _MERGE_KEYS.get(path)
+        if (key and isinstance(target, list)
+                and all(isinstance(x, dict) for x in patch)):
+            out = list(target)
+            index = {x.get(key): i for i, x in enumerate(out)
+                     if isinstance(x, dict)}
+            for item in patch:
+                i = index.get(item.get(key))
+                if i is None:
+                    out.append(item)
+                else:
+                    out[i] = _strategic_merge(out[i], item, path)
+            return out
+        return patch
+    if not isinstance(patch, dict):
+        return patch
+    if not isinstance(target, dict):
+        target = {}
+    out = dict(target)
+    for key, value in patch.items():
+        if value is None:
+            out.pop(key, None)
+        else:
+            out[key] = _strategic_merge(out.get(key), value,
+                                        path + (key,))
+    return out
 
 
 def _store_of(cluster: Cluster, resource: str):
@@ -115,6 +172,10 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._json(404, {"error": "lease key required"})
             version, record = self.cluster.get_lease(rest[0], rest[1])
             return self._json(200, {"version": version, "record": record})
+        try:  # server-side filtering (kubectl -l / --field-selector)
+            match = selectors.compile_query(resource, query)
+        except ValueError as exc:
+            return self._json(400, {"error": str(exc)})
         if query.get("watch"):
             since = None
             if query.get("resourceVersion"):
@@ -123,19 +184,23 @@ class _Handler(BaseHTTPRequestHandler):
                 except ValueError:
                     return self._json(400,
                                       {"error": "bad resourceVersion"})
-            return self._watch(resource, k8s, ns, since)
+            return self._watch(resource, k8s, ns, since, match)
         enc = codec_k8s.to_k8s if k8s else codec.encode
         single = None
-        with self.cluster.lock:  # encode under the lock, send outside it
-            store = _store_of(self.cluster, resource)
-            if rest:  # single-object GET
-                obj = (store.get("/".join(rest))
-                       if hasattr(store, "get") else None)
-                if obj is not None:
-                    single = enc(obj)
-            else:
-                items = [enc(o) for o in store.values()
-                         if ns is None or o.metadata.namespace == ns]
+        try:
+            with self.cluster.lock:  # encode under the lock, send outside
+                store = _store_of(self.cluster, resource)
+                if rest:  # single-object GET
+                    obj = (store.get("/".join(rest))
+                           if hasattr(store, "get") else None)
+                    if obj is not None:
+                        single = enc(obj)
+                else:
+                    items = [enc(o) for o in store.values()
+                             if (ns is None or o.metadata.namespace == ns)
+                             and (match is None or match(o))]
+        except ValueError as exc:  # unsupported fieldSelector path
+            return self._json(400, {"error": str(exc)})
         if rest:
             if single is None:
                 return self._json(404, {"error": "not found"})
@@ -225,14 +290,17 @@ class _Handler(BaseHTTPRequestHandler):
             if (resource == "pods" and len(rest) == 3
                     and rest[2] == "status"):
                 # Pod status subresource: a PodCondition upsert (native)
-                # or a full k8s Pod whose conditions are applied
-                # (cache.go:548-568 taskUnschedulable writeback).
+                # or a full k8s Pod whose entire status — phase AND
+                # conditions — replaces the stored one, like a real
+                # apiserver UpdateStatus (cache.go:548-568 writes
+                # conditions; kubelets write phase through this path).
                 from ..api.objects import Pod
-                conds = (obj.status.conditions if isinstance(obj, Pod)
-                         else [obj])
-                for cond in conds:
+                if isinstance(obj, Pod):
+                    self.cluster.put_pod_status(rest[0], rest[1],
+                                                obj.status)
+                else:
                     self.cluster.update_pod_condition(rest[0], rest[1],
-                                                      cond)
+                                                      obj)
                 return self._json(200, {"status": "updated"})
             update = {"pods": self.cluster.update_pod,
                       "nodes": self.cluster.update_node,
@@ -244,6 +312,70 @@ class _Handler(BaseHTTPRequestHandler):
         except KeyError as exc:
             return self._json(404, {"error": str(exc)})
         except (ValueError, TypeError) as exc:  # malformed/missing body
+            return self._json(400, {"error": str(exc)})
+
+    def do_PATCH(self):
+        """Merge-patch (``kubectl patch --type=merge``, RFC 7386) and
+        strategic-merge-patch (conditions merged by ``type``).
+        Supported on pods, podgroups (object + ``status`` subresource)
+        and nodes: the stored object is encoded in the path's wire
+        codec, deep-merged with the patch (null deletes a key), decoded,
+        and applied through the same update/status paths as PUT."""
+        resource, rest, _query, k8s, _ns = self._route()
+        if resource is None or not rest:
+            return self._json(404, {"error": "not found"})
+        ctype = (self.headers.get("Content-Type") or "").split(";")[0]
+        if ctype not in ("application/merge-patch+json",
+                        "application/strategic-merge-patch+json",
+                        "application/json", ""):
+            return self._json(415, {"error": f"unsupported patch type "
+                                             f"{ctype}"})
+        merge = (_strategic_merge
+                 if ctype == "application/strategic-merge-patch+json"
+                 else _merge_patch)
+        try:
+            patch = self._body()
+        except ValueError as exc:
+            return self._json(400, {"error": str(exc)})
+        if not isinstance(patch, dict):
+            return self._json(400, {"error": "patch body must be an "
+                                             "object"})
+        if resource not in ("pods", "podgroups", "nodes"):
+            return self._json(405, {"error": "patch not supported"})
+        # Same shape guard as do_PUT (len == 3): a pod legitimately
+        # NAMED "status" (rest == [ns, "status"]) is an object patch.
+        status_sub = len(rest) == 3 and rest[-1] == "status"
+        key_parts = rest[:-1] if status_sub else rest
+        enc = codec_k8s.to_k8s if k8s else codec.encode
+        try:
+            with self.cluster.lock:  # mutate under the lock, send outside
+                store = _store_of(self.cluster, resource)
+                current = (store.get("/".join(key_parts))
+                           if hasattr(store, "get") else None)
+                if current is not None:
+                    doc = merge(enc(current), patch)
+                    obj = (codec_k8s.from_k8s(doc) if k8s
+                           else codec.decode(doc))
+                    if resource == "pods":
+                        if status_sub:
+                            self.cluster.put_pod_status(key_parts[0],
+                                                        key_parts[1],
+                                                        obj.status)
+                        else:
+                            self.cluster.update_pod(obj)
+                    elif resource == "podgroups":
+                        if status_sub:
+                            self.cluster.put_pod_group_status(obj)
+                        else:
+                            self.cluster.update_pod_group(obj)
+                    else:
+                        self.cluster.update_node(obj)
+            if current is None:
+                return self._json(404, {"error": "not found"})
+            return self._json(200, {"status": "patched"})
+        except KeyError as exc:
+            return self._json(404, {"error": str(exc)})
+        except (ValueError, TypeError) as exc:
             return self._json(400, {"error": str(exc)})
 
     def do_DELETE(self):
@@ -270,7 +402,8 @@ class _Handler(BaseHTTPRequestHandler):
     # -- watch -------------------------------------------------------------
 
     def _watch(self, resource: str, k8s: bool = False,
-               ns: "str | None" = None, since: "int | None" = None) -> None:
+               ns: "str | None" = None, since: "int | None" = None,
+               match=None) -> None:
         informer = _informer_of(self.cluster, resource)
         if informer is None:
             return self._json(405, {"error": f"{resource} not watchable"})
@@ -278,9 +411,13 @@ class _Handler(BaseHTTPRequestHandler):
         history = self.history
 
         def in_scope(obj) -> bool:
-            # Namespaced watch paths scope server-side, matching the
-            # corresponding LIST (the k8s list+watch contract).
-            return ns is None or obj.metadata.namespace == ns
+            # Namespaced watch paths and selectors scope server-side,
+            # matching the corresponding LIST (k8s list+watch contract).
+            # Selectors are validated at compile time (do_GET), so
+            # match() cannot raise here.
+            if ns is not None and obj.metadata.namespace != ns:
+                return False
+            return match is None or match(obj)
 
         def last_rv() -> "int | None":
             # The per-connection handler runs right after the history
@@ -298,11 +435,21 @@ class _Handler(BaseHTTPRequestHandler):
         # Register BEFORE snapshotting, under the store lock, so no event
         # can fall between the initial list and the live stream.
         with self.cluster.lock:
+            def on_update(old, new):
+                # Selector boundary transitions surface as ADDED/DELETED,
+                # the way real apiserver filtered watches behave.
+                was, now = in_scope(old), in_scope(new)
+                if was and now:
+                    events.put(("MODIFIED", new, last_rv()))
+                elif now:
+                    events.put(("ADDED", new, last_rv()))
+                elif was:
+                    events.put(("DELETED", new, last_rv()))
+
             handle = informer.add_handlers(
                 on_add=lambda o: in_scope(o)
                 and events.put(("ADDED", o, last_rv())),
-                on_update=lambda old, new: in_scope(new)
-                and events.put(("MODIFIED", new, last_rv())),
+                on_update=on_update,
                 on_delete=lambda o: in_scope(o)
                 and events.put(("DELETED", o, last_rv())))
             pending = (history.since(resource, since)
@@ -342,9 +489,22 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             if resumed:
                 # Delta resume: no ADDED replay, no SYNC reconciliation.
+                # MODIFIED history carries the pre-update object so the
+                # selector boundary-transition rewrite (ADDED/DELETED)
+                # applies to replayed events exactly as to live ones —
+                # a filtered client must not miss an object's exit.
                 emit("RESUMED", None)
-                for rv, etype, obj in pending:
-                    if in_scope(obj):
+                for rv, etype, obj, old in pending:
+                    if etype == "MODIFIED":
+                        was = in_scope(old) if old is not None else True
+                        now = in_scope(obj)
+                        if was and now:
+                            emit("MODIFIED", obj, rv)
+                        elif now:
+                            emit("ADDED", obj, rv)
+                        elif was:
+                            emit("DELETED", obj, rv)
+                    elif in_scope(obj):
                         emit(etype, obj, rv)
             else:
                 for obj in initial:
@@ -394,7 +554,12 @@ class _EventHistory:
                     def fire(*args):
                         if len(buf) == self.maxlen:  # about to evict
                             self.watermark[resource] = buf[0][0]
-                        buf.append((next(cluster._rv), etype, args[-1]))
+                        # MODIFIED keeps the pre-update object too, so
+                        # resumed filtered watches can detect selector
+                        # boundary transitions.
+                        old = args[0] if etype == "MODIFIED" else None
+                        buf.append((next(cluster._rv), etype, args[-1],
+                                    old))
                     return fire
                 return (record("ADDED"), record("MODIFIED"),
                         record("DELETED"))

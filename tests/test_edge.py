@@ -356,10 +356,12 @@ class TestWatchResume:
             "8", "16Gi", pods=110)))
         cluster.delete_node("n0")
         # ...reconnect with the last seen rv: delta only, no ADDED replay.
+        # Stop at the DELETED frame: waiting for the 5 s keepalive PING
+        # races the client socket timeout (flaky).
         with urllib.request.urlopen(
                 f"{server.url}/v1/nodes?watch=1&resourceVersion={rv}",
-                timeout=5) as resp:
-            frames = self._read_frames(resp, {"PING"})
+                timeout=10) as resp:
+            frames = self._read_frames(resp, {"DELETED", "PING"})
         types = [f["type"] for f in frames]
         assert types[0] == "RESUMED"
         assert types[1:3] == ["ADDED", "DELETED"]

@@ -1,158 +1,144 @@
-"""Benchmark: scheduling-session solve latency on TPU.
+"""Benchmark: scheduling-session latency on TPU, variance-honest.
 
-Prints one JSON line: {"metric", "value", "unit", "vs_baseline"}.
-The metric is the on-device batched allocate solve (gang + DRF + proportion
-+ predicates + nodeorder scoring) on a synthetic kubemark-style snapshot.
-Baseline target (BASELINE.md): < 1000 ms per session at 50k pods x 10k nodes.
+Prints one JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+Every figure is a MEDIAN with its p90 alongside (VERDICT r3 next #3 —
+best-of sampling flatters a noisy machine); the headline metric is the
+on-device batched allocate solve (gang + DRF + proportion + predicates +
+nodeorder scoring) on a synthetic kubemark-style snapshot.  Baseline
+target (BASELINE.md): < 1000 ms per session at 50k pods x 10k nodes.
 
-Env overrides: BENCH_TASKS, BENCH_NODES, BENCH_JOBS, BENCH_QUEUES.
+Also measured, all at 50k x 10k:
+- session_ms / session_hetero_ms: full open->tensorize->ship->solve->
+  apply->close sessions on warm caches (homogeneous / 64-signature).
+- session_cold_ms: median of >= 5 first-sessions on fresh caches — the
+  restarted-scheduler shape (VERDICT r3 next #1).
+- session_steady_ms / session_steady_hetero_ms: long-lived cache, 1%
+  churn, informer-echoed binds.
+- actions_ms: the reference's shipped 4-action pipeline (reclaim,
+  allocate, backfill, preempt + conformance,
+  config/kube-batch-conf.yaml) on a full cluster with a high-priority
+  PriorityClass wave — per-action wall-clock, real evictions
+  (VERDICT r3 next #2).
+
+Env overrides: BENCH_TASKS, BENCH_NODES, BENCH_JOBS, BENCH_QUEUES;
+BENCH_PIPELINE=0 skips the 4-action scenario, BENCH_COLD_N (default 5).
 """
 
 import json
+import math
 import os
+import statistics
 import time
 
 
-def main():
-    import jax
+def _stats(runs_ms):
+    """(median, p90) of a list of millisecond samples."""
+    s = sorted(runs_ms)
+    med = statistics.median(s)
+    p90 = s[min(len(s) - 1, max(0, math.ceil(0.9 * len(s)) - 1))]
+    return round(med, 1), round(p90, 1)
 
-    n_tasks = int(os.environ.get("BENCH_TASKS", 50_000))
-    n_nodes = int(os.environ.get("BENCH_NODES", 10_000))
-    n_jobs = int(os.environ.get("BENCH_JOBS", 2_000))
-    n_queues = int(os.environ.get("BENCH_QUEUES", 4))
 
-    from kube_batch_tpu.models.synthetic import make_synthetic_inputs
-    from kube_batch_tpu.ops.solver import best_solve_allocate
+def _register():
+    from kube_batch_tpu.actions.factory import register_default_actions
+    from kube_batch_tpu.plugins.factory import register_default_plugins
+    register_default_actions()
+    register_default_plugins()
 
-    inputs, config = make_synthetic_inputs(
-        n_tasks=n_tasks, n_nodes=n_nodes, n_jobs=n_jobs, n_queues=n_queues,
-        seed=0)
 
-    import numpy as np
+def _tiers():
+    from kube_batch_tpu.scheduler import (DEFAULT_SCHEDULER_CONF,
+                                          load_scheduler_conf)
+    return load_scheduler_conf(DEFAULT_SCHEDULER_CONF)[1]
 
-    # Warm-up: compile (cached for subsequent sessions of the same bucket).
-    # np.asarray forces device completion + transfer; block_until_ready is
-    # not reliable on the experimental axon TPU tunnel.
-    warm = best_solve_allocate(inputs, config)
-    assignment = np.asarray(warm.assignment)
-    placed = int((assignment >= 0).sum())
 
-    # Placement parity on the real backend: the fast path (Pallas on TPU)
-    # must match the XLA two-level solver exactly — guards Mosaic argmax /
-    # rounding quirks shipping silently (VERDICT r1 weak #5).
-    import jax as _jax
-    parity = None  # null when the check does not apply (non-TPU backend)
-    if _jax.default_backend() == "tpu":
-        from kube_batch_tpu.ops.solver import solve_allocate
-        xla = np.asarray(solve_allocate(inputs, config).assignment)
-        parity = bool(np.array_equal(assignment, xla))
-        assert parity, "pallas vs XLA placement mismatch on TPU"
+def _session_ms(cache, tiers, action, binder) -> float:
+    from kube_batch_tpu.framework import close_session, open_session
+    start = time.perf_counter()
+    ssn = open_session(cache, tiers)
+    try:
+        action.execute(ssn)
+    finally:
+        close_session(ssn)
+    elapsed = (time.perf_counter() - start) * 1e3
+    assert binder.binds, "session bound nothing"
+    binder.binds.clear()
+    return elapsed
 
-    runs = []
-    for _ in range(3):
-        start = time.perf_counter()
-        result = best_solve_allocate(inputs, config)
-        np.asarray(result.assignment)
-        runs.append((time.perf_counter() - start) * 1e3)
-    value = min(runs)
-    assert placed > 0, "solver placed nothing"
 
-    session_ms = measure_full_session(n_tasks, n_nodes, n_jobs, n_queues)
-    # Heterogeneous variant: 64 distinct (selector, tolerations, affinity)
-    # signatures + unique per-node labels — the realistic worst case for
-    # the static [S, N] predicate mask (VERDICT r2 weak #1).
-    # Best-of-5: the shared dev machine's load spikes dominate variance
-    # on this borderline-to-target configuration.
-    hetero_ms = measure_full_session(n_tasks, n_nodes, n_jobs, n_queues,
-                                     n_signatures=64, repeat=5)
+def _gc_posture():
+    """Production GC posture (scheduler.run/run_once)."""
+    import contextlib
+    import gc
 
-    # Steady-state: long-lived cache, 1% pod churn per cycle, placed pods
-    # echoed back as Running — the production shape the incremental
-    # snapshot/tensorize path (clone pool + tensor blocks) is built for.
-    steady_cold_ms, steady_ms = measure_steady_session(
-        n_tasks, n_nodes, n_jobs, n_queues)
-
-    baseline_ms = 1000.0  # north-star TARGET per session (BASELINE.md
-    # publishes no measured reference numbers, so vs_baseline is
-    # target-relative, not reference-relative)
-    print(json.dumps({
-        "metric": f"sched-session solve latency @ {n_tasks} tasks x "
-                  f"{n_nodes} nodes (gang+DRF+proportion)",
-        "value": round(value, 2),
-        "unit": "ms",
-        "vs_baseline": round(baseline_ms / value, 3),
-        "parity": parity,
-        # The honest north-star number: full open->tensorize->ship->solve->
-        # apply->close over the object model (tools/session_bench.py has the
-        # per-stage breakdown).
-        "session_ms": session_ms,
-        # Same, on a 64-signature heterogeneous snapshot (north star also
-        # applies: < 1000 ms).
-        "session_hetero_ms": hetero_ms,
-        # Steady state at 1% churn (long-lived cache, informer-echoed
-        # binds) vs the cold first session on the same cache.
-        "session_steady_ms": steady_ms,
-        "session_cold_ms": steady_cold_ms,
-    }))
+    @contextlib.contextmanager
+    def posture():
+        gc.collect()
+        gc.freeze()
+        gc.disable()
+        try:
+            yield
+        finally:
+            gc.unfreeze()
+            gc.enable()
+    return posture()
 
 
 def measure_full_session(n_tasks, n_nodes, n_jobs, n_queues,
-                         repeat: int = 4, n_signatures: int = 1) -> float:
-    """End-to-end session wall-clock (best of ``repeat``), ms."""
-    import gc
-
-    from kube_batch_tpu.actions.factory import register_default_actions
+                         repeat: int = 5, n_signatures: int = 1):
+    """(median, p90) of ``repeat`` warm sessions (first extra session
+    discarded: it both compiles any new jit shapes and is a cold, which
+    measure_cold_sessions reports separately)."""
     from kube_batch_tpu.actions.tpu_allocate import TpuAllocateAction
-    from kube_batch_tpu.framework import close_session, open_session
     from kube_batch_tpu.models.synthetic import make_synthetic_cache
-    from kube_batch_tpu.plugins.factory import register_default_plugins
-    from kube_batch_tpu.scheduler import (DEFAULT_SCHEDULER_CONF,
-                                          load_scheduler_conf)
 
-    register_default_actions()
-    register_default_plugins()
+    _register()
     cache, binder = make_synthetic_cache(n_tasks, n_nodes, n_jobs, n_queues,
                                          n_signatures=n_signatures)
-    _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+    tiers = _tiers()
     action = TpuAllocateAction()
-    # Production GC posture (scheduler.run/run_once).
-    gc.collect()
-    gc.freeze()
-    gc.disable()
-    try:
-        best = None
-        for _ in range(repeat):
-            start = time.perf_counter()
-            ssn = open_session(cache, tiers)
-            try:
-                action.execute(ssn)
-            finally:
-                close_session(ssn)
-            elapsed = (time.perf_counter() - start) * 1e3
-            assert binder.binds, "session bound nothing"
-            binder.binds.clear()
-            best = elapsed if best is None else min(best, elapsed)
-    finally:
-        gc.unfreeze()
-        gc.enable()
-    return round(best, 1)
+    with _gc_posture():
+        _session_ms(cache, binder=binder, tiers=tiers, action=action)
+        runs = [_session_ms(cache, tiers, action, binder)
+                for _ in range(repeat)]
+    return _stats(runs)
+
+
+def measure_cold_sessions(n_tasks, n_nodes, n_jobs, n_queues,
+                          n_caches: int = 5, extra=()):
+    """(median, p90) over >= ``n_caches`` first-sessions, each on a
+    FRESH cache (empty clone pool, no tensor blocks, first-touch apply)
+    with the process already compile-warm — the restarted scheduler's
+    first cycle.  ``extra``: additional cold samples measured elsewhere
+    under the same protocol (the steady run's cold)."""
+    from kube_batch_tpu.actions.tpu_allocate import TpuAllocateAction
+    from kube_batch_tpu.models.synthetic import make_synthetic_cache
+
+    _register()
+    tiers = _tiers()
+    action = TpuAllocateAction()
+    runs = list(extra)
+    for _ in range(n_caches):
+        cache, binder = make_synthetic_cache(n_tasks, n_nodes, n_jobs,
+                                             n_queues)
+        with _gc_posture():
+            runs.append(_session_ms(cache, tiers, action, binder))
+    return _stats(runs)
 
 
 def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
                            churn: float = 0.01, rounds: int = 5,
                            n_signatures: int = 1):
-    """(cold_ms, steady_ms).
+    """(cold_ms, rounds_ms list).
 
     Cold: first full session on a fresh cache.  Steady: sessions on the
     long-lived cache with ``churn`` x n_tasks new pending pods per round
     (in fresh podgroups), pods placed two rounds ago retired, and every
     bind echoed back as a Running pod — the informer-delta steady state
-    the incremental snapshot/tensorize path serves.  Returns the best
-    steady round (round 1 re-absorbs the mass echo of the cold session)."""
+    the incremental snapshot/tensorize path serves.  Round 1 re-absorbs
+    the mass echo of the cold session; callers summarize rounds[1:]."""
     import dataclasses as dc
-    import gc
 
-    from kube_batch_tpu.actions.factory import register_default_actions
     from kube_batch_tpu.actions.tpu_allocate import TpuAllocateAction
     from kube_batch_tpu.api import (Container, ObjectMeta, Pod, PodSpec,
                                     PodStatus, pod_key)
@@ -160,15 +146,11 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
     from kube_batch_tpu.apis.scheduling.v1alpha1 import GroupNameAnnotationKey
     from kube_batch_tpu.framework import close_session, open_session
     from kube_batch_tpu.models.synthetic import make_synthetic_cache
-    from kube_batch_tpu.plugins.factory import register_default_plugins
-    from kube_batch_tpu.scheduler import (DEFAULT_SCHEDULER_CONF,
-                                          load_scheduler_conf)
 
-    register_default_actions()
-    register_default_plugins()
+    _register()
     cache, binder = make_synthetic_cache(n_tasks, n_nodes, n_jobs, n_queues,
                                          n_signatures=n_signatures)
-    _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+    tiers = _tiers()
     action = TpuAllocateAction()
     podmap = {}
     for job in cache.jobs.values():
@@ -205,10 +187,7 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
             updater.pod_groups.clear()
         return len(binds)
 
-    gc.collect()
-    gc.freeze()
-    gc.disable()
-    try:
+    with _gc_posture():
         cold = session_ms()
         assert echo() > 0, "cold session bound nothing"
         k = max(1, int(n_tasks * churn))
@@ -216,7 +195,7 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
         next_uid = n_tasks
         retire = []
         steady = []
-        for rnd in range(rounds):
+        for rnd in range(rounds + 1):
             new_keys, pgs = [], []
             remaining = k
             g = 0
@@ -258,10 +237,151 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
             steady.append(session_ms())
             echo()
             retire.append((pgs, new_keys))
-        return round(cold, 1), round(min(steady), 1)
-    finally:
-        gc.unfreeze()
-        gc.enable()
+    return round(cold, 1), steady[1:]
+
+
+def measure_action_pipeline(n_tasks, n_nodes, n_jobs, n_queues,
+                            cycles: int = 2):
+    """Per-action wall-clock for the SHIPPED pipeline — reclaim,
+    tpu-allocate, backfill, preempt with conformance in the tiers
+    (config/kube-batch-conf.yaml mirroring the reference's
+    kube-batch-conf.yaml:1-8) — on a FULL cluster with a high-priority
+    pending wave (preempt does real evictions; the starved queue drives
+    reclaim's cross-queue path).  One warm cache absorbs jit compiles;
+    each timed cycle runs on its own fresh cache (the scenario is
+    consumed by its own evictions).  Returns ({action: (med, p90)},
+    evictions)."""
+    from kube_batch_tpu.actions.factory import new_action
+    from kube_batch_tpu.framework import close_session, open_session
+    from kube_batch_tpu.models.synthetic import make_churn_cache
+    from kube_batch_tpu.scheduler import load_scheduler_conf
+
+    _register()
+    # The SHIPPED conf itself (kept in lockstep with the reference's
+    # kube-batch-conf.yaml), with the device action swapped in.
+    conf_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "config", "kube-batch-conf.yaml")
+    with open(conf_path) as fh:
+        conf = fh.read().replace('"reclaim, allocate, backfill, preempt"',
+                                 '"reclaim, tpu-allocate, backfill, '
+                                 'preempt"')
+    actions, tiers = load_scheduler_conf(conf)
+    per_action: dict = {}
+    evictions = 0
+    for cycle in range(cycles + 1):
+        cache, binder = make_churn_cache(n_tasks, n_nodes, n_jobs, n_queues)
+        with _gc_posture():
+            ssn = open_session(cache, tiers)
+            cycle_ms = {}
+            for a in actions:
+                t0 = time.perf_counter()
+                a.execute(ssn)
+                cycle_ms[a.name()] = (time.perf_counter() - t0) * 1e3
+            close_session(ssn)
+        if cycle == 0:
+            continue  # compile-warm cycle
+        for name, ms in cycle_ms.items():
+            per_action.setdefault(name, []).append(ms)
+        evictions = len(cache.evictor.evicts)
+    assert evictions > 0, "pipeline evicted nothing"
+    return ({name: _stats(runs) for name, runs in per_action.items()},
+            evictions)
+
+
+def main():
+    n_tasks = int(os.environ.get("BENCH_TASKS", 50_000))
+    n_nodes = int(os.environ.get("BENCH_NODES", 10_000))
+    n_jobs = int(os.environ.get("BENCH_JOBS", 2_000))
+    n_queues = int(os.environ.get("BENCH_QUEUES", 4))
+    cold_n = int(os.environ.get("BENCH_COLD_N", 5))
+    with_pipeline = os.environ.get("BENCH_PIPELINE", "1") != "0"
+
+    import numpy as np
+
+    from kube_batch_tpu.models.synthetic import make_synthetic_inputs
+    from kube_batch_tpu.ops.solver import best_solve_allocate
+
+    inputs, config = make_synthetic_inputs(
+        n_tasks=n_tasks, n_nodes=n_nodes, n_jobs=n_jobs, n_queues=n_queues,
+        seed=0)
+
+    # Warm-up: compile (cached for subsequent sessions of the same
+    # bucket).  np.asarray forces device completion + transfer;
+    # block_until_ready is not reliable on the experimental axon tunnel.
+    warm = best_solve_allocate(inputs, config)
+    assignment = np.asarray(warm.assignment)
+    placed = int((assignment >= 0).sum())
+    assert placed > 0, "solver placed nothing"
+
+    # Placement parity on the real backend: the fast path (Pallas on TPU)
+    # must match the XLA two-level solver exactly — guards Mosaic argmax /
+    # rounding quirks shipping silently (VERDICT r1 weak #5).
+    import jax as _jax
+    parity = None  # null when the check does not apply (non-TPU backend)
+    if _jax.default_backend() == "tpu":
+        from kube_batch_tpu.ops.solver import solve_allocate
+        xla = np.asarray(solve_allocate(inputs, config).assignment)
+        parity = bool(np.array_equal(assignment, xla))
+        assert parity, "pallas vs XLA placement mismatch on TPU"
+
+    runs = []
+    for _ in range(7):
+        start = time.perf_counter()
+        result = best_solve_allocate(inputs, config)
+        np.asarray(result.assignment)
+        runs.append((time.perf_counter() - start) * 1e3)
+    solve_med, solve_p90 = _stats(runs)
+
+    session_med, session_p90 = measure_full_session(
+        n_tasks, n_nodes, n_jobs, n_queues)
+    # Heterogeneous variant: 64 distinct (selector, tolerations, affinity)
+    # signatures + unique per-node labels — the realistic worst case for
+    # the static [S, N] predicate mask (VERDICT r2 weak #1).
+    hetero_med, hetero_p90 = measure_full_session(
+        n_tasks, n_nodes, n_jobs, n_queues, n_signatures=64)
+
+    # Steady-state: long-lived cache, 1% pod churn per cycle, placed pods
+    # echoed back as Running — homogeneous AND heterogeneous (the
+    # realistic production shape is both churning and heterogeneous).
+    steady_cold, steady_rounds = measure_steady_session(
+        n_tasks, n_nodes, n_jobs, n_queues)
+    steady_med, steady_p90 = _stats(steady_rounds)
+    _, steady_het_rounds = measure_steady_session(
+        n_tasks, n_nodes, n_jobs, n_queues, n_signatures=64)
+    steady_het_med, steady_het_p90 = _stats(steady_het_rounds)
+
+    # Cold: >= 5 fresh caches + the steady run's cold (same protocol).
+    cold_med, cold_p90 = measure_cold_sessions(
+        n_tasks, n_nodes, n_jobs, n_queues, n_caches=cold_n,
+        extra=[steady_cold])
+
+    out = {
+        "metric": f"sched-session solve latency @ {n_tasks} tasks x "
+                  f"{n_nodes} nodes (gang+DRF+proportion)",
+        "value": solve_med,
+        "unit": "ms",
+        "vs_baseline": round(1000.0 / solve_med, 3),
+        "parity": parity,
+        "solve_p90": solve_p90,
+        # The honest north-star numbers: full open->tensorize->ship->
+        # solve->apply->close over the object model, medians with p90
+        # (tools/session_bench.py has the per-stage breakdown).
+        "session_ms": session_med, "session_p90": session_p90,
+        "session_hetero_ms": hetero_med, "session_hetero_p90": hetero_p90,
+        "session_steady_ms": steady_med, "session_steady_p90": steady_p90,
+        "session_steady_hetero_ms": steady_het_med,
+        "session_steady_hetero_p90": steady_het_p90,
+        "session_cold_ms": cold_med, "session_cold_p90": cold_p90,
+    }
+    if with_pipeline:
+        per_action, evictions = measure_action_pipeline(
+            n_tasks, n_nodes, n_jobs, n_queues)
+        out["actions_ms"] = {name: med
+                             for name, (med, _p90) in per_action.items()}
+        out["actions_p90"] = {name: p90
+                              for name, (_med, p90) in per_action.items()}
+        out["pipeline_evictions"] = evictions
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

@@ -53,6 +53,13 @@ class PreemptAction(Action):
         # flatten, pure overhead on healthy clusters.
         from ..models.scanner import maybe_scanner
         scanner = maybe_scanner(ssn)
+        # One pass over residents: lets the walk skip nodes (and whole
+        # preemptors) that provably cannot yield a victim — the starved
+        # queue's O(tasks x nodes) empty walk collapses to O(tasks).
+        from ..models.victim_index import VictimIndex
+        vindex = VictimIndex(ssn)
+        if scanner is not None:
+            vindex.attach_nodes(scanner.snap.node_names)
 
         # Preemption between jobs within a queue (preempt.go:76-134).
         for queue in queues.values():
@@ -65,6 +72,7 @@ class PreemptAction(Action):
                 stmt = ssn.statement()
                 if scanner is not None:
                     scanner.checkpoint()
+                evict_log: List[tuple] = []
                 assigned = False
                 while True:
                     if preemptor_tasks[preemptor_job.uid].empty():
@@ -80,8 +88,18 @@ class PreemptAction(Action):
                         return (job.queue == preemptor_job.queue
                                 and preemptor.job != task.job)
 
+                    if not vindex.any_for_queue(preemptor_job.queue,
+                                                preemptor.job):
+                        continue  # no node anywhere holds a victim
+                    node_ok = (lambda name, q=preemptor_job.queue,
+                               ju=preemptor.job:
+                               vindex.node_for_queue(name, q, ju))
+                    mask_fn = (lambda q=preemptor_job.queue,
+                               ju=preemptor.job:
+                               vindex.queue_mask(q, ju))
                     if _preempt(ssn, stmt, preemptor, ssn.nodes, job_filter,
-                                scanner):
+                                scanner, node_ok, vindex, evict_log,
+                                mask_fn):
                         assigned = True
                     if ssn.job_pipelined(preemptor_job):
                         stmt.commit()
@@ -93,6 +111,8 @@ class PreemptAction(Action):
                     stmt.discard()
                     if scanner is not None:
                         scanner.restore()
+                    for entry in evict_log:  # discard restored the victims
+                        vindex.on_restore(*entry)
                     continue
                 if assigned:
                     preemptors.push(preemptor_job)
@@ -104,37 +124,62 @@ class PreemptAction(Action):
                     if tasks is None or tasks.empty():
                         break
                     preemptor = tasks.pop()
+                    if not vindex.any_for_job(job.uid):
+                        break  # the job has no Running task to sacrifice
                     stmt = ssn.statement()
                     assigned = _preempt(
                         ssn, stmt, preemptor, ssn.nodes,
                         lambda task: (task.status == TaskStatus.Running
                                       and preemptor.job == task.job),
-                        scanner)
+                        scanner,
+                        lambda name, ju=job.uid:
+                        vindex.node_for_job(name, ju),
+                        vindex)
                     stmt.commit()
                     if not assigned:
                         break
 
 
 def _preempt(ssn, stmt, preemptor: TaskInfo, nodes, filter_fn,
-             scanner=None) -> bool:
-    """Try to free room for preemptor on some node (preempt.go:171-254)."""
+             scanner=None, node_ok=None, vindex=None,
+             evict_log=None, mask_fn=None) -> bool:
+    """Try to free room for preemptor on some node (preempt.go:171-254).
+
+    ``node_ok(name)``: optional admissibility pre-filter (VictimIndex):
+    nodes it rejects provably yield no candidates under ``filter_fn``,
+    so they are skipped before materialization — the walk stops at the
+    first workable node, so the lazy generator touches only the nodes
+    actually visited."""
     scored = None
+    mask = None
     if scanner is not None:
-        scored = scanner.candidate_nodes(preemptor, scored=True)
+        if mask_fn is not None:
+            mask = mask_fn()  # vectorized admissibility, may be None
+        scored = scanner.candidate_nodes(preemptor, scored=True,
+                                         admissible=mask)
     if scored is not None:
-        selected_nodes = [ssn.nodes[name] for name, _ in scored
-                          if name in ssn.nodes]
+        if mask is not None:  # admissibility already applied in bulk
+            selected_nodes = (ssn.nodes[name] for name, _ in scored
+                              if name in ssn.nodes)
+        else:
+            selected_nodes = (ssn.nodes[name] for name, _ in scored
+                              if (node_ok is None or node_ok(name))
+                              and name in ssn.nodes)
     else:
         all_nodes = get_node_list(nodes)
         candidates = predicate_nodes(preemptor, all_nodes, ssn.predicate_fn)
         priority_list = prioritize_nodes(preemptor, candidates,
                                          ssn.node_prioritizers())
-        selected_nodes = sort_nodes(priority_list, ssn.nodes)
+        selected_nodes = (node for node in
+                          sort_nodes(priority_list, ssn.nodes)
+                          if node_ok is None or node_ok(node.name))
 
     assigned = False
     for node in selected_nodes:
         preemptees = [task.clone() for task in node.tasks.values()
                       if filter_fn is None or filter_fn(task)]
+        if not preemptees:
+            continue  # no candidates -> no victims, provably
         victims = ssn.preemptable(preemptor, preemptees)
         metrics.update_preemption_victims_count(len(victims))
 
@@ -152,6 +197,14 @@ def _preempt(ssn, stmt, preemptor: TaskInfo, nodes, filter_fn,
         while not victims_queue.empty():
             preemptee = victims_queue.pop()
             stmt.evict(preemptee, "preempt")
+            if vindex is not None:
+                vjob = ssn.jobs.get(preemptee.job)
+                entry = (node.name,
+                         vjob.queue if vjob is not None else "",
+                         preemptee.job)
+                vindex.on_evict(*entry)
+                if evict_log is not None:
+                    evict_log.append(entry)
             preempted.add(preemptee.resreq)
             if resreq.less_equal(preempted):
                 break

@@ -64,23 +64,43 @@ class ReclaimAction(Action):
                 from ..models.scanner import maybe_scanner
                 scanner = maybe_scanner(ssn)
                 scanner_built = True
+                from ..models.victim_index import VictimIndex
+                vindex = VictimIndex(ssn)
+                if scanner is not None:
+                    vindex.attach_nodes(scanner.snap.node_names)
+            if not vindex.any_for_other_queues(job.queue):
+                continue  # no node anywhere holds a reclaimable victim
             # Candidate walk in node order; the device scan answers the
             # predicate chain for all nodes at once (reclaim.go:115).
+            # Nodes without a Running resident of another queue are
+            # skipped lazily — they provably yield no reclaimees.
             if scanner is not None:
-                names = scanner.candidate_nodes(task, scored=False)
+                mask = vindex.other_queues_mask(job.queue)
+                names = scanner.candidate_nodes(task, scored=False,
+                                                admissible=mask)
             else:
-                names = None
+                mask, names = None, None
             if names is not None:
-                node_walk = [ssn.nodes[n] for n, _ in names
-                             if n in ssn.nodes]
+                if mask is not None:
+                    node_walk = (ssn.nodes[n] for n, _ in names
+                                 if n in ssn.nodes)
+                else:
+                    node_walk = (ssn.nodes[n] for n, _ in names
+                                 if vindex.node_for_other_queues(
+                                     n, job.queue)
+                                 and n in ssn.nodes)
             else:
-                node_walk = []
-                for node in get_node_list(ssn.nodes):
-                    try:
-                        ssn.predicate_fn(task, node)
-                    except FitError:
-                        continue
-                    node_walk.append(node)
+                def _host_walk(task=task, queue=job.queue):
+                    for node in get_node_list(ssn.nodes):
+                        if not vindex.node_for_other_queues(node.name,
+                                                            queue):
+                            continue
+                        try:
+                            ssn.predicate_fn(task, node)
+                        except FitError:
+                            continue
+                        yield node
+                node_walk = _host_walk()
             for node in node_walk:
 
                 resreq = task.init_resreq.clone()
@@ -111,6 +131,10 @@ class ReclaimAction(Action):
                         ssn.evict(reclaimee, "reclaim")
                     except Exception:
                         continue
+                    vjob = ssn.jobs.get(reclaimee.job)
+                    vindex.on_evict(node.name,
+                                    vjob.queue if vjob is not None else "",
+                                    reclaimee.job)
                     reclaimed.add(reclaimee.resreq)
                     if resreq.less_equal(reclaimed):
                         break

@@ -152,10 +152,17 @@ class NodeInfo:
         res.releasing = self.releasing.clone()
         res.idle = self.idle.clone()
         res.used = self.used.clone()
-        res.allocatable = self.allocatable.clone()
-        res.capability = self.capability.clone()
-        res.tasks = {key: task.clone_lite()
-                     for key, task in self.tasks.items()}
+        # Shared, not cloned: nothing mutates allocatable/capability in
+        # place — node updates replace them wholesale via
+        # from_resource_list (set_node), and plugins only read them.
+        res.allocatable = self.allocatable
+        res.capability = self.capability
+        from ..native import clone_task_map
+        if clone_task_map is not None and self.tasks:
+            res.tasks = clone_task_map(self.tasks)[0]
+        else:
+            res.tasks = {key: task.clone_lite()
+                         for key, task in self.tasks.items()}
         return res
 
     def __repr__(self) -> str:

@@ -207,8 +207,16 @@ class Event:
 
 
 def pod_key(pod: Pod) -> str:
-    """namespace/name key, the task identity on nodes (api/helpers.go:28-34)."""
-    return f"{pod.metadata.namespace}/{pod.metadata.name}"
+    """namespace/name key, the task identity on nodes (api/helpers.go:28-34).
+    Cached on the pod object: namespace/name are immutable for a given
+    Pod, and the hot paths (binds, node accounting, event egress) compute
+    this key several times per task per cycle."""
+    try:
+        return pod._pod_key
+    except AttributeError:
+        key = f"{pod.metadata.namespace}/{pod.metadata.name}"
+        pod._pod_key = key
+        return key
 
 
 def get_pod_resource_without_init_containers(pod: Pod) -> Resource:

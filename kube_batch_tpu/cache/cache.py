@@ -44,6 +44,13 @@ class _EventDeque(_deque):
             except Exception:
                 pass  # events are best-effort diagnostics
 
+    def extend(self, items):
+        if self._recorder is None:
+            super().extend(items)
+            return
+        for item in items:
+            self.append(item)
+
 
 class SchedulerCache(Cache):
     """In-memory cluster mirror (cache.go:73-105)."""
@@ -470,6 +477,10 @@ class SchedulerCache(Cache):
         self._check_write_fence()
         failures = self.binder.bind_many(
             [(t.pod, t.node_name) for t in tasks])
+        if not failures:  # one bulk event write for the whole batch
+            self.events.extend(("Scheduled", pod_key(t.pod), t.node_name)
+                               for t in tasks)
+            return
         failed_uids = set()
         for pod, hostname, _exc in failures:
             failed_uids.add(pod.metadata.uid)

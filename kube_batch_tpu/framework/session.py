@@ -15,11 +15,13 @@ import uuid
 from typing import Callable, Dict, List, Optional
 
 from ..api import (ClusterInfo, FitError, JobInfo, NodeInfo, QueueInfo,
-                   TaskInfo, TaskStatus, ValidateResult, allocated_status)
+                   TaskInfo, TaskStatus, ValidateResult, allocated_status,
+                   pod_key)
 from ..api.pod_group_info import (PodGroupCondition, PodGroupPending,
                                   PodGroupRunning, PodGroupUnknown,
                                   PodGroupUnschedulableType)
 from ..metrics import metrics
+from ..native import apply_placements as native_apply
 from .events import AllocateBatch, Event, EventHandler
 from .interface import Plugin
 
@@ -417,42 +419,79 @@ class Session:
         allocate_volumes = self.cache.allocate_volumes
         applied_append = applied.append
         allocated_st, pipelined_st = TaskStatus.Allocated, TaskStatus.Pipelined
-        for task, hostname, kind in placements:
-            job = jobs_get(task.job)
-            node = nodes_get(hostname)
-            if job is None or node is None:
-                skipped.append((task, hostname, kind))
-                continue
-            # pod_key(task.pod) == f"{namespace}/{name}" by construction.
-            key = f"{task.namespace}/{task.name}"
-            if key in node.tasks:  # add_task would raise; mirror log-and-skip
-                skipped.append((task, hostname, kind))
-                continue
-            if kind == 1:
-                if task.pod.spec.volumes:
-                    # Volume-less pods skip the binder round-trip: every
-                    # VolumeBinder is a no-op without claims, and 50k
-                    # no-op calls cost ~30 ms per cycle.
-                    try:
-                        allocate_volumes(task, hostname)
-                    except (KeyError, ValueError):
-                        # e.g. a missing PVC: skip this placement exactly
-                        # as the sequential path's per-task catch would.
-                        skipped.append((task, hostname, kind))
-                        continue
-                if agg is None:
-                    job.move_task_status(task, allocated_st)
+        # With agg, status-index moves are deferred and batched per job
+        # (same end state: index moves commute within the batch); the
+        # whole-bucket case — every Pending task of a job allocated, the
+        # norm for gang jobs — moves the bucket dict wholesale instead of
+        # one pop+insert per task.  The per-placement pass itself runs in
+        # C when the native extension built (kube_batch_tpu/native).
+        alloc_moves: dict = {}
+        pipe_moves: dict = {}
+        if agg is not None and native_apply is not None:
+            (applied, skipped, touched_jobs, alloc_moves,
+             pipe_moves) = native_apply(self.jobs, self.nodes, placements,
+                                        allocate_volumes)
+        else:
+            for task, hostname, kind in placements:
+                job = jobs_get(task.job)
+                node = nodes_get(hostname)
+                if job is None or node is None:
+                    skipped.append((task, hostname, kind))
+                    continue
+                key = pod_key(task.pod)  # f"{namespace}/{name}", cached
+                if key in node.tasks:  # add_task would raise; log-and-skip
+                    skipped.append((task, hostname, kind))
+                    continue
+                if kind == 1:
+                    if task.pod.spec.volumes:
+                        # Volume-less pods skip the binder round-trip:
+                        # every VolumeBinder is a no-op without claims,
+                        # and 50k no-op calls cost ~30 ms per cycle.
+                        try:
+                            allocate_volumes(task, hostname)
+                        except (KeyError, ValueError):
+                            # e.g. a missing PVC: skip this placement
+                            # exactly as the sequential path's per-task
+                            # catch would.
+                            skipped.append((task, hostname, kind))
+                            continue
+                    if agg is None:
+                        job.move_task_status(task, allocated_st)
+                    else:
+                        alloc_moves.setdefault(task.job, []).append(task)
                 else:
-                    job.move_task_index(task, allocated_st)
-            else:
-                if agg is None:
-                    job.move_task_status(task, pipelined_st)
+                    if agg is None:
+                        job.move_task_status(task, pipelined_st)
+                    else:
+                        pipe_moves.setdefault(task.job, []).append(task)
+                task.node_name = node.name
+                node.tasks[key] = task.clone_lite()
+                touched_jobs[task.job] = job
+                applied_append(task)
+
+        if alloc_moves or pipe_moves:
+            for uid, job in touched_jobs.items():
+                to_alloc = alloc_moves.get(uid, ())
+                to_pipe = pipe_moves.get(uid, ())
+                index = job.task_status_index
+                pend = index.get(TaskStatus.Pending)
+                if (to_alloc and not to_pipe and pend is not None
+                        and len(to_alloc) == len(pend)
+                        and all(pend.get(t.uid) is t for t in to_alloc)):
+                    # Whole-bucket move: Pending becomes Allocated.
+                    del index[TaskStatus.Pending]
+                    for t in pend.values():
+                        t.status = allocated_st
+                    existing = index.get(allocated_st)
+                    if existing:
+                        existing.update(pend)
+                    else:
+                        index[allocated_st] = pend
                 else:
-                    job.move_task_index(task, pipelined_st)
-            task.node_name = node.name
-            node.tasks[key] = task.clone_lite()
-            touched_jobs[task.job] = job
-            applied_append(task)
+                    for t in to_alloc:
+                        job.move_task_index(t, allocated_st)
+                    for t in to_pipe:
+                        job.move_task_index(t, pipelined_st)
 
         for uid in touched_jobs:
             self._dirty_job(uid)
@@ -521,6 +560,14 @@ class Session:
                 continue
             binding = job.task_status_index[TaskStatus.Binding]
             moving_items = list(moving.items())
+            if not any(t.pod.spec.volumes for t in moving.values()):
+                # Volume-free fast path: no bind_volumes call can raise,
+                # so the whole bucket moves in bulk.
+                for t in moving.values():
+                    t.status = TaskStatus.Binding
+                binding.update(moving)
+                dispatching.extend(moving.values())
+                continue
             for i, (uid, t) in enumerate(moving_items):
                 try:
                     if t.pod.spec.volumes:  # no-op (and raise-free) without

@@ -102,6 +102,15 @@ class DeviceNodeScanner:
         self._task_res = np.asarray(inp.task_res)
         self._task_sig = np.asarray(inp.task_sig)
         self._checkpoints: List[np.ndarray] = []
+        # Incremental rescoring: between consecutive scans only the few
+        # rows an evict/pipeline touched change, so cache the last score
+        # vector per task-row identity and recompute just the dirty rows
+        # (identical ints to a full recompute — the math is row-pure).
+        # A preemption storm scans once per preemptor; this turns each
+        # O(N) rescore into O(dirty).
+        self._dirty: set = set()
+        self._score_key = None
+        self._scores_cached: Optional[np.ndarray] = None
 
     # -- transaction mirror (Statement commit/discard) ----------------------
 
@@ -115,6 +124,11 @@ class DeviceNodeScanner:
     def restore(self) -> None:
         if self._checkpoints:
             self.dyn = self._checkpoints.pop()
+            # Arbitrary rollback: the dirty set no longer describes the
+            # delta from the cached scores.
+            self._score_key = None
+            self._scores_cached = None
+            self._dirty.clear()
 
     # -- state updates ------------------------------------------------------
     # ``used`` (the scoring dimension) tracks session allocate/deallocate
@@ -130,11 +144,13 @@ class DeviceNodeScanner:
             return
         self.dyn[nix, 0] += sign * quantize_value(task.resreq.milli_cpu, 0)
         self.dyn[nix, 1] += sign * quantize_value(task.resreq.memory, 1)
+        self._dirty.add(nix)
 
     def apply_pipeline(self, task: TaskInfo, hostname: str) -> None:
         nix = self.node_index.get(hostname)
         if nix is None:
             return
+        self._dirty.add(nix)
         row = self.dyn[nix]
         ti = self.task_index.get(task.uid)
         r = self.r
@@ -177,27 +193,53 @@ class DeviceNodeScanner:
             out = np.asarray(scan_nodes(self.cfg, self.r, self.np_pad,
                                         self.ns_pad, self.statics, self.dyn,
                                         trow))
+            return out[:len(self.snap.node_names)]
+        key = (int(self._task_sig[ti]), self._task_res[ti].tobytes(),
+               self._task_ports[ti].tobytes(),
+               self._task_aff[ti].tobytes(),
+               self._task_anti[ti].tobytes(),
+               self._task_paffw[ti].tobytes(),
+               self._task_pantiw[ti].tobytes())
+        if self._scores_cached is not None and key == self._score_key:
+            if self._dirty:  # patch only the touched rows
+                rows = np.fromiter(self._dirty, dtype=np.int64,
+                                   count=len(self._dirty))
+                self._scores_cached[rows] = self._scores_numpy(ti, rows)
+                self._dirty.clear()
+            out = self._scores_cached
         else:
             out = self._scores_numpy(ti)
+            self._score_key = key
+            self._scores_cached = out
+            self._dirty.clear()
         return out[:len(self.snap.node_names)]
 
-    def _scores_numpy(self, ti: int) -> np.ndarray:
+    def _scores_numpy(self, ti: int, rows=None) -> np.ndarray:
         """The exact integer math of ops/scan.py in numpy: the grid floor
         divisions and weighted sums are plain int ops, so both engines
-        produce identical score integers."""
+        produce identical score integers.  ``rows``: optional node-row
+        index array — compute only those rows (the incremental-rescore
+        patch path); the math is row-pure, so a subset recompute equals
+        the full one on those rows."""
         from ..ops.resources import SCORE_GRID_K
         inp = self.snap.inputs
         cfg = self.cfg
         r = self.r
-        dyn = self.dyn
+        dyn = self.dyn if rows is None else self.dyn[rows]
         used = dyn[:, :r]
         count = dyn[:, r]
         sig = int(self._task_sig[ti])
         alloc = np.asarray(inp.node_alloc)
+        sig_row = np.asarray(inp.sig_mask)[sig]
+        exists = np.asarray(inp.node_exists)
+        maxt = np.asarray(inp.node_max_tasks)
+        if rows is not None:
+            alloc = alloc[rows]
+            sig_row = sig_row[rows]
+            exists = exists[rows]
+            maxt = maxt[rows]
         shift = np.asarray(inp.score_shift)
-        feasible = (np.asarray(inp.sig_mask)[sig]
-                    & np.asarray(inp.node_exists)
-                    & (count < np.asarray(inp.node_max_tasks)))
+        feasible = sig_row & exists & (count < maxt)
         if cfg.has_ports:
             ports = dyn[:, r + 1:r + 1 + self.np_pad]
             conflict = ((self._task_ports[ti][None, :] > 0)
@@ -235,23 +277,33 @@ class DeviceNodeScanner:
             wdiff = (self._task_paffw[ti].astype(np.int64)
                      - self._task_pantiw[ti])[None, :]
             score += SCORE_GRID_K * (wdiff * selcnt).sum(axis=-1)
-        score += np.asarray(inp.sig_bonus)[sig]
+        bonus = np.asarray(inp.sig_bonus)[sig]
+        score += bonus if rows is None else bonus[rows]
         return np.where(feasible, score,
                         np.int64(SCORE_NEG_INF)).astype(np.int64)
 
-    def candidate_nodes(self, task: TaskInfo,
-                        scored: bool) -> Optional[List[Tuple[str, int]]]:
-        """Feasible (node_name, score) pairs; score-descending with
+    def candidate_nodes(self, task: TaskInfo, scored: bool,
+                        admissible=None):
+        """Feasible (node_name, score) pairs, LAZY; score-descending with
         name-ascending tie-break when ``scored`` (SortNodes semantics,
         scheduler_helper.go:174-185), name-ascending otherwise (the
-        reclaim walk order)."""
+        reclaim walk order).  Returns None when the task is outside the
+        snapshot's candidate set.  Laziness matters: the eviction
+        actions stop at the first workable node, so materializing all
+        ~N feasible pairs per preemptor dominated the preempt storm.
+        ``admissible``: optional bool[N] pre-filter (VictimIndex mask)
+        ANDed into feasibility — one vector op instead of a per-node
+        Python check over the walk."""
         s = self.scores(task)
         if s is None:
             return None
-        feasible = np.nonzero(s > SCORE_NEG_INF)[0]
+        ok = s > SCORE_NEG_INF
+        if admissible is not None:
+            ok = ok & admissible[:len(s)]
+        feasible = np.nonzero(ok)[0]
         if scored:
             order = feasible[np.argsort(-s[feasible], kind="stable")]
         else:
             order = feasible
         names = self.snap.node_names
-        return [(names[i], int(s[i])) for i in order]
+        return ((names[i], int(s[i])) for i in order)

@@ -23,10 +23,25 @@ _F = np.float64  # host-side staging dtype; cast at device put
 
 
 def bucket(n: int, minimum: int = 8) -> int:
-    """Next power-of-two bucket (compilation-cache friendly)."""
+    """Next padded-shape bucket (compilation-cache friendly).
+
+    Powers of two up to 1024; quarter steps within each octave above
+    (1.0/1.25/1.5/1.75 x 2^k).  Worst-case padding drops from 2x to
+    1.25x — at kubemark scale that is 37% less node-major device state
+    (10000 -> 10240 instead of 16384) — while the compile-shape count
+    stays bounded (four shapes per octave).  Every bucket above 1024 is
+    a multiple of 256, keeping TPU lane alignment and mesh-shard
+    divisibility (N % n_devices == 0) intact."""
     b = minimum
     while b < n:
         b *= 2
+    if b <= 1024:
+        return b
+    half = b // 2
+    for frac in (1.25, 1.5, 1.75):
+        cand = int(half * frac)
+        if n <= cand:
+            return cand
     return b
 
 
@@ -327,15 +342,10 @@ def _sig_example(sig: tuple):
     return TaskInfo(pod)
 
 
-def _build_job_block(tc: TensorCache, job, axis, stock_order: bool,
-                     ssn) -> _JobBlock:
-    """Build one job's tensor block from its session clone (candidate
-    collection + order, quantized request columns, global feature ids,
-    DRF initial allocation)."""
-    from ..api import TaskStatus, allocated_status
-    from ..ops.resources import quantize_columns
+def _collect_job_tasks(job, stock_order: bool, ssn):
+    """(pending, best_effort) with pending in solver order."""
+    from ..api import TaskStatus
 
-    r = len(axis)
     bucket_tasks = list(job.task_status_index.get(TaskStatus.Pending,
                                                   {}).values())
     pending = [t for t in bucket_tasks if not t.resreq.is_empty()]
@@ -350,26 +360,93 @@ def _build_job_block(tc: TensorCache, job, axis, stock_order: bool,
         pending.sort(key=functools.cmp_to_key(
             lambda a, b: -1 if ssn.task_order_fn(a, b)
             else (1 if ssn.task_order_fn(b, a) else 0)))
+    return pending, best_effort
+
+
+def _task_res_columns(tasks, axis):
+    """[len(tasks), R] f64 (init_resreq, resreq) column matrices."""
+    r = len(axis)
+    c = len(tasks)
+    req_f = np.zeros((c, r), _F)
+    res_f = np.zeros((c, r), _F)
+    if c:
+        req_f[:, 0] = [t.init_resreq.milli_cpu for t in tasks]
+        req_f[:, 1] = [t.init_resreq.memory for t in tasks]
+        res_f[:, 0] = [t.resreq.milli_cpu for t in tasks]
+        res_f[:, 1] = [t.resreq.memory for t in tasks]
+        for i, name in enumerate(axis[2:], start=2):
+            req_f[:, i] = [t.init_resreq.scalar_resources.get(name, 0.0)
+                           for t in tasks]
+            res_f[:, i] = [t.resreq.scalar_resources.get(name, 0.0)
+                           for t in tasks]
+    return req_f, res_f
+
+
+def _build_job_blocks_bulk(tc: TensorCache, jobs, axis, stock_order: bool,
+                           ssn) -> list:
+    """Vectorized multi-job block build, output identical per job to
+    _build_job_block.  The cold first session builds EVERY job's block;
+    per-job numpy overhead (four small array allocations + two quantize
+    calls per job) dominates that walk, so the resource columns for all
+    jobs are built and quantized as one [sum(c), R] matrix and sliced
+    back into per-job views (VERDICT r3 next #1)."""
+    from ..ops.resources import quantize_columns
+
+    collected = [_collect_job_tasks(job, stock_order, ssn) for job in jobs]
+    flat = [t for pending, _ in collected for t in pending]
+    req_f, res_f = _task_res_columns(flat, axis)
+    req_q = quantize_columns(req_f)
+    res_q = quantize_columns(res_f)
+    blocks = []
+    s = 0
+    for job, (pending, best_effort) in zip(jobs, collected):
+        c = len(pending)
+        b = _JobBlock()
+        b.epoch = -1
+        b.count = c
+        b.uids = [t.uid for t in pending]
+        # Copies, not views: blocks outlive this build in the per-job
+        # cache, and a view would pin the whole cohort matrix in memory
+        # for as long as any one block survives.
+        b.res_f = res_f[s:s + c].copy()
+        b.req_q = req_q[s:s + c].copy()
+        b.res_q = res_q[s:s + c].copy()
+        s += c
+        _fill_block_features(tc, b, pending, best_effort, job, axis)
+        blocks.append(b)
+    return blocks
+
+
+def _build_job_block(tc: TensorCache, job, axis, stock_order: bool,
+                     ssn) -> _JobBlock:
+    """Build one job's tensor block from its session clone (candidate
+    collection + order, quantized request columns, global feature ids,
+    DRF initial allocation)."""
+    from ..ops.resources import quantize_columns
+
+    pending, best_effort = _collect_job_tasks(job, stock_order, ssn)
     c = len(pending)
     b = _JobBlock()
     b.epoch = -1
     b.count = c
     b.uids = [t.uid for t in pending]
-    req_f = np.zeros((c, r), _F)
-    res_f = np.zeros((c, r), _F)
-    if c:
-        req_f[:, 0] = [t.init_resreq.milli_cpu for t in pending]
-        req_f[:, 1] = [t.init_resreq.memory for t in pending]
-        res_f[:, 0] = [t.resreq.milli_cpu for t in pending]
-        res_f[:, 1] = [t.resreq.memory for t in pending]
-        for i, name in enumerate(axis[2:], start=2):
-            req_f[:, i] = [t.init_resreq.scalar_resources.get(name, 0.0)
-                           for t in pending]
-            res_f[:, i] = [t.resreq.scalar_resources.get(name, 0.0)
-                           for t in pending]
+    req_f, res_f = _task_res_columns(pending, axis)
     b.res_f = res_f
     b.req_q = quantize_columns(req_f)
     b.res_q = quantize_columns(res_f)
+    _fill_block_features(tc, b, pending, best_effort, job, axis)
+    return b
+
+
+def _fill_block_features(tc: TensorCache, b: _JobBlock, pending,
+                         best_effort, job, axis) -> None:
+    """Signature/port/affinity ids, BestEffort rows, and the DRF initial
+    allocation — the per-task Python shared by the single and bulk block
+    builders."""
+    from ..api import allocated_status
+
+    c = len(pending)
+    r = len(axis)
     b.sig_g = np.zeros((c,), np.int32)
     b.ports = []
     b.aff = []
@@ -432,9 +509,9 @@ def _build_job_block(tc: TensorCache, job, axis, stock_order: bool,
                 if r > 2 and t.resreq.scalar_resources:
                     for i, name in enumerate(axis[2:], start=2):
                         acc[i] += t.resreq.scalar_resources.get(name, 0.0)
+    from ..ops.resources import quantize_columns
     b.init_f = np.asarray(acc, dtype=_F)
     b.init_q = quantize_columns(b.init_f)
-    return b
 
 
 def _node_row_vectors(node, axis):
@@ -727,6 +804,41 @@ def tensorize_session(ssn) -> TensorSnapshot:
     stock_order = set(ssn.task_order_fns) <= {"priority"}
     truth_jobs = getattr(ssn.cache, "jobs", None) if tc.persistent else None
     w_podaff = int(w_podaff)
+    # Resolve per-job blocks: the O(tasks) slice comes from the block
+    # cache when the informers have not touched the job since it was
+    # built — keyed on the clone's SNAPSHOT-time epoch (stamped under
+    # the cache mutex), never on live truth (TOCTOU with reflectors).
+    # Many misses at once (the cold first session builds EVERY job) go
+    # through the vectorized bulk builder.
+    resolved: Dict[str, _JobBlock] = {}
+    miss: List[tuple] = []
+    for uid in job_uids:
+        job = ssn.jobs[uid]
+        snap_epoch = (getattr(job, "snap_epoch", None)
+                      if uid not in mutated_jobs else None)
+        reusable = stock_order and snap_epoch is not None
+        block = None
+        if reusable:
+            block = tc.jobs.get(uid)
+            if block is not None and block.epoch != snap_epoch:
+                block = None
+        if block is None:
+            miss.append((uid, job, snap_epoch, reusable))
+        else:
+            resolved[uid] = block
+    if miss:
+        if len(miss) > 64:
+            built = _build_job_blocks_bulk(
+                tc, [m[1] for m in miss], axis, stock_order, ssn)
+        else:
+            built = [_build_job_block(tc, m[1], axis, stock_order, ssn)
+                     for m in miss]
+        for (uid, _job, snap_epoch, reusable), block in zip(miss, built):
+            if reusable:
+                block.epoch = snap_epoch
+                tc.jobs[uid] = block
+            resolved[uid] = block
+
     blocks: List[_JobBlock] = []
     cursor = 0
     for ji, uid in enumerate(job_uids):
@@ -736,23 +848,7 @@ def tensorize_session(ssn) -> TensorSnapshot:
         job_prio[ji] = job.priority
         job_ts[ji] = job.creation_timestamp
         job_init_ready[ji] = job.ready_task_num()
-        # The O(tasks) slice comes from the per-job block cache when the
-        # informers have not touched the job since the block was built.
-        # Keyed on the clone's SNAPSHOT-time epoch (stamped under the
-        # cache mutex), never on live truth (TOCTOU with reflectors).
-        block = None
-        snap_epoch = (getattr(job, "snap_epoch", None)
-                      if uid not in mutated_jobs else None)
-        reusable = stock_order and snap_epoch is not None
-        if reusable:
-            block = tc.jobs.get(uid)
-            if block is not None and block.epoch != snap_epoch:
-                block = None
-        if block is None:
-            block = _build_job_block(tc, job, axis, stock_order, ssn)
-            if reusable:
-                block.epoch = snap_epoch
-                tc.jobs[uid] = block
+        block = resolved[uid]
         blocks.append(block)
         job_start[ji] = cursor
         job_count[ji] = block.count

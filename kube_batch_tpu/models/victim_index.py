@@ -1,0 +1,189 @@
+"""Per-node Running-resident index for the eviction actions.
+
+The reference's preempt/reclaim loops walk every candidate node per
+pending task and collect victim candidates by filtering the node's
+residents (preempt.go:190-211, reclaim.go:115-138).  On a cluster where
+a queue owns nothing (the permanently starved queue the reclaim e2e
+scenario models, test/e2e/queue.go:26-70), that walk is O(tasks x nodes
+x residents) of guaranteed-empty work.  This index — one pass over the
+session's residents — answers "can node X possibly yield a candidate
+for filter F?" so the actions skip nodes (and whole walks) that cannot
+produce victims.  It is a SUPERSET filter: statement evicts during the
+action only remove Running residents, so a node the index rejects has
+no candidates under the action's filter, while a node it admits is
+still filtered exactly as before — behavior is unchanged, only
+provably-empty work is skipped (discard/restore re-adds candidates the
+index still counts, keeping the superset property).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+
+from ..api import TaskStatus
+
+
+class VictimIndex:
+    """Counts of Running residents per node, by queue and by job."""
+
+    def __init__(self, ssn):
+        self.node_queue: Dict[str, Dict[str, int]] = {}
+        self.node_job: Dict[str, Dict[str, int]] = {}
+        self.node_total: Dict[str, int] = {}
+        self.queue_total: Dict[str, int] = {}
+        self.job_total: Dict[str, int] = {}
+        self.total = 0
+        # Vectorized admissibility (attach_nodes): [N, Q] count matrix
+        # in the scanner's node order, so a preemptor's whole node walk
+        # filters as one numpy mask instead of a per-node lambda.
+        self._names = None
+        self._row: Dict[str, int] = {}
+        self._qcol: Dict[str, int] = {}
+        self._mat: Optional[np.ndarray] = None
+        self._tot: Optional[np.ndarray] = None
+        jobs_get = ssn.jobs.get
+        running = TaskStatus.Running
+        for name, node in ssn.nodes.items():
+            nq: Dict[str, int] = {}
+            nj: Dict[str, int] = {}
+            for t in node.tasks.values():
+                if t.status is not running:
+                    continue
+                j = jobs_get(t.job)
+                if j is None:
+                    continue
+                nq[j.queue] = nq.get(j.queue, 0) + 1
+                nj[t.job] = nj.get(t.job, 0) + 1
+            if nq:
+                self.node_queue[name] = nq
+                self.node_job[name] = nj
+                n = sum(nq.values())
+                self.node_total[name] = n
+                self.total += n
+                for q, c in nq.items():
+                    self.queue_total[q] = self.queue_total.get(q, 0) + c
+                for ju, c in nj.items():
+                    self.job_total[ju] = self.job_total.get(ju, 0) + c
+
+    # -- per-node admissibility ---------------------------------------------
+
+    def node_for_queue(self, name: str, queue: str, exclude_job: str) -> bool:
+        """Node has a Running resident in ``queue`` from another job
+        (the inter-job preempt filter, preempt.go:190-199)."""
+        nq = self.node_queue.get(name)
+        if not nq:
+            return False
+        count = nq.get(queue, 0)
+        if not count:
+            return False
+        return count > self.node_job.get(name, {}).get(exclude_job, 0)
+
+    def node_for_job(self, name: str, job: str) -> bool:
+        """Node has a Running resident of ``job`` (intra-job preempt,
+        preempt.go:136-165)."""
+        return self.node_job.get(name, {}).get(job, 0) > 0
+
+    def node_for_other_queues(self, name: str, queue: str) -> bool:
+        """Node has a Running resident outside ``queue`` (reclaim,
+        reclaim.go:126-138)."""
+        total = self.node_total.get(name, 0)
+        if not total:
+            return False
+        return total > self.node_queue.get(name, {}).get(queue, 0)
+
+    # -- vectorized admissibility -------------------------------------------
+
+    def attach_nodes(self, node_names) -> None:
+        """Build the [N, Q] count matrix in ``node_names`` order (the
+        scanner's), enabling whole-walk masks."""
+        if self._names is node_names:
+            return
+        self._names = node_names
+        self._row = {n: i for i, n in enumerate(node_names)}
+        queues = sorted(self.queue_total)
+        self._qcol = {q: i for i, q in enumerate(queues)}
+        mat = np.zeros((len(node_names), max(1, len(queues))), np.int32)
+        tot = np.zeros((len(node_names),), np.int32)
+        for name, nq in self.node_queue.items():
+            r = self._row.get(name)
+            if r is None:
+                continue
+            for q, c in nq.items():
+                mat[r, self._qcol[q]] = c
+            tot[r] = self.node_total.get(name, 0)
+        self._mat = mat
+        self._tot = tot
+
+    def queue_mask(self, queue: str, exclude_job: str):
+        """bool[N] admissibility for inter-job preempt, or None when the
+        vectorized form doesn't apply (no matrix, unknown queue, or the
+        preemptor's own job has Running residents — then the caller
+        falls back to the exact per-node check)."""
+        if self._mat is None:
+            return None
+        col = self._qcol.get(queue)
+        if col is None or self.job_total.get(exclude_job, 0):
+            return None
+        return self._mat[:, col] > 0
+
+    def other_queues_mask(self, queue: str):
+        """bool[N] of nodes with a Running resident outside ``queue``
+        (reclaim), or None when no matrix is attached."""
+        if self._mat is None:
+            return None
+        col = self._qcol.get(queue)
+        mine = self._mat[:, col] if col is not None else 0
+        return self._tot > mine
+
+    # -- live updates (keep the index exact as the actions evict) -----------
+
+    def on_evict(self, node: str, queue: str, job: str) -> None:
+        """A Running resident of ``job``/``queue`` on ``node`` was
+        evicted (Running -> Releasing): without this, every drained node
+        keeps getting admitted and the walk degenerates back to the
+        O(tasks x nodes) empty scan."""
+        nq = self.node_queue.get(node)
+        if nq is not None and nq.get(queue, 0) > 0:
+            nq[queue] -= 1
+            self.node_job[node][job] = self.node_job[node].get(job, 1) - 1
+            self.node_total[node] -= 1
+            self.total -= 1
+            self.queue_total[queue] = self.queue_total.get(queue, 1) - 1
+            self.job_total[job] = self.job_total.get(job, 1) - 1
+            self._mat_delta(node, queue, -1)
+
+    def on_restore(self, node: str, queue: str, job: str) -> None:
+        """Inverse of on_evict (Statement.discard rolled the evict back)."""
+        nq = self.node_queue.setdefault(node, {})
+        nq[queue] = nq.get(queue, 0) + 1
+        nj = self.node_job.setdefault(node, {})
+        nj[job] = nj.get(job, 0) + 1
+        self.node_total[node] = self.node_total.get(node, 0) + 1
+        self.total += 1
+        self.queue_total[queue] = self.queue_total.get(queue, 0) + 1
+        self.job_total[job] = self.job_total.get(job, 0) + 1
+        self._mat_delta(node, queue, +1)
+
+    def _mat_delta(self, node: str, queue: str, sign: int) -> None:
+        if self._mat is None:
+            return
+        r = self._row.get(node)
+        c = self._qcol.get(queue)
+        if r is None or c is None:
+            return
+        self._mat[r, c] += sign
+        self._tot[r] += sign
+
+    # -- whole-walk admissibility -------------------------------------------
+
+    def any_for_queue(self, queue: str, exclude_job: str) -> bool:
+        count = self.queue_total.get(queue, 0)
+        return count > self.job_total.get(exclude_job, 0) if count else False
+
+    def any_for_job(self, job: str) -> bool:
+        return self.job_total.get(job, 0) > 0
+
+    def any_for_other_queues(self, queue: str) -> bool:
+        return self.total > self.queue_total.get(queue, 0)

@@ -1,0 +1,59 @@
+"""Native host-loop kernels (C extension, lazily built).
+
+The device solve runs on TPU; the remaining critical path at kubemark
+scale is Python bytecode over per-task object work.  ``fastpath.c``
+implements those loops against the CPython C API (the environment's
+sanctioned binding route) and this package builds it on first import
+with the system compiler, caching the shared object next to the source.
+Everything degrades transparently: when no compiler is available, or
+the build fails, callers get ``None`` and use their Python loops.
+
+Set ``KUBE_BATCH_TPU_NO_NATIVE=1`` to force the Python paths (used by
+the parity tests to compare both implementations).
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+
+_DIR = os.path.dirname(__file__)
+_SRC = os.path.join(_DIR, "fastpath.c")
+_SO = os.path.join(
+    _DIR, f"_fastpath.{sys.implementation.cache_tag}.so")
+
+
+def _build() -> bool:
+    cc = (sysconfig.get_config_var("CC") or "cc").split()[0]
+    include = sysconfig.get_paths()["include"]
+    cmd = [cc, "-O2", "-fPIC", "-shared", f"-I{include}",
+           _SRC, "-o", _SO]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return False
+    return proc.returncode == 0 and os.path.exists(_SO)
+
+
+def _load():
+    if os.environ.get("KUBE_BATCH_TPU_NO_NATIVE"):
+        return None
+    if (not os.path.exists(_SO)
+            or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):
+        if not _build():
+            return None
+    try:
+        spec = importlib.util.spec_from_file_location("_fastpath", _SO)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except (ImportError, OSError):
+        return None
+
+
+_mod = _load()
+apply_placements = getattr(_mod, "apply_placements", None)
+clone_task_map = getattr(_mod, "clone_task_map", None)

@@ -6,6 +6,8 @@ fake effectors, run the real open_session -> action.execute pipeline, and
 assert on the FakeBinder's recorded decisions.
 """
 
+import os
+
 import pytest
 
 from kube_batch_tpu.actions.allocate import AllocateAction
@@ -544,3 +546,87 @@ class TestBatchApplyVolumeFailure:
             assert statuses["p0"] != TaskStatus.Pending
         finally:
             close_session(ssn)
+
+
+class TestShippedPipelineAtScale:
+    """VERDICT r3 next #2: the reference's shipped 4-action pipeline
+    (reclaim, allocate, backfill, preempt + conformance) drives real
+    preemptions and reclaims on the full-cluster churn scenario."""
+
+    def _run(self, n_tasks, n_nodes, n_jobs, n_queues):
+        from kube_batch_tpu.api import TaskStatus
+        from kube_batch_tpu.actions.factory import register_default_actions
+        from kube_batch_tpu.framework import close_session, open_session
+        from kube_batch_tpu.models.synthetic import make_churn_cache
+        from kube_batch_tpu.plugins.factory import register_default_plugins
+        from kube_batch_tpu.scheduler import load_scheduler_conf
+        register_default_actions()
+        register_default_plugins()
+        conf_path = os.path.join(os.path.dirname(__file__), "..",
+                                 "config", "kube-batch-conf.yaml")
+        with open(conf_path) as fh:  # the SHIPPED conf, device action in
+            conf = fh.read().replace(
+                '"reclaim, allocate, backfill, preempt"',
+                '"reclaim, tpu-allocate, backfill, preempt"')
+        actions, tiers = load_scheduler_conf(conf)
+        cache, binder = make_churn_cache(n_tasks, n_nodes, n_jobs, n_queues)
+        ssn = open_session(cache, tiers)
+        for a in actions:
+            a.execute(ssn)
+        from kube_batch_tpu.api import TaskStatus as _TS
+        pipelined = sum(
+            len(j.task_status_index.get(_TS.Pipelined, {}))
+            for j in ssn.jobs.values())
+        close_session(ssn)
+        return cache, pipelined
+
+    def test_pipeline_preempts_and_reclaims(self):
+        cache, pipelined = self._run(1200, 200, 60, 4)
+        evicts = cache.evictor.evicts
+        assert len(evicts) > 0, "no evictions on a full cluster"
+        # Victims are exclusively low-priority pods.
+        assert all(key.startswith("churn/low") for key in evicts), \
+            evicts[:5]
+        # Every eviction freed room that a high-priority task now holds
+        # speculatively (Pipelined; binding happens next cycle once the
+        # kubelet analog confirms the release — reference semantics).
+        assert pipelined > 0
+        assert pipelined >= len(evicts) * 0.9
+
+    def test_conformance_protects_critical_pods(self):
+        """A kube-system victim survives the same storm (conformance veto
+        in the shipped tiers, conformance.go:41-61)."""
+        import dataclasses as dc
+        from kube_batch_tpu.actions.factory import register_default_actions
+        from kube_batch_tpu.framework import close_session, open_session
+        from kube_batch_tpu.models.synthetic import make_churn_cache
+        from kube_batch_tpu.plugins.factory import register_default_plugins
+        from kube_batch_tpu.scheduler import load_scheduler_conf
+        register_default_actions()
+        register_default_plugins()
+        conf_path = os.path.join(os.path.dirname(__file__), "..",
+                                 "config", "kube-batch-conf.yaml")
+        with open(conf_path) as fh:  # the SHIPPED conf, device action in
+            conf = fh.read().replace(
+                '"reclaim, allocate, backfill, preempt"',
+                '"reclaim, tpu-allocate, backfill, preempt"')
+        actions, tiers = load_scheduler_conf(conf)
+        cache, binder = make_churn_cache(600, 100, 30, 4)
+        # Mark one low-priority victim system-cluster-critical (replace
+        # the pod through the informer path; specs are immutable in
+        # place): conformance must veto it while its twins are evicted.
+        job = next(j for j in cache.jobs.values()
+                   if j.name.startswith("low"))
+        victim = next(iter(job.tasks.values()))
+        old_pod = victim.pod
+        new_pod = dc.replace(old_pod, spec=dc.replace(
+            old_pod.spec, priority_class_name="system-cluster-critical"))
+        cache.update_pod(old_pod, new_pod)
+        protected = f"{new_pod.metadata.namespace}/{new_pod.metadata.name}"
+        ssn = open_session(cache, tiers)
+        for a in actions:
+            a.execute(ssn)
+        close_session(ssn)
+        evicts = cache.evictor.evicts
+        assert len(evicts) > 0
+        assert protected not in evicts

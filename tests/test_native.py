@@ -1,0 +1,76 @@
+"""Native fastpath (kube_batch_tpu/native): the C pass of batch_apply
+must end sessions in exactly the state the Python loop produces."""
+
+import subprocess
+import sys
+
+import pytest
+
+from kube_batch_tpu.native import apply_placements
+
+
+@pytest.mark.skipif(apply_placements is None,
+                    reason="native extension unavailable")
+class TestNativeApplyParity:
+    def _state(self, ssn):
+        jobs = {}
+        for uid, job in ssn.jobs.items():
+            jobs[uid] = {
+                "alloc": (job.allocated.milli_cpu, job.allocated.memory),
+                "index": {st.name: sorted(b) for st, b in
+                          job.task_status_index.items()},
+                "statuses": {t.uid: t.status.name
+                             for t in job.tasks.values()},
+            }
+        nodes = {}
+        for name, node in ssn.nodes.items():
+            nodes[name] = {
+                "idle": (node.idle.milli_cpu, node.idle.memory),
+                "tasks": {k: (t.uid, t.status.name, t.node_name)
+                          for k, t in node.tasks.items()},
+            }
+        return jobs, nodes
+
+    def test_session_end_state_matches_python_loop(self):
+        out = {}
+        for force_python in (False, True):
+            code = f"""
+import os
+os.environ["JAX_PLATFORMS"] = "cpu"
+if {force_python}:
+    os.environ["KUBE_BATCH_TPU_NO_NATIVE"] = "1"
+import json
+from kube_batch_tpu.native import apply_placements
+assert ({force_python} and apply_placements is None) or \\
+       (not {force_python} and apply_placements is not None)
+from kube_batch_tpu.actions.factory import register_default_actions
+from kube_batch_tpu.actions.tpu_allocate import TpuAllocateAction
+from kube_batch_tpu.framework import close_session, open_session
+from kube_batch_tpu.models.synthetic import make_synthetic_cache
+from kube_batch_tpu.plugins.factory import register_default_plugins
+from kube_batch_tpu.scheduler import DEFAULT_SCHEDULER_CONF, load_scheduler_conf
+register_default_actions(); register_default_plugins()
+cache, binder = make_synthetic_cache(600, 40, 30, 3, n_signatures=4)
+_, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+ssn = open_session(cache, tiers)
+TpuAllocateAction().execute(ssn)
+jobs = {{}}
+for uid, job in ssn.jobs.items():
+    jobs[uid] = dict(
+        alloc=(job.allocated.milli_cpu, job.allocated.memory),
+        index={{st.name: sorted(b) for st, b in job.task_status_index.items()}})
+nodes = {{}}
+for name, node in ssn.nodes.items():
+    nodes[name] = dict(
+        idle=(node.idle.milli_cpu, node.idle.memory),
+        tasks={{k: (t.uid, t.status.name, t.node_name)
+               for k, t in sorted(node.tasks.items())}})
+close_session(ssn)
+print(json.dumps(dict(jobs=jobs, nodes=nodes, binds=sorted(binder.binds.items()))))
+"""
+            proc = subprocess.run([sys.executable, "-c", code],
+                                  capture_output=True, text=True,
+                                  timeout=300)
+            assert proc.returncode == 0, proc.stderr[-2000:]
+            out[force_python] = proc.stdout.strip().splitlines()[-1]
+        assert out[False] == out[True]

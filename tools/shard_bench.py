@@ -84,5 +84,62 @@ def main():
     }))
 
 
+def sweep():
+    """Crossover derivation (VERDICT r3 next #4): measure the
+    single-chip solve across node counts to get the per-node marginal
+    cost of one placement step, then derive where sharding over K chips
+    pays for its 2 packed ICI collectives per placement:
+
+        saves/placement = per_node_cost * N * (1 - 1/K)
+        crossover N*    = collective_cost / (per_node_cost * (1 - 1/K))
+
+    Only the single-chip side is measurable on this machine (one real
+    TPU; the 8-device CPU mesh timeshares one host core, so its wall
+    clock measures overhead, not speedup — also recorded).  The ICI
+    collective cost is the documented v5e ring latency band (2-10 us
+    for a small all-reduce pair); the gate ships at the conservative
+    top of the band.  Prints one JSON line consumed into
+    doc/SHARD_BENCH.json."""
+    import numpy as np
+
+    from kube_batch_tpu.models.synthetic import make_synthetic_inputs
+    from kube_batch_tpu.ops.solver import solve_allocate
+
+    n_tasks = int(os.environ.get("SHARD_TASKS", 2048))
+    points = []
+    for n_nodes in (2560, 5120, 10240, 20480, 40960):
+        inputs, config = make_synthetic_inputs(
+            n_tasks=n_tasks, n_nodes=n_nodes, n_jobs=64, n_queues=4,
+            seed=0)
+        np.asarray(solve_allocate(inputs, config).assignment)  # compile
+        runs = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            np.asarray(solve_allocate(inputs, config).assignment)
+            runs.append((time.perf_counter() - t0) * 1e3)
+        points.append((n_nodes, sorted(runs)[1]))
+    # Least-squares slope of solve-ms vs N -> per-(node*placement) cost.
+    xs = np.array([p[0] for p in points], dtype=np.float64)
+    ys = np.array([p[1] for p in points], dtype=np.float64)
+    slope_ms_per_node = float(np.polyfit(xs, ys, 1)[0])
+    per_node_us = slope_ms_per_node * 1e3 / n_tasks  # per placement step
+    k = 8
+    crossover = {}
+    for coll_us in (2.0, 5.0, 10.0):
+        n_star = coll_us / max(per_node_us * (1 - 1 / k), 1e-9)
+        crossover[f"collective_{coll_us}us"] = int(n_star)
+    print(json.dumps({
+        "metric": f"single-chip solve scaling, {n_tasks} tasks",
+        "backend": __import__("jax").default_backend(),
+        "points_ms": {str(n): round(ms, 1) for n, ms in points},
+        "per_node_per_placement_us": round(per_node_us, 5),
+        "mesh_devices": k,
+        "crossover_nodes": crossover,
+    }))
+
+
 if __name__ == "__main__":
-    main()
+    if "--sweep" in sys.argv:
+        sweep()
+    else:
+        main()

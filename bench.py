@@ -251,7 +251,6 @@ def measure_action_pipeline(n_tasks, n_nodes, n_jobs, n_queues,
     each timed cycle runs on its own fresh cache (the scenario is
     consumed by its own evictions).  Returns ({action: (med, p90)},
     evictions)."""
-    from kube_batch_tpu.actions.factory import new_action
     from kube_batch_tpu.framework import close_session, open_session
     from kube_batch_tpu.models.synthetic import make_churn_cache
     from kube_batch_tpu.scheduler import load_scheduler_conf

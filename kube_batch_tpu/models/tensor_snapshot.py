@@ -180,6 +180,9 @@ def _task_port_keys(task) -> tuple:
     return _pod_static(task.pod)[3]
 
 
+_EMPTY_SIG = ((), (), (), ())
+
+
 def _pod_static(pod) -> tuple:
     """(spec, has_features, signature, port_keys) for a pod, cached on the
     pod object keyed by spec IDENTITY.
@@ -195,9 +198,17 @@ def _pod_static(pod) -> tuple:
     cached = pod.__dict__.get("_tensor_static")
     if cached is not None and cached[0] is spec:
         return cached
+    has_ports = False
+    for c in spec.containers:  # explicit loops: no genexpr frame per pod
+        for p in c.ports:
+            if p.host_port > 0:
+                has_ports = True
+                break
+        if has_ports:
+            break
     has_features = bool(
         spec.node_selector or spec.tolerations or spec.affinity is not None
-        or any(p.host_port > 0 for c in spec.containers for p in c.ports))
+        or has_ports)
     if has_features:
         sel = tuple(sorted(spec.node_selector.items()))
         tol = tuple(sorted((t.key, t.operator, t.value, t.effect)
@@ -219,7 +230,7 @@ def _pod_static(pod) -> tuple:
                       for c in spec.containers for p in c.ports
                       if p.host_port > 0)
     else:
-        sig = ((), (), (), ())
+        sig = _EMPTY_SIG  # interned: featureless pods share one tuple
         ports = ()
     cached = (spec, has_features, sig, ports)
     pod.__dict__["_tensor_static"] = cached
@@ -453,9 +464,11 @@ def _fill_block_features(tc: TensorCache, b: _JobBlock, pending,
     b.anti = []
     b.paff = []
     b.panti = []
+    empty_gid = tc.sig_id(_EMPTY_SIG)  # skip the tuple hash per task
     for off, t in enumerate(pending):
         _spec, has_features, sig, pkeys = _pod_static(t.pod)
-        b.sig_g[off] = tc.sig_id(sig)
+        b.sig_g[off] = (empty_gid if sig is _EMPTY_SIG
+                        else tc.sig_id(sig))
         if has_features:
             for pk in pkeys:
                 b.ports.append((off, tc.port_id(pk)))
@@ -485,7 +498,8 @@ def _fill_block_features(tc: TensorCache, b: _JobBlock, pending,
     b.be_anti = []
     for off, t in enumerate(best_effort):
         _spec, has_features, sig, pkeys = _pod_static(t.pod)
-        b.be_sig[off] = tc.sig_id(sig)
+        b.be_sig[off] = (empty_gid if sig is _EMPTY_SIG
+                         else tc.sig_id(sig))
         if has_features:
             for pk in pkeys:
                 b.be_ports.append((off, tc.port_id(pk)))

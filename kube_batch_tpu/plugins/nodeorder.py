@@ -22,7 +22,10 @@ from typing import Dict, Tuple
 from ..api import NodeInfo, TaskInfo
 from ..framework import Arguments, Plugin
 from ..framework.events import EventHandler
-from ..ops.resources import (SCORE_GRID_K, grid_fraction_int, quantize_value,
+import numpy as np
+
+from ..ops.resources import (SCORE_GRID_K, grid_fraction_int,
+                             quantize_columns, quantize_value,
                              score_shift_for)
 
 # Argument keys (nodeorder.go:41-66).
@@ -43,17 +46,29 @@ class GridUsage:
     paths."""
 
     def __init__(self, ssn):
-        max_cpu = max_mem = 0
         self.cap: Dict[str, Tuple[int, int]] = {}
         self.used: Dict[str, Tuple[int, int]] = {}
-        for name, node in ssn.nodes.items():
-            cap = (quantize_value(node.allocatable.milli_cpu, 0),
-                   quantize_value(node.allocatable.memory, 1))
-            self.cap[name] = cap
-            self.used[name] = (quantize_value(node.used.milli_cpu, 0),
-                               quantize_value(node.used.memory, 1))
-            max_cpu = max(max_cpu, cap[0])
-            max_mem = max(max_mem, cap[1])
+        names = list(ssn.nodes)
+        if names:
+            # Column-wise quantization (identical ints to per-value
+            # quantize_value: same exact power-of-two scale + rint);
+            # 4 numpy passes beat 4 Python calls per node.
+            nodes = [ssn.nodes[n] for n in names]
+            arr = np.empty((len(names), 2), np.float64)
+            arr[:, 0] = [nd.allocatable.milli_cpu for nd in nodes]
+            arr[:, 1] = [nd.allocatable.memory for nd in nodes]
+            caps = quantize_columns(arr)
+            arr[:, 0] = [nd.used.milli_cpu for nd in nodes]
+            arr[:, 1] = [nd.used.memory for nd in nodes]
+            useds = quantize_columns(arr)
+            self.cap = {n: (int(c), int(m)) for n, (c, m)
+                        in zip(names, caps.tolist())}
+            self.used = {n: (int(c), int(m)) for n, (c, m)
+                         in zip(names, useds.tolist())}
+            max_cpu = int(caps[:, 0].max())
+            max_mem = int(caps[:, 1].max())
+        else:
+            max_cpu = max_mem = 0
         self.shift = (score_shift_for(max_cpu), score_shift_for(max_mem))
 
     def task_quanta(self, task: TaskInfo) -> Tuple[int, int]:

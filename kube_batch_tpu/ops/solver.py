@@ -412,11 +412,20 @@ def fetch_result(result: "SolveResult"):
     return packed[0], packed[1], packed[2]
 
 
-# A single chip solves comfortably until node-major state approaches its
-# VMEM/HBM working-set budget; past this the session shards over the mesh.
-# Overridable for ops tuning; FORCE_SHARD exists for tests and drills.
+# When to shard the solve over the mesh.  MEASUREMENT-DERIVED
+# (doc/SHARD_BENCH.json, tools/shard_bench.py --sweep): the single-chip
+# solve's per-node marginal cost is ~0.51 ns per placement step (TPU
+# v5e, node axis 2.5k-41k sweep), so sharding over K=8 chips saves
+# ~0.51ns * N * 7/8 per placement and costs one packed pmax + one
+# packed pmin on ICI (~2-10 us for the pair).  Break-even lands between
+# ~4.5k nodes (2 us collectives) and ~22.5k (10 us); the default gate
+# sits mid-conservative at 16384.  A bytes cap still triggers sharding
+# when node-major state would pressure one chip's HBM regardless of
+# latency.  Overridable for ops tuning; FORCE_SHARD for tests/drills.
+SHARD_NODES_ENV = "KUBE_BATCH_TPU_SHARD_NODES"
 SHARD_BYTES_ENV = "KUBE_BATCH_TPU_SHARD_BYTES"
 FORCE_SHARD_ENV = "KUBE_BATCH_TPU_FORCE_SHARD"
+DEFAULT_SHARD_NODES = 16384
 DEFAULT_SHARD_BYTES = 256 * 1024 * 1024
 
 
@@ -433,6 +442,21 @@ def _node_state_bytes(inp: SolverInputs) -> int:
     return n * per_node
 
 
+def _env_int(name: str, default: int) -> int:
+    """Tuning-knob parse that cannot take down the routing chokepoint:
+    a malformed value falls back to the default instead of raising in
+    every solve."""
+    import os
+
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return int(raw)
+    except ValueError:
+        return default
+
+
 def choose_solver_mesh(inp: SolverInputs):
     """('sharded'|'pallas'|'xla', mesh) — one production chokepoint, chosen
     by shape and environment (SURVEY.md §7 stage 7: pjit-shard [P, N] when
@@ -443,8 +467,10 @@ def choose_solver_mesh(inp: SolverInputs):
     from ..parallel.mesh import default_mesh
     mesh = default_mesh()
     if mesh is not None and inp.node_idle.shape[0] % mesh.size == 0:
-        limit = int(os.environ.get(SHARD_BYTES_ENV, DEFAULT_SHARD_BYTES))
+        node_gate = _env_int(SHARD_NODES_ENV, DEFAULT_SHARD_NODES)
+        limit = _env_int(SHARD_BYTES_ENV, DEFAULT_SHARD_BYTES)
         if os.environ.get(FORCE_SHARD_ENV) == "1" \
+                or inp.node_idle.shape[0] >= node_gate \
                 or _node_state_bytes(inp) > limit:
             return "sharded", mesh
     if jax.default_backend() == "tpu":

@@ -300,10 +300,27 @@ class DeviceNodeScanner:
         ok = s > SCORE_NEG_INF
         if admissible is not None:
             ok = ok & admissible[:len(s)]
-        feasible = np.nonzero(ok)[0]
-        if scored:
-            order = feasible[np.argsort(-s[feasible], kind="stable")]
-        else:
-            order = feasible
         names = self.snap.node_names
-        return ((names[i], int(s[i])) for i in order)
+        if not scored:
+            return ((names[i], int(s[i])) for i in np.nonzero(ok)[0])
+
+        def ranked():
+            # Repeated argmax for the first few nodes — the walk almost
+            # always stops within a handful — then one full sort for the
+            # (rare) long tail.  Sequence is IDENTICAL to the stable
+            # descending argsort: np.argmax returns the lowest index
+            # among equal maxima, the same index-ascending tie-break.
+            masked = np.where(ok, s, np.int64(SCORE_NEG_INF))
+            if masked.size == 0:  # zero-node snapshot: nothing to rank
+                return
+            for _ in range(8):
+                i = int(np.argmax(masked))
+                if masked[i] == SCORE_NEG_INF:
+                    return
+                yield names[i], int(s[i])
+                masked[i] = SCORE_NEG_INF
+            feas = np.nonzero(masked > SCORE_NEG_INF)[0]
+            order = feas[np.argsort(-masked[feas], kind="stable")]
+            for i in order:
+                yield names[i], int(s[i])
+        return ranked()

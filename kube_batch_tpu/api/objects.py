@@ -211,12 +211,13 @@ def pod_key(pod: Pod) -> str:
     Cached on the pod object: namespace/name are immutable for a given
     Pod, and the hot paths (binds, node accounting, event egress) compute
     this key several times per task per cycle."""
-    try:
-        return pod._pod_key
-    except AttributeError:
+    # getattr-with-default, not try/except: materializing an
+    # AttributeError per first-touch pod costs more than the key build.
+    key = getattr(pod, "_pod_key", None)
+    if key is None:
         key = f"{pod.metadata.namespace}/{pod.metadata.name}"
         pod._pod_key = key
-        return key
+    return key
 
 
 def get_pod_resource_without_init_containers(pod: Pod) -> Resource:

@@ -57,3 +57,5 @@ def _load():
 _mod = _load()
 apply_placements = getattr(_mod, "apply_placements", None)
 clone_task_map = getattr(_mod, "clone_task_map", None)
+pod_static = getattr(_mod, "pod_static", None)
+pod_static_setup = getattr(_mod, "pod_static_setup", None)

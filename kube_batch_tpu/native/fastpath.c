@@ -22,6 +22,19 @@
 #include <Python.h>
 #include <structmember.h>
 
+/* Exception-free attribute probe (returns -1 err / 0 missing / 1 found
+ * with a new ref in *result): a missed PyObject_GetAttr materializes an
+ * AttributeError per miss, which costs more than the work these fast
+ * paths replace.  CPython 3.13 made this public as
+ * PyObject_GetOptionalAttr; on 3.12 and older the same function is
+ * exported (but undeclared) as _PyObject_LookupAttr. */
+#if PY_VERSION_HEX >= 0x030D0000
+#define LOOKUP_ATTR PyObject_GetOptionalAttr
+#else
+extern int _PyObject_LookupAttr(PyObject *, PyObject *, PyObject **);
+#define LOOKUP_ATTR _PyObject_LookupAttr
+#endif
+
 /* Cached attribute-name objects (created once at module init). */
 static PyObject *s_job, *s_pod, *s_spec, *s_volumes, *s_node_name,
     *s_name, *s_tasks, *s_clone_lite, *s_pod_key_cache, *s_metadata,
@@ -105,10 +118,11 @@ get_pod_key(PyObject *pod)
 {
     /* pod._pod_key, computing and caching "ns/name" on first use —
      * mirrors api/objects.py pod_key(). */
-    PyObject *key = PyObject_GetAttr(pod, s_pod_key_cache);
+    PyObject *key;
+    if (LOOKUP_ATTR(pod, s_pod_key_cache, &key) < 0)
+        return NULL;
     if (key != NULL)
         return key;
-    PyErr_Clear();
     PyObject *meta = PyObject_GetAttr(pod, s_metadata);
     if (meta == NULL)
         return NULL;
@@ -454,11 +468,154 @@ cfail:
     return NULL;
 }
 
+/* pod_static: the first-touch static-feature derivation of
+ * models/tensor_snapshot._pod_static.  The cold first session derives
+ * it for EVERY pod (50k calls); the common case — a featureless pod —
+ * is a handful of attribute reads ending in an interned result tuple,
+ * which is pure C here.  Pods with any static feature (selector,
+ * tolerations, affinity, host ports) delegate to the Python body
+ * registered via pod_static_setup, which also owns the tuple-building
+ * and caching for that branch.  Cache contract is identical: the
+ * result is stored on the pod keyed by spec identity. */
+static PyObject *ps_empty_sig = NULL, *ps_slow_fn = NULL,
+    *ps_empty_tuple = NULL;
+static PyObject *s_tensor_static, *s_containers, *s_ports, *s_host_port,
+    *s_node_selector, *s_tolerations, *s_affinity;
+
+static PyObject *
+pod_static_setup(PyObject *self, PyObject *args)
+{
+    PyObject *empty_sig, *slow_fn;
+    if (!PyArg_ParseTuple(args, "OO", &empty_sig, &slow_fn))
+        return NULL;
+    Py_XDECREF(ps_empty_sig);
+    Py_XDECREF(ps_slow_fn);
+    Py_INCREF(empty_sig);
+    ps_empty_sig = empty_sig;
+    Py_INCREF(slow_fn);
+    ps_slow_fn = slow_fn;
+    if (ps_empty_tuple == NULL) {
+        ps_empty_tuple = PyTuple_New(0);
+        if (ps_empty_tuple == NULL)
+            return NULL;
+    }
+    Py_RETURN_NONE;
+}
+
+static PyObject *
+pod_static(PyObject *self, PyObject *pod)
+{
+    if (ps_slow_fn == NULL) {
+        PyErr_SetString(PyExc_RuntimeError, "pod_static_setup not called");
+        return NULL;
+    }
+    PyObject *spec = PyObject_GetAttr(pod, s_spec);
+    if (spec == NULL)
+        return NULL;
+    PyObject *cached;
+    if (LOOKUP_ATTR(pod, s_tensor_static, &cached) < 0) {
+        Py_DECREF(spec);
+        return NULL;
+    }
+    if (cached != NULL) {
+        if (PyTuple_CheckExact(cached) && PyTuple_GET_SIZE(cached) == 4
+            && PyTuple_GET_ITEM(cached, 0) == spec) {
+            Py_DECREF(spec);
+            return cached;
+        }
+        Py_DECREF(cached);
+    }
+
+    /* Featureless probe; anything unexpected delegates to Python. */
+    int featured = 0, delegate = 0;
+    PyObject *sel = PyObject_GetAttr(spec, s_node_selector);
+    PyObject *tol = sel ? PyObject_GetAttr(spec, s_tolerations) : NULL;
+    PyObject *aff = tol ? PyObject_GetAttr(spec, s_affinity) : NULL;
+    if (aff == NULL) {
+        PyErr_Clear();
+        delegate = 1;
+    } else {
+        int t1 = PyObject_IsTrue(sel);
+        int t2 = PyObject_IsTrue(tol);
+        if (t1 < 0 || t2 < 0) {
+            PyErr_Clear();
+            delegate = 1;
+        } else {
+            featured = t1 || t2 || (aff != Py_None);
+        }
+    }
+    Py_XDECREF(sel);
+    Py_XDECREF(tol);
+    Py_XDECREF(aff);
+
+    if (!delegate && !featured) {
+        PyObject *containers = PyObject_GetAttr(spec, s_containers);
+        if (containers == NULL || !PyList_CheckExact(containers)) {
+            Py_XDECREF(containers);
+            PyErr_Clear();
+            delegate = 1;
+        } else {
+            for (Py_ssize_t i = 0;
+                 !featured && !delegate
+                     && i < PyList_GET_SIZE(containers); i++) {
+                PyObject *ports = PyObject_GetAttr(
+                    PyList_GET_ITEM(containers, i), s_ports);
+                if (ports == NULL || !PyList_CheckExact(ports)) {
+                    Py_XDECREF(ports);
+                    PyErr_Clear();
+                    delegate = 1;
+                    break;
+                }
+                for (Py_ssize_t k = 0; k < PyList_GET_SIZE(ports); k++) {
+                    PyObject *hp = PyObject_GetAttr(
+                        PyList_GET_ITEM(ports, k), s_host_port);
+                    if (hp == NULL) {
+                        PyErr_Clear();
+                        delegate = 1;
+                        break;
+                    }
+                    long v = PyLong_AsLong(hp);
+                    Py_DECREF(hp);
+                    if (v == -1 && PyErr_Occurred()) {
+                        PyErr_Clear();
+                        delegate = 1;
+                        break;
+                    }
+                    if (v > 0) {
+                        featured = 1;
+                        break;
+                    }
+                }
+                Py_DECREF(ports);
+            }
+            Py_DECREF(containers);
+        }
+    }
+
+    if (delegate || featured) {
+        Py_DECREF(spec);
+        return PyObject_CallOneArg(ps_slow_fn, pod);
+    }
+
+    PyObject *result = PyTuple_Pack(4, spec, Py_False, ps_empty_sig,
+                                    ps_empty_tuple);
+    Py_DECREF(spec);
+    if (result == NULL)
+        return NULL;
+    if (PyObject_SetAttr(pod, s_tensor_static, result) < 0)
+        PyErr_Clear();  /* uncacheable pod: still return the tuple */
+    return result;
+}
+
 static PyMethodDef methods[] = {
     {"apply_placements", apply_placements, METH_VARARGS,
      "Pass 1 of Session.batch_apply (see module docstring)."},
     {"clone_task_map", clone_task_map, METH_VARARGS,
      "Clone a job's {uid: TaskInfo} map plus its status index."},
+    {"pod_static_setup", pod_static_setup, METH_VARARGS,
+     "Register (empty_sig, slow_fn) for pod_static."},
+    {"pod_static", pod_static, METH_O,
+     "First-touch static-feature derivation for a pod (cached)."},
     {NULL, NULL, 0, NULL},
 };
 
@@ -481,9 +638,18 @@ PyInit__fastpath(void)
     s_pod_key_cache = PyUnicode_InternFromString("_pod_key");
     s_metadata = PyUnicode_InternFromString("metadata");
     s_namespace = PyUnicode_InternFromString("namespace");
+    s_tensor_static = PyUnicode_InternFromString("_tensor_static");
+    s_containers = PyUnicode_InternFromString("containers");
+    s_ports = PyUnicode_InternFromString("ports");
+    s_host_port = PyUnicode_InternFromString("host_port");
+    s_node_selector = PyUnicode_InternFromString("node_selector");
+    s_tolerations = PyUnicode_InternFromString("tolerations");
+    s_affinity = PyUnicode_InternFromString("affinity");
     if (!s_job || !s_pod || !s_spec || !s_volumes || !s_node_name
         || !s_name || !s_tasks || !s_clone_lite || !s_pod_key_cache
-        || !s_metadata || !s_namespace)
+        || !s_metadata || !s_namespace || !s_tensor_static
+        || !s_containers || !s_ports || !s_host_port || !s_node_selector
+        || !s_tolerations || !s_affinity)
         return NULL;
     return PyModule_Create(&moduledef);
 }

@@ -6,7 +6,7 @@ import sys
 
 import pytest
 
-from kube_batch_tpu.native import apply_placements
+from kube_batch_tpu.native import apply_placements, pod_static
 
 
 @pytest.mark.skipif(apply_placements is None,
@@ -36,7 +36,12 @@ class TestNativeApplyParity:
         for force_python in (False, True):
             code = f"""
 import os
-os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+# config.update, not the env var: the runtime may register a TPU
+# plugin at interpreter start, and the env route can block on its
+# backend while config.update reliably pins the CPU platform
+# (tests/conftest.py uses the same route).
+jax.config.update("jax_platforms", "cpu")
 if {force_python}:
     os.environ["KUBE_BATCH_TPU_NO_NATIVE"] = "1"
 import json
@@ -74,3 +79,71 @@ print(json.dumps(dict(jobs=jobs, nodes=nodes, binds=sorted(binder.binds.items())
             assert proc.returncode == 0, proc.stderr[-2000:]
             out[force_python] = proc.stdout.strip().splitlines()[-1]
         assert out[False] == out[True]
+
+
+@pytest.mark.skipif(pod_static is None,
+                    reason="native extension unavailable")
+class TestPodStaticParity:
+    """The C first-touch derivation must produce the same tuples (and the
+    same interning/caching behavior) as the Python body for every feature
+    combination; featured pods delegate to the Python body."""
+
+    def _pods(self):
+        from kube_batch_tpu.api import (Affinity, Container, ContainerPort,
+                                        ObjectMeta, Pod, PodSpec, PodStatus,
+                                        Toleration)
+
+        def pod(uid, spec):
+            return Pod(metadata=ObjectMeta(name=uid, namespace="n", uid=uid),
+                       spec=spec, status=PodStatus(phase="Pending"))
+
+        return [
+            pod("plain", PodSpec(containers=[
+                Container(requests={"cpu": "1"})])),
+            pod("no-containers", PodSpec()),
+            pod("zero-port", PodSpec(containers=[
+                Container(requests={"cpu": "1"},
+                          ports=[ContainerPort(host_port=0)])])),
+            pod("host-port", PodSpec(containers=[
+                Container(requests={"cpu": "1"},
+                          ports=[ContainerPort(host_port=80,
+                                               protocol="UDP")])])),
+            pod("selector", PodSpec(node_selector={"zone": "z1", "a": "b"})),
+            pod("tolerations", PodSpec(tolerations=[
+                Toleration("k", "Equal", "v", "NoSchedule")])),
+            pod("affinity", PodSpec(affinity=Affinity(
+                required_node_terms=[{"x": "y"}],
+                preferred_node_terms=[(3, {"p": "q"})]))),
+            pod("empty-affinity", PodSpec(affinity=Affinity())),
+        ]
+
+    def test_matches_python_body(self):
+        import kube_batch_tpu.models.tensor_snapshot as ts
+
+        assert ts._pod_static is pod_static  # native path is wired in
+        for pod in self._pods():
+            got = ts._pod_static(pod)
+            # Re-derive via a fresh equivalent pod through the Python
+            # body (registered as the slow path): strip the cache and
+            # compare tuples field by field.
+            import dataclasses as dc
+            clone = dc.replace(pod)
+            py = ts._pod_static_py(clone)
+            assert got[1] == py[1], pod.metadata.uid       # has_features
+            assert got[2] == py[2], pod.metadata.uid       # signature
+            assert got[3] == py[3], pod.metadata.uid       # port keys
+            if not got[1]:
+                assert got[2] is ts._EMPTY_SIG             # interned
+            # cache hit returns the identical tuple
+            assert ts._pod_static(pod) is got
+
+    def test_cache_invalidates_on_spec_replacement(self):
+        import dataclasses as dc
+
+        import kube_batch_tpu.models.tensor_snapshot as ts
+        pod = self._pods()[0]
+        first = ts._pod_static(pod)
+        pod.spec = dc.replace(pod.spec, node_selector={"k": "v"})
+        second = ts._pod_static(pod)
+        assert second is not first
+        assert second[1] is True and second[2][0] == (("k", "v"),)

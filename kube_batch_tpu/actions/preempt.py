@@ -101,21 +101,29 @@ class PreemptAction(Action):
                                 scanner, node_ok, vindex, evict_log,
                                 mask_fn):
                         assigned = True
+                    # Pipelined checked at loop BOTTOM (preempt.go:
+                    # 117-121): a re-popped already-pipelined job still
+                    # preempts for one more task per pop.
                     if ssn.job_pipelined(preemptor_job):
-                        stmt.commit()
-                        if scanner is not None:
-                            scanner.commit()
                         break
 
-                if not ssn.job_pipelined(preemptor_job):
+                # Commit/discard decided once after the walk — every
+                # checkpoint frame is balanced by exactly one commit or
+                # restore, including the re-popped pipelined job whose
+                # task queue is empty (an empty commit; the old
+                # commit-inside-the-loop leaked that frame).
+                if ssn.job_pipelined(preemptor_job):
+                    stmt.commit()
+                    if scanner is not None:
+                        scanner.commit()
+                    if assigned:
+                        preemptors.push(preemptor_job)
+                else:
                     stmt.discard()
                     if scanner is not None:
                         scanner.restore()
                     for entry in evict_log:  # discard restored the victims
                         vindex.on_restore(*entry)
-                    continue
-                if assigned:
-                    preemptors.push(preemptor_job)
 
             # Preemption between tasks within a job (preempt.go:136-165).
             for job in under_request:

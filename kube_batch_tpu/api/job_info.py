@@ -96,6 +96,11 @@ class JobInfo:
         # node name -> leftover-after-fit vector for fit-error reporting.
         self.nodes_fit_delta: Dict[str, Resource] = {}
         self.task_status_index: Dict[TaskStatus, Dict[str, TaskInfo]] = defaultdict(dict)
+        # Memoized ready_task_num; every status-index mutation resets it
+        # to None.  The gang job-order comparator reads readiness per
+        # heap comparison (thousands of times per preemption storm), so
+        # recounting the buckets per call dominated the comparators.
+        self._ready_num = None
         self.tasks: Dict[str, TaskInfo] = {}
         self.allocated: Resource = Resource.empty()
         self.total_request: Resource = Resource.empty()
@@ -134,6 +139,7 @@ class JobInfo:
     def add_task_info(self, ti: TaskInfo) -> None:
         self.tasks[ti.uid] = ti
         self.task_status_index[ti.status][ti.uid] = ti
+        self._ready_num = None
         self.total_request.add(ti.resreq)
         if allocated_status(ti.status):
             self.allocated.add(ti.resreq)
@@ -148,6 +154,7 @@ class JobInfo:
         if allocated_status(task.status):
             self.allocated.sub(task.resreq)
         del self.tasks[task.uid]
+        self._ready_num = None
         index = self.task_status_index.get(task.status)
         if index is not None:
             index.pop(task.uid, None)
@@ -165,6 +172,7 @@ class JobInfo:
         """Move only the status index (callers settle the allocated vector
         themselves — the batch-apply path adds one per-job aggregate
         instead of one vector op per task)."""
+        self._ready_num = None
         index = self.task_status_index.get(task.status)
         if index is not None:
             index.pop(task.uid, None)
@@ -196,10 +204,13 @@ class JobInfo:
     # -- gang accounting (job_info.go:383-434) ------------------------------
 
     def ready_task_num(self) -> int:
-        n = 0
-        for status, tasks in self.task_status_index.items():
-            if allocated_status(status) or status == TaskStatus.Succeeded:
-                n += len(tasks)
+        n = self._ready_num
+        if n is None:
+            n = 0
+            for status, tasks in self.task_status_index.items():
+                if allocated_status(status) or status == TaskStatus.Succeeded:
+                    n += len(tasks)
+            self._ready_num = n
         return n
 
     def waiting_task_num(self) -> int:

@@ -59,6 +59,15 @@ class Session:
         self.job_valid_fns: Dict[str, Callable] = {}
         self.node_order_fns: Dict[str, List] = {}
 
+        # Lazily resolved tier-walk chains for the order comparators:
+        # heap-heavy actions (a preemption storm pushes/pops thousands
+        # of jobs and tasks) call these per comparison, and the
+        # tier x plugin x dict-lookup walk per call dominated them.
+        # Registrations are fixed once open_session returns, so the
+        # first call freezes the chain.
+        self._job_order_chain: Optional[List[Callable]] = None
+        self._task_order_chain: Optional[List[Callable]] = None
+
     # ------------------------------------------------------------------
     # registration (session_plugins.go:25-77)
 
@@ -179,16 +188,16 @@ class Session:
     def job_order_fn(self, l: JobInfo, r: JobInfo) -> bool:
         """First non-zero comparison wins; fallback creation-time then UID
         (go:247-271)."""
-        for tier in self.tiers:
-            for plugin in tier.plugins:
-                if not plugin.enabled_job_order:
-                    continue
-                fn = self.job_order_fns.get(plugin.name)
-                if fn is None:
-                    continue
-                j = fn(l, r)
-                if j != 0:
-                    return j < 0
+        chain = self._job_order_chain
+        if chain is None:
+            chain = self._job_order_chain = [
+                fn for tier in self.tiers for plugin in tier.plugins
+                if plugin.enabled_job_order
+                and (fn := self.job_order_fns.get(plugin.name)) is not None]
+        for fn in chain:
+            j = fn(l, r)
+            if j != 0:
+                return j < 0
         if l.creation_timestamp == r.creation_timestamp:
             return l.uid < r.uid
         return l.creation_timestamp < r.creation_timestamp
@@ -211,16 +220,16 @@ class Session:
         return lt < rt
 
     def task_compare_fns(self, l: TaskInfo, r: TaskInfo) -> int:
-        for tier in self.tiers:
-            for plugin in tier.plugins:
-                if not plugin.enabled_task_order:
-                    continue
-                fn = self.task_order_fns.get(plugin.name)
-                if fn is None:
-                    continue
-                j = fn(l, r)
-                if j != 0:
-                    return j
+        chain = self._task_order_chain
+        if chain is None:
+            chain = self._task_order_chain = [
+                fn for tier in self.tiers for plugin in tier.plugins
+                if plugin.enabled_task_order
+                and (fn := self.task_order_fns.get(plugin.name)) is not None]
+        for fn in chain:
+            j = fn(l, r)
+            if j != 0:
+                return j
         return 0
 
     def task_order_fn(self, l: TaskInfo, r: TaskInfo) -> bool:
@@ -487,6 +496,7 @@ class Session:
                         existing.update(pend)
                     else:
                         index[allocated_st] = pend
+                    job._ready_num = None  # bypassed move_task_index
                 else:
                     for t in to_alloc:
                         job.move_task_index(t, allocated_st)
@@ -558,6 +568,10 @@ class Session:
             moving = job.task_status_index.pop(TaskStatus.Allocated, None)
             if not moving:
                 continue
+            # Allocated -> Binding keeps ready_task_num invariant (both
+            # are allocated statuses), but reset the memo anyway: this
+            # path bypasses move_task_index.
+            job._ready_num = None
             binding = job.task_status_index[TaskStatus.Binding]
             moving_items = list(moving.items())
             if not any(t.pod.spec.volumes for t in moving.values()):

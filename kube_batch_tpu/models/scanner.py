@@ -101,7 +101,16 @@ class DeviceNodeScanner:
         self._task_pantiw = np.asarray(inp.task_panti_w)
         self._task_res = np.asarray(inp.task_res)
         self._task_sig = np.asarray(inp.task_sig)
-        self._checkpoints: List[np.ndarray] = []
+        # numpy mirrors of the static node tensors: _scores_numpy runs
+        # once per preemptor (thousands per storm) and np.asarray on a
+        # device array per call is pure overhead.
+        self._np_alloc = np.asarray(inp.node_alloc)
+        self._np_sig_mask = np.asarray(inp.sig_mask)
+        self._np_exists = np.asarray(inp.node_exists)
+        self._np_maxt = np.asarray(inp.node_max_tasks)
+        self._np_shift = np.asarray(inp.score_shift)
+        self._np_bonus = np.asarray(inp.sig_bonus)
+        self._checkpoints: List[Dict[int, np.ndarray]] = []
         # Incremental rescoring: between consecutive scans only the few
         # rows an evict/pipeline touched change, so cache the last score
         # vector per task-row identity and recompute just the dirty rows
@@ -113,22 +122,37 @@ class DeviceNodeScanner:
         self._scores_cached: Optional[np.ndarray] = None
 
     # -- transaction mirror (Statement commit/discard) ----------------------
+    # Copy-on-write: a checkpoint is a {row -> saved row copy} undo log
+    # filled lazily by _save_row at the first touch of each row, not a
+    # full dyn copy — a preemption storm opens one Statement per
+    # preemptor job (thousands per cycle) while each statement touches a
+    # handful of rows, so whole-array copies dominated the action.
 
     def checkpoint(self) -> None:
-        self._checkpoints.append(self.dyn.copy())
+        self._checkpoints.append({})
+
+    def _save_row(self, nix: int) -> None:
+        if self._checkpoints:
+            undo = self._checkpoints[-1]
+            if nix not in undo:
+                undo[nix] = self.dyn[nix].copy()
 
     def commit(self) -> None:
         if self._checkpoints:
-            self._checkpoints.pop()
+            committed = self._checkpoints.pop()
+            if self._checkpoints and committed:
+                # Nested transactions: the outer frame must still be
+                # able to undo rows the inner one touched first.
+                outer = self._checkpoints[-1]
+                for nix, row in committed.items():
+                    outer.setdefault(nix, row)
 
     def restore(self) -> None:
         if self._checkpoints:
-            self.dyn = self._checkpoints.pop()
-            # Arbitrary rollback: the dirty set no longer describes the
-            # delta from the cached scores.
-            self._score_key = None
-            self._scores_cached = None
-            self._dirty.clear()
+            undo = self._checkpoints.pop()
+            for nix, row in undo.items():
+                self.dyn[nix] = row
+                self._dirty.add(nix)  # restored rows need a rescore
 
     # -- state updates ------------------------------------------------------
     # ``used`` (the scoring dimension) tracks session allocate/deallocate
@@ -142,6 +166,7 @@ class DeviceNodeScanner:
         nix = self.node_index.get(task.node_name)
         if nix is None:
             return
+        self._save_row(nix)
         self.dyn[nix, 0] += sign * quantize_value(task.resreq.milli_cpu, 0)
         self.dyn[nix, 1] += sign * quantize_value(task.resreq.memory, 1)
         self._dirty.add(nix)
@@ -150,6 +175,7 @@ class DeviceNodeScanner:
         nix = self.node_index.get(hostname)
         if nix is None:
             return
+        self._save_row(nix)
         self._dirty.add(nix)
         row = self.dyn[nix]
         ti = self.task_index.get(task.uid)
@@ -222,23 +248,22 @@ class DeviceNodeScanner:
         patch path); the math is row-pure, so a subset recompute equals
         the full one on those rows."""
         from ..ops.resources import SCORE_GRID_K
-        inp = self.snap.inputs
         cfg = self.cfg
         r = self.r
         dyn = self.dyn if rows is None else self.dyn[rows]
         used = dyn[:, :r]
         count = dyn[:, r]
         sig = int(self._task_sig[ti])
-        alloc = np.asarray(inp.node_alloc)
-        sig_row = np.asarray(inp.sig_mask)[sig]
-        exists = np.asarray(inp.node_exists)
-        maxt = np.asarray(inp.node_max_tasks)
+        alloc = self._np_alloc
+        sig_row = self._np_sig_mask[sig]
+        exists = self._np_exists
+        maxt = self._np_maxt
         if rows is not None:
             alloc = alloc[rows]
             sig_row = sig_row[rows]
             exists = exists[rows]
             maxt = maxt[rows]
-        shift = np.asarray(inp.score_shift)
+        shift = self._np_shift
         feasible = sig_row & exists & (count < maxt)
         if cfg.has_ports:
             ports = dyn[:, r + 1:r + 1 + self.np_pad]
@@ -277,7 +302,7 @@ class DeviceNodeScanner:
             wdiff = (self._task_paffw[ti].astype(np.int64)
                      - self._task_pantiw[ti])[None, :]
             score += SCORE_GRID_K * (wdiff * selcnt).sum(axis=-1)
-        bonus = np.asarray(inp.sig_bonus)[sig]
+        bonus = self._np_bonus[sig]
         score += bonus if rows is None else bonus[rows]
         return np.where(feasible, score,
                         np.int64(SCORE_NEG_INF)).astype(np.int64)

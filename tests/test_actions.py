@@ -468,6 +468,27 @@ class TestDeviceScanParity:
         assert dev == host
         assert host[1], "scenario must actually evict"
 
+    def test_checkpoint_frames_balanced(self, monkeypatch):
+        """Every scanner checkpoint must be popped by commit or restore
+        by the end of the action — the gang scenario re-pops a pipelined
+        job with an emptied task queue, the path that used to leak a
+        frame (and, with copy-on-write undo logs, would then swallow
+        every later transaction's saved rows)."""
+        monkeypatch.setenv("KUBE_BATCH_TPU_SCAN_MIN_NODES", "0")
+        from kube_batch_tpu.models import scanner as scanner_mod
+        captured = []
+        real = scanner_mod.maybe_scanner
+
+        def capture(ssn):
+            s = real(ssn)
+            captured.append(s)
+            return s
+
+        monkeypatch.setattr(scanner_mod, "maybe_scanner", capture)
+        self._run("preempt", self._preempt_cluster, monkeypatch, 0)
+        assert captured and captured[0] is not None
+        assert captured[0]._checkpoints == []
+
     def test_scanner_active_when_forced(self, monkeypatch):
         monkeypatch.setenv("KUBE_BATCH_TPU_SCAN_MIN_NODES", "0")
         from kube_batch_tpu.models.scanner import maybe_scanner

@@ -56,8 +56,10 @@ class PreemptAction(Action):
         # One pass over residents: lets the walk skip nodes (and whole
         # preemptors) that provably cannot yield a victim — the starved
         # queue's O(tasks x nodes) empty walk collapses to O(tasks).
+        # Session-shared: reclaim (which runs first in the shipped
+        # pipeline) already built and live-updated it.
         from ..models.victim_index import VictimIndex
-        vindex = VictimIndex(ssn)
+        vindex = VictimIndex.for_session(ssn)
         if scanner is not None:
             vindex.attach_nodes(scanner.snap.node_names)
 

@@ -65,7 +65,7 @@ class ReclaimAction(Action):
                 scanner = maybe_scanner(ssn)
                 scanner_built = True
                 from ..models.victim_index import VictimIndex
-                vindex = VictimIndex(ssn)
+                vindex = VictimIndex.for_session(ssn)
                 if scanner is not None:
                     vindex.attach_nodes(scanner.snap.node_names)
             if not vindex.any_for_other_queues(job.queue):

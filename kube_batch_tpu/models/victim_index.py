@@ -28,6 +28,21 @@ from ..api import TaskStatus
 class VictimIndex:
     """Counts of Running residents per node, by queue and by job."""
 
+    @classmethod
+    def for_session(cls, ssn):
+        """The session's shared index, built on first use.  Sharing is
+        exact: within one session only the eviction actions change the
+        Running resident set, and every evict/restore path updates the
+        index (reclaim.py on_evict; preempt.py on_evict/on_restore) —
+        allocate/backfill add Pipelined/Binding residents, which the
+        index deliberately does not count.  Reclaim and preempt each
+        paid the full O(residents) rebuild per cycle before this."""
+        idx = getattr(ssn, "_victim_index", None)
+        if idx is None:
+            idx = cls(ssn)
+            ssn._victim_index = idx
+        return idx
+
     def __init__(self, ssn):
         self.node_queue: Dict[str, Dict[str, int]] = {}
         self.node_job: Dict[str, Dict[str, int]] = {}

@@ -501,6 +501,60 @@ class TestDeviceScanParity:
         finally:
             close_session(ssn)
 
+    def test_copy_on_write_checkpoint_semantics(self, monkeypatch):
+        """The undo-log checkpoint must behave exactly like the
+        full-array copy it replaced: restore rewinds only to the frame
+        being popped, nested commits hand their undo rows to the outer
+        frame, and restored rows rescore."""
+        monkeypatch.setenv("KUBE_BATCH_TPU_SCAN_MIN_NODES", "0")
+        from kube_batch_tpu.models.scanner import maybe_scanner
+        from kube_batch_tpu.scheduler import load_scheduler_conf
+        cache, _, _ = self._preempt_cluster()
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        try:
+            sc = maybe_scanner(ssn)
+            assert sc is not None
+            task = sc.snap.tasks[0]
+            node0 = sc.snap.node_names[0]
+            node1 = sc.snap.node_names[1]
+            base = sc.dyn.copy()
+
+            # outer frame: touch node0
+            sc.checkpoint()
+            sc.apply_pipeline(task, node0)
+            after_outer = sc.dyn.copy()
+            # inner frame: touch node0 again and node1, then COMMIT —
+            # the inner undo rows must merge into the outer frame
+            sc.checkpoint()
+            sc.apply_pipeline(task, node0)
+            sc.apply_pipeline(task, node1)
+            sc.commit()
+            # restore the outer frame: EVERYTHING rewinds to base,
+            # including node1 (touched only inside the committed inner)
+            sc.restore()
+            assert (sc.dyn == base).all()
+            assert sc._checkpoints == []
+
+            # commit-only path keeps the mutation
+            sc.checkpoint()
+            sc.apply_pipeline(task, node0)
+            sc.commit()
+            assert (sc.dyn == after_outer).all()
+
+            # restored rows feed the incremental rescore: scores after a
+            # restore match a fresh full recompute
+            sc.checkpoint()
+            sc.scores(task)               # prime the cache
+            sc.apply_pipeline(task, node1)
+            sc.restore()
+            import numpy as np
+            got = sc.scores(task)
+            fresh = sc._scores_numpy(sc.task_index[task.uid])
+            assert np.array_equal(got, fresh[:len(got)])
+        finally:
+            close_session(ssn)
+
 
 class TestScanEngines:
     def test_numpy_and_device_scan_agree(self, monkeypatch):

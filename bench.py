@@ -20,8 +20,20 @@ Also measured, all at 50k x 10k:
   PriorityClass wave — per-action wall-clock, real evictions
   (VERDICT r3 next #2).
 
+An artifact ALWAYS materializes (VERDICT r4 weak #1 / next #2, matching
+the reference's always-write discipline in test/e2e/metric_util.go:1-122):
+the backend is probed in a SUBPROCESS with a timeout before any JAX work
+in this process, a dead/hung backend falls back to CPU (pinned via
+``jax.config.update`` — the env var does not stop a wedged-tunnel hang),
+results fill in incrementally, and any failure or SIGTERM still prints
+the one JSON line (with an ``error`` field) and exits 0.
+
 Env overrides: BENCH_TASKS, BENCH_NODES, BENCH_JOBS, BENCH_QUEUES;
-BENCH_PIPELINE=0 skips the 4-action scenario, BENCH_COLD_N (default 5).
+BENCH_PIPELINE=0 skips the 4-action scenario, BENCH_COLD_N (default 5);
+BENCH_PROBE_TIMEOUT (s, default 150), BENCH_DEADLINE (s, default 5400 —
+wall-clock backstop that emits whatever was measured and exits 0),
+BENCH_FORCE_PROBE_FAIL=1 forces the fallback path (used by
+tests/test_bench_guard.py).
 """
 
 import json
@@ -287,14 +299,109 @@ def measure_action_pipeline(n_tasks, n_nodes, n_jobs, n_queues,
             evictions)
 
 
-def main():
-    n_tasks = int(os.environ.get("BENCH_TASKS", 50_000))
-    n_nodes = int(os.environ.get("BENCH_NODES", 10_000))
-    n_jobs = int(os.environ.get("BENCH_JOBS", 2_000))
-    n_queues = int(os.environ.get("BENCH_QUEUES", 4))
-    cold_n = int(os.environ.get("BENCH_COLD_N", 5))
-    with_pipeline = os.environ.get("BENCH_PIPELINE", "1") != "0"
+def _probe_backend(timeout_s: float):
+    """Initialize the default JAX backend in a SUBPROCESS and run one op.
 
+    Returns (platform, None) on success or (None, error_str) on any
+    failure — nonzero exit, crash, or hang past ``timeout_s``.  Isolating
+    init in a child means a wedged device tunnel (which hangs
+    ``jax.devices()`` indefinitely and is unrecoverable in-process)
+    cannot take this process with it; the child is SIGKILLed on timeout.
+    """
+    import subprocess
+    import sys
+
+    if os.environ.get("BENCH_FORCE_PROBE_FAIL") == "1":
+        code = "import sys; sys.exit(1)"  # forced-failure test hook
+    else:
+        # The child time-bounds ITSELF (watchdog just under the outer
+        # timeout): a self-exit beats an external SIGKILL, which — if the
+        # backend were merely slow, not wedged — could kill a client
+        # mid-transfer and take a loopback-relay style tunnel down with
+        # it.  The outer timeout stays as the backstop of last resort.
+        # Proportional clamp so a short timeout_s still leaves the child
+        # >= 80% of the budget (import jax alone takes seconds).
+        child_deadline = max(timeout_s - 5, timeout_s * 0.8, 1.0)
+        # Timer must be daemon: a fail-fast probe exception would
+        # otherwise block thread-shutdown on the non-daemon timer until
+        # the deadline instead of returning the real error immediately.
+        code = (f"import os, threading\n"
+                f"_t = threading.Timer({child_deadline},"
+                f" lambda: os._exit(3))\n"
+                "_t.daemon = True\n"
+                "_t.start()\n"
+                "import jax\n"
+                "d = jax.devices()\n"
+                "import jax.numpy as jnp\n"
+                "x = jnp.ones((128, 128))\n"
+                "assert (x @ x).sum().item() > 0\n"
+                "print(d[0].platform)\n"
+                "import sys; sys.stdout.flush()\n"  # os._exit skips flush
+                "os._exit(0)\n")
+    try:
+        p = subprocess.Popen([sys.executable, "-c", code],
+                             stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                             text=True, start_new_session=True)
+        try:
+            stdout, stderr = p.communicate(timeout=timeout_s)
+        except subprocess.TimeoutExpired:
+            # Kill the whole process GROUP (start_new_session made the
+            # child its leader): a helper process holding the inherited
+            # pipe write-ends would otherwise keep communicate() blocked
+            # forever after the child alone is killed.
+            import signal
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                p.kill()
+            p.communicate()
+            return None, (f"backend probe timed out after {timeout_s:.0f}s "
+                          "(device tunnel hung)")
+    except Exception as exc:  # pragma: no cover - spawn failure
+        return None, f"backend probe could not run: {exc!r}"
+    if p.returncode != 0:
+        tail = (stderr or stdout or "").strip()[-400:]
+        return None, f"backend probe exited {p.returncode}: {tail}"
+    lines = stdout.strip().splitlines()
+    return (lines[-1] if lines else "unknown"), None
+
+
+class _Interrupted(BaseException):
+    """SIGTERM/SIGINT as a control-flow exception.  BaseException so no
+    intermediate ``except Exception`` (e.g. _probe_backend's) can swallow
+    it — it must reach main's emit-and-exit handler."""
+
+
+def _install_signal_guard():
+    """Convert SIGTERM/SIGINT into _Interrupted so the in-flight results
+    are still emitted as the one JSON line before exiting."""
+    import signal
+
+    def _raise(sig, _frame):
+        raise _Interrupted(f"interrupted by signal {sig}")
+
+    for s in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(s, _raise)
+        except (ValueError, OSError):  # pragma: no cover - non-main thread
+            pass
+
+
+def _ignore_signals():
+    """Close the emit window: a signal landing mid-print would truncate
+    the artifact line."""
+    import signal
+
+    for s in (signal.SIGTERM, signal.SIGINT):
+        try:
+            signal.signal(s, signal.SIG_IGN)
+        except (ValueError, OSError):  # pragma: no cover - non-main thread
+            pass
+
+
+def _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline):
+    """Fill ``out`` incrementally; a failure partway leaves every
+    completed measurement in place for the caller to emit."""
     import numpy as np
 
     from kube_batch_tpu.models.synthetic import make_synthetic_inputs
@@ -316,12 +423,12 @@ def main():
     # must match the XLA two-level solver exactly — guards Mosaic argmax /
     # rounding quirks shipping silently (VERDICT r1 weak #5).
     import jax as _jax
-    parity = None  # null when the check does not apply (non-TPU backend)
+    out["platform"] = _jax.default_backend()
     if _jax.default_backend() == "tpu":
         from kube_batch_tpu.ops.solver import solve_allocate
         xla = np.asarray(solve_allocate(inputs, config).assignment)
-        parity = bool(np.array_equal(assignment, xla))
-        assert parity, "pallas vs XLA placement mismatch on TPU"
+        out["parity"] = bool(np.array_equal(assignment, xla))
+        assert out["parity"], "pallas vs XLA placement mismatch on TPU"
 
     runs = []
     for _ in range(7):
@@ -330,48 +437,42 @@ def main():
         np.asarray(result.assignment)
         runs.append((time.perf_counter() - start) * 1e3)
     solve_med, solve_p90 = _stats(runs)
+    out["value"] = solve_med
+    out["vs_baseline"] = (round(1000.0 / solve_med, 3) if solve_med
+                          else None)  # sub-0.05ms medians round to 0.0
+    out["solve_p90"] = solve_p90
 
+    # The honest north-star numbers: full open->tensorize->ship->solve->
+    # apply->close over the object model, medians with p90
+    # (tools/session_bench.py has the per-stage breakdown).
     session_med, session_p90 = measure_full_session(
         n_tasks, n_nodes, n_jobs, n_queues)
+    out["session_ms"], out["session_p90"] = session_med, session_p90
     # Heterogeneous variant: 64 distinct (selector, tolerations, affinity)
     # signatures + unique per-node labels — the realistic worst case for
     # the static [S, N] predicate mask (VERDICT r2 weak #1).
     hetero_med, hetero_p90 = measure_full_session(
         n_tasks, n_nodes, n_jobs, n_queues, n_signatures=64)
+    out["session_hetero_ms"], out["session_hetero_p90"] = (hetero_med,
+                                                           hetero_p90)
 
     # Steady-state: long-lived cache, 1% pod churn per cycle, placed pods
     # echoed back as Running — homogeneous AND heterogeneous (the
     # realistic production shape is both churning and heterogeneous).
     steady_cold, steady_rounds = measure_steady_session(
         n_tasks, n_nodes, n_jobs, n_queues)
-    steady_med, steady_p90 = _stats(steady_rounds)
+    out["session_steady_ms"], out["session_steady_p90"] = _stats(
+        steady_rounds)
     _, steady_het_rounds = measure_steady_session(
         n_tasks, n_nodes, n_jobs, n_queues, n_signatures=64)
-    steady_het_med, steady_het_p90 = _stats(steady_het_rounds)
+    out["session_steady_hetero_ms"], out["session_steady_hetero_p90"] = (
+        _stats(steady_het_rounds))
 
     # Cold: >= 5 fresh caches + the steady run's cold (same protocol).
-    cold_med, cold_p90 = measure_cold_sessions(
+    out["session_cold_ms"], out["session_cold_p90"] = measure_cold_sessions(
         n_tasks, n_nodes, n_jobs, n_queues, n_caches=cold_n,
         extra=[steady_cold])
 
-    out = {
-        "metric": f"sched-session solve latency @ {n_tasks} tasks x "
-                  f"{n_nodes} nodes (gang+DRF+proportion)",
-        "value": solve_med,
-        "unit": "ms",
-        "vs_baseline": round(1000.0 / solve_med, 3),
-        "parity": parity,
-        "solve_p90": solve_p90,
-        # The honest north-star numbers: full open->tensorize->ship->
-        # solve->apply->close over the object model, medians with p90
-        # (tools/session_bench.py has the per-stage breakdown).
-        "session_ms": session_med, "session_p90": session_p90,
-        "session_hetero_ms": hetero_med, "session_hetero_p90": hetero_p90,
-        "session_steady_ms": steady_med, "session_steady_p90": steady_p90,
-        "session_steady_hetero_ms": steady_het_med,
-        "session_steady_hetero_p90": steady_het_p90,
-        "session_cold_ms": cold_med, "session_cold_p90": cold_p90,
-    }
     if with_pipeline:
         per_action, evictions = measure_action_pipeline(
             n_tasks, n_nodes, n_jobs, n_queues)
@@ -380,7 +481,101 @@ def main():
         out["actions_p90"] = {name: p90
                               for name, (_med, p90) in per_action.items()}
         out["pipeline_evictions"] = evictions
-    print(json.dumps(out))
+
+
+def main():
+    # The artifact dict exists before ANYTHING that can fail — env
+    # parsing, probing, measuring — so every death path below has
+    # something to emit.
+    out = {
+        "metric": "sched-session solve latency",
+        "value": None,
+        "unit": "ms",
+        "vs_baseline": None,
+        "platform": None,
+        "parity": None,  # null when the check does not apply (non-TPU)
+    }
+
+    import threading
+    emit_lock = threading.Lock()
+    emitted = [False]
+
+    def emit():
+        """Print the one JSON line exactly once (main path, signal path,
+        or deadline watchdog — whichever gets there first)."""
+        with emit_lock:
+            if emitted[0]:
+                return
+            emitted[0] = True
+            try:
+                line = json.dumps(dict(out))
+            except Exception:  # pragma: no cover - mid-mutation race
+                line = json.dumps({"metric": out.get("metric"),
+                                   "error": "emit raced a mutation"})
+            print(line, flush=True)
+
+    try:
+        # First statement INSIDE the try: every _Interrupted the handler
+        # can raise is then guaranteed an enclosing except.  (A signal
+        # before install gets default disposition — no worse than
+        # pre-interpreter delivery.)
+        _install_signal_guard()
+        n_tasks = int(os.environ.get("BENCH_TASKS", 50_000))
+        n_nodes = int(os.environ.get("BENCH_NODES", 10_000))
+        n_jobs = int(os.environ.get("BENCH_JOBS", 2_000))
+        n_queues = int(os.environ.get("BENCH_QUEUES", 4))
+        cold_n = int(os.environ.get("BENCH_COLD_N", 5))
+        probe_timeout = float(os.environ.get("BENCH_PROBE_TIMEOUT", 150))
+        deadline_s = float(os.environ.get("BENCH_DEADLINE", 5400))
+        with_pipeline = os.environ.get("BENCH_PIPELINE", "1") != "0"
+        out["metric"] = (f"sched-session solve latency @ {n_tasks} tasks "
+                         f"x {n_nodes} nodes (gang+DRF+proportion)")
+
+        # Wall-clock backstop for hangs the signal guard cannot reach
+        # (a device call blocked in an extension never returns to the
+        # interpreter, so _Interrupted can never be raised): emit
+        # whatever has been measured and exit 0.
+        def _deadline():
+            out["error"] = (out.get("error", "") +
+                            f" | deadline {deadline_s:.0f}s hit").strip(" |")
+            emit()
+            os._exit(0)
+
+        watchdog = threading.Timer(deadline_s, _deadline)
+        watchdog.daemon = True
+        watchdog.start()
+
+        platform, probe_err = _probe_backend(probe_timeout)
+        if probe_err is not None:
+            # The default backend is unusable.  Pin CPU and measure
+            # anyway: a degraded, CPU-marked artifact beats the rc=1
+            # nothing that erased round 4's evidence.  The pin MUST be
+            # jax.config.update after import — JAX_PLATFORMS=cpu in the
+            # env does not stop the in-process hang when an axon-style
+            # tunnel is wedged.
+            out["error"] = probe_err
+            out["platform"] = "cpu"
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+        else:
+            out["platform"] = platform
+        _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline)
+        # Last statement INSIDE the try: a signal landing here is still
+        # caught below — no handlerless gap before the emit.
+        _ignore_signals()
+    except BaseException as exc:
+        # First thing: stop listening — a second SIGTERM during handler
+        # work would raise _Interrupted OUTSIDE the try and erase the
+        # artifact after all.
+        _ignore_signals()
+        import traceback
+        tb = traceback.format_exc(limit=3)[-600:]
+        prior = out.get("error")
+        out["error"] = ((f"{prior} | " if prior else "") +
+                        f"run aborted: {exc!r} :: {tb}")
+    _ignore_signals()
+    emit()
+    raise SystemExit(0)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,92 @@
+"""Forced-failure test of bench.py's artifact guard (VERDICT r4 next #2).
+
+The reference's benchmark suite always writes its metrics artifact even
+on partial failure (/root/reference/test/e2e/metric_util.go:1-122);
+bench.py's analog is: probe the backend in a subprocess, fall back to a
+CPU-pinned run on failure, and ALWAYS print one JSON line and exit 0.
+Round 4 lost its entire evidence record to an rc=1 crash when the device
+tunnel was down — this test pins the guard that prevents a repeat.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BENCH = os.path.join(REPO, "bench.py")
+
+TINY = {
+    "BENCH_TASKS": "200",
+    "BENCH_NODES": "40",
+    "BENCH_JOBS": "20",
+    "BENCH_QUEUES": "2",
+    "BENCH_COLD_N": "2",
+    "BENCH_PROBE_TIMEOUT": "60",
+}
+
+
+def _run_bench(extra_env):
+    env = dict(os.environ)
+    env.update(TINY)
+    env.update(extra_env)
+    return subprocess.run([sys.executable, BENCH], cwd=REPO,
+                          capture_output=True, text=True, timeout=900,
+                          env=env)
+
+
+@pytest.mark.slow
+def test_probe_failure_still_emits_artifact():
+    """A dead backend degrades the artifact to CPU-marked numbers —
+    never erases it.  rc must be 0 and the JSON line complete."""
+    r = _run_bench({"BENCH_FORCE_PROBE_FAIL": "1", "BENCH_PIPELINE": "0"})
+    assert r.returncode == 0, r.stderr[-2000:]
+    line = r.stdout.strip().splitlines()[-1]
+    out = json.loads(line)
+    assert out["platform"] == "cpu"
+    assert "error" in out and "probe" in out["error"]
+    # The fallback still MEASURES (not just reports the failure).  A
+    # sub-0.05ms median legitimately rounds to 0.0 (vs_baseline then
+    # None), so assert presence, not magnitude.
+    assert out["value"] is not None and out["value"] >= 0
+    for key in ("session_ms", "session_hetero_ms", "session_steady_ms",
+                "session_steady_hetero_ms", "session_cold_ms"):
+        assert out[key] > 0, key
+    assert out["parity"] is None  # check does not apply off-TPU
+    assert out["unit"] == "ms"
+    assert out["metric"].startswith("sched-session solve latency")
+
+
+@pytest.mark.slow
+def test_sigterm_mid_run_still_emits_artifact():
+    """SIGTERM mid-measurement converts to _Interrupted, emits the JSON
+    line with whatever was measured plus an ``error``, and exits 0 —
+    never a traceback-and-rc-1 death."""
+    env = dict(os.environ)
+    env.update(TINY)
+    # Big enough that the run cannot finish before the signal lands.
+    env.update({"BENCH_FORCE_PROBE_FAIL": "1", "BENCH_PIPELINE": "0",
+                "BENCH_TASKS": "20000", "BENCH_NODES": "4000",
+                "BENCH_JOBS": "800"})
+    import signal
+    import time
+    p = subprocess.Popen([sys.executable, BENCH], cwd=REPO,
+                         stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+                         text=True, env=env)
+    time.sleep(6)
+    already_done = p.poll() is not None
+    if not already_done:
+        p.send_signal(signal.SIGTERM)
+    stdout, stderr = p.communicate(timeout=300)
+    assert p.returncode == 0, stderr[-2000:]
+    out = json.loads(stdout.strip().splitlines()[-1])
+    assert out["platform"] == "cpu"
+    # A fast box can finish between poll() and the signal (or ignore the
+    # signal during its emit window) — then there is no error, which is
+    # also a correct outcome; only assert the signal path when the run
+    # was genuinely cut short (no final measurement present).
+    if not already_done and "session_cold_ms" not in out:
+        assert "signal" in out.get("error", "")
+

@@ -74,7 +74,6 @@ class PreemptAction(Action):
                 stmt = ssn.statement()
                 if scanner is not None:
                     scanner.checkpoint()
-                evict_log: List[tuple] = []
                 assigned = False
                 while True:
                     if preemptor_tasks[preemptor_job.uid].empty():
@@ -100,8 +99,7 @@ class PreemptAction(Action):
                                ju=preemptor.job:
                                vindex.queue_mask(q, ju))
                     if _preempt(ssn, stmt, preemptor, ssn.nodes, job_filter,
-                                scanner, node_ok, vindex, evict_log,
-                                mask_fn):
+                                scanner, node_ok, vindex, mask_fn):
                         assigned = True
                     # Pipelined checked at loop BOTTOM (preempt.go:
                     # 117-121): a re-popped already-pipelined job still
@@ -121,11 +119,9 @@ class PreemptAction(Action):
                     if assigned:
                         preemptors.push(preemptor_job)
                 else:
-                    stmt.discard()
+                    stmt.discard()  # also counts victims back into vindex
                     if scanner is not None:
                         scanner.restore()
-                    for entry in evict_log:  # discard restored the victims
-                        vindex.on_restore(*entry)
 
             # Preemption between tasks within a job (preempt.go:136-165).
             for job in under_request:
@@ -152,7 +148,7 @@ class PreemptAction(Action):
 
 def _preempt(ssn, stmt, preemptor: TaskInfo, nodes, filter_fn,
              scanner=None, node_ok=None, vindex=None,
-             evict_log=None, mask_fn=None) -> bool:
+             mask_fn=None) -> bool:
     """Try to free room for preemptor on some node (preempt.go:171-254).
 
     ``node_ok(name)``: optional admissibility pre-filter (VictimIndex):
@@ -209,12 +205,9 @@ def _preempt(ssn, stmt, preemptor: TaskInfo, nodes, filter_fn,
             stmt.evict(preemptee, "preempt")
             if vindex is not None:
                 vjob = ssn.jobs.get(preemptee.job)
-                entry = (node.name,
-                         vjob.queue if vjob is not None else "",
-                         preemptee.job)
-                vindex.on_evict(*entry)
-                if evict_log is not None:
-                    evict_log.append(entry)
+                vindex.on_evict(node.name,
+                                vjob.queue if vjob is not None else "",
+                                preemptee.job)
             preempted.add(preemptee.resreq)
             if resreq.less_equal(preempted):
                 break

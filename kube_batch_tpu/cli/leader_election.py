@@ -120,7 +120,12 @@ class LeaderElectionConfig:
 
     def __post_init__(self):
         if not self.identity:
-            self.identity = f"{socket.gethostname()}-{os.getpid()}"
+            # hostname + uuid, like client-go's default id: pid alone
+            # collides for two electors in one process, and the second
+            # would mistake the first's lease for its own and self-renew.
+            import uuid
+            self.identity = (f"{socket.gethostname()}-{os.getpid()}-"
+                             f"{uuid.uuid4().hex[:8]}")
 
 
 class LeaderElector:

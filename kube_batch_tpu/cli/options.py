@@ -30,6 +30,11 @@ class ServerOption:
     print_version: bool = False
     listen_address: str = DEFAULT_LISTEN_ADDRESS
     priority_class: bool = True
+    # Explicit opt-in to the per-host FileLock HA backend (flock
+    # coherence does not span hosts on common network filesystems; see
+    # leader_election.FileLock).  Without it, a cluster edge that cannot
+    # host a store lock refuses leader election at config time.
+    file_lock_same_host_ok: bool = False
     # Simulator extras (no reference counterpart): cluster spec to load.
     cluster_state: str = ""
 
@@ -68,6 +73,12 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
     parser.add_argument("--no-priority-class", dest="priority_class",
                         action="store_false",
                         help="Disable PriorityClass-based job priority")
+    parser.add_argument("--leader-elect-file-lock", dest="file_lock",
+                        action="store_true", default=False,
+                        help="Accept the file-based election lock (flock "
+                             "coherence is PER-HOST: safe only for "
+                             "same-host standbys or a flock-coherent "
+                             "shared filesystem)")
     parser.add_argument("--cluster-state", default="",
                         help="Path to a JSON cluster snapshot for the simulator")
 
@@ -83,4 +94,6 @@ def parse_options(argv=None) -> ServerOption:
         enable_leader_election=ns.leader_elect,
         lock_object_namespace=ns.lock_object_namespace,
         print_version=ns.version, listen_address=ns.listen_address,
-        priority_class=ns.priority_class, cluster_state=ns.cluster_state)
+        priority_class=ns.priority_class,
+        file_lock_same_host_ok=ns.file_lock,
+        cluster_state=ns.cluster_state)

@@ -100,6 +100,13 @@ class ServerRuntime:
                  lease_config: Optional[LeaderElectionConfig] = None):
         self.opt = opt
         self._lease_config = lease_config
+        # Whether the backing store is SHARED with other standbys — the
+        # precondition for a store-hosted election lock.  An injected
+        # cluster is shared by construction (the embedder hands the same
+        # object/edge to every runtime); a --master edge is shared by the
+        # server behind it; a self-built in-process Cluster is private to
+        # this process, so a lease in it would only ever elect ourselves.
+        self._cluster_shared = True
         if cluster is not None:
             self.cluster = cluster
         elif opt.master:
@@ -110,6 +117,7 @@ class ServerRuntime:
             self.cluster = RemoteCluster(opt.master).start()
         else:
             self.cluster = Cluster()
+            self._cluster_shared = False
         if opt.cluster_state:
             # Works against both edges: RemoteCluster exposes the same
             # create verbs over REST, so a seed file submits remotely too.
@@ -138,14 +146,40 @@ class ServerRuntime:
             # the reference's ConfigMap lock (server.go:115-139): any
             # standby pointing at the same store can take over.  The lock
             # file remains the fallback for bare shared-filesystem runs.
-            if hasattr(self.cluster, "cas_lease"):
+            if self._cluster_shared and hasattr(self.cluster, "cas_lease"):
                 lock = StoreLock(self.cluster,
                                  self.opt.lock_object_namespace)
                 config = self._lease_config or LeaderElectionConfig()
             else:
+                # A process-private store cannot host the election lock
+                # (every standby would elect itself in its own world), so
+                # HA falls to the lock FILE.  But FileLock's flock CAS is
+                # coherent per-host only; two standbys on different hosts
+                # over NFS/SMB could dual-acquire.  Refuse at config time
+                # unless the deployment explicitly accepts same-host
+                # failover — by flag, or by injecting a lease_config with
+                # its own lock_path (already a deliberate opt-in).
+                # Reference analog: HA is always store-locked,
+                # server.go:115-139.
+                if (not self.opt.file_lock_same_host_ok
+                        and not (self._lease_config is not None
+                                 and self._lease_config.lock_path)):
+                    raise ValueError(
+                        "leader election needs a SHARED store for its "
+                        "lock, but this runtime's store is process-"
+                        "private (or has no lease support); the file-"
+                        "lock fallback is safe for SAME-HOST standbys "
+                        "only (flock coherence is per-host on network "
+                        "filesystems).  Point every standby at one "
+                        "cluster edge (--master), or pass "
+                        "--leader-elect-file-lock to accept same-host-"
+                        "only failover.")
+                default_path = (f"{self.opt.lock_object_namespace}/"
+                                f"kube-batch-lock.json")
                 config = self._lease_config or LeaderElectionConfig(
-                    lock_path=(f"{self.opt.lock_object_namespace}/"
-                               f"kube-batch-lock.json"))
+                    lock_path=default_path)
+                if not config.lock_path:  # timing-only injected config
+                    config.lock_path = default_path
                 lock = None
             self.elector = LeaderElector(
                 config,

@@ -62,6 +62,14 @@ class Statement:
             self.ssn._dirty_node(reclaimee.node_name)
             node.update_task(reclaimee)
         self.ssn._fire_allocate(reclaimee)
+        # Count the restored Running resident back into the session-
+        # shared VictimIndex (the evicting action counted it out at
+        # stmt.evict time).  Living here covers BOTH rollback paths —
+        # discard and commit-failure — at one altitude; an under-counted
+        # index would let later preemptors skip nodes holding victims.
+        idx = getattr(self.ssn, "_victim_index", None)
+        if idx is not None and job is not None:
+            idx.on_restore(reclaimee.node_name, job.queue, reclaimee.job)
 
     def _unpipeline(self, task: TaskInfo) -> None:
         job = self.ssn.jobs.get(task.job)
@@ -95,5 +103,5 @@ class Statement:
                 try:
                     self.ssn.cache.evict(reclaimee, reason)
                 except Exception:
-                    self._unevict(reclaimee)
+                    self._unevict(reclaimee)  # also restores VictimIndex
         self.operations.clear()

@@ -198,6 +198,44 @@ class TestPreempt:
         assert evictor.evicts == []
 
 
+class TestStatementVictimIndex:
+    def test_commit_failure_restores_victim_index(self):
+        """Statement.commit's un-evict path must count the restored task
+        back into the session-shared VictimIndex (the evicting action
+        already counted it out), or later preemptors in the same session
+        would skip nodes holding real victims."""
+        from kube_batch_tpu.api import TaskStatus
+        from kube_batch_tpu.framework.statement import Statement
+        from kube_batch_tpu.models.victim_index import VictimIndex
+        pods = [build_pod("c1", "r1", "n1", "Running",
+                          build_resource_list("1", "1Gi"), "pg1")]
+        nodes = [build_node("n1", build_resource_list("2", "4Gi", pods=10))]
+        cache, _, _ = make_cache(pods, nodes, [make_pg("pg1")])
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        try:
+            vindex = VictimIndex.for_session(ssn)
+            assert vindex.total == 1
+            job = next(iter(ssn.jobs.values()))
+            task = next(t for t in job.tasks.values()
+                        if t.status is TaskStatus.Running)
+            stmt = Statement(ssn)
+            stmt.evict(task, "test")
+            vindex.on_evict(task.node_name, job.queue, task.job)
+            assert vindex.total == 0
+
+            def boom(*_a, **_k):
+                raise RuntimeError("apiserver down")
+
+            ssn.cache.evict = boom
+            stmt.commit()  # eviction fails -> task restored to Running
+            assert task.status is TaskStatus.Running
+            assert vindex.total == 1, "restored resident must be counted"
+            assert vindex.node_for_other_queues("n1", "another-queue")
+        finally:
+            close_session(ssn)
+
+
 class TestConformance:
     """Critical pods survive victim selection (VERDICT r3 weak #4; mirrors
     /root/reference/pkg/scheduler/plugins/conformance/conformance.go:41-61)."""

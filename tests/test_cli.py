@@ -37,6 +37,83 @@ class TestOptions:
         with pytest.raises(ValueError):
             opt.check_option_or_die()
 
+    def test_file_lock_refused_without_opt_in(self, tmp_path):
+        """A runtime whose store is process-private (self-built Cluster,
+        no --master) must NOT elect through it — every standby would
+        elect itself in its own world — and cannot silently fall back to
+        the per-host FileLock either (flock coherence does not span
+        hosts).  Config-time error unless --leader-elect-file-lock
+        accepts same-host scope."""
+        opt = ServerOption(enable_leader_election=True,
+                           lock_object_namespace=str(tmp_path),
+                           listen_address="")
+        runtime = ServerRuntime(opt)  # self-built private Cluster
+        with pytest.raises(ValueError, match="SAME-HOST"):
+            runtime.run()
+
+    def test_injected_cluster_elects_through_store(self):
+        """An INJECTED cluster is shared by construction: the lock lives
+        in the store and no file-lock refusal fires."""
+        opt = ServerOption(enable_leader_election=True,
+                           lock_object_namespace="kube-system",
+                           listen_address="", schedule_period=0.1)
+        runtime = ServerRuntime(opt, cluster=Cluster())
+        runtime.run()
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and not runtime.elector.is_leader:
+                time.sleep(0.05)
+            assert runtime.elector.is_leader
+            assert runtime.cluster.get_lease("kube-system",
+                                             "kube-batch-lock")[1]
+        finally:
+            runtime.stop()
+
+    def test_file_lock_allowed_with_opt_in(self, tmp_path):
+        opt = ServerOption(enable_leader_election=True,
+                           lock_object_namespace=str(tmp_path),
+                           listen_address="",
+                           file_lock_same_host_ok=True)
+        runtime = ServerRuntime(opt)  # private store + explicit opt-in
+        runtime.run()  # elector thread starts on the file lock
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and not runtime.elector.is_leader:
+                time.sleep(0.05)
+            assert runtime.elector.is_leader
+        finally:
+            runtime.stop()
+
+    def test_two_standbys_one_file_lock_single_leader(self, tmp_path):
+        """The deployment README HA shape: two runtimes, one lock
+        directory, file-lock opt-in -> exactly one leader.  Pins two
+        past holes: private-store self-election, and the hostname-pid
+        identity collision that let a second same-process elector
+        mistake the first's lease for its own."""
+        def mk():
+            return ServerRuntime(ServerOption(
+                enable_leader_election=True,
+                lock_object_namespace=str(tmp_path), listen_address="",
+                file_lock_same_host_ok=True, schedule_period=0.1))
+        a, b = mk(), mk()
+        a.run()
+        b.run()
+        try:
+            deadline = time.time() + 10
+            while (time.time() < deadline
+                   and not (a.elector.is_leader or b.elector.is_leader)):
+                time.sleep(0.05)
+            time.sleep(1.0)  # give a wrongful second election time to land
+            assert a.elector.is_leader != b.elector.is_leader
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_file_lock_flag_parses(self):
+        opt = parse_options(["--leader-elect", "--lock-object-namespace",
+                             "/tmp", "--leader-elect-file-lock"])
+        assert opt.file_lock_same_host_ok
+
 
 class TestLeaderElection:
     def test_single_candidate_acquires(self, tmp_path):

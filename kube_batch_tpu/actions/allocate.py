@@ -57,14 +57,11 @@ class AllocateAction(Action):
 
             job = jobs.pop()
             if job.uid not in pending_tasks:
-                tasks = PriorityQueue(ssn.task_order_fn)
-                for task in job.task_status_index.get(TaskStatus.Pending,
-                                                      {}).values():
-                    # BestEffort tasks wait for backfill (allocate.go:112-117).
-                    if task.resreq.is_empty():
-                        continue
-                    tasks.push(task)
-                pending_tasks[job.uid] = tasks
+                # BestEffort tasks wait for backfill (allocate.go:112-117).
+                pending_tasks[job.uid] = ssn.task_queue(
+                    task for task in job.task_status_index.get(
+                        TaskStatus.Pending, {}).values()
+                    if not task.resreq.is_empty())
             tasks = pending_tasks[job.uid]
 
             while not tasks.empty():

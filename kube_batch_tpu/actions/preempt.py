@@ -43,9 +43,8 @@ class PreemptAction(Action):
                     preemptors_map[job.queue] = PriorityQueue(ssn.job_order_fn)
                 preemptors_map[job.queue].push(job)
                 under_request.append(job)
-                preemptor_tasks[job.uid] = PriorityQueue(ssn.task_order_fn)
-                for task in job.task_status_index[TaskStatus.Pending].values():
-                    preemptor_tasks[job.uid].push(task)
+                preemptor_tasks[job.uid] = ssn.task_queue(
+                    job.task_status_index[TaskStatus.Pending].values())
 
         if not preemptors_map:
             return
@@ -194,9 +193,7 @@ def _preempt(ssn, stmt, preemptor: TaskInfo, nodes, filter_fn,
 
         # Lowest-priority victims evicted first: reversed task order
         # (preempt.go:213-218).
-        victims_queue = PriorityQueue(lambda l, r: not ssn.task_order_fn(l, r))
-        for victim in victims:
-            victims_queue.push(victim)
+        victims_queue = ssn.victims_queue(victims)
 
         preempted = Resource.empty()
         resreq = preemptor.init_resreq.clone()

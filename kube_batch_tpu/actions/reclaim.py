@@ -38,9 +38,8 @@ class ReclaimAction(Action):
                 if job.queue not in preemptors_map:
                     preemptors_map[job.queue] = PriorityQueue(ssn.job_order_fn)
                 preemptors_map[job.queue].push(job)
-                preemptor_tasks[job.uid] = PriorityQueue(ssn.task_order_fn)
-                for task in job.task_status_index[TaskStatus.Pending].values():
-                    preemptor_tasks[job.uid].push(task)
+                preemptor_tasks[job.uid] = ssn.task_queue(
+                    job.task_status_index[TaskStatus.Pending].values())
 
         while not queues.empty():
             queue = queues.pop()

@@ -22,6 +22,7 @@ from ..api.pod_group_info import (PodGroupCondition, PodGroupPending,
                                   PodGroupUnschedulableType)
 from ..metrics import metrics
 from ..native import apply_placements as native_apply
+from ..utils.priority_queue import PriorityQueue, SortedDrainQueue
 from .events import AllocateBatch, Event, EventHandler
 from .interface import Plugin
 
@@ -50,6 +51,12 @@ class Session:
         self.job_order_fns: Dict[str, Callable] = {}
         self.queue_order_fns: Dict[str, Callable] = {}
         self.task_order_fns: Dict[str, Callable] = {}
+        # Optional static-key forms of task_order_fns: key_fn(task) must
+        # sort ascending exactly like the cmp fn.  When EVERY enabled
+        # task-order plugin registers one, task_sort_key() lets the
+        # actions replace O(n)-scan comparator queues with sorted drains
+        # (task keys are immutable within a session).
+        self.task_order_key_fns: Dict[str, Callable] = {}
         self.predicate_fns: Dict[str, Callable] = {}
         self.preemptable_fns: Dict[str, Callable] = {}
         self.reclaimable_fns: Dict[str, Callable] = {}
@@ -67,6 +74,7 @@ class Session:
         # first call freezes the chain.
         self._job_order_chain: Optional[List[Callable]] = None
         self._task_order_chain: Optional[List[Callable]] = None
+        self._task_key_fn = False  # False = uncomputed, None = unavailable
 
     # ------------------------------------------------------------------
     # registration (session_plugins.go:25-77)
@@ -79,6 +87,9 @@ class Session:
 
     def add_task_order_fn(self, name, fn):
         self.task_order_fns[name] = fn
+
+    def add_task_order_key_fn(self, name, key_fn):
+        self.task_order_key_fns[name] = key_fn
 
     def add_predicate_fn(self, name, fn):
         self.predicate_fns[name] = fn
@@ -241,6 +252,61 @@ class Session:
         if lt == rt:
             return l.uid < r.uid
         return lt < rt
+
+    def task_sort_key(self) -> Optional[Callable]:
+        """Static ascending sort key equivalent to task_order_fn, or None
+        when some enabled task-order plugin has no key form.  Task keys
+        are immutable within a session (the cmp chain reads only
+        priority/timestamps/uid-class fields), so a one-time sort equals
+        the comparator queue's live re-evaluation exactly — including
+        the creation-time/UID total-order fallback."""
+        if self._task_key_fn is not False:
+            return self._task_key_fn
+        key_fns = []
+        for tier in self.tiers:
+            for plugin in tier.plugins:
+                if (plugin.enabled_task_order
+                        and plugin.name in self.task_order_fns):
+                    kf = self.task_order_key_fns.get(plugin.name)
+                    if kf is None:
+                        self._task_key_fn = None
+                        return None
+                    key_fns.append(kf)
+        if len(key_fns) == 1:
+            k0 = key_fns[0]
+
+            def key(t, _k0=k0):
+                return (_k0(t), t.pod.metadata.creation_timestamp, t.uid)
+        else:
+            def key(t, _ks=tuple(key_fns)):
+                return (*[k(t) for k in _ks],
+                        t.pod.metadata.creation_timestamp, t.uid)
+        self._task_key_fn = key
+        return key
+
+    def task_queue(self, items=()):
+        """Queue over tasks in task_order_fn order.  A one-sort drain
+        when every enabled task-order plugin registered a static key
+        form (task keys are immutable within a session), else the live
+        comparator queue — identical pop order either way."""
+        key = self.task_sort_key()
+        if key is not None:
+            return SortedDrainQueue(key, items)
+        q = PriorityQueue(self.task_order_fn)
+        for t in items:
+            q.push(t)
+        return q
+
+    def victims_queue(self, victims):
+        """Victims in REVERSED task order — lowest priority evicted
+        first (preempt.go:213-218)."""
+        key = self.task_sort_key()
+        if key is not None:
+            return SortedDrainQueue(key, victims, reverse=True)
+        q = PriorityQueue(lambda l, r: not self.task_order_fn(l, r))
+        for v in victims:
+            q.push(v)
+        return q
 
     def predicate_fn(self, task: TaskInfo, node: NodeInfo) -> None:
         """All enabled predicates across all tiers must pass (go:334-351).

@@ -21,7 +21,7 @@ import numpy as np
 
 from ..api import TaskInfo
 from ..ops.resources import quantize_value
-from ..ops.scan import ScanStatics, scan_nodes
+from ..ops.scan import ScanStatics, best_scan_nodes
 from ..ops.scoring import SCORE_NEG_INF
 
 # Node counts below this are cheaper as the plain per-node object walk
@@ -216,9 +216,9 @@ class DeviceNodeScanner:
                  self._task_anti[ti],
                  self._task_paffw[ti], self._task_pantiw[ti]]
             ).astype(np.int32)
-            out = np.asarray(scan_nodes(self.cfg, self.r, self.np_pad,
-                                        self.ns_pad, self.statics, self.dyn,
-                                        trow))
+            out = np.asarray(best_scan_nodes(self.cfg, self.r, self.np_pad,
+                                             self.ns_pad, self.statics,
+                                             self.dyn, trow))
             return out[:len(self.snap.node_names)]
         key = (int(self._task_sig[ti]), self._task_res[ti].tobytes(),
                self._task_ports[ti].tobytes(),

@@ -40,19 +40,12 @@ class ScanStatics(NamedTuple):
     score_shift: jnp.ndarray  # [2] i32
 
 
-@functools.partial(jax.jit,
-                   static_argnames=("cfg", "r", "np_pad", "ns_pad"))
-def scan_nodes(cfg, r: int, np_pad: int, ns_pad: int, statics: ScanStatics,
+def _scan_body(cfg, r: int, np_pad: int, ns_pad: int, statics: ScanStatics,
                dyn: jnp.ndarray, trow: jnp.ndarray) -> jnp.ndarray:
-    """[N] i32 scores; SCORE_NEG_INF where the predicate chain rejects.
-
-    ``dyn`` packs the mutable node state column-wise:
-        [0:r] used | [r] count | [r+1 : r+1+np_pad] ports |
-        [r+1+np_pad : r+1+np_pad+ns_pad] selcnt
-    (idle/releasing are irrelevant here — no fit check, and scoring reads
-    used only).  ``trow`` packs the preemptor:
-        [0] sig | [1:1+r] res | ports | aff | anti | match(paffw) | pantiw
-    """
+    """The scan math, un-jitted: every term is per-node elementwise, so
+    the same body serves the single-chip jit (scan_nodes) and each
+    device's shard of the node axis (parallel/sharded_scan.py) with no
+    cross-shard traffic."""
     used = dyn[:, :r]
     count = dyn[:, r]
     ports = dyn[:, r + 1:r + 1 + np_pad]
@@ -89,3 +82,42 @@ def scan_nodes(cfg, r: int, np_pad: int, ns_pad: int, statics: ScanStatics,
         score = score + SCORE_GRID_K * jnp.sum(wdiff * selcnt, axis=-1)
     score = score + statics.sig_bonus[sig]
     return jnp.where(feasible, score, SCORE_NEG_INF)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "r", "np_pad", "ns_pad"))
+def scan_nodes(cfg, r: int, np_pad: int, ns_pad: int, statics: ScanStatics,
+               dyn: jnp.ndarray, trow: jnp.ndarray) -> jnp.ndarray:
+    """[N] i32 scores; SCORE_NEG_INF where the predicate chain rejects.
+
+    ``dyn`` packs the mutable node state column-wise:
+        [0:r] used | [r] count | [r+1 : r+1+np_pad] ports |
+        [r+1+np_pad : r+1+np_pad+ns_pad] selcnt
+    (idle/releasing are irrelevant here — no fit check, and scoring reads
+    used only).  ``trow`` packs the preemptor:
+        [0] sig | [1:1+r] res | ports | aff | anti | match(paffw) | pantiw
+    """
+    return _scan_body(cfg, r, np_pad, ns_pad, statics, dyn, trow)
+
+
+def best_scan_nodes(cfg, r: int, np_pad: int, ns_pad: int,
+                    statics: ScanStatics, dyn, trow) -> jnp.ndarray:
+    """Route one preemptor's node walk to the node-sharded scan when the
+    mesh gate says the node bucket outgrew one chip — the allocate
+    solver's node-count gate and envs (solver.choose_solver_mesh minus
+    its bytes-limit branch, which needs full SolverInputs), so
+    preempt/reclaim shard when allocate does."""
+    import os
+
+    from .solver import (DEFAULT_SHARD_NODES, FORCE_SHARD_ENV,
+                         SHARD_NODES_ENV, _env_int)
+    from ..parallel.mesh import default_mesh
+    mesh = default_mesh()
+    n = statics.node_exists.shape[0]
+    if mesh is not None and n % mesh.size == 0 and (
+            os.environ.get(FORCE_SHARD_ENV) == "1"
+            or n >= _env_int(SHARD_NODES_ENV, DEFAULT_SHARD_NODES)):
+        from ..parallel.sharded_scan import scan_nodes_sharded
+        return scan_nodes_sharded(cfg, r, np_pad, ns_pad, statics, dyn,
+                                  trow, mesh)
+    return scan_nodes(cfg, r, np_pad, ns_pad, statics, dyn, trow)

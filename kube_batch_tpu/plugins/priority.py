@@ -27,6 +27,10 @@ class PriorityPlugin(Plugin):
             return -1 if l.priority > r.priority else 1
 
         ssn.add_task_order_fn(self.name(), task_order_fn)
+        # Static-key form of the same order (higher priority first, so
+        # ascending key = negated priority); enables sorted-drain task
+        # queues in the actions.
+        ssn.add_task_order_key_fn(self.name(), lambda t: -t.priority)
 
         def job_order_fn(l: JobInfo, r: JobInfo) -> int:
             # Higher PriorityClass value first (priority.go:61-79).

@@ -18,6 +18,7 @@ order total, so ties only occur for duplicate pushes of the same object.
 
 from __future__ import annotations
 
+import bisect
 from collections import deque
 from typing import Callable
 
@@ -48,3 +49,47 @@ class PriorityQueue:
 
     def __len__(self) -> int:
         return len(self._items)
+
+
+class SortedDrainQueue:
+    """PriorityQueue specialization for IMMUTABLE sort keys: one C-speed
+    sort at build, O(1) pops.  Equal to PriorityQueue's live re-evaluation
+    exactly when ``key`` is a total order that cannot change while queued
+    (Session.task_sort_key: per-session task keys are immutable; the
+    uid fallback makes the order total, so tie handling never differs).
+    Late pushes keep correctness via bisect insertion."""
+
+    def __init__(self, key: Callable, items=(), reverse: bool = False):
+        self._key = key
+        self._reverse = reverse
+        self._items = sorted(items, key=key, reverse=reverse)
+        self._lo = 0  # drain pointer; avoids O(n) pop(0) shifting
+
+    def push(self, value) -> None:
+        # Rare path (task queues are build-then-drain); insert after
+        # equal keys so a same-key duplicate pops after the earlier one,
+        # matching PriorityQueue's insertion-order ties.
+        k = self._key(value)
+        if self._reverse:
+            i = self._lo
+            n = len(self._items)
+            while i < n and not (self._key(self._items[i]) < k):
+                i += 1
+        else:
+            keys = [self._key(x) for x in self._items[self._lo:]]
+            i = bisect.bisect_right(keys, k) + self._lo
+        self._items.insert(i, value)
+
+    def pop(self):
+        if self._lo >= len(self._items):
+            return None
+        value = self._items[self._lo]
+        self._items[self._lo] = None  # release the reference
+        self._lo += 1
+        return value
+
+    def empty(self) -> bool:
+        return self._lo >= len(self._items)
+
+    def __len__(self) -> int:
+        return len(self._items) - self._lo

@@ -119,3 +119,83 @@ class TestPriorityQueue:
         pq = PriorityQueue(lambda l, r: l < r)
         assert pq.pop() is None
         assert pq.empty()
+
+
+class TestSortedDrainQueue:
+    """The static-key drain must be pop-for-pop identical to the live
+    comparator queue whenever the key is immutable and total — the
+    contract Session.task_queue relies on."""
+
+    def test_matches_comparator_queue(self):
+        from kube_batch_tpu.utils.priority_queue import SortedDrainQueue
+        import random
+        rng = random.Random(7)
+        items = [(rng.randint(0, 5), i) for i in range(200)]
+        sdq = SortedDrainQueue(lambda x: x, items)
+        pq = PriorityQueue(lambda l, r: l < r)
+        for it in items:
+            pq.push(it)
+        assert [sdq.pop() for _ in range(len(items))] == \
+               [pq.pop() for _ in range(len(items))]
+        assert sdq.pop() is None and sdq.empty()
+
+    def test_late_push_both_directions(self):
+        from kube_batch_tpu.utils.priority_queue import SortedDrainQueue
+        sdq = SortedDrainQueue(lambda x: x, [1, 3, 5])
+        assert sdq.pop() == 1
+        sdq.push(2)
+        sdq.push(4)
+        assert [sdq.pop() for _ in range(4)] == [2, 3, 4, 5]
+        rev = SortedDrainQueue(lambda x: x, [5, 3, 1], reverse=True)
+        assert rev.pop() == 5
+        rev.push(4)
+        rev.push(0)
+        assert [rev.pop() for _ in range(4)] == [4, 3, 1, 0]
+        assert len(rev) == 0
+
+    def test_session_task_queue_equivalence(self):
+        """ssn.task_queue / ssn.victims_queue drain in exactly the
+        comparator order (priority desc, creation ts, uid) — and the
+        victims drain is its exact reverse (preempt.go:213-218)."""
+        import random
+        from kube_batch_tpu.api import TaskInfo
+        from kube_batch_tpu.plugins.priority import new as priority_new
+        from kube_batch_tpu.utils.priority_queue import SortedDrainQueue
+        from tests.test_session_combinators import mk_session
+        from tests.test_utils import build_pod, build_resource_list
+
+        ssn = mk_session([["priority"]])
+        priority_new(Arguments({})).on_session_open(ssn)
+        rng = random.Random(3)
+        tasks = [TaskInfo(build_pod(
+            "ns", f"t{i}", "", "Pending", build_resource_list("1", "1Gi"),
+            "pg", priority=rng.randint(0, 3), ts=float(rng.randint(0, 2))))
+            for i in range(60)]
+        rng.shuffle(tasks)
+
+        fast = ssn.task_queue(tasks)
+        assert isinstance(fast, SortedDrainQueue)
+        slow = PriorityQueue(ssn.task_order_fn)
+        for t in tasks:
+            slow.push(t)
+        drained = [fast.pop() for _ in range(len(tasks))]
+        assert [t.uid for t in drained] == \
+               [slow.pop().uid for _ in range(len(tasks))]
+
+        rev = ssn.victims_queue(tasks)
+        slow_rev = PriorityQueue(lambda l, r: not ssn.task_order_fn(l, r))
+        for t in tasks:
+            slow_rev.push(t)
+        assert [rev.pop().uid for _ in range(len(tasks))] == \
+               [slow_rev.pop().uid for _ in range(len(tasks))]
+
+    def test_session_falls_back_without_key_form(self):
+        """A task-order plugin with no key form forces the comparator
+        queue (correctness over speed)."""
+        from tests.test_session_combinators import mk_session
+        ssn = mk_session([["priority"]])
+        ssn.add_task_order_fn("priority", lambda l, r: 0)
+        # no add_task_order_key_fn
+        assert ssn.task_sort_key() is None
+        q = ssn.task_queue([])
+        assert isinstance(q, PriorityQueue)

@@ -106,3 +106,94 @@ def test_gate_routes_sharded_unforced(monkeypatch):
                                    n_nodes=DEFAULT_SHARD_NODES + 1024,
                                    n_jobs=8, n_queues=2, seed=0)
     assert choose_solver(big) == "sharded"
+
+
+class TestShardedScan:
+    """Node-sharded preempt/reclaim scan (parallel/sharded_scan.py) vs the
+    single-chip scan kernel on the virtual 8-device CPU mesh — the
+    eviction-path analog of the allocate parity above (preempt fans over
+    the same node set allocate shards, preempt.go:180-189)."""
+
+    @staticmethod
+    def _statics_dyn(inputs, n_sigs_min=64):
+        import jax.numpy as jnp
+        from kube_batch_tpu.ops.scan import ScanStatics
+        sig_mask = np.asarray(inputs.sig_mask)
+        sig_bonus = np.asarray(inputs.sig_bonus)
+        if sig_mask.shape[0] < n_sigs_min:
+            # Widen the signature axis to >= 64 distinct rows: flip one
+            # node per extra signature so every row is its own profile.
+            reps = -(-n_sigs_min // sig_mask.shape[0])
+            sig_mask = np.tile(sig_mask, (reps, 1))[:n_sigs_min].copy()
+            sig_bonus = np.tile(sig_bonus, (reps, 1))[:n_sigs_min].copy()
+            for s in range(sig_mask.shape[0]):
+                sig_mask[s, s % sig_mask.shape[1]] ^= True
+        statics = ScanStatics(
+            sig_mask=jnp.asarray(sig_mask),
+            sig_bonus=jnp.asarray(sig_bonus),
+            node_alloc=jnp.asarray(inputs.node_alloc),
+            node_max_tasks=jnp.asarray(inputs.node_max_tasks),
+            node_exists=jnp.asarray(inputs.node_exists),
+            score_shift=jnp.asarray(inputs.score_shift))
+        r = inputs.task_req.shape[1]
+        dyn = np.concatenate(
+            [np.asarray(inputs.node_used),
+             np.asarray(inputs.node_count)[:, None],
+             np.asarray(inputs.node_ports).astype(np.int32),
+             np.asarray(inputs.node_selcnt)], axis=1).astype(np.int32)
+        return statics, dyn, r
+
+    @pytest.mark.parametrize("seed", [0, 2])
+    def test_scan_matches_single_chip(self, seed):
+        from kube_batch_tpu.ops.scan import scan_nodes
+        from kube_batch_tpu.parallel.sharded_scan import scan_nodes_sharded
+        inputs, config = make_synthetic_inputs(
+            n_tasks=96, n_nodes=64, n_jobs=12, n_queues=3, seed=seed)
+        statics, dyn, r = self._statics_dyn(inputs)
+        assert statics.sig_mask.shape[0] >= 64
+        np_pad = inputs.task_ports.shape[1]
+        ns_pad = inputs.task_aff_req.shape[1]
+        mesh = make_mesh(8)
+        rng = np.random.RandomState(seed)
+        for ti in rng.choice(96, size=4, replace=False):
+            sig = int(np.asarray(inputs.task_sig)[ti]) \
+                % statics.sig_mask.shape[0]
+            trow = np.concatenate(
+                [np.asarray([sig], np.int32),
+                 np.asarray(inputs.task_res)[ti],
+                 np.asarray(inputs.task_ports)[ti].astype(np.int32),
+                 np.asarray(inputs.task_aff_req)[ti],
+                 np.asarray(inputs.task_anti)[ti],
+                 np.asarray(inputs.task_paff_w)[ti],
+                 np.asarray(inputs.task_panti_w)[ti]]).astype(np.int32)
+            sharded = np.asarray(scan_nodes_sharded(
+                config, r, np_pad, ns_pad, statics, dyn, trow, mesh))
+            single = np.asarray(scan_nodes(
+                config, r, np_pad, ns_pad, statics, dyn, trow))
+            assert np.array_equal(sharded, single)
+
+    def test_best_scan_routes_sharded(self, monkeypatch):
+        """The production chokepoint (best_scan_nodes) reaches the mesh
+        path under the allocate solver's own FORCE_SHARD env."""
+        from kube_batch_tpu.ops.scan import best_scan_nodes, scan_nodes
+        from kube_batch_tpu.ops.solver import FORCE_SHARD_ENV
+        from kube_batch_tpu.parallel import mesh as mesh_mod
+        inputs, config = make_synthetic_inputs(
+            n_tasks=64, n_nodes=64, n_jobs=8, n_queues=2, seed=1)
+        statics, dyn, r = self._statics_dyn(inputs)
+        np_pad = inputs.task_ports.shape[1]
+        ns_pad = inputs.task_aff_req.shape[1]
+        trow = np.concatenate(
+            [np.asarray([0], np.int32), np.asarray(inputs.task_res)[0],
+             np.asarray(inputs.task_ports)[0].astype(np.int32),
+             np.asarray(inputs.task_aff_req)[0],
+             np.asarray(inputs.task_anti)[0],
+             np.asarray(inputs.task_paff_w)[0],
+             np.asarray(inputs.task_panti_w)[0]]).astype(np.int32)
+        monkeypatch.setenv(FORCE_SHARD_ENV, "1")
+        monkeypatch.setattr(mesh_mod, "_default_mesh", make_mesh(8))
+        routed = np.asarray(best_scan_nodes(
+            config, r, np_pad, ns_pad, statics, dyn, trow))
+        single = np.asarray(scan_nodes(
+            config, r, np_pad, ns_pad, statics, dyn, trow))
+        assert np.array_equal(routed, single)

@@ -393,6 +393,15 @@ class ClusterBinder(Binder):
     def bind(self, pod, hostname: str) -> None:
         self.cluster.bind_pod(pod.metadata.namespace, pod.metadata.name, hostname)
 
+    def bind_many(self, pairs) -> list:
+        # A remote edge amortizes the wire: concurrent keep-alive
+        # connections instead of one serial round trip per bind
+        # (edge/client.py bind_pods_many — the goroutine-per-bind analog).
+        many = getattr(self.cluster, "bind_pods_many", None)
+        if many is not None:
+            return many(pairs)
+        return super().bind_many(pairs)
+
 
 class ClusterEvictor(Evictor):
     """Evicts by deleting the pod (reference cache.go:138-146)."""

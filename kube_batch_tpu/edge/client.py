@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import http.client
 import json
+import socket
 import threading
 import urllib.error
 import urllib.request
@@ -19,6 +20,23 @@ from typing import Dict
 
 from ..cache.cluster import Informer
 from . import codec, codec_k8s
+
+
+class _NodelayConnection(http.client.HTTPConnection):
+    """Keep-alive connection with Nagle off: headers and body go out as
+    separate small sends, and without NODELAY the second send can sit
+    behind Nagle until the server's delayed ACK (~40 ms per request).
+    Subclassed so the retry path's auto-reconnect keeps the option."""
+
+    def connect(self):
+        super().connect()
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+
+class _NodelayHTTPSConnection(http.client.HTTPSConnection):
+    def connect(self):
+        super().connect()
+        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
 
 _WATCHED = ("pods", "nodes", "podgroups", "queues", "priorityclasses",
             "pdbs")
@@ -295,17 +313,107 @@ class RemoteCluster:
             raise KeyError(f"{method} {path}: {exc.code} {detail}") from exc
 
     # effectors the SchedulerCache wiring uses (cluster.py effectors):
-    def bind_pod(self, namespace: str, name: str, hostname: str) -> None:
+    def _bind_request(self, namespace: str, name: str, hostname: str):
+        """(path, payload) for one bind, by wire mode."""
         if self.wire == "k8s":  # the real Binding subresource
-            self._request(
-                "POST",
-                self._object_path("pods", namespace, name) + "/binding",
-                {"apiVersion": "v1", "kind": "Binding",
-                 "metadata": {"name": name, "namespace": namespace},
-                 "target": {"kind": "Node", "name": hostname}})
-        else:
-            self._request("POST", f"/v1/pods/{namespace}/{name}/bind",
-                          {"node": hostname})
+            return (self._object_path("pods", namespace, name) + "/binding",
+                    {"apiVersion": "v1", "kind": "Binding",
+                     "metadata": {"name": name, "namespace": namespace},
+                     "target": {"kind": "Node", "name": hostname}})
+        return (f"/v1/pods/{namespace}/{name}/bind", {"node": hostname})
+
+    def bind_pods_many(self, pairs, workers: int = 8) -> list:
+        """Bind [(pod, hostname)] concurrently over persistent
+        connections; returns [(pod, hostname, exc)] failures.
+
+        The reference fires one goroutine per bind (cache.go:491-535 via
+        the bind channel); the HTTP analog is a small worker pool where
+        each worker keeps ONE keep-alive connection and streams its
+        share of Binding POSTs down it — n_binds round trips become
+        ~n_binds/workers serialized on each of ``workers`` sockets,
+        without per-request TCP setup."""
+        from urllib.parse import urlsplit
+
+        if not pairs:
+            return []
+        parts = urlsplit(self.base_url)
+        prefix = parts.path.rstrip("/")  # reverse-proxied edge prefix
+        conn_cls = (_NodelayHTTPSConnection if parts.scheme == "https"
+                    else _NodelayConnection)
+        failures = []
+        flock = threading.Lock()
+        workers = max(1, min(workers, len(pairs)))
+
+        def post(conn, pod, hostname, path, body):
+            sent = False
+            for attempt in (0, 1):
+                try:
+                    sent = False
+                    conn.request("POST", prefix + path, body,
+                                 {"Content-Type": "application/json"})
+                    sent = True  # delivered; a later failure may have
+                    # been applied server-side — don't blind-retry
+                    resp = conn.getresponse()
+                    data = resp.read()
+                except (http.client.HTTPException, OSError):
+                    conn.close()  # next request auto-reconnects
+                    if attempt or sent:
+                        # Send-phase failures are safe to retry (the
+                        # server never saw the POST); after delivery,
+                        # binds are non-idempotent — check the pod
+                        # instead of re-POSTing.
+                        if sent and self._pod_bound_to(pod, hostname):
+                            return
+                        raise
+                    continue
+                if resp.status >= 400:
+                    if attempt and self._pod_bound_to(pod, hostname):
+                        return  # first attempt did land; 409-shaped echo
+                    raise KeyError(f"POST {path}: {resp.status} "
+                                   f"{data.decode(errors='replace')}")
+                return
+
+        def run(chunk):
+            conn = conn_cls(parts.hostname, parts.port,
+                            timeout=self.timeout)
+            try:
+                for pod, hostname in chunk:
+                    path, payload = self._bind_request(
+                        pod.metadata.namespace, pod.metadata.name, hostname)
+                    try:
+                        post(conn, pod, hostname, path,
+                             json.dumps(payload))
+                    except Exception as exc:  # per-task failure isolation
+                        with flock:
+                            failures.append((pod, hostname, exc))
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(
+            target=run, args=(pairs[i::workers],), daemon=True)
+            for i in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return failures
+
+    def _pod_bound_to(self, pod, hostname: str) -> bool:
+        """Read-back check for the bind retry path: was the first POST
+        applied before the connection died?  GETs the SERVER (the local
+        mirror lags the watch stream); GETs are idempotent, so this is
+        safe where re-POSTing a Binding is not."""
+        try:
+            doc = self._request("GET", self._object_path(
+                "pods", pod.metadata.namespace, pod.metadata.name))
+            current = self._decode(doc)
+            return current.spec.node_name == hostname
+        except Exception:
+            return False
+
+    def bind_pod(self, namespace: str, name: str, hostname: str) -> None:
+        path, payload = self._bind_request(namespace, name, hostname)
+        self._request("POST", path, payload)
 
     def delete_pod(self, namespace: str, name: str) -> None:
         self._request("DELETE",

@@ -112,6 +112,12 @@ def _informer_of(cluster: Cluster, resource: str):
 
 class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
+    # Keep-alive + small unbuffered writes (wbufsize=0) otherwise hit
+    # Nagle/delayed-ACK stalls: a response written as status + headers +
+    # body segments can wait ~40 ms per round for the peer's delayed ACK,
+    # turning a bulk bind egress into minutes (measured 4 ms/bind ->
+    # sub-ms with NODELAY on the loopback edge).
+    disable_nagle_algorithm = True
     cluster: Cluster = None  # set by ApiServer subclassing
     history = None           # _EventHistory, set by ApiServer subclassing
 

@@ -389,3 +389,90 @@ class TestWatchResume:
             assert frames[-1]["object"]["code"] == 410
         finally:
             server2.stop()
+
+
+class TestConcurrentBindEgress:
+    """bind_pods_many: the goroutine-per-bind analog — a worker pool of
+    keep-alive connections (cache.go:491-535's concurrent bind fan-out)."""
+
+    def _seed(self, cluster, n):
+        cluster.create_node(build_node(
+            "n0", build_resource_list(str(n), f"{n}Gi", pods=2 * n)))
+        for i in range(n):
+            cluster.create_pod(build_pod(
+                "ns", f"p{i}", "", "Pending",
+                build_resource_list("1", "1Gi")))
+
+    @pytest.mark.parametrize("wire", ["native", "k8s"])
+    def test_bulk_bind_lands_server_side(self, api, wire):
+        cluster, server = api
+        self._seed(cluster, 20)
+        remote = RemoteCluster(server.url, wire=wire).start()
+        try:
+            with remote.lock:
+                pods = [remote.pods[f"ns/p{i}"] for i in range(20)]
+            failures = remote.bind_pods_many(
+                [(p, "n0") for p in pods], workers=4)
+        finally:
+            remote.stop()
+        assert failures == []
+        with cluster.lock:
+            assert all(p.spec.node_name == "n0"
+                       for p in cluster.pods.values())
+
+    def test_per_bind_failure_isolation(self, api):
+        """One missing pod fails alone; every other bind still lands —
+        the same isolation Binder.bind_many's serial default gives."""
+        cluster, server = api
+        self._seed(cluster, 6)
+        remote = RemoteCluster(server.url).start()
+        try:
+            with remote.lock:
+                pods = [remote.pods[f"ns/p{i}"] for i in range(6)]
+            ghost = build_pod("ns", "ghost", "", "Pending",
+                              build_resource_list("1", "1Gi"))
+            failures = remote.bind_pods_many(
+                [(p, "n0") for p in pods[:3]] + [(ghost, "n0")]
+                + [(p, "n0") for p in pods[3:]], workers=3)
+        finally:
+            remote.stop()
+        assert len(failures) == 1
+        assert failures[0][0].metadata.name == "ghost"
+        with cluster.lock:
+            bound = [p for p in cluster.pods.values() if p.spec.node_name]
+        assert len(bound) == 6
+
+    def test_cluster_binder_delegates(self, api):
+        """ClusterBinder.bind_many routes through the concurrent path for
+        a RemoteCluster and the serial loop for the in-process store."""
+        from kube_batch_tpu.cache.cluster import ClusterBinder
+        cluster, server = api
+        self._seed(cluster, 4)
+        remote = RemoteCluster(server.url).start()
+        try:
+            with remote.lock:
+                pods = [remote.pods[f"ns/p{i}"] for i in range(4)]
+            assert ClusterBinder(remote).bind_many(
+                [(p, "n0") for p in pods]) == []
+        finally:
+            remote.stop()
+        with cluster.lock:
+            assert sum(1 for p in cluster.pods.values()
+                       if p.spec.node_name) == 4
+
+    def test_bind_retry_readback_asks_the_server(self, api):
+        """_pod_bound_to consults the SERVER, not the (lagging) local
+        mirror — the delivered-but-unanswered retry case."""
+        cluster, server = api
+        self._seed(cluster, 1)
+        remote = RemoteCluster(server.url).start()
+        try:
+            with remote.lock:
+                pod = remote.pods["ns/p0"]
+            assert not remote._pod_bound_to(pod, "n0")
+            # Bind server-side only; don't wait for the watch echo.
+            cluster.bind_pod("ns", "p0", "n0")
+            assert remote._pod_bound_to(pod, "n0")
+            assert not remote._pod_bound_to(pod, "elsewhere")
+        finally:
+            remote.stop()

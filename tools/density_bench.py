@@ -21,6 +21,11 @@ import time
 
 sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
+import jax
+
+jax.config.update("jax_platforms", "cpu")  # density cost is host-side;
+# the env var alone cannot stop a wedged-tunnel hang (memory: axon relay)
+
 from kube_batch_tpu.api import (Container, ObjectMeta, Pod, PodSpec,
                                 PodStatus)
 from kube_batch_tpu.apis.scheduling import v1alpha1

@@ -252,6 +252,86 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
     return round(cold, 1), steady[1:]
 
 
+def run_session_stages(cache, tiers):
+    """ONE stage-timed session — open -> tensorize -> ship -> solve ->
+    apply (incl. fit-delta recording, the shipped action's full apply
+    phase, tpu_allocate.py:84-93) -> close.  Returns ({stage: seconds},
+    placed).  Shared by measure_session_stages and
+    tools/session_bench.py so the stage protocol exists once."""
+    import numpy as np
+
+    from kube_batch_tpu.actions.tpu_allocate import TpuAllocateAction
+    from kube_batch_tpu.framework import close_session, open_session
+    from kube_batch_tpu.models.shipping import ship_inputs
+    from kube_batch_tpu.models.tensor_snapshot import (
+        build_apply_aggregates, tensorize_session)
+    from kube_batch_tpu.ops.solver import best_solve_allocate, fetch_result
+
+    stages = {}
+    t = time.perf_counter()
+    ssn = open_session(cache, tiers)
+    try:
+        stages["open"] = time.perf_counter() - t
+        t = time.perf_counter()
+        snap = tensorize_session(ssn)
+        stages["tensorize"] = time.perf_counter() - t
+        assert not snap.needs_fallback, snap.fallback_reason
+        t = time.perf_counter()
+        inputs = ship_inputs(snap.inputs)
+        stages["ship"] = time.perf_counter() - t
+        t = time.perf_counter()
+        result = best_solve_allocate(inputs, snap.config)
+        assignment, kind, order = fetch_result(result)
+        stages["solve"] = time.perf_counter() - t
+        t = time.perf_counter()
+        placed = np.nonzero(kind > 0)[0]
+        ordered = placed[np.argsort(order[placed], kind="stable")]
+        agg = build_apply_aggregates(snap, assignment, kind, ordered)
+        kinds = kind[ordered].tolist()
+        hostnames = [snap.node_names[i]
+                     for i in assignment[ordered].tolist()]
+        ssn.batch_apply(
+            zip((snap.tasks[i] for i in ordered.tolist()),
+                hostnames, kinds), agg=agg)
+        TpuAllocateAction._record_fit_deltas(ssn, snap, kind, assignment,
+                                             order)
+        stages["apply"] = time.perf_counter() - t
+    finally:
+        t = time.perf_counter()
+        close_session(ssn)
+        stages["close"] = time.perf_counter() - t
+    return stages, int(len(ordered))
+
+
+def measure_session_stages(n_tasks, n_nodes, n_jobs, n_queues,
+                           repeat: int = 3):
+    """({stage: median ms}, {stage: p90 ms}) per pipeline stage, so the
+    artifact itself shows WHERE the session budget goes and the next
+    bottleneck is visible in the record (tools/session_bench.py is the
+    standalone form)."""
+    from kube_batch_tpu.models.synthetic import make_synthetic_cache
+
+    _register()
+    cache, binder = make_synthetic_cache(n_tasks, n_nodes, n_jobs, n_queues)
+    tiers = _tiers()
+    per_stage: dict = {}
+    with _gc_posture():
+        for cycle in range(repeat + 1):
+            stages, placed = run_session_stages(cache, tiers)
+            assert placed > 0, "stage session placed nothing"
+            assert binder.binds, "stage session bound nothing"
+            binder.binds.clear()
+            if cycle == 0:
+                continue  # compile/cold warm-up
+            for k, v in stages.items():
+                per_stage.setdefault(k, []).append(v * 1e3)
+    meds = {}
+    p90s = {}
+    for k, v in per_stage.items():
+        meds[k], p90s[k] = _stats(v)
+    return meds, p90s
+
+
 def measure_action_pipeline(n_tasks, n_nodes, n_jobs, n_queues,
                             cycles: int = 2):
     """Per-action wall-clock for the SHIPPED pipeline — reclaim,
@@ -472,6 +552,16 @@ def _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline):
     out["session_cold_ms"], out["session_cold_p90"] = measure_cold_sessions(
         n_tasks, n_nodes, n_jobs, n_queues, n_caches=cold_n,
         extra=[steady_cold])
+
+    # Per-stage medians + p90s: where the session budget goes (VERDICT
+    # r4 weak #6 — the breakdown belongs in the artifact, not just in
+    # commit messages).  Optional: a stage-bench failure must not erase
+    # the pipeline measurements that follow.
+    try:
+        out["stages_ms"], out["stages_p90"] = measure_session_stages(
+            n_tasks, n_nodes, n_jobs, n_queues)
+    except Exception as exc:  # noqa: BLE001 — artifact stays honest
+        out["stages_error"] = f"{type(exc).__name__}: {exc}"
 
     if with_pipeline:
         per_action, evictions = measure_action_pipeline(

@@ -27,6 +27,18 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 
 def main():
+    # Same backend discipline as bench.py main(): probe in a subprocess;
+    # on failure pin CPU (a wedged axon tunnel hangs in-process backend
+    # init forever, and only the post-import config update avoids it).
+    import bench
+    _, probe_err = bench._probe_backend(
+        float(os.environ.get("BENCH_PROBE_TIMEOUT", 150)))
+    if probe_err is not None:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        print(json.dumps({"warning": "backend unusable; measuring on CPU",
+                          "error": probe_err[:200]}), file=sys.stderr)
+
     n_tasks = int(os.environ.get("SESSION_TASKS", 50_000))
     n_nodes = int(os.environ.get("SESSION_NODES", 10_000))
     n_jobs = int(os.environ.get("SESSION_JOBS", 2_000))
@@ -39,21 +51,18 @@ def main():
         # Steady-state protocol (long-lived cache + churn deltas + bind
         # echo) lives in bench.measure_steady_session.
         import bench
-        cold, steady = bench.measure_steady_session(
+        cold, rounds = bench.measure_steady_session(
             n_tasks, n_nodes, n_jobs, n_queues, churn=churn,
             n_signatures=n_sigs)
+        med, p90 = bench._stats(rounds)
         print(json.dumps({
             "metric": (f"steady-state session @ {n_tasks} tasks x "
                        f"{n_nodes} nodes, {churn:.1%} churn"),
-            "value": steady, "unit": "ms", "cold_ms": cold,
-            "vs_baseline": round(1000.0 / steady, 3)}))
+            "value": med, "unit": "ms", "p90": p90, "cold_ms": cold,
+            "vs_baseline": round(1000.0 / med, 3) if med else None}))
         return
 
-    import numpy as np
-    from kube_batch_tpu.framework import close_session, open_session
-    from kube_batch_tpu.models.shipping import ship_inputs
-    from kube_batch_tpu.models.tensor_snapshot import tensorize_session
-    from kube_batch_tpu.ops.solver import best_solve_allocate, fetch_result
+    from bench import run_session_stages
     from kube_batch_tpu.actions.factory import register_default_actions
     from kube_batch_tpu.plugins.factory import register_default_plugins
     from kube_batch_tpu.scheduler import (DEFAULT_SCHEDULER_CONF,
@@ -77,42 +86,9 @@ def main():
 
     best = None
     for _ in range(repeat):
-        stages = {}
-        t = time.perf_counter()
-        ssn = open_session(cache, tiers)
-        stages["open"] = time.perf_counter() - t
-
-        t = time.perf_counter()
-        snap = tensorize_session(ssn)
-        stages["tensorize"] = time.perf_counter() - t
-        assert not snap.needs_fallback, snap.fallback_reason
-
-        t = time.perf_counter()
-        inputs = ship_inputs(snap.inputs)
-        stages["ship"] = time.perf_counter() - t
-
-        t = time.perf_counter()
-        result = best_solve_allocate(inputs, snap.config)
-        assignment, kind, order = fetch_result(result)
-        stages["solve"] = time.perf_counter() - t
-
-        t = time.perf_counter()
-        from kube_batch_tpu.models.tensor_snapshot import build_apply_aggregates
-        placed = np.nonzero(kind > 0)[0]
-        ordered = placed[np.argsort(order[placed], kind="stable")]
-        agg = build_apply_aggregates(snap, assignment, kind, ordered)
-        kinds = kind[ordered].tolist()
-        hostnames = [snap.node_names[i] for i in assignment[ordered].tolist()]
-        ssn.batch_apply(
-            zip((snap.tasks[i] for i in ordered.tolist()), hostnames, kinds),
-            agg=agg)
-        stages["apply"] = time.perf_counter() - t
-
-        t = time.perf_counter()
-        close_session(ssn)
-        stages["close"] = time.perf_counter() - t
+        stages, placed = run_session_stages(cache, tiers)
         stages["binds"] = len(binder.binds)
-        stages["placed"] = int(len(ordered))
+        stages["placed"] = placed
 
         total = sum(v for k, v in stages.items()
                     if k not in ("binds", "placed"))

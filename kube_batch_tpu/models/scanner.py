@@ -15,6 +15,7 @@ tensorizer can't express fall back to the pure-host walk transparently.
 
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -33,6 +34,10 @@ DEFAULT_SCAN_MIN_NODES = 64
 # tunneled dev chip), the jitted kernel when node state is huge or the TPU
 # is local.  Set =1 to run the scan on device.
 SCAN_DEVICE_ENV = "KUBE_BATCH_TPU_SCAN_DEVICE"
+
+# Distinct task profiles whose score vectors stay warm at once; a storm
+# interleaves preemptors of a handful of profiles, far under this.
+_SCORE_CACHE_CAP = 64
 
 
 def maybe_scanner(ssn) -> Optional["DeviceNodeScanner"]:
@@ -112,14 +117,16 @@ class DeviceNodeScanner:
         self._np_bonus = np.asarray(inp.sig_bonus)
         self._checkpoints: List[Dict[int, np.ndarray]] = []
         # Incremental rescoring: between consecutive scans only the few
-        # rows an evict/pipeline touched change, so cache the last score
-        # vector per task-row identity and recompute just the dirty rows
-        # (identical ints to a full recompute — the math is row-pure).
-        # A preemption storm scans once per preemptor; this turns each
-        # O(N) rescore into O(dirty).
-        self._dirty: set = set()
-        self._score_key = None
-        self._scores_cached: Optional[np.ndarray] = None
+        # rows an evict/pipeline touched change, so cache score vectors
+        # per task-profile key and recompute just the rows touched since
+        # that entry was last current (identical ints to a full
+        # recompute — the math is row-pure).  A single-entry cache
+        # thrashed when a storm interleaves preemptors of different
+        # profiles (every scores() call was a full [N] recompute); the
+        # keyed LRU + append-only edit log make the steady state O(rows
+        # touched since last seen) per profile.
+        self._edit_log: List[int] = []
+        self._score_cache: "OrderedDict[tuple, list]" = OrderedDict()
 
     # -- transaction mirror (Statement commit/discard) ----------------------
     # Copy-on-write: a checkpoint is a {row -> saved row copy} undo log
@@ -152,7 +159,7 @@ class DeviceNodeScanner:
             undo = self._checkpoints.pop()
             for nix, row in undo.items():
                 self.dyn[nix] = row
-                self._dirty.add(nix)  # restored rows need a rescore
+                self._edit_log.append(nix)  # restored rows need a rescore
 
     # -- state updates ------------------------------------------------------
     # ``used`` (the scoring dimension) tracks session allocate/deallocate
@@ -169,14 +176,14 @@ class DeviceNodeScanner:
         self._save_row(nix)
         self.dyn[nix, 0] += sign * quantize_value(task.resreq.milli_cpu, 0)
         self.dyn[nix, 1] += sign * quantize_value(task.resreq.memory, 1)
-        self._dirty.add(nix)
+        self._edit_log.append(nix)
 
     def apply_pipeline(self, task: TaskInfo, hostname: str) -> None:
         nix = self.node_index.get(hostname)
         if nix is None:
             return
         self._save_row(nix)
-        self._dirty.add(nix)
+        self._edit_log.append(nix)
         row = self.dyn[nix]
         ti = self.task_index.get(task.uid)
         r = self.r
@@ -226,18 +233,29 @@ class DeviceNodeScanner:
                self._task_anti[ti].tobytes(),
                self._task_paffw[ti].tobytes(),
                self._task_pantiw[ti].tobytes())
-        if self._scores_cached is not None and key == self._score_key:
-            if self._dirty:  # patch only the touched rows
-                rows = np.fromiter(self._dirty, dtype=np.int64,
-                                   count=len(self._dirty))
-                self._scores_cached[rows] = self._scores_numpy(ti, rows)
-                self._dirty.clear()
-            out = self._scores_cached
+        log = self._edit_log
+        entry = self._score_cache.get(key)
+        if entry is not None:
+            out, pos = entry
+            gap = len(log) - pos
+            if gap > self.dyn.shape[0]:
+                # The patch pass scans the whole log gap; past one row
+                # per node the plain full recompute is strictly cheaper
+                # (the log is append-only and lives one session, so a
+                # profile revisited after a long storm hits this).
+                out[:] = self._scores_numpy(ti)
+                entry[1] = len(log)
+            elif gap:  # patch rows touched since last seen
+                rows = np.unique(np.fromiter(
+                    log[pos:], dtype=np.int64, count=gap))
+                out[rows] = self._scores_numpy(ti, rows)
+                entry[1] = len(log)
+            self._score_cache.move_to_end(key)
         else:
             out = self._scores_numpy(ti)
-            self._score_key = key
-            self._scores_cached = out
-            self._dirty.clear()
+            self._score_cache[key] = [out, len(log)]
+            if len(self._score_cache) > _SCORE_CACHE_CAP:
+                self._score_cache.popitem(last=False)
         return out[:len(self.snap.node_names)]
 
     def _scores_numpy(self, ti: int, rows=None) -> np.ndarray:

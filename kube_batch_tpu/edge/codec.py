@@ -11,6 +11,7 @@ consumers unpack positionally, so round-tripping preserves semantics.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import typing
 from typing import Any, Dict
 
@@ -35,37 +36,74 @@ _TOP_LEVEL = [
 _BY_KIND = {_kind_of(cls): cls for cls in _TOP_LEVEL}
 
 
+@functools.lru_cache(maxsize=None)
+def _field_names(cls) -> tuple:
+    return tuple(f.name for f in dataclasses.fields(cls))
+
+
+def _encode_value(v):
+    """dataclasses.asdict semantics minus the per-leaf deepcopy: the
+    result feeds json.dumps immediately, so sharing leaf references is
+    safe and ~10x cheaper (the codec was the watch/LIST bottleneck)."""
+    if dataclasses.is_dataclass(v) and not isinstance(v, type):
+        return {name: _encode_value(getattr(v, name))
+                for name in _field_names(type(v))}
+    if isinstance(v, (list, tuple)):
+        return [_encode_value(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _encode_value(x) for k, x in v.items()}
+    return v
+
+
 def encode(obj) -> Dict[str, Any]:
-    doc = dataclasses.asdict(obj)
+    doc = _encode_value(obj)
     doc["__kind__"] = _kind_of(type(obj))
     return doc
 
 
-def _decode_value(typ, value):
-    if value is None:
-        return None
+def _decoder_for(typ):
+    """Callable(value) -> decoded, or None (identity) — computed ONCE
+    per field type by _decode_plan; the old path re-resolved
+    typing.get_type_hints and get_origin per OBJECT, which dominated
+    watch-echo and LIST ingest."""
     origin = typing.get_origin(typ)
     if origin is typing.Union:  # Optional[T]
         args = [a for a in typing.get_args(typ) if a is not type(None)]
-        return _decode_value(args[0], value) if args else value
+        if not args:
+            return None
+        inner = _decoder_for(args[0])
+        if inner is None:
+            return None
+        return lambda v, _i=inner: None if v is None else _i(v)
     if origin in (list, tuple) or typ is list:
         args = typing.get_args(typ)
-        inner = args[0] if args else Any
-        return [_decode_value(inner, v) for v in value]
+        inner = _decoder_for(args[0]) if args else None
+        if inner is None:
+            return lambda v: v if isinstance(v, list) else list(v)
+        return (lambda v, _i=inner:
+                None if v is None else [_i(x) for x in v])
     if origin is dict or typ is dict:
-        return dict(value)
-    if dataclasses.is_dataclass(typ) and isinstance(value, dict):
-        return _decode_dataclass(typ, value)
-    return value
+        return lambda v: None if v is None else dict(v)
+    if dataclasses.is_dataclass(typ):
+        return (lambda v, _c=typ: _decode_dataclass(_c, v)
+                if isinstance(v, dict) else v)
+    return None
+
+
+@functools.lru_cache(maxsize=None)
+def _decode_plan(cls) -> tuple:
+    """((field_name, decoder-or-None), ...) resolved once per class."""
+    hints = typing.get_type_hints(cls)
+    return tuple((f.name, _decoder_for(hints.get(f.name, Any)))
+                 for f in dataclasses.fields(cls))
 
 
 def _decode_dataclass(cls, data: Dict[str, Any]):
-    hints = typing.get_type_hints(cls)
     kwargs = {}
-    for f in dataclasses.fields(cls):
-        if f.name in data:
-            kwargs[f.name] = _decode_value(hints.get(f.name, Any),
-                                           data[f.name])
+    for name, dec in _decode_plan(cls):
+        if name in data:
+            v = data[name]
+            kwargs[name] = v if dec is None or v is None else dec(v)
     return cls(**kwargs)
 
 

@@ -16,7 +16,7 @@ tensorizer can't express fall back to the pure-host walk transparently.
 from __future__ import annotations
 
 from collections import OrderedDict
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import numpy as np
 

@@ -166,10 +166,17 @@ class Scheduler:
                 if delay > 0:
                     self._stop.wait(delay)
 
-        self._thread = threading.Thread(target=loop, daemon=True)
-        self._thread.start()
+        # Start BEFORE publishing: run() may execute on an elector
+        # callback thread while stop() runs on the main thread (HA
+        # shutdown), and joining a created-but-unstarted thread raises.
+        # A stop() that misses the publish is still safe — _stop is set,
+        # so the (daemon) loop exits at its first check.
+        thread = threading.Thread(target=loop, daemon=True)
+        thread.start()
+        self._thread = thread
 
     def stop(self) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=5)
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=5)

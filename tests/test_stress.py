@@ -4,7 +4,8 @@ The analog of the reference's race-detector runs (KUBE_RACE=-race,
 hack/make-rules/test.sh:64): pods/nodes are created, bound, and deleted by
 concurrent writer threads while the scheduler loop snapshots and binds.
 Passes when no exception escapes either side and the final state is
-consistent."""
+consistent.  Two write surfaces: the in-process store, and the HTTP edge
+(reflector ingest + concurrent bind egress)."""
 
 import random
 import threading
@@ -17,64 +18,135 @@ from kube_batch_tpu.scheduler import Scheduler
 from tests.test_utils import build_node, build_resource_list
 
 
-def test_churn_under_scheduling_loop():
-    cluster = Cluster()
+def _seed(cluster):
     cluster.create_queue(v1alpha1.Queue(
         metadata=ObjectMeta(name="default"),
         spec=v1alpha1.QueueSpec(weight=1)))
     for i in range(8):
         cluster.create_node(build_node(
             f"n{i}", build_resource_list("16", "32Gi", pods=110)))
-    cache = new_scheduler_cache(cluster)
-    sched = Scheduler(cache, schedule_period=0.02)
-    sched.run()
 
-    errors = []
 
-    def churn(worker):
-        rng = random.Random(worker)
-        try:
-            for i in range(40):
-                name = f"w{worker}-{i}"
-                cluster.create_pod_group(v1alpha1.PodGroup(
-                    metadata=ObjectMeta(name=name, namespace="churn"),
-                    spec=v1alpha1.PodGroupSpec(min_member=1,
-                                               queue="default")))
-                cluster.create_pod(Pod(
-                    metadata=ObjectMeta(
-                        name=name, namespace="churn",
-                        annotations={v1alpha1.GroupNameAnnotationKey: name}),
-                    spec=PodSpec(containers=[Container(
-                        requests={"cpu": "100m", "memory": "64Mi"})]),
-                    status=PodStatus(phase="Pending")))
-                if rng.random() < 0.3:
-                    time.sleep(0.005)
-                if rng.random() < 0.25:
+def _churn(surface, iterations, errors, worker):
+    """One writer: create gang-of-1 pods against ``surface`` (in-process
+    Cluster or RemoteCluster — same verb set), occasionally delete them.
+    Only not-found errors are tolerated (the scheduler may have raced a
+    delete); anything else — a 500 under concurrent bind+delete, say —
+    is exactly what this test hunts and must fail it."""
+    rng = random.Random(worker)
+    try:
+        for i in range(iterations):
+            name = f"w{worker}-{i}"
+            surface.create_pod_group(v1alpha1.PodGroup(
+                metadata=ObjectMeta(name=name, namespace="churn"),
+                spec=v1alpha1.PodGroupSpec(min_member=1,
+                                           queue="default")))
+            surface.create_pod(Pod(
+                metadata=ObjectMeta(
+                    name=name, namespace="churn",
+                    annotations={v1alpha1.GroupNameAnnotationKey: name}),
+                spec=PodSpec(containers=[Container(
+                    requests={"cpu": "100m", "memory": "64Mi"})]),
+                status=PodStatus(phase="Pending")))
+            if rng.random() < 0.3:
+                time.sleep(0.005)
+            if rng.random() < 0.25:
+                for deleter in (surface.delete_pod,
+                                surface.delete_pod_group):
                     try:
-                        cluster.delete_pod("churn", name)
-                        cluster.delete_pod_group("churn", name)
-                    except KeyError:
-                        pass
-        except Exception as exc:  # pragma: no cover - failure path
-            errors.append(exc)
+                        deleter("churn", name)
+                    except KeyError as exc:
+                        # RemoteCluster maps every HTTP error to KeyError
+                        # (client.py _request); swallow only not-found.
+                        msg = str(exc)
+                        if "404" not in msg and "not found" not in msg:
+                            raise
+    except Exception as exc:  # pragma: no cover - failure path
+        errors.append(exc)
 
-    threads = [threading.Thread(target=churn, args=(w,)) for w in range(4)]
+
+def _run_writers(surface, iterations, n_workers=4):
+    errors = []
+    threads = [threading.Thread(target=_churn,
+                                args=(surface, iterations, errors, w))
+               for w in range(n_workers)]
     for t in threads:
         t.start()
     for t in threads:
         t.join()
+    return errors
 
-    # Let the loop settle and bind the survivors.
-    deadline = time.time() + 20
+
+def _wait_all_bound(cluster, deadline_s):
+    deadline = time.time() + deadline_s
     while time.time() < deadline:
-        unbound = [p for p in cluster.pods.values() if not p.spec.node_name]
-        if not unbound:
-            break
+        with cluster.lock:
+            if all(p.spec.node_name for p in cluster.pods.values()):
+                return
         time.sleep(0.05)
-    sched.stop()
+
+
+def test_churn_under_scheduling_loop():
+    cluster = Cluster()
+    _seed(cluster)
+    cache = new_scheduler_cache(cluster)
+    sched = Scheduler(cache, schedule_period=0.02)
+    sched.run()
+    try:
+        errors = _run_writers(cluster, iterations=40)
+        _wait_all_bound(cluster, 20)
+    finally:
+        sched.stop()
 
     assert not errors, errors
     assert all(p.spec.node_name for p in cluster.pods.values())
     # Cache accounting stayed consistent: all nodes remain Ready.
     snap = cache.snapshot()
     assert len(snap.nodes) == 8
+
+
+def test_churn_over_the_wire():
+    """The same race, through the network edge: writers hammer the HTTP
+    API while the scheduler's only view is the RemoteCluster reflector
+    and every bind rides the concurrent egress pool.  Exercises the
+    reflector's watch thread, the mirror stores, and bind_pods_many
+    against concurrent deletes."""
+    from kube_batch_tpu.edge import ApiServer, RemoteCluster
+
+    cluster = Cluster()
+    server = ApiServer(cluster).start()
+    sched = remote = None
+    try:
+        _seed(cluster)
+        remote = RemoteCluster(server.url).start()
+        cache = new_scheduler_cache(remote)
+        sched = Scheduler(cache, schedule_period=0.02)
+        sched.run()
+
+        errors = _run_writers(remote, iterations=25)
+        _wait_all_bound(cluster, 30)
+
+        # The reflector's mirror converged to the server's end state:
+        # same pod keys, binds included (watch lag bounded by a poll).
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with cluster.lock:
+                server_state = {k: p.spec.node_name
+                                for k, p in cluster.pods.items()}
+            with remote.lock:
+                mirror_state = {k: p.spec.node_name
+                                for k, p in remote.pods.items()}
+            if server_state == mirror_state:
+                break
+            time.sleep(0.05)
+        assert server_state == mirror_state
+    finally:
+        if sched is not None:
+            sched.stop()
+        if remote is not None:
+            remote.stop()
+        server.stop()
+
+    assert not errors, errors
+    with cluster.lock:
+        assert all(p.spec.node_name for p in cluster.pods.values())

@@ -30,7 +30,9 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _ROOT)
+sys.path.insert(0, os.path.join(_ROOT, "tests"))  # object builders
 
 import jax
 
@@ -51,8 +53,6 @@ def seed_cluster(n_tasks, n_nodes, n_jobs):
     from kube_batch_tpu.apis.scheduling import v1alpha1
     from kube_batch_tpu.cache import Cluster
 
-    sys.path.insert(0, os.path.join(os.path.dirname(
-        os.path.dirname(os.path.abspath(__file__))), "tests"))
     from test_utils import build_node, build_pod, build_resource_list
 
     cluster = Cluster()
@@ -78,7 +78,7 @@ def seed_cluster(n_tasks, n_nodes, n_jobs):
     return cluster
 
 
-def run_cycle(server_url, cluster, n_tasks):
+def run_cycle(server_url, cluster, n_tasks, steady_cycles: int = 0):
     from kube_batch_tpu.cache import new_scheduler_cache
     from kube_batch_tpu.edge import RemoteCluster
     from kube_batch_tpu.scheduler import Scheduler
@@ -105,13 +105,69 @@ def run_cycle(server_url, cluster, n_tasks):
             break
         time.sleep(0.05)
     t4 = time.perf_counter()
+
+    # Steady state over the wire: the long-lived reflector + cache keep
+    # serving while a 1% churn wave arrives each cycle — retiring the
+    # same number of bound pods first so the wave is SCHEDULABLE and
+    # each timed cycle does real allocate + bind-egress work (the
+    # in-process analog, bench.measure_steady_session, retires the
+    # round-before-last the same way).
+    steady_ms = []
+    if steady_cycles:
+        from kube_batch_tpu.api import ObjectMeta
+        from kube_batch_tpu.apis.scheduling import v1alpha1
+        from test_utils import build_pod, build_resource_list
+        churn = max(1, n_tasks // 100)
+        retired = 0
+        for cycle in range(steady_cycles):
+            for _ in range(churn):  # free capacity: retire seed pods
+                remote.delete_pod("bench", f"pod-{retired}")
+                retired += 1
+            for i in range(churn):
+                name = f"churn-{cycle}-{i}"
+                remote.create_pod_group(v1alpha1.PodGroup(
+                    metadata=ObjectMeta(name=name, namespace="bench"),
+                    spec=v1alpha1.PodGroupSpec(min_member=1,
+                                               queue="default")))
+                remote.create_pod(build_pod(
+                    "bench", name, "", "Pending",
+                    build_resource_list("1", "1Gi"), groupname=name))
+            # Wave visible in the mirror before the cycle starts; a
+            # stalled watch must fail the bench, not pollute the number.
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                with remote.lock:
+                    have = f"bench/churn-{cycle}-{churn - 1}" in remote.pods
+                if have:
+                    break
+                time.sleep(0.01)
+            else:
+                raise TimeoutError(
+                    f"steady cycle {cycle}: churn wave not visible in "
+                    f"the mirror after 30s")
+            t = time.perf_counter()
+            sched.run_once()
+            steady_ms.append((time.perf_counter() - t) * 1e3)
+        # The steady cycles must have done real work: every churn pod
+        # bound server-side (zero-allocation cycles measure nothing).
+        with cluster.lock:
+            unbound_churn = [k for k, p in cluster.pods.items()
+                             if k.startswith("bench/churn-")
+                             and not p.spec.node_name]
+        assert not unbound_churn, (
+            f"{len(unbound_churn)} churn pods never bound — the steady "
+            f"cycles did no allocation work")
+
     remote.stop()
     with cluster.lock:
         server_bound = sum(1 for p in cluster.pods.values()
                            if p.spec.node_name)
-    return {"ingest_ms": (t1 - t0) * 1e3, "cache_ms": (t2 - t1) * 1e3,
-            "cycle_ms": (t3 - t2) * 1e3, "visible_ms": (t4 - t3) * 1e3,
-            "bound_reflector": bound, "bound_server": server_bound}
+    out = {"ingest_ms": (t1 - t0) * 1e3, "cache_ms": (t2 - t1) * 1e3,
+           "cycle_ms": (t3 - t2) * 1e3, "visible_ms": (t4 - t3) * 1e3,
+           "bound_reflector": bound, "bound_server": server_bound}
+    if steady_ms:
+        out["steady_cycles_ms"] = steady_ms  # raw; caller aggregates
+    return out
 
 
 def main(argv=None):
@@ -125,6 +181,9 @@ def main(argv=None):
     parser.add_argument("--cycles", type=int, default=3)
     parser.add_argument("--warmup", type=int, default=1,
                         help="unrecorded jit/codec warm-up cycles")
+    parser.add_argument("--steady", type=int, default=0,
+                        help="per-run steady cycles (1%% churn each) on "
+                             "the long-lived reflector + cache")
     parser.add_argument("--out", default="")
     ns = parser.parse_args(argv)
 
@@ -136,7 +195,8 @@ def main(argv=None):
         cluster = seed_cluster(ns.tasks, ns.nodes, ns.jobs)
         server = ApiServer(cluster).start()
         try:
-            r = run_cycle(server.url, cluster, ns.tasks)
+            r = run_cycle(server.url, cluster, ns.tasks,
+                          steady_cycles=ns.steady)
         finally:
             server.stop()
         assert r["bound_server"] >= ns.tasks, (
@@ -148,6 +208,9 @@ def main(argv=None):
                   "bound_reflector": r["bound_reflector"]}
         for k in ("ingest_ms", "cache_ms", "cycle_ms", "visible_ms"):
             phases.setdefault(k, []).append(r[k])
+        if "steady_cycles_ms" in r:  # raw per-cycle values, not medians
+            phases.setdefault("steady_cycle_ms", []).extend(
+                r["steady_cycles_ms"])
 
     out = {"scenario": f"{ns.tasks} pods x {ns.nodes} nodes over HTTP "
                        f"(create -> ingest -> schedule -> bind egress "

@@ -1,0 +1,27 @@
+# One-command verify recipes (see README.md "Verifying").
+PYTHON ?= python
+COMPILE_CACHE ?= $(CURDIR)/.compile-cache
+
+.PHONY: test bench bench-cached clean-cache
+
+# Tier-1 verify: the exact pytest line ROADMAP.md pins (CPU-pinned, slow
+# markers excluded, collection errors reported but not fatal).
+test:
+	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
+		--continue-on-collection-errors -p no:cacheprovider \
+		-p no:xdist -p no:randomly
+
+# Full benchmark artifact: always emits exactly one JSON line (see
+# bench.py docstring for the BENCH_* environment knobs).
+bench:
+	$(PYTHON) bench.py
+
+# Benchmark with the persistent compilation cache enabled.  Run it twice:
+# the second run's compile_ms drops to the trace+lower residual — the
+# XLA-compile share (which dominates at scale) is served from
+# $(COMPILE_CACHE) instead of recompiled.
+bench-cached:
+	env BENCH_COMPILE_CACHE_DIR=$(COMPILE_CACHE) $(PYTHON) bench.py
+
+clean-cache:
+	rm -rf $(COMPILE_CACHE)

@@ -37,6 +37,11 @@ class ServerOption:
     file_lock_same_host_ok: bool = False
     # Simulator extras (no reference counterpart): cluster spec to load.
     cluster_state: str = ""
+    # Compile-ahead subsystem (ops/compile_cache.py): solver buckets to
+    # pre-compile at boot, and the persistent XLA cache location so those
+    # compiles survive restarts and leader failover.
+    warmup_buckets: str = ""
+    compile_cache_dir: str = ""
 
     def check_option_or_die(self) -> None:
         """options.go:81-88: leader election requires a lock namespace."""
@@ -81,6 +86,16 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                              "shared filesystem)")
     parser.add_argument("--cluster-state", default="",
                         help="Path to a JSON cluster snapshot for the simulator")
+    parser.add_argument("--warmup-buckets", default="",
+                        help="Comma-separated TASKSxNODES[xJOBS[xQUEUES]] "
+                             "shape buckets to pre-compile the solver "
+                             "family for at boot (e.g. 50000x10000x2000x4),"
+                             " so no live session pays a first-call XLA "
+                             "compile")
+    parser.add_argument("--compile-cache-dir", default="",
+                        help="Directory for JAX's persistent compilation "
+                             "cache; solver compiles survive process "
+                             "restarts and leader failover")
 
 
 def parse_options(argv=None) -> ServerOption:
@@ -96,4 +111,6 @@ def parse_options(argv=None) -> ServerOption:
         print_version=ns.version, listen_address=ns.listen_address,
         priority_class=ns.priority_class,
         file_lock_same_host_ok=ns.file_lock,
-        cluster_state=ns.cluster_state)
+        cluster_state=ns.cluster_state,
+        warmup_buckets=ns.warmup_buckets,
+        compile_cache_dir=ns.compile_cache_dir)

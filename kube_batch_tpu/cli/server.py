@@ -100,6 +100,19 @@ class ServerRuntime:
                  lease_config: Optional[LeaderElectionConfig] = None):
         self.opt = opt
         self._lease_config = lease_config
+        # Compile-ahead subsystem (ops/compile_cache.py): point JAX's
+        # persistent cache at the configured directory BEFORE anything can
+        # compile (so even un-warmed shapes persist across restarts), and
+        # parse the warmup buckets now — a malformed flag must fail boot,
+        # not the first session.  The warmup thread itself starts in run().
+        self.warmup = None
+        self._warmup_buckets = []
+        if opt.compile_cache_dir:
+            from ..ops.compile_cache import enable_persistent_cache
+            enable_persistent_cache(opt.compile_cache_dir)
+        if opt.warmup_buckets:
+            from ..ops.compile_cache import parse_warmup_buckets
+            self._warmup_buckets = parse_warmup_buckets(opt.warmup_buckets)
         # Whether the backing store is SHARED with other standbys — the
         # precondition for a store-hosted election lock.  An injected
         # cluster is shared by construction (the embedder hands the same
@@ -139,6 +152,23 @@ class ServerRuntime:
         """server.go Run(): metrics endpoint, then leader-elect or start."""
         if self.opt.listen_address:
             self.metrics_server = start_metrics_server(self.opt.listen_address)
+        if self._warmup_buckets:
+            # Pre-compile the solver family for the configured buckets in
+            # the background: the scheduler loop starts immediately, and
+            # the first live session of a warmed bucket never waits on
+            # XLA.  A standby wins doubly — by the time it acquires the
+            # lease its compiles are done (or already on disk).  The cfg
+            # is derived from the LOADED conf (SolverConfig is a static
+            # jit arg — warming the default cfg under a non-default conf
+            # would compile executables no session ever hits); a conf
+            # that needs the host fallback skips warmup entirely.
+            from ..models.tensor_snapshot import solver_config_from_tiers
+            cfg = solver_config_from_tiers(self.scheduler.tiers)
+            if cfg is not None:
+                from ..ops.compile_cache import SolverWarmup
+                self.warmup = SolverWarmup(
+                    self._warmup_buckets, cfg=cfg,
+                    cache_dir=self.opt.compile_cache_dir or None).start()
         if self.opt.enable_leader_election:
             self.opt.check_option_or_die()
             # The HA lock lives IN THE STORE whenever the cluster edge
@@ -176,10 +206,17 @@ class ServerRuntime:
                         "only failover.")
                 default_path = (f"{self.opt.lock_object_namespace}/"
                                 f"kube-batch-lock.json")
-                config = self._lease_config or LeaderElectionConfig(
-                    lock_path=default_path)
-                if not config.lock_path:  # timing-only injected config
-                    config.lock_path = default_path
+                if self._lease_config is None:
+                    config = LeaderElectionConfig(lock_path=default_path)
+                elif not self._lease_config.lock_path:
+                    # Timing-only injected config: fill the default on a
+                    # COPY — the caller's dataclass may be shared across
+                    # runtimes and must not be mutated from here.
+                    import dataclasses
+                    config = dataclasses.replace(self._lease_config,
+                                                 lock_path=default_path)
+                else:
+                    config = self._lease_config
                 lock = None
             self.elector = LeaderElector(
                 config,
@@ -199,6 +236,10 @@ class ServerRuntime:
             self.scheduler.run()
 
     def stop(self) -> None:
+        if self.warmup is not None:
+            # Signal between buckets; an XLA compile in flight cannot be
+            # interrupted, so don't block shutdown on it (daemon thread).
+            self.warmup.stop(timeout=0.5)
         if self.elector is not None:
             self.elector.stop()
         self.scheduler.stop()

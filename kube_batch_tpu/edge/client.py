@@ -358,13 +358,19 @@ class RemoteCluster:
                 except (http.client.HTTPException, OSError):
                     conn.close()  # next request auto-reconnects
                     if attempt or sent:
-                        # Send-phase failures are safe to retry (the
-                        # server never saw the POST); after delivery,
-                        # binds are non-idempotent — check the pod
-                        # instead of re-POSTing.
+                        # After delivery, binds are non-idempotent —
+                        # check the pod instead of re-POSTing.
                         if sent and self._pod_bound_to(pod, hostname):
                             return
                         raise
+                    # Send-phase failure: the bytes PROBABLY never
+                    # reached the server, but TCP cannot prove it (an
+                    # RST can race a request that was delivered and
+                    # applied).  Read the pod back before the resend:
+                    # if the first POST landed, skip the retry rather
+                    # than lean on duplicate binds being idempotent.
+                    if self._pod_bound_to(pod, hostname):
+                        return
                     continue
                 if resp.status >= 400:
                     if attempt and self._pod_bound_to(pod, hostname):

@@ -79,7 +79,11 @@ def _decoder_for(typ):
         args = typing.get_args(typ)
         inner = _decoder_for(args[0]) if args else None
         if inner is None:
-            return lambda v: v if isinstance(v, list) else list(v)
+            # Copy unconditionally and pass None through: returning the
+            # wire doc's own list would alias the decoded object to it,
+            # and list(None) would raise where a null element inside a
+            # nested List[List[T]] used to decode to None.
+            return lambda v: None if v is None else list(v)
         return (lambda v, _i=inner:
                 None if v is None else [_i(x) for x in v])
     if origin is dict or typ is dict:

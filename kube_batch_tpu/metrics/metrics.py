@@ -103,6 +103,10 @@ class Counter:
         with self._lock:
             self._values[labels] += amount
 
+    def value(self, *labels: str) -> float:
+        with self._lock:
+            return self._values.get(labels, 0.0)
+
     def expose(self) -> str:
         lines = [f"# HELP {self.name} {self.help}",
                  f"# TYPE {self.name} counter"]
@@ -189,6 +193,22 @@ tpu_apply_latency = registry.register(Histogram(
     f"{SUBSYSTEM}_tpu_apply_latency_milliseconds",
     "Host-side batched placement apply latency in milliseconds",
     _MS_BUCKETS))
+# Compile-ahead subsystem (ops/compile_cache.py): a session solve whose
+# (solver, bucket, cfg) signature was pre-compiled (warmup or an earlier
+# solve) is a hit; a miss paid a fresh in-process XLA compile.
+compile_cache_hits = registry.register(Counter(
+    f"{SUBSYSTEM}_compile_cache_hits_total",
+    "Session solves served by an already-compiled solver executable"))
+compile_cache_misses = registry.register(Counter(
+    f"{SUBSYSTEM}_compile_cache_misses_total",
+    "Session solves that triggered a fresh in-process XLA compile"))
+compile_cache_inflight = registry.register(Gauge(
+    f"{SUBSYSTEM}_compile_cache_inflight",
+    "Warmup bucket compiles currently pending or in flight"))
+bucket_pad_waste = registry.register(Gauge(
+    f"{SUBSYSTEM}_bucket_pad_waste_ratio",
+    "Fraction of the padded bucket unused by real rows, per axis",
+    ("axis",)))
 
 
 # Helper API (metrics.go:123-191).
@@ -250,3 +270,21 @@ def observe_tpu_transfer_latency(seconds: float) -> None:
 
 def observe_tpu_apply_latency(seconds: float) -> None:
     tpu_apply_latency.observe(seconds * 1e3)
+
+
+def note_compile_cache(hit: bool) -> None:
+    (compile_cache_hits if hit else compile_cache_misses).inc()
+
+
+def compile_cache_counts() -> tuple:
+    """(hits, misses) so far — bench.py's artifact split."""
+    return (int(compile_cache_hits.value()),
+            int(compile_cache_misses.value()))
+
+
+def set_compile_inflight(count: int) -> None:
+    compile_cache_inflight.set(float(count))
+
+
+def set_bucket_pad_waste(axis: str, ratio: float) -> None:
+    bucket_pad_waste.set(round(float(ratio), 4), axis)

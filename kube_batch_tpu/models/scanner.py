@@ -209,7 +209,15 @@ class DeviceNodeScanner:
 
     def scores(self, task: TaskInfo) -> Optional[np.ndarray]:
         """[N_real] int scores (SCORE_NEG_INF = predicate-rejected), or None
-        when the task is outside the snapshot's candidate set."""
+        when the task is outside the snapshot's candidate set.
+
+        CONTRACT — no-retain, no-mutate: the returned vector is a live
+        view into this scanner's LRU-cached score array, which later
+        ``scores()`` calls patch IN PLACE (the incremental-rescore path).
+        Callers must consume it before their next ``scores()`` call and
+        must never write to it (e.g. an in-place admissibility mask) —
+        either silently corrupts or observes-mutated cached scores.
+        Retaining callers must copy (``scores(t).copy()``)."""
         import os
 
         ti = self.task_index.get(task.uid)

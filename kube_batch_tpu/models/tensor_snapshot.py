@@ -18,32 +18,12 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..api import TaskStatus, allocated_status
+# The bucket ladder lives with the compile-ahead subsystem (it is the
+# compile-cache key space); re-exported here for the existing callers.
+from ..ops.compile_cache import bucket  # noqa: F401
 from ..plugins.nodeorder import NodeOrderPlugin
 
 _F = np.float64  # host-side staging dtype; cast at device put
-
-
-def bucket(n: int, minimum: int = 8) -> int:
-    """Next padded-shape bucket (compilation-cache friendly).
-
-    Powers of two up to 1024; quarter steps within each octave above
-    (1.0/1.25/1.5/1.75 x 2^k).  Worst-case padding drops from 2x to
-    1.25x — at kubemark scale that is 37% less node-major device state
-    (10000 -> 10240 instead of 16384) — while the compile-shape count
-    stays bounded (four shapes per octave).  Every bucket above 1024 is
-    a multiple of 256, keeping TPU lane alignment and mesh-shard
-    divisibility (N % n_devices == 0) intact."""
-    b = minimum
-    while b < n:
-        b *= 2
-    if b <= 1024:
-        return b
-    half = b // 2
-    for frac in (1.25, 1.5, 1.75):
-        cand = int(half * frac)
-        if n <= cand:
-            return cand
-    return b
 
 
 @dataclass
@@ -648,18 +628,15 @@ _JOB_ORDER_PLUGINS = ("priority", "gang", "drf")
 _QUEUE_ORDER_PLUGINS = ("proportion",)
 
 
-def tensorize_session(ssn) -> TensorSnapshot:
-    """Flatten the session into SolverInputs (cpu-staged numpy; device put
-    happens in the action)."""
-    import jax.numpy as jnp
-    from ..ops.resources import (EPS_QUANTA, quantize_columns,
-                                 score_shift_for)
-    from ..ops.scoring import ScoreWeights
-    from ..ops.solver import SolverConfig, SolverInputs
-
-    snap = TensorSnapshot(inputs=None, config=None)
-
-    # ---- plugin structure -> static config --------------------------------
+def plugin_structure(tiers):
+    """(struct, fallback_reason): the conf-derived, cluster-independent
+    facts that shape the static SolverConfig — tier-ordered job/queue
+    key orders, gang/proportion/predicates flags, and the summed integer
+    scoring weights.  A non-empty fallback_reason means sessions under
+    this conf take the host path (unsupported plugin, fractional or
+    overflowing weights).  Single source of truth for tensorize_session
+    AND the compile-ahead warmup (solver_config_from_tiers): a warmed
+    executable is only useful if its cfg key matches the live one."""
     enabled_job_order: List[str] = []
     enabled_queue_order: List[str] = []
     has_gang = False
@@ -671,11 +648,10 @@ def tensorize_session(ssn) -> TensorSnapshot:
     # means their weights add.  No scoring plugin -> all-zero scores and the
     # first feasible node wins on both paths.
     w_least = w_most = w_balanced = w_podaff = w_nodeaff = 0.0
-    for tier in ssn.tiers:
+    for tier in tiers:
         for option in tier.plugins:
             if option.name not in _SUPPORTED_PLUGINS:
-                snap.fallback_reason = f"unsupported plugin {option.name}"
-                return snap
+                return None, f"unsupported plugin {option.name}"
             if option.name in _JOB_ORDER_PLUGINS and option.enabled_job_order:
                 enabled_job_order.append(option.name)
             if (option.name in _QUEUE_ORDER_PLUGINS
@@ -699,16 +675,65 @@ def tensorize_session(ssn) -> TensorSnapshot:
                                  w_nodeaff)):
         # Grid scoring combines integer weights exactly; fractional weights
         # would need float score sums with platform-dependent rounding.
-        snap.fallback_reason = "fractional nodeorder weights"
-        return snap
+        return None, "fractional nodeorder weights"
+    from ..ops.scoring import ScoreWeights, max_weight_sum
+    from ..ops.resources import SCORE_GRID_K
     weights = ScoreWeights(least_requested=int(w_least),
                            most_requested=int(w_most),
                            balanced_resource=int(w_balanced))
-    from ..ops.scoring import max_weight_sum
-    from ..ops.resources import SCORE_GRID_K
     if max_weight_sum(weights) * 10 * SCORE_GRID_K > np.iinfo(np.int32).max:
-        snap.fallback_reason = "nodeorder weights overflow int32 scores"
+        return None, "nodeorder weights overflow int32 scores"
+    struct = {"job_order": enabled_job_order,
+              "queue_order": enabled_queue_order,
+              "has_gang": has_gang, "has_proportion": has_proportion,
+              "has_predicates": has_predicates, "weights": weights,
+              "w_podaff": w_podaff, "w_nodeaff": w_nodeaff}
+    return struct, ""
+
+
+def solver_config_from_tiers(tiers):
+    """The static SolverConfig a FEATURELESS session (no host ports, no
+    pod affinity — the overwhelming common case and exactly what
+    compile_cache.make_bucket_inputs stages) compiles with under this
+    conf; the compile-ahead warmup target.  None when the conf needs the
+    host fallback — warming would compile executables no session uses."""
+    from ..ops.solver import SolverConfig
+
+    struct, reason = plugin_structure(tiers)
+    if reason:
+        return None
+    return SolverConfig(
+        job_key_order=tuple(struct["job_order"]),
+        queue_key_order=tuple(struct["queue_order"]),
+        has_gang=struct["has_gang"],
+        has_proportion=struct["has_proportion"],
+        weights=struct["weights"])
+
+
+def tensorize_session(ssn) -> TensorSnapshot:
+    """Flatten the session into SolverInputs (cpu-staged numpy; device put
+    happens in the action)."""
+    import jax.numpy as jnp
+    from ..ops.resources import (EPS_QUANTA, quantize_columns,
+                                 score_shift_for)
+    from ..ops.scoring import ScoreWeights
+    from ..ops.solver import SolverConfig, SolverInputs
+
+    snap = TensorSnapshot(inputs=None, config=None)
+
+    # ---- plugin structure -> static config (shared with the warmup) ------
+    struct, reason = plugin_structure(ssn.tiers)
+    if reason:
+        snap.fallback_reason = reason
         return snap
+    enabled_job_order = struct["job_order"]
+    enabled_queue_order = struct["queue_order"]
+    has_gang = struct["has_gang"]
+    has_proportion = struct["has_proportion"]
+    has_predicates = struct["has_predicates"]
+    weights = struct["weights"]
+    w_podaff = struct["w_podaff"]
+    w_nodeaff = struct["w_nodeaff"]
 
     axis = _resource_axis(ssn)
     snap.resource_names = axis
@@ -1246,6 +1271,16 @@ def tensorize_session(ssn) -> TensorSnapshot:
     # requests and within one quantum otherwise.
     from ..ops.resources import scale_columns
     queue_deserved_f = scale_columns(queue_deserved.copy())
+
+    # Bucket-pad waste per axis: how much of the padded device state the
+    # ladder wastes this session (the compile-ahead subsystem's cost side;
+    # four lock+set gauge writes, negligible against the session).
+    from ..metrics.metrics import set_bucket_pad_waste
+    for axis, real, pad in (("tasks", p_total, p_pad),
+                            ("nodes", n_real, n_pad),
+                            ("jobs", j_real, j_pad),
+                            ("queues", q_real, q_pad)):
+        set_bucket_pad_waste(axis, 1.0 - (real / pad if pad else 0.0))
 
     snap.inputs = SolverInputs(
         task_req=task_req_q, task_res=task_res_q,

@@ -1,0 +1,500 @@
+"""Compile-ahead subsystem: bucketed AOT warmup + persistent XLA cache.
+
+The cold-session killer (BENCH_r05: 12.6 s of a 13.6 s cold session is
+the solver family's first-call XLA compile) is structural: every solver
+entry point is a bare ``jax.jit``, so any new (shape-bucket, cfg)
+signature pays a multi-second compile *inside a live scheduling
+session*.  This module keeps that compile out of the session loop the
+same way the reference keeps one-time setup out of its per-session path
+(scheduler.go:88) and production JAX serving stacks solve cold start —
+ahead-of-time lowering plus persisted executables:
+
+1. **Bucket ladder** (``bucket`` / ``bucket_shapes``): the geometric
+   padded-shape ladder every tensorized axis rounds up to (tasks, nodes,
+   jobs, queues — models/tensor_snapshot.py pads with it at tensorize
+   time), so session-to-session shape drift lands on ONE executable
+   instead of recompiling.  Lives here because the ladder *is* the
+   compile-cache key space; tensor_snapshot re-exports it.
+2. **Startup warmup** (``SolverWarmup`` / ``warm_bucket``): at server
+   boot (cli/server.py ``--warmup-buckets``), pre-build zero-valued
+   inputs at the configured buckets, ship them through the real packed
+   transfer (warming shipping's per-layout unpack program too), and
+   execute every applicable member of the solver family —
+   two-level XLA, stepwise oracle, Pallas on TPU, node-sharded on a
+   mesh — in a background thread.  Executing the jitted entry point
+   (rather than only ``.lower().compile()``) both populates the
+   in-process jit cache the live path actually hits and writes the
+   persistent cache; the run itself is ~free because warmup inputs have
+   no active queues, so the solve loop exits after the first predicate.
+3. **Persistence** (``enable_persistent_cache``): JAX's persistent
+   compilation cache (``--compile-cache-dir``), thresholds dropped to
+   zero so every solver executable is written; compiles then survive
+   process restarts and leader failover.  A version/cfg-keyed manifest
+   records what was warmed so the next boot (and bench.py) can
+   attribute cold-vs-warm.
+4. **Observability**: every routed solve is keyed (``solve_key``) and
+   counted as a compile-cache hit or miss (metrics.py
+   ``compile_cache_{hits,misses}_total``), warmup exposes an inflight
+   gauge, and tensorize reports per-axis bucket pad waste.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Iterable, List, NamedTuple, Optional, Sequence
+
+# NOTE: no jax / numpy / models imports at module level — this module is
+# imported from the solver chokepoint and from tensor_snapshot, and must
+# stay cycle-free and cheap to import.
+
+
+# ---------------------------------------------------------------------------
+# 1. Bucket ladder
+# ---------------------------------------------------------------------------
+
+def bucket(n: int, minimum: int = 8) -> int:
+    """Next padded-shape bucket (compilation-cache friendly).
+
+    Powers of two up to 1024; quarter steps within each octave above
+    (1.0/1.25/1.5/1.75 x 2^k).  Worst-case padding drops from 2x to
+    1.25x — at kubemark scale that is 37% less node-major device state
+    (10000 -> 10240 instead of 16384) — while the compile-shape count
+    stays bounded (four shapes per octave).  Every bucket above 1024 is
+    a multiple of 256, keeping TPU lane alignment and mesh-shard
+    divisibility (N % n_devices == 0) intact."""
+    b = minimum
+    while b < n:
+        b *= 2
+    if b <= 1024:
+        return b
+    half = b // 2
+    for frac in (1.25, 1.5, 1.75):
+        cand = int(half * frac)
+        if n <= cand:
+            return cand
+    return b
+
+
+class BucketSpec(NamedTuple):
+    """Requested (unbucketed) axis sizes of one warmup target."""
+    tasks: int
+    nodes: int
+    jobs: int
+    queues: int
+
+    def padded(self) -> "BucketSpec":
+        return BucketSpec(bucket(max(self.tasks, 1)),
+                          bucket(max(self.nodes, 1)),
+                          bucket(max(self.jobs, 1)),
+                          bucket(max(self.queues, 1)))
+
+
+def bucket_shapes(tasks: int, nodes: int, jobs: int,
+                  queues: int) -> BucketSpec:
+    """The padded bucket every tensorized session of these sizes lands on."""
+    return BucketSpec(tasks, nodes, jobs, queues).padded()
+
+
+def parse_warmup_buckets(spec: str) -> List[BucketSpec]:
+    """Parse the ``--warmup-buckets`` flag: comma/semicolon-separated
+    ``TASKSxNODES[xJOBS[xQUEUES]]`` entries (e.g. ``50000x10000x2000x4``).
+    Omitted jobs default to tasks/25 (the bench-scale task:job ratio);
+    omitted queues default to 4.  Malformed entries raise ValueError at
+    config time — a bad flag must fail boot, not the first session."""
+    out: List[BucketSpec] = []
+    for entry in spec.replace(";", ",").split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.lower().split("x")
+        if len(parts) < 2 or len(parts) > 4:
+            raise ValueError(
+                f"warmup bucket {entry!r}: want TASKSxNODES[xJOBS[xQUEUES]]")
+        try:
+            nums = [int(p) for p in parts]
+        except ValueError as exc:
+            raise ValueError(f"warmup bucket {entry!r}: {exc}") from None
+        if any(v <= 0 for v in nums):
+            raise ValueError(f"warmup bucket {entry!r}: sizes must be > 0")
+        tasks, nodes = nums[0], nums[1]
+        jobs = nums[2] if len(nums) > 2 else max(1, tasks // 25)
+        queues = nums[3] if len(nums) > 3 else 4
+        out.append(BucketSpec(tasks, nodes, jobs, queues))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 3. Persistent compilation cache + manifest
+# ---------------------------------------------------------------------------
+
+_MANIFEST_NAME = "kube_batch_tpu_warmup_manifest.json"
+_cache_dir: Optional[str] = None
+_cache_lock = threading.Lock()
+
+
+def persistent_cache_dir() -> Optional[str]:
+    return _cache_dir
+
+
+def enable_persistent_cache(cache_dir: str) -> Optional[str]:
+    """Point JAX's persistent compilation cache at ``cache_dir`` with the
+    write thresholds dropped to zero (every solver executable persists,
+    CPU included), so compiles survive process restarts and leader
+    failover.  Returns the directory, or None when this JAX build has no
+    persistent cache (the subsystem then degrades to in-process warmup
+    only).  Must run before the first compile to cover it."""
+    global _cache_dir
+    import jax
+
+    cache_dir = os.path.abspath(cache_dir)
+    os.makedirs(cache_dir, exist_ok=True)
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    except Exception:
+        return None
+    try:
+        # JAX memoizes its cache-enabled decision at the first compile;
+        # if anything compiled before this call (an eager op is enough),
+        # the new dir would be silently ignored without a reset.
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+    except Exception:
+        pass
+    with _cache_lock:
+        _cache_dir = cache_dir
+    return cache_dir
+
+
+def _version_key() -> dict:
+    """Executable identity: a manifest entry is only trustworthy for the
+    exact (jax, repo, backend) that produced it — XLA's own cache keys
+    change across any of these, so a mismatched manifest is reset."""
+    import jax
+
+    from ..version import __version__
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = "unknown"
+    return {"jax": jax.__version__, "kube_batch_tpu": __version__,
+            "backend": backend}
+
+
+def _manifest_path(cache_dir: str) -> str:
+    return os.path.join(cache_dir, _MANIFEST_NAME)
+
+
+def read_manifest(cache_dir: str) -> dict:
+    """The warmup manifest for this version key, or an empty one (missing
+    file, unreadable file, or a version mismatch all reset it)."""
+    empty = {"version": _version_key(), "warmed": {}}
+    try:
+        with open(_manifest_path(cache_dir)) as f:
+            doc = json.load(f)
+    except (OSError, ValueError):
+        return empty
+    if not isinstance(doc, dict) or doc.get("version") != empty["version"]:
+        return empty
+    if not isinstance(doc.get("warmed"), dict):
+        return empty
+    return doc
+
+
+def record_warmed(cache_dir: str, entries: dict) -> None:
+    """Merge ``entries`` ({key_str: {...}}) into the manifest atomically
+    (temp file + rename: concurrent standbys warming the same dir may
+    lose each other's merge but can never corrupt the document)."""
+    doc = read_manifest(cache_dir)
+    doc["warmed"].update(entries)
+    tmp = _manifest_path(cache_dir) + f".tmp.{os.getpid()}"
+    try:
+        with open(tmp, "w") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+        os.replace(tmp, _manifest_path(cache_dir))
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# 4. Hit/miss registry (the solver chokepoint reports here)
+# ---------------------------------------------------------------------------
+
+_seen_lock = threading.Lock()
+_seen: set = set()
+
+
+def solve_key(choice: str, inp, cfg) -> tuple:
+    """In-process identity of one compiled solver executable: routing
+    choice + every jit-cache-relevant degree of freedom — the padded
+    axis shapes (P/N/J/Q/R and the port/selector/signature pads), the
+    float key dtype, and the static cfg.  Two solves with equal keys hit
+    one executable; a new key is a fresh XLA compile."""
+    return (choice,
+            tuple(inp.task_req.shape),      # (P, R)
+            tuple(inp.node_idle.shape),     # (N, R)
+            inp.job_start.shape[0],         # J
+            inp.queue_deserved.shape[0],    # Q
+            inp.task_ports.shape[1],        # NP pad
+            inp.task_aff_req.shape[1],      # NS pad
+            inp.sig_mask.shape[0],          # S
+            str(inp.job_ts.dtype),          # float key dtype (x64 or not)
+            cfg)
+
+
+def note_solve(choice: str, inp, cfg) -> bool:
+    """Record one routed solve; returns True on a compile-cache hit (the
+    signature was warmed or already solved in-process).  O(1): a tuple
+    of ints + one set probe per session."""
+    from ..metrics import metrics
+
+    key = solve_key(choice, inp, cfg)
+    with _seen_lock:
+        hit = key in _seen
+        _seen.add(key)
+    metrics.note_compile_cache(hit)
+    return hit
+
+
+def note_warmed(key: tuple) -> None:
+    """Mark a signature as compiled (warmup path) WITHOUT counting it as
+    a live hit or miss — warmup is setup, not traffic."""
+    with _seen_lock:
+        _seen.add(key)
+
+
+def reset_seen() -> None:
+    """Test hook: forget every in-process signature."""
+    with _seen_lock:
+        _seen.clear()
+
+
+# ---------------------------------------------------------------------------
+# 2. Warmup inputs + the warmup run
+# ---------------------------------------------------------------------------
+
+def make_bucket_inputs(spec: BucketSpec, r: int = 2, np_pad: int = 8,
+                       ns_pad: int = 8, n_sigs: int = 1):
+    """Zero-valued, numpy-staged SolverInputs at ``spec``'s padded bucket,
+    leaf-for-leaf aval-identical (shape AND dtype) to what tensorize_session
+    emits for a featureless session of those sizes — so the executable
+    compiled here is the one live sessions of this bucket reuse.  All
+    queues are non-existent, so executing the solve is O(1): the loop
+    predicate fails on the first check."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from .resources import EPS_QUANTA
+    from .solver import SolverInputs
+
+    p, n, j, q = spec.padded()
+    r = max(r, 2)
+    np_dtype = (np.float64 if jnp.asarray(np.float64(1.0)).dtype
+                == jnp.float64 else np.float32)
+
+    def f(*shape):
+        return np.zeros(shape, np_dtype)
+
+    def i(*shape):
+        return np.zeros(shape, np.int32)
+
+    def b(*shape):
+        return np.zeros(shape, bool)
+
+    return SolverInputs(
+        task_req=i(p, r), task_res=i(p, r), task_sig=i(p),
+        task_sorted=np.arange(p, dtype=np.int32),
+        task_ports=b(p, np_pad), task_aff_req=b(p, ns_pad),
+        task_anti=b(p, ns_pad), task_match=b(p, ns_pad),
+        task_paff_w=i(p, ns_pad), task_panti_w=i(p, ns_pad),
+        job_start=i(j), job_count=i(j), job_queue=i(j),
+        job_minavail=np.full((j,), -1, np.int32),
+        job_prio=f(j), job_ts=f(j), job_uid_rank=f(j),
+        job_init_ready=i(j), job_init_alloc=i(j, r),
+        queue_deserved=i(q, r), queue_deserved_f=f(q, r),
+        queue_init_alloc=i(q, r), queue_ts=f(q), queue_uid_rank=f(q),
+        queue_exists=b(q),
+        node_idle=i(n, r), node_releasing=i(n, r), node_used=i(n, r),
+        node_alloc=i(n, r), node_count=i(n), node_max_tasks=i(n),
+        node_exists=b(n), node_ports=b(n, np_pad),
+        node_selcnt=i(n, ns_pad),
+        sig_mask=b(max(n_sigs, 1), n), sig_bonus=i(max(n_sigs, 1), n),
+        total_res=f(r),
+        eps=np.full((r,), EPS_QUANTA, dtype=np.int32),
+        scalar_dims=np.asarray([False, False] + [True] * (r - 2)),
+        score_shift=i(2))
+
+
+class WarmupRecord(NamedTuple):
+    spec: BucketSpec
+    solver: str
+    key: tuple
+    compile_ms: float
+    error: Optional[str] = None
+
+
+def _resolve_family(family: Sequence[str], inp) -> List[str]:
+    """Expand ``family`` names to the concrete solvers to warm for this
+    bucket.  ``auto`` = whatever best_solve_allocate would route this
+    shape to (exactly the executable a live session of this bucket
+    needs); explicit names add the rest of the family where the backend
+    supports them."""
+    import jax
+
+    from ..parallel.mesh import default_mesh
+    from .solver import choose_solver_mesh
+
+    out: List[str] = []
+    for name in family:
+        if name == "auto":
+            out.append(choose_solver_mesh(inp)[0])
+        elif name == "pallas":
+            if jax.default_backend() == "tpu":
+                out.append("pallas")
+        elif name == "sharded":
+            mesh = default_mesh()
+            if mesh is not None and inp.node_idle.shape[0] % mesh.size == 0:
+                out.append("sharded")
+        elif name in ("xla", "two-level", "stepwise"):
+            out.append("xla" if name == "two-level" else name)
+        else:
+            raise ValueError(f"unknown warmup solver {name!r}")
+    deduped: List[str] = []
+    for name in out:
+        if name not in deduped:
+            deduped.append(name)
+    return deduped
+
+
+def warm_bucket(spec: BucketSpec, cfg=None, family: Sequence[str] = ("auto",),
+                r: int = 2) -> List[WarmupRecord]:
+    """Compile (and persist, when the cache dir is enabled) the solver
+    family for one bucket by executing each member on zero-valued inputs
+    shipped through the real packed-transfer path — which also warms
+    shipping's per-layout unpack program.  Returns one record per
+    solver; a member's failure is recorded, not raised (warmup must
+    never take down boot)."""
+    from ..models.shipping import ship_inputs
+    from .solver import fetch_result, solve_allocate, solve_allocate_stepwise
+
+    if cfg is None:
+        from .solver import SolverConfig
+        cfg = SolverConfig()
+    inp_np = make_bucket_inputs(spec, r=r)
+    names = _resolve_family(family, inp_np)
+    records: List[WarmupRecord] = []
+    inp = ship_inputs(inp_np)
+    for name in names:
+        key = solve_key(name, inp_np, cfg)
+        start = time.perf_counter()
+        try:
+            if name == "xla":
+                result = solve_allocate(inp, cfg)
+            elif name == "stepwise":
+                result = solve_allocate_stepwise(inp, cfg)
+            elif name == "pallas":
+                from .pallas_solver import solve_allocate_pallas
+                result = solve_allocate_pallas(inp, cfg)
+            elif name == "sharded":
+                from ..parallel.mesh import default_mesh
+                from ..parallel.sharded_solver import solve_allocate_sharded
+                result = solve_allocate_sharded(inp, cfg, default_mesh())
+            else:  # pragma: no cover - _resolve_family guards
+                raise ValueError(name)
+            fetch_result(result)  # forces completion + warms the pack jit
+        except Exception as exc:  # noqa: BLE001 - warmup is best-effort
+            records.append(WarmupRecord(
+                spec, name, key,
+                round((time.perf_counter() - start) * 1e3, 1),
+                f"{type(exc).__name__}: {exc}"))
+            continue
+        note_warmed(key)
+        records.append(WarmupRecord(
+            spec, name, key,
+            round((time.perf_counter() - start) * 1e3, 1)))
+    return records
+
+
+class SolverWarmup:
+    """Background startup warmup: compile the solver family for each
+    configured bucket off the scheduler thread, so the first live
+    session of a warmed bucket never waits on XLA.
+
+    ``start`` is idempotent (one thread per instance, ever), ``stop``
+    signals between buckets — an XLA compile in flight cannot be
+    interrupted, so the thread is a daemon and stop() bounds its own
+    wait instead of the process exit."""
+
+    def __init__(self, buckets: Iterable[BucketSpec], cfg=None,
+                 family: Sequence[str] = ("auto",),
+                 cache_dir: Optional[str] = None):
+        self.buckets = list(buckets)
+        self._cfg = cfg
+        self._family = tuple(family)
+        self._cache_dir = cache_dir
+        self._stop = threading.Event()
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self.records: List[WarmupRecord] = []
+        self.errors: List[str] = []
+
+    def start(self) -> "SolverWarmup":
+        with self._lock:
+            if self._thread is not None:
+                return self
+            self._thread = threading.Thread(
+                target=self._run, name="solver-warmup", daemon=True)
+            self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        from ..metrics import metrics
+
+        manifest: dict = {}
+        try:
+            for k, spec in enumerate(self.buckets):
+                if self._stop.is_set():
+                    break
+                metrics.set_compile_inflight(len(self.buckets) - k)
+                try:
+                    records = warm_bucket(spec, cfg=self._cfg,
+                                          family=self._family)
+                except Exception as exc:  # noqa: BLE001 - never kill boot
+                    self.errors.append(f"{spec}: {type(exc).__name__}: {exc}")
+                    continue
+                self.records.extend(records)
+                for rec in records:
+                    if rec.error:
+                        self.errors.append(
+                            f"{rec.spec}/{rec.solver}: {rec.error}")
+                    else:
+                        manifest[repr(rec.key)] = {
+                            "spec": list(rec.spec),
+                            "solver": rec.solver,
+                            "compile_ms": rec.compile_ms,
+                        }
+        finally:
+            metrics.set_compile_inflight(0)
+            if self._cache_dir and manifest:
+                record_warmed(self._cache_dir, manifest)
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    def stop(self, timeout: float = 0.0) -> None:
+        self._stop.set()
+        self.join(timeout)
+
+    @property
+    def done(self) -> bool:
+        t = self._thread
+        return t is not None and not t.is_alive()

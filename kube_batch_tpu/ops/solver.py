@@ -488,6 +488,8 @@ def best_solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
     single-kernel Pallas solve on TPU (ops/pallas_solver.py), the two-level
     XLA solve elsewhere.  All are placement-identical (parity suite)."""
     choice, mesh = choose_solver_mesh(inp)
+    from .compile_cache import note_solve
+    note_solve(choice, inp, cfg)  # compile-cache hit/miss observability
     if choice == "sharded":
         from ..parallel.sharded_solver import solve_allocate_sharded
         return solve_allocate_sharded(inp, cfg, mesh)

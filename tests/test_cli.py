@@ -32,6 +32,19 @@ class TestOptions:
         assert opt.default_queue == "batch"
         assert opt.enable_leader_election
 
+    def test_compile_ahead_flags_parse(self):
+        opt = parse_options(["--warmup-buckets", "50000x10000x2000x4",
+                             "--compile-cache-dir", "/tmp/kbt-cache"])
+        assert opt.warmup_buckets == "50000x10000x2000x4"
+        assert opt.compile_cache_dir == "/tmp/kbt-cache"
+        assert parse_options([]).warmup_buckets == ""
+
+    def test_malformed_warmup_buckets_fail_boot(self):
+        opt = ServerOption(warmup_buckets="not-a-bucket",
+                           enable_leader_election=False, listen_address="")
+        with pytest.raises(ValueError, match="warmup bucket"):
+            ServerRuntime(opt)
+
     def test_leader_election_requires_namespace(self):
         opt = ServerOption(enable_leader_election=True)
         with pytest.raises(ValueError):
@@ -81,6 +94,29 @@ class TestOptions:
             while time.time() < deadline and not runtime.elector.is_leader:
                 time.sleep(0.05)
             assert runtime.elector.is_leader
+        finally:
+            runtime.stop()
+
+    def test_injected_lease_config_not_mutated(self, tmp_path):
+        """ADVICE r5 #2 regression: a timing-only injected lease config
+        (empty lock_path) gets the default path filled on a COPY — the
+        caller's dataclass is never written from inside the runtime."""
+        injected = LeaderElectionConfig(retry_period=0.05)
+        assert injected.lock_path == ""
+        opt = ServerOption(enable_leader_election=True,
+                           lock_object_namespace=str(tmp_path),
+                           listen_address="",
+                           file_lock_same_host_ok=True)
+        runtime = ServerRuntime(opt, lease_config=injected)
+        runtime.run()
+        try:
+            deadline = time.time() + 10
+            while time.time() < deadline and not runtime.elector.is_leader:
+                time.sleep(0.05)
+            assert runtime.elector.is_leader
+            assert injected.lock_path == ""  # caller's object untouched
+            assert runtime.elector.config.lock_path.endswith(
+                "kube-batch-lock.json")
         finally:
             runtime.stop()
 

@@ -1,0 +1,326 @@
+"""Compile-ahead subsystem tests (ops/compile_cache.py).
+
+Covers the bucket ladder, flag parsing, warmup-input aval parity with the
+tensorize path, hit/miss accounting at the solver chokepoint, warmup
+thread idempotence/shutdown, persistent-cache reuse across two solver
+instantiations, and padded-bucket vs exact-shape solve parity."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu.ops.compile_cache import (BucketSpec, SolverWarmup,
+                                              bucket, bucket_shapes,
+                                              enable_persistent_cache,
+                                              make_bucket_inputs,
+                                              parse_warmup_buckets,
+                                              read_manifest, solve_key,
+                                              warm_bucket)
+
+# One tiny bucket shared by every compiling test in this module: each
+# distinct padded shape costs a real XLA compile (~seconds on CPU).
+SPEC = BucketSpec(60, 16, 8, 4)  # pads to (64, 16, 8, 8)
+
+
+def _synthetic(spec=SPEC):
+    from kube_batch_tpu.models.synthetic import make_synthetic_inputs
+    return make_synthetic_inputs(n_tasks=spec.tasks, n_nodes=spec.nodes,
+                                 n_jobs=spec.jobs, n_queues=spec.queues)
+
+
+class TestBucketLadder:
+    def test_powers_of_two_below_1024(self):
+        assert bucket(1) == 8 and bucket(8) == 8
+        assert bucket(9) == 16
+        assert bucket(600) == 1024
+        assert bucket(1024) == 1024
+
+    def test_quarter_octaves_above_1024(self):
+        assert bucket(1025) == 1280
+        assert bucket(1281) == 1536
+        assert bucket(1537) == 1792
+        assert bucket(1793) == 2048
+        assert bucket(10000) == 10240
+
+    def test_ladder_is_monotone_and_aligned(self):
+        prev = 0
+        for n in range(1, 70000, 997):
+            b = bucket(n)
+            assert b >= n and b >= prev
+            if b > 1024:
+                # TPU lane alignment + mesh divisibility above 1024
+                assert b % 256 == 0
+            prev = b
+
+    def test_bucket_shapes(self):
+        assert bucket_shapes(50_000, 10_000, 2_000, 4) == \
+            BucketSpec(57344, 10240, 2048, 8)
+        assert SPEC.padded() == BucketSpec(64, 16, 8, 8)
+
+
+class TestParseWarmupBuckets:
+    def test_full_and_defaulted_specs(self):
+        specs = parse_warmup_buckets("50000x10000x2000x4; 1000x100")
+        assert specs[0] == BucketSpec(50000, 10000, 2000, 4)
+        assert specs[1] == BucketSpec(1000, 100, 40, 4)  # jobs=tasks/25
+
+    def test_empty_entries_skipped(self):
+        assert parse_warmup_buckets(" , 64x16x8x4,") == \
+            [BucketSpec(64, 16, 8, 4)]
+
+    @pytest.mark.parametrize("bad", ["64", "64x0", "axb", "1x2x3x4x5"])
+    def test_malformed_fails_at_config_time(self, bad):
+        with pytest.raises(ValueError):
+            parse_warmup_buckets(bad)
+
+
+class TestWarmupInputs:
+    def test_aval_parity_with_synthetic_bucket(self):
+        """The zero-valued warmup inputs must be leaf-for-leaf
+        aval-identical (shape AND dtype) to a real session of the same
+        bucket, or warmup compiles an executable no live session hits."""
+        warm_inp = make_bucket_inputs(SPEC)
+        live_inp, _cfg = _synthetic()
+        for name, w, l in zip(warm_inp._fields, warm_inp, live_inp):
+            w, l = np.asarray(w), np.asarray(l)
+            assert w.shape == l.shape, name
+            assert w.dtype == np.asarray(l).dtype, name
+
+    def test_solve_key_matches_live_route(self):
+        from kube_batch_tpu.ops.solver import choose_solver_mesh
+        live_inp, cfg = _synthetic()
+        choice = choose_solver_mesh(live_inp)[0]
+        assert solve_key(choice, make_bucket_inputs(SPEC), cfg) == \
+            solve_key(choice, live_inp, cfg)
+
+
+class TestWarmupAndHits:
+    def test_warm_then_live_solve_is_a_cache_hit(self):
+        from kube_batch_tpu.metrics.metrics import compile_cache_counts
+        from kube_batch_tpu.ops.solver import best_solve_allocate
+
+        records = warm_bucket(SPEC)
+        assert records and all(r.error is None for r in records)
+        assert all(r.compile_ms >= 0 for r in records)
+
+        inputs, config = _synthetic()
+        h0, m0 = compile_cache_counts()
+        result = best_solve_allocate(inputs, config)
+        assert int((np.asarray(result.assignment) >= 0).sum()) > 0
+        h1, m1 = compile_cache_counts()
+        assert (h1 - h0, m1 - m0) == (1, 0)
+
+    def test_unwarmed_bucket_counts_a_miss(self):
+        from kube_batch_tpu.metrics.metrics import compile_cache_counts
+        from kube_batch_tpu.ops.compile_cache import note_solve, reset_seen
+        from kube_batch_tpu.ops.solver import SolverConfig
+
+        inp = make_bucket_inputs(BucketSpec(7, 7, 7, 7))
+        cfg = SolverConfig()
+        reset_seen()
+        h0, m0 = compile_cache_counts()
+        assert note_solve("xla", inp, cfg) is False
+        assert note_solve("xla", inp, cfg) is True
+        h1, m1 = compile_cache_counts()
+        assert (h1 - h0, m1 - m0) == (1, 1)
+
+    def test_warmup_thread_idempotent_and_shutdown(self):
+        w = SolverWarmup([SPEC])
+        assert w.start() is w
+        assert w.start() is w  # second start: same thread, no second run
+        w.join(120)
+        assert w.done
+        assert len(w.records) == 1  # one bucket x one routed solver
+        assert w.errors == []
+        w.stop()  # after completion: no-op, returns immediately
+
+    def test_stop_before_heavy_work_skips_buckets(self):
+        w = SolverWarmup([SPEC] * 4)
+        w._stop.set()  # signal before start: every bucket is skipped
+        w.start()
+        w.join(30)
+        assert w.done and w.records == []
+
+
+class TestPersistentCache:
+    def test_cache_dir_reuse_across_two_instantiations(self, tmp_path):
+        """First warmup writes executables + manifest to the cache dir; a
+        second solver instantiation (in-memory jit caches dropped) must
+        be served from disk — asserted via JAX's own persistent-cache
+        hit event, not timing."""
+        import jax
+        from jax._src import monitoring
+
+        spec = BucketSpec(60, 24, 8, 4)  # distinct bucket: fresh compile
+        cache_dir = str(tmp_path / "cc")
+        assert enable_persistent_cache(cache_dir) == os.path.abspath(
+            cache_dir)
+        try:
+            SolverWarmup([spec], cache_dir=cache_dir).start().join(300)
+            manifest = read_manifest(cache_dir)
+            assert manifest["warmed"], "warmup recorded nothing"
+            entry = next(iter(manifest["warmed"].values()))
+            assert entry["spec"] == list(spec)
+            assert any(f.endswith("-cache") for f in os.listdir(cache_dir))
+
+            hits = []
+            monitoring.register_event_listener(
+                lambda name, **kw: hits.append(name)
+                if name == "/jax/compilation_cache/cache_hits" else None)
+            try:
+                jax.clear_caches()  # second instantiation: no in-memory jit
+                second = SolverWarmup([spec], cache_dir=cache_dir)
+                second.start().join(300)
+                assert second.done and not second.errors
+                assert hits, "recompile was not served from the disk cache"
+            finally:
+                monitoring.clear_event_listeners()
+        finally:
+            jax.config.update("jax_compilation_cache_dir", None)
+
+    def test_manifest_version_mismatch_resets(self, tmp_path):
+        cache_dir = str(tmp_path)
+        with open(os.path.join(
+                cache_dir, "kube_batch_tpu_warmup_manifest.json"),
+                "w") as f:
+            json.dump({"version": {"jax": "0.0.0"},
+                       "warmed": {"stale": {}}}, f)
+        assert read_manifest(cache_dir)["warmed"] == {}
+
+    def test_manifest_survives_garbage_file(self, tmp_path):
+        cache_dir = str(tmp_path)
+        with open(os.path.join(
+                cache_dir, "kube_batch_tpu_warmup_manifest.json"),
+                "w") as f:
+            f.write("{not json")
+        assert read_manifest(cache_dir)["warmed"] == {}
+
+
+def _repad(inp, spec):
+    """Re-stage SolverInputs at a LARGER padded bucket with the exact
+    padding semantics of tensorize_session: zero rows, exists=False,
+    minavail=-1 for padding jobs, task_sorted=arange."""
+    from kube_batch_tpu.ops.solver import SolverInputs
+
+    p2, n2, j2, q2 = spec.padded()
+    a = {name: np.asarray(v) for name, v in zip(inp._fields, inp)}
+    p, n, j, q = (a["task_req"].shape[0], a["node_idle"].shape[0],
+                  a["job_start"].shape[0], a["queue_deserved"].shape[0])
+    assert p2 >= p and n2 >= n and j2 >= j and q2 >= q
+
+    def grow(arr, axis, new):
+        pad = [(0, 0)] * arr.ndim
+        pad[axis] = (0, new - arr.shape[axis])
+        return np.pad(arr, pad)
+
+    out = dict(a)
+    for f in ("task_req", "task_res", "task_sig", "task_ports",
+              "task_aff_req", "task_anti", "task_match", "task_paff_w",
+              "task_panti_w"):
+        out[f] = grow(a[f], 0, p2)
+    out["task_sorted"] = np.arange(p2, dtype=np.int32)
+    for f in ("job_start", "job_count", "job_queue", "job_prio", "job_ts",
+              "job_uid_rank", "job_init_ready", "job_init_alloc"):
+        out[f] = grow(a[f], 0, j2)
+    out["job_minavail"] = np.concatenate(
+        [a["job_minavail"], np.full((j2 - j,), -1, np.int32)])
+    for f in ("queue_deserved", "queue_deserved_f", "queue_init_alloc",
+              "queue_ts", "queue_uid_rank", "queue_exists"):
+        out[f] = grow(a[f], 0, q2)
+    for f in ("node_idle", "node_releasing", "node_used", "node_alloc",
+              "node_count", "node_max_tasks", "node_exists", "node_ports",
+              "node_selcnt"):
+        out[f] = grow(a[f], 0, n2)
+    for f in ("sig_mask", "sig_bonus"):
+        out[f] = grow(a[f], 1, n2)
+    return SolverInputs(**out)
+
+
+class TestPaddedBucketParity:
+    def test_padded_solve_equals_exact_shape_solve(self):
+        """Bucket drift must be free: the same session padded one ladder
+        rung up solves to bit-identical placements and evictions-order
+        (assignment / kind / order) on the real rows, with every padding
+        row untouched."""
+        from kube_batch_tpu.ops.solver import solve_allocate
+
+        inputs, config = _synthetic()
+        grown = _repad(inputs, BucketSpec(128, 32, 16, 16))
+        base = solve_allocate(inputs, config)
+        big = solve_allocate(grown, config)
+
+        p = np.asarray(inputs.task_req).shape[0]
+        b_assign = np.asarray(base.assignment)
+        g_assign = np.asarray(big.assignment)
+        assert np.array_equal(b_assign, g_assign[:p])
+        assert np.all(g_assign[p:] == -1)
+        assert np.array_equal(np.asarray(base.kind),
+                              np.asarray(big.kind)[:p])
+        assert np.all(np.asarray(big.kind)[p:] == 0)
+        assert np.array_equal(np.asarray(base.order),
+                              np.asarray(big.order)[:p])
+        assert int(base.step) == int(big.step)
+        assert int((b_assign >= 0).sum()) > 0  # the parity is non-vacuous
+
+
+class TestWarmupConfig:
+    def test_default_conf_cfg_matches_live_sessions(self):
+        """The boot warmup must compile the SAME static cfg the loaded
+        conf's sessions key on, or it warms executables nothing hits."""
+        from kube_batch_tpu.actions.factory import register_default_actions
+        from kube_batch_tpu.models.tensor_snapshot import (
+            solver_config_from_tiers)
+        from kube_batch_tpu.ops.solver import SolverConfig
+        from kube_batch_tpu.plugins.factory import register_default_plugins
+        from kube_batch_tpu.scheduler import (DEFAULT_SCHEDULER_CONF,
+                                              load_scheduler_conf)
+
+        register_default_actions()
+        register_default_plugins()
+        _actions, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        cfg = solver_config_from_tiers(tiers)
+        assert cfg == SolverConfig()  # == every default-conf session cfg
+
+    def test_non_default_conf_changes_cfg(self):
+        from kube_batch_tpu.actions.factory import register_default_actions
+        from kube_batch_tpu.models.tensor_snapshot import (
+            solver_config_from_tiers)
+        from kube_batch_tpu.plugins.factory import register_default_plugins
+        from kube_batch_tpu.scheduler import load_scheduler_conf
+
+        register_default_actions()
+        register_default_plugins()
+        conf = ("actions: \"tpu-allocate\"\n"
+                "tiers:\n"
+                "- plugins:\n"
+                "  - name: priority\n"
+                "  - name: drf\n")
+        _actions, tiers = load_scheduler_conf(conf)
+        cfg = solver_config_from_tiers(tiers)
+        assert cfg is not None
+        assert cfg.has_gang is False
+        assert cfg.has_proportion is False
+        assert cfg.job_key_order == ("priority", "drf")
+        assert cfg.queue_key_order == ()
+
+    def test_unsupported_conf_skips_warmup(self):
+        from kube_batch_tpu.conf import PluginOption, Tier
+        from kube_batch_tpu.models.tensor_snapshot import (
+            solver_config_from_tiers)
+
+        tiers = [Tier(plugins=[PluginOption(name="mystery-plugin")])]
+        assert solver_config_from_tiers(tiers) is None
+
+
+class TestMetricsSurface:
+    def test_counters_and_gauges_exposed(self):
+        from kube_batch_tpu.metrics.metrics import (registry,
+                                                    set_bucket_pad_waste)
+        set_bucket_pad_waste("tasks", 0.25)
+        text = registry.expose()
+        assert "kube_batch_compile_cache_hits_total" in text
+        assert "kube_batch_compile_cache_misses_total" in text
+        assert "kube_batch_compile_cache_inflight" in text
+        assert 'kube_batch_bucket_pad_waste_ratio{axis="tasks"} 0.25' in text

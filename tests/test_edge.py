@@ -48,6 +48,36 @@ class TestCodec:
         w, sel = back.spec.affinity.preferred_pod_affinity[0]
         assert (w, sel) == (10, {"tier": "db"})
 
+    def test_decode_bare_list_copies_and_keeps_none(self):
+        """ADVICE r5 #1 regression: the untyped-list decode fast path must
+        COPY (not alias the wire doc) and pass None through — a null
+        element inside a nested List[List[T]] decodes to None instead of
+        raising via list(None)."""
+        from typing import List
+
+        from kube_batch_tpu.edge.codec import _decoder_for
+
+        bare = _decoder_for(list)
+        src = [1, 2]
+        out = bare(src)
+        assert out == src and out is not src
+        assert bare(None) is None
+        assert bare((1, 2)) == [1, 2]
+
+        nested = _decoder_for(List[List[int]])
+        assert nested([[1], None, [2, 3]]) == [[1], None, [2, 3]]
+
+    def test_decode_plain_list_field_does_not_alias_doc(self):
+        pod = build_pod("ns", "p1", "n1", "Pending",
+                        build_resource_list("1", "1Gi"), "pg1")
+        pod.spec.volumes = ["vol-a", "vol-b"]
+        doc = encode(pod)
+        back = decode(doc)
+        assert back.spec.volumes == ["vol-a", "vol-b"]
+        # mutating the decoded object must not write through to the doc
+        back.spec.volumes.append("vol-c")
+        assert doc["spec"]["volumes"] == ["vol-a", "vol-b"]
+
     def test_crd_versions_distinct(self):
         from kube_batch_tpu.apis.scheduling import v1alpha2
         pg1 = v1alpha1.PodGroup(metadata=ObjectMeta(name="a", namespace="ns"),

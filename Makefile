@@ -2,7 +2,7 @@
 PYTHON ?= python
 COMPILE_CACHE ?= $(CURDIR)/.compile-cache
 
-.PHONY: test bench bench-cached clean-cache
+.PHONY: test bench bench-cached bench-steady clean-cache
 
 # Tier-1 verify: the exact pytest line ROADMAP.md pins (CPU-pinned, slow
 # markers excluded, collection errors reported but not fatal).
@@ -22,6 +22,15 @@ bench:
 # $(COMPILE_CACHE) instead of recompiled.
 bench-cached:
 	env BENCH_COMPILE_CACHE_DIR=$(COMPILE_CACHE) $(PYTHON) bench.py
+
+# Back-to-back sustained-throughput mode on CPU at a small shape: fast
+# enough to run alongside tier-1, and it exercises the pipelined
+# engine's overlap split (host_overlap_ms / device_wait_ms) and the
+# delta-ship counters without the slow full bench (doc/PIPELINE.md).
+bench-steady:
+	env JAX_PLATFORMS=cpu BENCH_STEADY_ONLY=1 BENCH_STEADY_ROUNDS=8 \
+		BENCH_TASKS=2000 BENCH_NODES=256 BENCH_JOBS=80 \
+		BENCH_QUEUES=4 $(PYTHON) bench.py
 
 clean-cache:
 	rm -rf $(COMPILE_CACHE)

@@ -23,12 +23,18 @@ class BackfillAction(Action):
     def execute(self, ssn) -> None:
         from ..models.scanner import maybe_scanner
         # Don't tensorize a second time in the common no-BestEffort cycle:
-        # the scanner only pays off when there is a sweep to answer.
-        has_best_effort = any(
-            t.init_resreq.is_empty()
-            for job in ssn.jobs.values()
-            for t in job.task_status_index.get(TaskStatus.Pending,
-                                               {}).values())
+        # the scanner only pays off when there is a sweep to answer.  The
+        # pipelined tpu-allocate action already answered the discovery
+        # question from the tensorizer's BestEffort rows during its
+        # device-wait window (ssn.prescan); only sessions it didn't see
+        # (host fallback, different pipeline) pay the O(pending) walk.
+        has_best_effort = ssn.prescan.get("has_best_effort")
+        if has_best_effort is None:
+            has_best_effort = any(
+                t.init_resreq.is_empty()
+                for job in ssn.jobs.values()
+                for t in job.task_status_index.get(TaskStatus.Pending,
+                                                   {}).values())
         scanner = maybe_scanner(ssn) if has_best_effort else None
         for job in list(ssn.jobs.values()):
             pending = list(job.task_status_index.get(TaskStatus.Pending,

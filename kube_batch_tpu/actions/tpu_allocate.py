@@ -25,6 +25,11 @@ from ..metrics import metrics
 # Set to a directory path to capture an XLA profiler trace of each session
 # solve (the sidecar profiling hook, SURVEY.md §5).
 PROFILE_ENV = "KUBE_BATCH_TPU_PROFILE"
+# =0 runs the pre-pipeline sequential path (solve barrier, then apply
+# preparation): the A/B control and parity oracle for the pipelined
+# engine (doc/PIPELINE.md; tests/test_pipeline.py proves both paths
+# produce identical placements, events, and binds).
+PIPELINE_ENV = "KUBE_BATCH_TPU_PIPELINE"
 
 
 def _maybe_profile():
@@ -56,23 +61,59 @@ class TpuAllocateAction(Action):
             return
         metrics.observe_tpu_transfer_latency(time.time() - start)
 
+        # Backfill pre-scan: the tensorizer already collected every
+        # BestEffort pending task (snap.tasks_extra), so the backfill
+        # action's O(all pending) discovery walk is answered here for
+        # free.  Valid for the whole session: the candidate set is fixed
+        # at snapshot time and this action only places non-BestEffort
+        # tasks.  A negative answer is only trustworthy when the
+        # tensorizer saw EVERY job — it skips jobs whose queue is missing
+        # (allocate.go:52-56), which backfill's own walk still visits.
+        if snap.tasks_extra:
+            ssn.prescan["has_best_effort"] = True
+        elif len(snap.job_uids) == len(ssn.jobs):
+            ssn.prescan["has_best_effort"] = False
+
         if not snap.tasks:
             return
 
-        from ..models.shipping import ship_inputs
-        from ..ops.solver import best_solve_allocate, fetch_result
+        from ..models.shipping import resident_shipper
+        from ..ops.solver import (best_solve_allocate, dispatch_solve,
+                                  fetch_result, fetch_solve)
 
         import numpy as np
         ship_start = time.time()
-        inputs = ship_inputs(snap.inputs)
+        # Device-resident delta shipping: steady cycles move only the
+        # dirty blocks of the packed buffer (models/shipping.py).
+        inputs = resident_shipper(ssn.cache).ship(snap.inputs, snap.config)
         metrics.observe_tpu_transfer_latency(time.time() - ship_start)
 
+        from ..models.tensor_snapshot import (build_apply_aggregates,
+                                              prepare_apply_scaffold)
+        pipelined = os.environ.get(PIPELINE_ENV, "1") != "0"
         solve_start = time.time()
         with _maybe_profile():
-            result = best_solve_allocate(inputs, snap.config)
-            # One packed readback transfer; it also forces completion
-            # (block_until_ready is unreliable on the axon tunnel).
-            assignment, kind, order = fetch_result(result)
+            if pipelined:
+                # Dispatch, overlap the result-independent apply
+                # preparation with the executing device program, then
+                # block only when the result is actually consumed.  The
+                # packed readback also forces completion
+                # (block_until_ready is unreliable on the axon tunnel).
+                pending = dispatch_solve(inputs, snap.config)
+                overlap_start = time.perf_counter()
+                scaffold = prepare_apply_scaffold(snap)
+                metrics.observe_host_overlap_latency(
+                    time.perf_counter() - overlap_start)
+                wait_start = time.perf_counter()
+                assignment, kind, order, ordered = fetch_solve(pending)
+                metrics.observe_device_wait_latency(
+                    time.perf_counter() - wait_start)
+            else:
+                result = best_solve_allocate(inputs, snap.config)
+                assignment, kind, order = fetch_result(result)
+                placed = np.nonzero(kind > 0)[0]
+                ordered = placed[np.argsort(order[placed], kind="stable")]
+                scaffold = None
         metrics.observe_tpu_solve_latency(time.time() - solve_start)
 
         # Apply placements in device-solve order through the batched path:
@@ -80,20 +121,22 @@ class TpuAllocateAction(Action):
         # dispatch) is identical to per-task ssn.allocate/pipeline calls,
         # at one vector op per node instead of seven per task.
         apply_start = time.time()
-        from ..models.tensor_snapshot import build_apply_aggregates
-        placed = np.nonzero(kind > 0)[0]
-        ordered = placed[np.argsort(order[placed], kind="stable")]
-        agg = build_apply_aggregates(snap, assignment, kind, ordered)
+        if scaffold is None:
+            scaffold = prepare_apply_scaffold(snap)
+        agg = build_apply_aggregates(snap, assignment, kind, ordered,
+                                     scaffold=scaffold)
         kinds = kind[ordered].tolist()
-        hostnames = [snap.node_names[i] for i in assignment[ordered].tolist()]
+        hostnames = scaffold.node_names_arr[assignment[ordered]].tolist()
         ssn.batch_apply(
-            zip((snap.tasks[i] for i in ordered.tolist()), hostnames, kinds),
+            zip(scaffold.tasks_arr[ordered].tolist(), hostnames, kinds),
             agg=agg)
-        self._record_fit_deltas(ssn, snap, kind, assignment, order)
+        self._record_fit_deltas(ssn, snap, kind, assignment, order,
+                                scaffold=scaffold)
         metrics.observe_tpu_apply_latency(time.time() - apply_start)
 
     @staticmethod
-    def _record_fit_deltas(ssn, snap, kind, assignment, order) -> None:
+    def _record_fit_deltas(ssn, snap, kind, assignment, order,
+                           scaffold=None) -> None:
         """Fit-error diagnostics (allocate.go:139-141, job_info.go:348-380).
 
         The host path records NodesFitDelta when the selected node fails
@@ -117,8 +160,11 @@ class TpuAllocateAction(Action):
 
         names = snap.node_names
         inp = snap.inputs
-        job_start = np.asarray(inp.job_start)
-        job_count = np.asarray(inp.job_count)
+        if scaffold is not None:
+            job_start, job_count = scaffold.job_start, scaffold.job_count
+        else:
+            job_start = np.asarray(inp.job_start)
+            job_count = np.asarray(inp.job_count)
         for ji, uid in enumerate(snap.job_uids):
             count = int(job_count[ji])
             if not count:

@@ -43,8 +43,18 @@ class Session:
         # Clones this session has mutated: their pooled copies must not be
         # reused by the next snapshot, and tensorization must not serve
         # cached blocks for them (cache.py snapshot / tensor_snapshot.py).
+        # The delta-shipping layer (models/shipping.py) relies on these
+        # being complete: a mutation that bypasses _dirty_job/_dirty_node
+        # would leave the next cycle staging stale rows.
         self.mutated_jobs: set = set()
         self.mutated_nodes: set = set()
+
+        # Cross-action pre-scan results: a pipelined action computes
+        # snapshot-derived facts during its device-wait window and later
+        # actions consume them instead of re-walking the session (e.g.
+        # tpu-allocate answers backfill's BestEffort discovery from the
+        # tensorizer's rows).  Entries are valid for this session only.
+        self.prescan: Dict[str, object] = {}
 
         self.plugins: Dict[str, Plugin] = {}
         self.event_handlers: List[EventHandler] = []
@@ -792,11 +802,17 @@ def close_session(ssn: Session) -> None:
         else:
             ssn.cache.record_job_status_event(job)
 
+    # Publish the cycle's mutation footprint: the dirty-set sizes that
+    # bound the next cycle's incremental staging and delta ship.
+    metrics.set_session_mutations(len(ssn.mutated_jobs),
+                                  len(ssn.mutated_nodes))
+
     ssn.jobs = {}
     ssn.nodes = {}
     ssn.queues = {}
     ssn.plugins = {}
     ssn.event_handlers = []
+    ssn.prescan = {}
 
 
 def _derive_job_status(ssn: Session, job_info: JobInfo):

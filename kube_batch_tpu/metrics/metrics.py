@@ -209,6 +209,41 @@ bucket_pad_waste = registry.register(Gauge(
     f"{SUBSYSTEM}_bucket_pad_waste_ratio",
     "Fraction of the padded bucket unused by real rows, per axis",
     ("axis",)))
+# Pipelined session engine (actions/tpu_allocate.py, models/shipping.py):
+# the solve dispatch/fetch split exposes how much host-side apply
+# preparation actually overlapped the device solve, and how long the
+# action then blocked waiting on the device; the ship counters record
+# full vs dirty-row-delta input shipments and the bytes each moved.
+tpu_host_overlap_latency = registry.register(Histogram(
+    f"{SUBSYSTEM}_tpu_host_overlap_latency_milliseconds",
+    "Host-side apply preparation overlapped with the device solve, ms",
+    _MS_BUCKETS))
+tpu_device_wait_latency = registry.register(Histogram(
+    f"{SUBSYSTEM}_tpu_device_wait_latency_milliseconds",
+    "Time the action blocked on the device result after overlap work, ms",
+    _MS_BUCKETS))
+ship_total = registry.register(Counter(
+    f"{SUBSYSTEM}_tpu_ship_total",
+    "SolverInputs shipments by mode (full | delta | clean)", ("mode",)))
+ship_bytes = registry.register(Counter(
+    f"{SUBSYSTEM}_tpu_ship_bytes_total",
+    "Bytes moved host->device by SolverInputs shipments, by mode",
+    ("mode",)))
+# Scheduler loop health (scheduler.py): a persistently failing cycle or
+# repair worker is visible on /metrics instead of vanishing into a bare
+# ``except Exception``.
+scheduler_loop_errors = registry.register(Counter(
+    f"{SUBSYSTEM}_scheduler_loop_errors_total",
+    "Exceptions swallowed by the scheduling loop, by stage", ("stage",)))
+# Per-session mutation footprint (framework/session.py close_session):
+# the dirty-set sizes that drive the delta-shipping and block-reuse
+# paths — how much of the cluster each cycle actually churns.
+session_mutated_jobs = registry.register(Gauge(
+    f"{SUBSYSTEM}_session_mutated_jobs",
+    "Job clones mutated by the last scheduling session"))
+session_mutated_nodes = registry.register(Gauge(
+    f"{SUBSYSTEM}_session_mutated_nodes",
+    "Node clones mutated by the last scheduling session"))
 
 
 # Helper API (metrics.go:123-191).
@@ -284,6 +319,49 @@ def compile_cache_counts() -> tuple:
 
 def set_compile_inflight(count: int) -> None:
     compile_cache_inflight.set(float(count))
+
+
+def observe_host_overlap_latency(seconds: float) -> None:
+    tpu_host_overlap_latency.observe(seconds * 1e3)
+
+
+def observe_device_wait_latency(seconds: float) -> None:
+    tpu_device_wait_latency.observe(seconds * 1e3)
+
+
+def overlap_split_totals() -> tuple:
+    """(host_overlap_ms_sum, device_wait_ms_sum, sessions): bench.py reads
+    per-session values as deltas of these running sums (one observation of
+    each per pipelined session)."""
+    with tpu_host_overlap_latency._lock:
+        host = tpu_host_overlap_latency._sums.get((), 0.0)
+        n = tpu_host_overlap_latency._totals.get((), 0)
+    with tpu_device_wait_latency._lock:
+        wait = tpu_device_wait_latency._sums.get((), 0.0)
+    return host, wait, n
+
+
+def note_ship(mode: str, nbytes: int) -> None:
+    ship_total.inc(1.0, mode)
+    ship_bytes.inc(float(nbytes), mode)
+
+
+def ship_counts() -> dict:
+    """{mode: (shipments, bytes)} so far — bench.py's artifact split."""
+    out = {}
+    for mode in ("full", "delta", "clean"):
+        out[mode] = (int(ship_total.value(mode)),
+                     int(ship_bytes.value(mode)))
+    return out
+
+
+def inc_scheduler_loop_error(stage: str) -> None:
+    scheduler_loop_errors.inc(1.0, stage)
+
+
+def set_session_mutations(jobs: int, nodes: int) -> None:
+    session_mutated_jobs.set(float(jobs))
+    session_mutated_nodes.set(float(nodes))
 
 
 def set_bucket_pad_waste(axis: str, ratio: float) -> None:

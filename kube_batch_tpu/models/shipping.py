@@ -1,22 +1,50 @@
-"""Host->device shipping of SolverInputs.
+"""Host->device shipping of SolverInputs: packed full ships + dirty-row
+delta updates against a device-resident buffer.
 
 The TPU tunnel charges a fixed latency per host->device transfer (measured
 ~6-60 ms), so shipping SolverInputs' ~30 arrays individually dominates the
-session. ``ship_inputs`` packs all leaves into three flat host buffers (one
-per dtype family), performs three transfers, and reconstructs the pytree on
-device inside one jitted unpack call — a single dispatch regardless of leaf
-count.  The unpack program is compiled once per padded-bucket layout.
+session. ``ship_inputs`` packs all leaves into one flat byte buffer,
+performs ONE transfer, and reconstructs the pytree on device inside one
+jitted unpack call — a single dispatch regardless of leaf count.  The
+unpack program is compiled once per padded-bucket layout.
+
+``DeviceResidentShipper`` is the steady-state form (doc/PIPELINE.md): the
+flat buffer stays device-resident across sessions, and each cycle ships
+only the 512-byte blocks whose contents changed — in the steady protocol
+(~1% churn) that is the node rows the informer echo touched, the shifted
+task rows of churned jobs, and the fairness vectors, a small fraction of
+the buffer.  The update is scattered into the DONATED previous buffer
+(no reallocation) and re-unpacked on device.  A layout change (bucket,
+dtype, leaf spec) or a solver-config key change falls back to a full
+ship.  Delta-shipped inputs are bit-identical to a fresh full ship by
+construction: dirty blocks are detected by comparing against the exact
+bytes previously shipped (tests/test_pipeline.py pins this).
 """
 
 from __future__ import annotations
 
 import functools
+import os
+import warnings
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..ops.compile_cache import bucket
 from ..ops.solver import SolverInputs
+
+# Dirty-detection granularity.  Smaller blocks ship fewer clean bytes but
+# lengthen the scatter index; 512 B holds 64 int64 words — a handful of
+# node/task rows — and keeps the block count of a kubemark-scale buffer
+# (~10 MB) at ~20k, so the host compare is one vectorized pass.
+_BLOCK = 512
+# Beyond this dirty fraction a full ship moves fewer total bytes than
+# blocks + index + scatter.
+_DELTA_MAX_FRACTION = 0.5
+# Escape hatch for A/B measurement and field debugging: =0 disables the
+# device-resident path entirely (every session full-ships, no state kept).
+DELTA_SHIP_ENV = "KUBE_BATCH_TPU_DELTA_SHIP"
 
 
 def _kind_of(dtype: np.dtype) -> str:
@@ -27,8 +55,7 @@ def _kind_of(dtype: np.dtype) -> str:
     return "f"
 
 
-@functools.partial(jax.jit, static_argnums=(0, 1))
-def _unpack(spec, float_dtype, flat_u8):
+def _unpack_body(spec, float_dtype, flat_u8):
     """Slice each leaf's byte range out of the one shipped buffer and
     bitcast it back to its dtype on device."""
     leaves = []
@@ -46,14 +73,28 @@ def _unpack(spec, float_dtype, flat_u8):
     return leaves
 
 
-def ship_inputs(inp: SolverInputs, float_dtype=None) -> SolverInputs:
-    """Pack numpy-staged SolverInputs into ONE byte buffer and ship it as
-    a single transfer (the tunnel charges fixed latency per transfer;
-    one beats three), reconstructing every leaf on device with bitcasts
-    inside one jitted unpack call."""
-    if float_dtype is None:
-        float_dtype = np.float64 if jnp.asarray(
-            np.float64(1.0)).dtype == jnp.float64 else np.float32
+_unpack = functools.partial(jax.jit, static_argnums=(0, 1))(_unpack_body)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1))
+def _unpack_blocks(spec, float_dtype, flat2d):
+    """Unpack from the shipper's block-major resident buffer."""
+    return _unpack_body(spec, float_dtype, flat2d.reshape(-1))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_blocks(flat2d, idx, blocks):
+    """Overwrite the dirty blocks of the DONATED resident buffer in place
+    (duplicate padding indices carry identical rows, so last-write-wins
+    is value-deterministic)."""
+    return flat2d.at[idx].set(blocks)
+
+
+def _pack_host(inp, float_dtype, pad_to: int = 1):
+    """Flatten every leaf into one host byte buffer with final device
+    dtypes applied; returns (spec, flat_u8, treedef).  ``pad_to`` zero-pads
+    the tail so the buffer length is a stable multiple (the shipper's
+    block layout must not retrace per session)."""
     fwidth = np.dtype(float_dtype).itemsize
     leaves, treedef = jax.tree.flatten(inp)
     spec = []
@@ -75,7 +116,147 @@ def ship_inputs(inp: SolverInputs, float_dtype=None) -> SolverInputs:
         spec.append((kind, byte_off, flat.size, np.asarray(leaf).shape))
         bufs.append(flat.view(np.uint8))
         byte_off += flat.size * width
-    flat_u8 = (np.concatenate(bufs) if bufs
-               else np.zeros(1, np.uint8))
-    out_leaves = _unpack(tuple(spec), float_dtype, jnp.asarray(flat_u8))
+    if not bufs:
+        bufs.append(np.zeros(1, np.uint8))
+        byte_off = 1
+    if pad_to > 1 and byte_off % pad_to:
+        bufs.append(np.zeros(pad_to - byte_off % pad_to, np.uint8))
+    return tuple(spec), np.concatenate(bufs), treedef
+
+
+def _default_float_dtype():
+    return (np.float64 if jnp.asarray(np.float64(1.0)).dtype == jnp.float64
+            else np.float32)
+
+
+def ship_inputs(inp: SolverInputs, float_dtype=None) -> SolverInputs:
+    """Pack numpy-staged SolverInputs into ONE byte buffer and ship it as
+    a single transfer (the tunnel charges fixed latency per transfer;
+    one beats three), reconstructing every leaf on device with bitcasts
+    inside one jitted unpack call.  Stateless: every call moves the whole
+    buffer (DeviceResidentShipper is the steady-state delta form)."""
+    if float_dtype is None:
+        float_dtype = _default_float_dtype()
+    spec, flat_u8, treedef = _pack_host(inp, float_dtype)
+    out_leaves = _unpack(spec, float_dtype, jnp.asarray(flat_u8))
     return jax.tree.unflatten(treedef, out_leaves)
+
+
+class _ShipState:
+    """The device-resident image of the last shipped layout."""
+    __slots__ = ("layout", "spec", "treedef", "float_dtype",
+                 "host_flat", "device_flat", "inputs")
+
+
+class DeviceResidentShipper:
+    """Delta shipping against a device-resident SolverInputs buffer.
+
+    Contract (doc/PIPELINE.md "dirty-row invalidation"): the host stages
+    the session's tensors exactly as a full ship would (the TensorCache's
+    epoch/mutated-set tracking already bounds how much of that staging is
+    rebuilt per cycle); the shipper then compares the packed bytes against
+    the image it last shipped and moves only the changed blocks.  Full
+    re-ship triggers: first session, any layout-key change (padded bucket,
+    leaf spec, float dtype — e.g. churn crossing a bucket boundary), any
+    solver-config key change, dirty fraction above _DELTA_MAX_FRACTION,
+    or the env gate disabling residency.  The returned leaves are
+    bit-identical to ``ship_inputs`` of the same staging in every mode.
+    """
+
+    def __init__(self):
+        self._state: _ShipState | None = None
+        self.last_mode: str = ""  # "full" | "delta" | "clean" (tests/obs)
+
+    def ship(self, inp: SolverInputs, cfg=None,
+             float_dtype=None) -> SolverInputs:
+        from ..metrics import metrics
+
+        if float_dtype is None:
+            float_dtype = _default_float_dtype()
+        if os.environ.get(DELTA_SHIP_ENV, "1") == "0":
+            self._state = None  # clean A/B: no stale image survives
+            spec, flat, treedef = _pack_host(inp, float_dtype)
+            out = jax.tree.unflatten(
+                treedef, _unpack(spec, float_dtype, jnp.asarray(flat)))
+            self.last_mode = "full"
+            metrics.note_ship("full", flat.nbytes)
+            return out
+
+        spec, flat, treedef = _pack_host(inp, float_dtype, pad_to=_BLOCK)
+        layout = (spec, np.dtype(float_dtype).str, cfg)
+        st = self._state
+        if st is not None and st.layout == layout:
+            idx = self._dirty_blocks(st.host_flat, flat)
+            if idx.size == 0:
+                self.last_mode = "clean"
+                metrics.note_ship("clean", 0)
+                return st.inputs
+            if idx.size * _BLOCK <= _DELTA_MAX_FRACTION * flat.nbytes:
+                return self._ship_delta(st, flat, idx)
+        return self._ship_full(layout, spec, treedef, float_dtype, flat)
+
+    @staticmethod
+    def _dirty_blocks(old: np.ndarray, new: np.ndarray) -> np.ndarray:
+        diff = (old.view(np.int64) != new.view(np.int64))
+        return np.nonzero(diff.reshape(-1, _BLOCK // 8).any(axis=1))[0]
+
+    def _ship_full(self, layout, spec, treedef, float_dtype,
+                   flat: np.ndarray) -> SolverInputs:
+        from ..metrics import metrics
+
+        st = _ShipState()
+        st.layout = layout
+        st.spec = spec
+        st.treedef = treedef
+        st.float_dtype = float_dtype
+        st.host_flat = flat
+        st.device_flat = jnp.asarray(flat.reshape(-1, _BLOCK))
+        st.inputs = jax.tree.unflatten(
+            treedef, _unpack_blocks(spec, float_dtype, st.device_flat))
+        self._state = st
+        self.last_mode = "full"
+        metrics.note_ship("full", flat.nbytes)
+        return st.inputs
+
+    def _ship_delta(self, st: _ShipState, flat: np.ndarray,
+                    idx: np.ndarray) -> SolverInputs:
+        from ..metrics import metrics
+
+        k = idx.size
+        # Pad the update to a bucketed row count so the scatter compiles
+        # once per bucket, not once per distinct dirty count; padding rows
+        # repeat the last real row (same index, same bytes — a no-op).
+        kb = bucket(k)
+        idx_p = np.full((kb,), idx[-1], np.int32)
+        idx_p[:k] = idx
+        new2d = flat.reshape(-1, _BLOCK)
+        upd = np.empty((kb, _BLOCK), np.uint8)
+        upd[:k] = new2d[idx]
+        upd[k:] = new2d[idx[-1]]
+        with warnings.catch_warnings():
+            # CPU backends that cannot honor donation warn per call; the
+            # fallback (copy) is correct, just not free.
+            warnings.simplefilter("ignore")
+            st.device_flat = _scatter_blocks(
+                st.device_flat, jnp.asarray(idx_p), jnp.asarray(upd))
+        st.host_flat = flat
+        st.inputs = jax.tree.unflatten(
+            st.treedef,
+            _unpack_blocks(st.spec, st.float_dtype, st.device_flat))
+        self.last_mode = "delta"
+        metrics.note_ship("delta", upd.nbytes + idx_p.nbytes)
+        return st.inputs
+
+
+def resident_shipper(cache) -> DeviceResidentShipper:
+    """The cache's persistent shipper, created on first use; a throwaway
+    instance (always full ship) for cache objects that refuse attributes
+    — mirroring tensor_snapshot._tensor_cache's persistence gate."""
+    sh = getattr(cache, "_ship_cache", None)
+    if sh is None:
+        sh = DeviceResidentShipper()
+        try:
+            cache._ship_cache = sh
+        except AttributeError:
+            pass
+    return sh

@@ -72,8 +72,36 @@ def _res_from_vec(vec, axis) -> object:
     return r
 
 
+@dataclass
+class ApplyScaffold:
+    """The result-independent half of the apply phase, built while the
+    device solve is still executing (the pipelined action's host-overlap
+    window, actions/tpu_allocate.py).  Everything here depends only on
+    the snapshot: object arrays for vectorized task/hostname fan-out and
+    the numpy views the aggregate builder and fit-delta recorder index."""
+    tasks_arr: np.ndarray       # [P_real] object: snap.tasks
+    node_names_arr: np.ndarray  # [N] object: snap.node_names
+    res_q: np.ndarray           # [P_pad, R] int quanta (task_res leaf)
+    job_start: np.ndarray       # [J] i32
+    job_count: np.ndarray       # [J] i32
+
+
+def prepare_apply_scaffold(snap: "TensorSnapshot") -> ApplyScaffold:
+    tasks_arr = np.empty(len(snap.tasks), dtype=object)
+    tasks_arr[:] = snap.tasks
+    names_arr = np.empty(len(snap.node_names), dtype=object)
+    names_arr[:] = snap.node_names
+    return ApplyScaffold(
+        tasks_arr=tasks_arr, node_names_arr=names_arr,
+        res_q=np.asarray(snap.inputs.task_res),
+        job_start=np.asarray(snap.inputs.job_start),
+        job_count=np.asarray(snap.inputs.job_count))
+
+
 def build_apply_aggregates(snap: "TensorSnapshot", assignment, kind,
-                           ordered) -> BatchAggregates:
+                           ordered,
+                           scaffold: Optional[ApplyScaffold] = None
+                           ) -> BatchAggregates:
     """Per-node/per-job sums of the solve result, computed with numpy from
     the f64 staging and int-quanta arrays instead of 50k Resource ops.
 
@@ -83,7 +111,8 @@ def build_apply_aggregates(snap: "TensorSnapshot", assignment, kind,
     axis = snap.resource_names
     r = len(axis)
     res_f = snap.task_res_f64
-    res_q = np.asarray(snap.inputs.task_res)
+    res_q = (scaffold.res_q if scaffold is not None
+             else np.asarray(snap.inputs.task_res))
     jobix = snap.task_job
 
     alloc_idx = ordered[kind[ordered] == 1]

@@ -412,6 +412,50 @@ def fetch_result(result: "SolveResult"):
     return packed[0], packed[1], packed[2]
 
 
+@jax.jit
+def _pack_result_ordered(assignment, kind, order):
+    """[4, P] packed readback with the placement permutation computed ON
+    DEVICE: row 3 sorts task ids by placement step (unplaced rows pushed
+    to the tail via an int32-max key), so the host-side
+    ``argsort(order[placed])`` the apply phase needs rides the async solve
+    instead of serializing after the fetch.  Placed steps are unique, so
+    the sort equals the host's stable argsort exactly."""
+    key = jnp.where(kind > 0, order, jnp.iinfo(jnp.int32).max)
+    perm = jnp.argsort(key).astype(jnp.int32)
+    return jnp.stack([assignment, kind, order, perm])
+
+
+class PendingSolve(NamedTuple):
+    """An in-flight solve: the packed result tensor has been DISPATCHED
+    (device executing asynchronously) but not fetched.  The action runs
+    its host-overlappable apply preparation between ``dispatch_solve``
+    and ``fetch_solve`` — the input-pipeline overlap the pipelined
+    session engine is built on (doc/PIPELINE.md)."""
+    packed: jnp.ndarray  # [4, P]: assignment / kind / order / placed-perm
+
+
+def dispatch_solve(inp: SolverInputs, cfg: SolverConfig) -> PendingSolve:
+    """Route and dispatch the solve without blocking on its result.  All
+    solver family members dispatch asynchronously (JAX async dispatch on
+    every backend), so this returns as soon as the programs are enqueued."""
+    result = best_solve_allocate(inp, cfg)
+    return PendingSolve(_pack_result_ordered(result.assignment, result.kind,
+                                             result.order))
+
+
+def fetch_solve(pending: PendingSolve):
+    """Block on and read back a dispatched solve as ONE transfer.
+
+    Returns (assignment, kind, order, ordered) where ``ordered`` is the
+    placed task ids in placement order — the device-computed equivalent of
+    ``placed[np.argsort(order[placed], kind="stable")]``."""
+    import numpy as np
+    packed = np.asarray(pending.packed)
+    assignment, kind, order, perm = packed
+    n_placed = int(np.count_nonzero(kind > 0))
+    return assignment, kind, order, perm[:n_placed]
+
+
 # When to shard the solve over the mesh.  MEASUREMENT-DERIVED
 # (doc/SHARD_BENCH.json, tools/shard_bench.py --sweep): the single-chip
 # solve's per-node marginal cost is ~0.51 ns per placement step (TPU

@@ -7,9 +7,12 @@ schedule-period) and util.go (YAML conf loading with the default
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import List, Optional, Tuple
+
+log = logging.getLogger(__name__)
 
 from .conf import (SchedulerConfiguration, Tier, apply_plugin_conf_defaults,
                    configuration_from_dict)
@@ -108,6 +111,32 @@ class Scheduler:
             scheduler_conf or DEFAULT_SCHEDULER_CONF)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        self._seen_errors: set = set()
+
+    def _log_cycle_error(self, stage: str) -> None:
+        """Count and log a swallowed loop exception.  The counter moves on
+        every occurrence (a persistently failing cycle is visible on
+        /metrics); the traceback is logged once per DISTINCT error —
+        (stage, type, message, raise site) — so a wedged dependency can't
+        flood the log at one line per schedule period."""
+        import sys
+        import traceback
+
+        metrics.inc_scheduler_loop_error(stage)
+        etype, exc, tb = sys.exc_info()
+        frames = traceback.extract_tb(tb)
+        site = (frames[-1].filename, frames[-1].lineno) if frames else None
+        key = (stage, getattr(etype, "__name__", ""), str(exc), site)
+        if key in self._seen_errors:
+            return
+        if len(self._seen_errors) >= 128:
+            # Messages can embed per-occurrence data (pod names, ids); a
+            # flapping dependency must not grow the dedup set — or the
+            # log — without bound.  The counter keeps moving regardless.
+            return
+        self._seen_errors.add(key)
+        log.error("scheduler %s failed (repeats of this error are counted "
+                  "but not re-logged):\n%s", stage, traceback.format_exc())
 
     def run_once(self) -> None:
         """One scheduling cycle (scheduler.go:88-102).
@@ -154,14 +183,15 @@ class Scheduler:
                     self.run_once()
                 except Exception:  # loop must survive a bad cycle
                     metrics.register_schedule_attempt("error")
+                    self._log_cycle_error("cycle")
                 # Repair workers (cache.go:357-378: resync + cleanup run
                 # alongside the scheduling loop).
                 try:
                     self.cache.process_cleanup_jobs()
                     self.cache.process_resync_tasks(
                         getattr(self.cache.binder, "cluster", None))
-                except Exception:
-                    pass
+                except Exception:  # repair must survive too — but visibly
+                    self._log_cycle_error("repair")
                 delay = self.schedule_period - (time.time() - cycle_start)
                 if delay > 0:
                     self._stop.wait(delay)
@@ -175,8 +205,17 @@ class Scheduler:
         thread.start()
         self._thread = thread
 
-    def stop(self) -> None:
+    def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
         thread = self._thread
         if thread is not None:
-            thread.join(timeout=5)
+            thread.join(timeout=timeout)
+            if thread.is_alive():
+                # A wedged mid-cycle call (device tunnel, binder RPC)
+                # cannot be interrupted from here; the daemon thread
+                # won't block process exit, but a silent return would
+                # leave the wedge undiagnosable.
+                log.warning(
+                    "scheduler loop thread still running %.1fs after "
+                    "stop(); a cycle is wedged — the daemon thread will "
+                    "be abandoned at process exit", timeout)

@@ -1,0 +1,433 @@
+"""Pipelined session engine tests (doc/PIPELINE.md).
+
+Pins the two parity contracts the engine is built on:
+
+1. Delta-shipped inputs are bit-identical to a fresh full ship of the
+   same staging — across churn sequences, with the full-reship fallback
+   on bucket/cfg-key changes.
+2. The pipelined action (async dispatch + host-overlap + deferred fetch)
+   produces exactly the sequential path's placements, binds, fit deltas,
+   and node accounting.
+
+Plus the satellite behaviors of the same PR: scheduler loop error
+visibility, the wedged-shutdown warning, the bench probe retry, and the
+sustained-throughput stats record.
+"""
+
+import dataclasses as dc
+import logging
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+
+from kube_batch_tpu.actions.factory import register_default_actions
+from kube_batch_tpu.actions.tpu_allocate import (PIPELINE_ENV,
+                                                 TpuAllocateAction)
+from kube_batch_tpu.api import (Container, ObjectMeta, Pod, PodSpec,
+                                PodStatus, pod_key)
+from kube_batch_tpu.apis.scheduling import v1alpha1
+from kube_batch_tpu.apis.scheduling.v1alpha1 import GroupNameAnnotationKey
+from kube_batch_tpu.framework import close_session, open_session
+from kube_batch_tpu.models.shipping import (DELTA_SHIP_ENV,
+                                            DeviceResidentShipper,
+                                            resident_shipper, ship_inputs)
+from kube_batch_tpu.models.synthetic import make_synthetic_cache
+from kube_batch_tpu.models.tensor_snapshot import tensorize_session
+from kube_batch_tpu.ops.compile_cache import BucketSpec, make_bucket_inputs
+from kube_batch_tpu.ops.solver import SolverConfig
+from kube_batch_tpu.plugins.factory import register_default_plugins
+from kube_batch_tpu.scheduler import (DEFAULT_SCHEDULER_CONF, Scheduler,
+                                      load_scheduler_conf)
+
+
+def _tiers():
+    register_default_actions()
+    register_default_plugins()
+    return load_scheduler_conf(DEFAULT_SCHEDULER_CONF)[1]
+
+
+def _assert_inputs_equal(got, want):
+    la = jax.tree.flatten(got)[0]
+    lb = jax.tree.flatten(want)[0]
+    assert len(la) == len(lb)
+    for a, b in zip(la, lb):
+        assert a.shape == b.shape and a.dtype == b.dtype
+        assert np.array_equal(np.asarray(a), np.asarray(b))
+
+
+class _Churner:
+    """Minimal steady-state protocol driver: churn pods in, echo binds
+    back as Running pods (the informer round-trip)."""
+
+    def __init__(self, cache, binder):
+        self.cache = cache
+        self.binder = binder
+        self.podmap = {}
+        for job in cache.jobs.values():
+            for t in job.tasks.values():
+                self.podmap[pod_key(t.pod)] = t.pod
+
+    def echo(self):
+        binds = dict(self.binder.binds)
+        self.binder.binds.clear()
+        for key, node in binds.items():
+            old = self.podmap.get(key)
+            if old is None:
+                continue
+            new = dc.replace(old,
+                             spec=dc.replace(old.spec, node_name=node),
+                             status=PodStatus(phase="Running"))
+            self.podmap[key] = new
+            self.cache.update_pod(old, new)
+        updater = self.cache.status_updater
+        if getattr(updater, "pod_groups", None):
+            for pg in updater.pod_groups:
+                self.cache.add_pod_group(pg)
+            updater.pod_groups.clear()
+        return len(binds)
+
+    def churn(self, rnd, k, requests=None):
+        pg = f"churn-{rnd}"
+        self.cache.add_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name=pg, namespace="t"),
+            spec=v1alpha1.PodGroupSpec(min_member=1, queue="q0")))
+        for i in range(k):
+            uid = 100000 + rnd * 1000 + i
+            spec = PodSpec(containers=[Container(
+                requests=({"cpu": "500m", "memory": "1Gi"}
+                          if requests is None else requests))])
+            pod = Pod(metadata=ObjectMeta(
+                name=f"c{uid}", namespace="t", uid=f"c{uid}",
+                annotations={GroupNameAnnotationKey: pg},
+                creation_timestamp=float(uid)),
+                spec=spec, status=PodStatus(phase="Pending"))
+            self.podmap[pod_key(pod)] = pod
+            self.cache.add_pod(pod)
+
+
+# ---------------------------------------------------------------------------
+# 1. delta-ship parity
+# ---------------------------------------------------------------------------
+
+class TestDeltaShipParity:
+
+    def test_modes_and_bit_parity(self):
+        """full -> clean -> delta -> full(bucket) -> full(cfg), every mode
+        bit-identical to a from-scratch full ship."""
+        cfg = SolverConfig()
+        sh = DeviceResidentShipper()
+        inp = make_bucket_inputs(BucketSpec(512, 256, 64, 8))
+
+        _assert_inputs_equal(sh.ship(inp, cfg), ship_inputs(inp))
+        assert sh.last_mode == "full"
+
+        # Unchanged staging: nothing moves, the resident leaves come back.
+        _assert_inputs_equal(sh.ship(inp, cfg), ship_inputs(inp))
+        assert sh.last_mode == "clean"
+
+        # Dirty a few node rows (the steady informer-echo shape).
+        idle = inp.node_idle.copy()
+        idle[5] = 7
+        idle[17] = 3
+        inp2 = inp._replace(node_idle=idle)
+        _assert_inputs_equal(sh.ship(inp2, cfg), ship_inputs(inp2))
+        assert sh.last_mode == "delta"
+
+        # Dirty a task row on top: delta again, cumulative state correct.
+        req = inp2.task_req.copy()
+        req[100] = 9
+        inp3 = inp2._replace(task_req=req)
+        _assert_inputs_equal(sh.ship(inp3, cfg), ship_inputs(inp3))
+        assert sh.last_mode == "delta"
+
+        # Bucket (layout) change: full reship.
+        big = make_bucket_inputs(BucketSpec(1200, 256, 64, 8))
+        _assert_inputs_equal(sh.ship(big, cfg), ship_inputs(big))
+        assert sh.last_mode == "full"
+
+        # Solver-config key change: full reship even with equal staging.
+        cfg2 = cfg._replace(has_gang=not cfg.has_gang)
+        _assert_inputs_equal(sh.ship(big, cfg2), ship_inputs(big))
+        assert sh.last_mode == "full"
+
+    def test_mass_churn_falls_back_to_full(self):
+        """Above the dirty-fraction threshold a delta would move more
+        bytes than a full ship; the shipper must reship wholesale."""
+        cfg = SolverConfig()
+        sh = DeviceResidentShipper()
+        inp = make_bucket_inputs(BucketSpec(128, 64, 16, 4))
+        sh.ship(inp, cfg)
+        flipped = jax.tree.map(
+            lambda a: ~a if a.dtype == np.bool_ else a + 1, inp)
+        _assert_inputs_equal(sh.ship(flipped, cfg), ship_inputs(flipped))
+        assert sh.last_mode == "full"
+
+    def test_env_gate_disables_residency(self, monkeypatch):
+        monkeypatch.setenv(DELTA_SHIP_ENV, "0")
+        cfg = SolverConfig()
+        sh = DeviceResidentShipper()
+        inp = make_bucket_inputs(BucketSpec(64, 32, 8, 4))
+        _assert_inputs_equal(sh.ship(inp, cfg), ship_inputs(inp))
+        _assert_inputs_equal(sh.ship(inp, cfg), ship_inputs(inp))
+        assert sh.last_mode == "full"  # no clean/delta without residency
+        assert sh._state is None
+
+    def test_churn_sequence_end_to_end(self):
+        """Real sessions over a churning cache: whatever mode each cycle
+        picks, the shipped leaves equal a from-scratch full ship of the
+        same snapshot."""
+        tiers = _tiers()
+        cache, binder = make_synthetic_cache(300, 32, 20, 2)
+        driver = _Churner(cache, binder)
+        action = TpuAllocateAction()
+        sh = resident_shipper(cache)
+        modes = []
+        for rnd in range(4):
+            driver.churn(rnd, k=6)
+            ssn = open_session(cache, tiers)
+            snap = tensorize_session(ssn)
+            assert not snap.needs_fallback
+            _assert_inputs_equal(sh.ship(snap.inputs, snap.config),
+                                 ship_inputs(snap.inputs))
+            modes.append(sh.last_mode)
+            action.execute(ssn)
+            close_session(ssn)
+            assert driver.echo() > 0
+        assert modes[0] == "full"
+
+
+# ---------------------------------------------------------------------------
+# 2. pipelined-vs-sequential action parity
+# ---------------------------------------------------------------------------
+
+def _run_action_cycles(monkeypatch, pipeline: str, rounds: int = 3):
+    monkeypatch.setenv(PIPELINE_ENV, pipeline)
+    tiers = _tiers()
+    cache, binder = make_synthetic_cache(300, 32, 20, 2, n_signatures=4)
+    driver = _Churner(cache, binder)
+    action = TpuAllocateAction()
+    record = []
+    events = []
+    for rnd in range(rounds):
+        if rnd:
+            driver.churn(rnd, k=8)
+        ssn = open_session(cache, tiers)
+        from kube_batch_tpu.framework.events import EventHandler
+        ssn.add_event_handler(EventHandler(
+            allocate_func=lambda e: events.append(e.task.uid)))
+        action.execute(ssn)
+        statuses = {t.uid: t.status.name for job in ssn.jobs.values()
+                    for t in job.tasks.values()}
+        fit = {uid: {n: (r.milli_cpu, r.memory)
+                     for n, r in j.nodes_fit_delta.items()}
+               for uid, j in ssn.jobs.items() if j.nodes_fit_delta}
+        nodes = {n.name: (round(n.idle.milli_cpu, 6),
+                          round(n.idle.memory, 2),
+                          round(n.used.milli_cpu, 6))
+                 for n in ssn.nodes.values()}
+        close_session(ssn)
+        record.append((dict(binder.binds), statuses, fit, nodes))
+        driver.echo()
+    return record, events
+
+
+class TestPipelinedActionParity:
+
+    def test_same_placements_events_and_accounting(self, monkeypatch):
+        from kube_batch_tpu.metrics.metrics import overlap_split_totals
+        _h, _w, n0 = overlap_split_totals()
+        pipelined, ev_p = _run_action_cycles(monkeypatch, "1")
+        _h, _w, n1 = overlap_split_totals()
+        sequential, ev_s = _run_action_cycles(monkeypatch, "0")
+        _h, _w, n2 = overlap_split_totals()
+        assert pipelined == sequential
+        assert ev_p == ev_s  # same events, same order
+        assert n1 - n0 >= 3   # overlap split observed per pipelined cycle
+        assert n2 == n1       # ...and never on the sequential path
+
+    def test_scaffold_aggregates_match_unscaffolded(self):
+        """build_apply_aggregates with the overlap-built scaffold equals
+        the from-scratch build (same sums, same touched sets)."""
+        from kube_batch_tpu.models.tensor_snapshot import (
+            build_apply_aggregates, prepare_apply_scaffold)
+        from kube_batch_tpu.models.shipping import ship_inputs as _ship
+        from kube_batch_tpu.ops.solver import dispatch_solve, fetch_solve
+
+        tiers = _tiers()
+        cache, _binder = make_synthetic_cache(200, 24, 10, 2)
+        ssn = open_session(cache, tiers)
+        snap = tensorize_session(ssn)
+        assert not snap.needs_fallback
+        inputs = _ship(snap.inputs)
+        assignment, kind, order, ordered = fetch_solve(
+            dispatch_solve(inputs, snap.config))
+        # Device-computed placement order == host stable argsort.
+        placed = np.nonzero(kind > 0)[0]
+        host_ordered = placed[np.argsort(order[placed], kind="stable")]
+        assert np.array_equal(ordered, host_ordered)
+        a = build_apply_aggregates(snap, assignment, kind, ordered,
+                                   scaffold=prepare_apply_scaffold(snap))
+        b = build_apply_aggregates(snap, assignment, kind, ordered)
+        assert a.node_quanta == b.node_quanta
+        assert set(a.node_alloc) == set(b.node_alloc)
+        assert set(a.job_sums) == set(b.job_sums)
+        for name in a.node_alloc:
+            assert a.node_alloc[name].milli_cpu \
+                == b.node_alloc[name].milli_cpu
+        close_session(ssn)
+
+    def test_backfill_prescan(self):
+        """tpu-allocate answers backfill's BestEffort discovery during its
+        overlap window; backfill still places BestEffort tasks."""
+        from kube_batch_tpu.actions.backfill import BackfillAction
+
+        tiers = _tiers()
+        cache, binder = make_synthetic_cache(100, 16, 5, 2)
+        driver = _Churner(cache, binder)
+        # One BestEffort pod (no requests) in its own group.
+        driver.churn(0, k=1, requests={})
+        action = TpuAllocateAction()
+        ssn = open_session(cache, tiers)
+        action.execute(ssn)
+        assert ssn.prescan.get("has_best_effort") is True
+        BackfillAction().execute(ssn)
+        placed = [t for job in ssn.jobs.values()
+                  for t in job.tasks.values()
+                  if t.uid.startswith("c") and t.node_name]
+        assert placed, "BestEffort task was not backfilled"
+        close_session(ssn)
+        driver.echo()
+
+        # Steady no-BestEffort cycle: the prescan answers False and the
+        # backfill walk is skipped entirely.
+        ssn = open_session(cache, tiers)
+        action.execute(ssn)
+        assert ssn.prescan.get("has_best_effort") is False
+        close_session(ssn)
+
+
+# ---------------------------------------------------------------------------
+# 3. scheduler satellites
+# ---------------------------------------------------------------------------
+
+class _FailingCache:
+    """Cache whose snapshot always raises: the persistently failing
+    cycle the loop must survive VISIBLY."""
+    binder = None
+
+    def run(self):
+        pass
+
+    def wait_for_cache_sync(self):
+        pass
+
+    def snapshot(self):
+        raise RuntimeError("snapshot wedged")
+
+    def process_cleanup_jobs(self):
+        pass
+
+    def process_resync_tasks(self, cluster=None):
+        pass
+
+
+class TestSchedulerSatellites:
+
+    def test_loop_errors_counted_and_logged_once(self, caplog):
+        from kube_batch_tpu.metrics.metrics import scheduler_loop_errors
+
+        sched = Scheduler(cache=_FailingCache(), schedule_period=0.01)
+        before = scheduler_loop_errors.value("cycle")
+        with caplog.at_level(logging.ERROR,
+                             logger="kube_batch_tpu.scheduler"):
+            sched.run()
+            deadline = time.time() + 5
+            while (scheduler_loop_errors.value("cycle") - before < 3
+                   and time.time() < deadline):
+                time.sleep(0.02)
+            sched.stop(timeout=2)
+        # Counter moved on every failing cycle...
+        assert scheduler_loop_errors.value("cycle") - before >= 3
+        # ...but the identical traceback was logged exactly once.
+        tracebacks = [r for r in caplog.records
+                      if "scheduler cycle failed" in r.getMessage()]
+        assert len(tracebacks) == 1
+        assert "snapshot wedged" in tracebacks[0].getMessage()
+
+    def test_distinct_errors_each_logged(self, caplog):
+        sched = Scheduler(cache=_FailingCache(), schedule_period=1.0)
+        with caplog.at_level(logging.ERROR,
+                             logger="kube_batch_tpu.scheduler"):
+            for msg in ("boom-a", "boom-a", "boom-b"):
+                try:
+                    raise ValueError(msg)
+                except ValueError:
+                    sched._log_cycle_error("repair")
+        msgs = [r.getMessage() for r in caplog.records
+                if "scheduler repair failed" in r.getMessage()]
+        assert len(msgs) == 2  # one per DISTINCT error
+        assert any("boom-a" in m for m in msgs)
+        assert any("boom-b" in m for m in msgs)
+
+    def test_stop_warns_when_loop_wedged(self, caplog):
+        sched = Scheduler(cache=_FailingCache(), schedule_period=1.0)
+        wedge = threading.Thread(target=time.sleep, args=(1.0,),
+                                 daemon=True)
+        wedge.start()
+        sched._thread = wedge
+        with caplog.at_level(logging.WARNING,
+                             logger="kube_batch_tpu.scheduler"):
+            sched.stop(timeout=0.05)
+        assert any("wedged" in r.getMessage() for r in caplog.records
+                   if r.levelno == logging.WARNING)
+        wedge.join()
+
+    def test_stop_quiet_when_loop_exits(self, caplog):
+        sched = Scheduler(cache=_FailingCache(), schedule_period=0.01)
+        sched.run()
+        with caplog.at_level(logging.WARNING,
+                             logger="kube_batch_tpu.scheduler"):
+            sched.stop(timeout=5)
+        assert not any("wedged" in r.getMessage() for r in caplog.records
+                       if r.levelno == logging.WARNING)
+
+
+# ---------------------------------------------------------------------------
+# 4. bench satellites: probe retry + sustained stats
+# ---------------------------------------------------------------------------
+
+class TestBenchSatellites:
+
+    def test_probe_retry_embeds_stderr_tail(self, monkeypatch):
+        import bench
+
+        monkeypatch.setenv("BENCH_FORCE_PROBE_FAIL", "1")
+        monkeypatch.setenv("BENCH_PROBE_BACKOFF", "0.05")
+        platform, err = bench._probe_backend_with_retry(30)
+        assert platform is None
+        assert "attempt 1" in err and "attempt 2" in err
+        assert "forced probe failure" in err  # the child's stderr tail
+
+    def test_sustained_stats_record(self):
+        import bench
+
+        cold, rounds, stats = bench.measure_steady_session(200, 40, 20, 2,
+                                                           rounds=3)
+        assert cold > 0 and len(rounds) == 3
+        assert stats["sessions_per_sec"] is not None
+        assert stats["sessions_per_sec"] > 0
+        # One overlap observation per steady session (pipeline default on).
+        assert len(stats["host_overlap_ms"]) == 3
+        assert len(stats["device_wait_ms"]) == 3
+        assert all(v >= 0 for v in stats["host_overlap_ms"])
+        # The counters cover exactly the [1:] steady window: one shipment
+        # per round, whatever mode each round picked, with bytes only for
+        # the modes that actually moved data.
+        ship = stats["ship"]
+        assert sum(n for n, _b in ship.values()) == 3
+        assert all(b == 0 for n, b in ship.values() if n == 0)
+        assert sum(b for _n, b in ship.values()) > 0

@@ -51,7 +51,7 @@ def main():
         # Steady-state protocol (long-lived cache + churn deltas + bind
         # echo) lives in bench.measure_steady_session.
         import bench
-        cold, rounds = bench.measure_steady_session(
+        cold, rounds, stats = bench.measure_steady_session(
             n_tasks, n_nodes, n_jobs, n_queues, churn=churn,
             n_signatures=n_sigs)
         med, p90 = bench._stats(rounds)
@@ -59,6 +59,8 @@ def main():
             "metric": (f"steady-state session @ {n_tasks} tasks x "
                        f"{n_nodes} nodes, {churn:.1%} churn"),
             "value": med, "unit": "ms", "p90": p90, "cold_ms": cold,
+            "sessions_per_sec": stats["sessions_per_sec"],
+            "ship": stats["ship"],
             "vs_baseline": round(1000.0 / med, 3) if med else None}))
         return
 

@@ -2,11 +2,23 @@
 PYTHON ?= python
 COMPILE_CACHE ?= $(CURDIR)/.compile-cache
 
-.PHONY: test bench bench-cached bench-steady clean-cache
+.PHONY: lint lint-inventory test bench bench-cached bench-steady clean-cache
 
-# Tier-1 verify: the exact pytest line ROADMAP.md pins (CPU-pinned, slow
-# markers excluded, collection errors reported but not fatal).
-test:
+# graftlint: the repo's contract-enforcing static analysis (doc/LINT.md)
+# — lock discipline, donation safety, tracer hygiene, ship/no-mutate
+# contracts, exception policy.  Zero runtime deps (stdlib ast only), so
+# it runs before — and much faster than — the test suite.
+lint:
+	$(PYTHON) -m tools.graftlint kube_batch_tpu bench.py
+
+# Greppable audit trail of every annotation/suppression marker.
+lint-inventory:
+	$(PYTHON) -m tools.graftlint kube_batch_tpu bench.py --inventory
+
+# Tier-1 verify: lint first (cheap, catches contract breaks in seconds),
+# then the exact pytest line ROADMAP.md pins (CPU-pinned, slow markers
+# excluded, collection errors reported but not fatal).
+test: lint
 	env JAX_PLATFORMS=cpu $(PYTHON) -m pytest tests/ -q -m 'not slow' \
 		--continue-on-collection-errors -p no:cacheprovider \
 		-p no:xdist -p no:randomly

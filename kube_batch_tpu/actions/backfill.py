@@ -48,7 +48,7 @@ class BackfillAction(Action):
                         for name, _score in candidates:
                             try:
                                 ssn.allocate(task, name)
-                            except Exception:
+                            except Exception:  # lint: allow-swallow(per-node probe: allocate failure means try the next scanned candidate)
                                 continue
                             # Membership occupancy (count/ports/selcnt)
                             # for subsequent scans; resource `used` rides
@@ -63,7 +63,7 @@ class BackfillAction(Action):
                         continue
                     try:
                         ssn.allocate(task, node.name)
-                    except Exception:
+                    except Exception:  # lint: allow-swallow(per-node probe on the host walk: failure means try the next node)
                         continue
                     break
 

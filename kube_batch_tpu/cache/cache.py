@@ -42,7 +42,10 @@ class _EventDeque(_deque):
             try:
                 self._recorder.record(*item)
             except Exception:
-                pass  # events are best-effort diagnostics
+                # Events are best-effort diagnostics, but a recorder that
+                # fails every enqueue should not fail invisibly.
+                from ..metrics import metrics
+                metrics.note_swallowed("event_record")
 
     def extend(self, items):
         if self._recorder is None:
@@ -70,11 +73,14 @@ class SchedulerCache(Cache):
         # ignored (the reference skips the informer, cache.go:337-344).
         self.priority_class_enabled = priority_class_enabled
 
-        self.jobs: Dict[str, JobInfo] = {}
-        self.nodes: Dict[str, NodeInfo] = {}
-        self.queues: Dict[str, Queue] = {}
-        self.priority_classes: Dict[str, object] = {}
-        self.default_priority_class = None
+        # Informer callbacks (reflector threads) and the scheduling loop
+        # both touch the mirror; graftlint enforces the guarded-by
+        # relation (doc/LINT.md rule 1).
+        self.jobs: Dict[str, JobInfo] = {}          # guarded-by: mutex
+        self.nodes: Dict[str, NodeInfo] = {}        # guarded-by: mutex
+        self.queues: Dict[str, Queue] = {}          # guarded-by: mutex
+        self.priority_classes: Dict[str, object] = {}  # guarded-by: mutex
+        self.default_priority_class = None          # guarded-by: mutex
 
         self.binder = binder
         self.evictor = evictor
@@ -83,8 +89,8 @@ class SchedulerCache(Cache):
 
         # Failed-effect repair queue (cache.go:602-624): tasks whose async
         # bind/evict failed are resynced against cluster ground truth.
-        self.err_tasks: List[TaskInfo] = []
-        self.deleted_jobs: List[JobInfo] = []
+        self.err_tasks: List[TaskInfo] = []         # guarded-by: mutex
+        self.deleted_jobs: List[JobInfo] = []       # guarded-by: mutex
         # Recorded cluster events (bounded; the reference emits to the k8s
         # event stream which is similarly retention-limited).  When an
         # event_recorder is configured (cluster.ClusterEventRecorder),
@@ -100,9 +106,10 @@ class SchedulerCache(Cache):
         # have not touched, and lets tensorization (models/tensor_snapshot)
         # reuse per-job/per-node tensor blocks.  Sessions invalidate pooled
         # clones they mutate via discard_pooled_{job,node}.
-        self.epoch: int = 0
-        self._pooled_jobs: Dict[str, tuple] = {}   # uid -> (epoch, clone)
-        self._pooled_nodes: Dict[str, tuple] = {}  # name -> (epoch, clone)
+        self.epoch: int = 0                        # guarded-by: mutex
+        # uid -> (epoch, clone) / name -> (epoch, clone)
+        self._pooled_jobs: Dict[str, tuple] = {}   # guarded-by: mutex
+        self._pooled_nodes: Dict[str, tuple] = {}  # guarded-by: mutex
 
         # Leadership write fence.  The reference fences by exiting the
         # process on lost lease (server.go:135-137); here an in-flight
@@ -124,11 +131,16 @@ class SchedulerCache(Cache):
     def discard_pooled_job(self, uid: str) -> None:
         """Called by a Session the moment it mutates a job clone: the clone
         is no longer a faithful copy of cache truth and must not be reused
-        by the next snapshot."""
-        self._pooled_jobs.pop(uid, None)
+        by the next snapshot.  Runs on the scheduling thread while
+        reflector threads repopulate the pool inside snapshot() — the pop
+        must see the mutex like every other pool access (found by
+        graftlint's guarded-by check)."""
+        with self.mutex:
+            self._pooled_jobs.pop(uid, None)
 
     def discard_pooled_node(self, name: str) -> None:
-        self._pooled_nodes.pop(name, None)
+        with self.mutex:
+            self._pooled_nodes.pop(name, None)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -142,7 +154,7 @@ class SchedulerCache(Cache):
     # ------------------------------------------------------------------
     # pod / task ingestion (event_handlers.go:72-161)
 
-    def _get_or_create_job(self, ti: _TaskInfo) -> Optional[JobInfo]:
+    def _get_or_create_job(self, ti: _TaskInfo) -> Optional[JobInfo]:  # holds-lock: mutex
         if not ti.job:
             # No PodGroup annotation: only pods of our scheduler get shadow
             # groups (event_handlers.go:45-70).
@@ -160,7 +172,7 @@ class SchedulerCache(Cache):
             self.jobs[ti.job] = JobInfo(ti.job)
         return self.jobs[ti.job]
 
-    def _add_task(self, ti: _TaskInfo) -> None:
+    def _add_task(self, ti: _TaskInfo) -> None:  # holds-lock: mutex
         job = self._get_or_create_job(ti)
         if job is not None:
             # Watch streams can redeliver an ADDED on relist (the network
@@ -193,7 +205,7 @@ class SchedulerCache(Cache):
                 self.events.append(("FailedAddTask", pod_key(ti.pod),
                                     str(exc)))
 
-    def _delete_task(self, ti: _TaskInfo) -> None:
+    def _delete_task(self, ti: _TaskInfo) -> None:  # holds-lock: mutex
         job = self.jobs.get(ti.job)
         if job is not None:
             existing = job.tasks.get(ti.uid)
@@ -496,7 +508,11 @@ class SchedulerCache(Cache):
         if self.evictor is None:
             raise RuntimeError("no evictor configured")
         self._check_write_fence()
-        job = self.jobs.get(task.job)
+        # Resolve the job under the mutex: the evict runs on the scheduler
+        # thread while reflector callbacks mutate self.jobs (found by
+        # graftlint's guarded-by check).
+        with self.mutex:
+            job = self.jobs.get(task.job)
         try:
             self.evictor.evict(task.pod)
             self.events.append(("Evict", pod_key(task.pod), reason))
@@ -518,13 +534,20 @@ class SchedulerCache(Cache):
                         pass
 
     def _resync_task(self, task: TaskInfo) -> None:
-        self.err_tasks.append(task)
+        with self.mutex:
+            self.err_tasks.append(task)
 
     def process_resync_tasks(self, cluster=None) -> None:
         """Drain the error queue against the cluster's ground truth
-        (cache.go:602-611 processResyncTask)."""
-        while self.err_tasks:
-            task = self.err_tasks.pop()
+        (cache.go:602-611 processResyncTask).  Pops run under the mutex;
+        the (possibly remote) ground-truth fetch and the resync itself run
+        outside it — sync_task re-acquires, and holding the mutex across a
+        network read would stall every informer callback."""
+        while True:
+            with self.mutex:
+                if not self.err_tasks:
+                    return
+                task = self.err_tasks.pop()
             cluster_pod = cluster.get_pod(task.namespace, task.name) \
                 if cluster is not None else None
             self.sync_task(task, cluster_pod)

@@ -106,13 +106,16 @@ class Cluster:
 
     def __init__(self, auto_run_bound_pods: bool = True):
         self.lock = threading.RLock()
-        self.pods: Dict[str, Pod] = {}
-        self.nodes: Dict[str, Node] = {}
-        self.pod_groups: Dict[str, object] = {}
-        self.queues: Dict[str, object] = {}
-        self.priority_classes: Dict[str, PriorityClass] = {}
-        self.pdbs: Dict[str, object] = {}
-        self.pvcs: Dict[str, PersistentVolumeClaim] = {}
+        # Verb handlers run on arbitrary caller threads (edge server
+        # workers, tests, the scheduler's effectors); the object stores
+        # are lock-guarded and graftlint enforces it (doc/LINT.md).
+        self.pods: Dict[str, Pod] = {}                 # guarded-by: lock
+        self.nodes: Dict[str, Node] = {}               # guarded-by: lock
+        self.pod_groups: Dict[str, object] = {}        # guarded-by: lock
+        self.queues: Dict[str, object] = {}            # guarded-by: lock
+        self.priority_classes: Dict[str, PriorityClass] = {}  # guarded-by: lock
+        self.pdbs: Dict[str, object] = {}              # guarded-by: lock
+        self.pvcs: Dict[str, PersistentVolumeClaim] = {}  # guarded-by: lock
         self.pod_informer = Informer()
         self.node_informer = Informer()
         self.pod_group_informer = Informer()
@@ -123,10 +126,11 @@ class Cluster:
         # TTL-bounded events; reference recorder cache.go:238-240).
         self.events = EventLog()
         # Leader-election leases: key -> (resource_version, record dict).
+        # (guarded-by: lock — annotated below on the assignment.)
         # The ConfigMap-lock analog (reference server.go:115-139): any
         # standby anywhere coordinates through the store via CAS on the
         # version, like resourceVersion-guarded ConfigMap updates.
-        self.leases: Dict[str, tuple] = {}
+        self.leases: Dict[str, tuple] = {}             # guarded-by: lock
         # Kubelet stand-in: a bound pod starts Running immediately.
         self.auto_run_bound_pods = auto_run_bound_pods
         # Resource-version clock (lease CAS versions, watch-resume rvs):
@@ -492,7 +496,7 @@ class ClusterEventRecorder:
         self._queue = deque(maxlen=maxlen)
         self._wake = threading.Event()
         self._stop = threading.Event()
-        self._thread: Optional[threading.Thread] = None
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def record(self, reason: str, object_key: str, message: str) -> None:
@@ -518,7 +522,10 @@ class ClusterEventRecorder:
                 try:
                     self.cluster.create_event(event)
                 except Exception:
-                    pass  # best-effort; dropped like an expired event
+                    # Best-effort; dropped like an expired event — but
+                    # countable, so a dead egress edge is visible.
+                    from ..metrics import metrics
+                    metrics.note_swallowed("event_egress")
 
     def stop(self) -> None:
         self._stop.set()

@@ -18,8 +18,8 @@ from .interface import Binder, Evictor, StatusUpdater, VolumeBinder
 class FakeBinder(Binder):
     def __init__(self):
         self.lock = threading.Lock()
-        self.binds: Dict[str, str] = {}
-        self.channel: List[str] = []
+        self.binds: Dict[str, str] = {}    # guarded-by: lock
+        self.channel: List[str] = []       # guarded-by: lock
 
     def bind(self, pod, hostname: str) -> None:
         with self.lock:
@@ -39,8 +39,8 @@ class FakeBinder(Binder):
 class FakeEvictor(Evictor):
     def __init__(self):
         self.lock = threading.Lock()
-        self.evicts: List[str] = []
-        self.channel: List[str] = []
+        self.evicts: List[str] = []        # guarded-by: lock
+        self.channel: List[str] = []       # guarded-by: lock
 
     def evict(self, pod) -> None:
         with self.lock:

@@ -31,8 +31,8 @@ class Cache(abc.ABC):
         for t in tasks:
             try:
                 self.bind(t, t.node_name)
-            except Exception:
-                continue  # bind() already queued the resync
+            except Exception:  # lint: allow-swallow(bind() already queued the resync; the repair loop owns recovery)
+                continue
 
     @abc.abstractmethod
     def evict(self, task: TaskInfo, reason: str) -> None: ...

@@ -157,8 +157,8 @@ class LeaderElector:
     def try_acquire_or_renew(self) -> bool:
         try:
             version, record = self.lock.get()
-        except Exception:
-            return False  # store unreachable: cannot prove the lease
+        except Exception:  # lint: allow-swallow(store unreachable: cannot prove the lease, so report not-acquired and retry next tick)
+            return False
         now = time.time()
         if (record is not None
                 and record.get("holderIdentity") != self.config.identity):
@@ -171,7 +171,7 @@ class LeaderElector:
                       "leaseDurationSeconds": self.config.lease_duration}
         try:
             return self.lock.cas(new_record, version)
-        except Exception:
+        except Exception:  # lint: allow-swallow(CAS conflict or unreachable store both mean "did not acquire"; the elector loop retries)
             return False
 
     # -- loop ---------------------------------------------------------------

@@ -130,12 +130,14 @@ class RemoteCluster:
         self.base_url = base_url.rstrip("/")
         self.timeout = timeout
         self.lock = threading.RLock()
-        self.pods: Dict[str, object] = {}
-        self.nodes: Dict[str, object] = {}
-        self.pod_groups: Dict[str, object] = {}
-        self.queues: Dict[str, object] = {}
-        self.priority_classes: Dict[str, object] = {}
-        self.pdbs: Dict[str, object] = {}
+        # Mirror stores: written by six reflector threads, read by the
+        # scheduler's resync path — guarded-by enforced by graftlint.
+        self.pods: Dict[str, object] = {}              # guarded-by: lock
+        self.nodes: Dict[str, object] = {}             # guarded-by: lock
+        self.pod_groups: Dict[str, object] = {}        # guarded-by: lock
+        self.queues: Dict[str, object] = {}            # guarded-by: lock
+        self.priority_classes: Dict[str, object] = {}  # guarded-by: lock
+        self.pdbs: Dict[str, object] = {}              # guarded-by: lock
         self.pvcs = _PvcStore(self)
         self.pod_informer = Informer()
         self.node_informer = Informer()
@@ -414,7 +416,7 @@ class RemoteCluster:
                 "pods", pod.metadata.namespace, pod.metadata.name))
             current = self._decode(doc)
             return current.spec.node_name == hostname
-        except Exception:
+        except Exception:  # lint: allow-swallow(read-back probe: any failure means "unproven", and False makes the retry path surface the original error)
             return False
 
     def bind_pod(self, namespace: str, name: str, hostname: str) -> None:

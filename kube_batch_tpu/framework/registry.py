@@ -15,8 +15,8 @@ from .interface import Action, Plugin
 PluginBuilder = Callable[[Arguments], Plugin]
 
 _lock = threading.Lock()
-_plugin_builders: Dict[str, PluginBuilder] = {}
-_actions: Dict[str, Action] = {}
+_plugin_builders: Dict[str, PluginBuilder] = {}  # guarded-by: _lock
+_actions: Dict[str, Action] = {}                 # guarded-by: _lock
 
 
 def register_plugin_builder(name: str, builder: PluginBuilder) -> None:

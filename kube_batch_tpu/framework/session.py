@@ -758,7 +758,9 @@ def open_session(cache, tiers: List[Tier],
                 try:
                     ssn.cache.update_job_status(job)
                 except Exception:
-                    pass
+                    # A failed PodGroup status write must not abort the
+                    # session open; countable instead of invisible.
+                    metrics.note_swallowed("job_status_update")
             del ssn.jobs[job.uid]
 
     return ssn
@@ -798,7 +800,9 @@ def close_session(ssn: Session) -> None:
             try:
                 ssn.cache.update_job_status(job)
             except Exception:
-                pass
+                # Same policy as open_session's discard path: the close
+                # must finish; the failure is counted.
+                metrics.note_swallowed("job_status_update")
         else:
             ssn.cache.record_job_status_event(job)
 

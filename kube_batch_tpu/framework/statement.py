@@ -102,6 +102,6 @@ class Statement:
                 reclaimee, reason = args
                 try:
                     self.ssn.cache.evict(reclaimee, reason)
-                except Exception:
+                except Exception:  # lint: allow-swallow(commit continues past one failed evict; _unevict restores session state and cache.evict queued the resync)
                     self._unevict(reclaimee)  # also restores VictimIndex
         self.operations.clear()

@@ -34,9 +34,9 @@ class Histogram:
         self.label_names = label_names
         self._lock = threading.Lock()
         self._counts: Dict[tuple, List[int]] = defaultdict(
-            lambda: [0] * (len(buckets) + 1))
-        self._sums: Dict[tuple, float] = defaultdict(float)
-        self._totals: Dict[tuple, int] = defaultdict(int)
+            lambda: [0] * (len(buckets) + 1))        # guarded-by: _lock
+        self._sums: Dict[tuple, float] = defaultdict(float)    # guarded-by: _lock
+        self._totals: Dict[tuple, int] = defaultdict(int)      # guarded-by: _lock
 
     def observe(self, value: float, *labels: str) -> None:
         with self._lock:
@@ -97,7 +97,7 @@ class Counter:
         self.help = help_
         self.label_names = label_names
         self._lock = threading.Lock()
-        self._values: Dict[tuple, float] = defaultdict(float)
+        self._values: Dict[tuple, float] = defaultdict(float)  # guarded-by: _lock
 
     def inc(self, amount: float = 1.0, *labels: str) -> None:
         with self._lock:
@@ -244,6 +244,14 @@ session_mutated_jobs = registry.register(Gauge(
 session_mutated_nodes = registry.register(Gauge(
     f"{SUBSYSTEM}_session_mutated_nodes",
     "Node clones mutated by the last scheduling session"))
+# Reviewed-swallow visibility (graftlint exception-policy, doc/LINT.md):
+# broad handlers that neither re-raise nor have a dedicated counter count
+# here by site, so a permanently failing best-effort path shows up on
+# /metrics instead of disappearing into `except Exception: pass`.
+swallowed_exceptions = registry.register(Counter(
+    f"{SUBSYSTEM}_swallowed_exceptions_total",
+    "Exceptions swallowed by reviewed best-effort paths, by site",
+    ("site",)))
 
 
 # Helper API (metrics.go:123-191).
@@ -357,6 +365,12 @@ def ship_counts() -> dict:
 
 def inc_scheduler_loop_error(stage: str) -> None:
     scheduler_loop_errors.inc(1.0, stage)
+
+
+def note_swallowed(site: str) -> None:
+    """Count one reviewed exception swallow at ``site`` (the
+    exception-policy counter route — see doc/LINT.md rule 5)."""
+    swallowed_exceptions.inc(1.0, site)
 
 
 def set_session_mutations(jobs: int, nodes: int) -> None:

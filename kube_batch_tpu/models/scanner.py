@@ -38,6 +38,12 @@ SCAN_DEVICE_ENV = "KUBE_BATCH_TPU_SCAN_DEVICE"
 # Distinct task profiles whose score vectors stay warm at once; a storm
 # interleaves preemptors of a handful of profiles, far under this.
 _SCORE_CACHE_CAP = 64
+# =1 makes scores() return a defensive copy instead of the live cached
+# view (ADVICE r5 #3 hardened): callers may then retain or mutate freely
+# at the cost of one [N] copy per call.  Default off — the fast path's
+# no-retain/no-mutate contract is machine-checked by graftlint's
+# frozen-after rule instead — and on in tests (tests/conftest.py).
+SAFE_SCORES_ENV = "KUBE_BATCH_TPU_SAFE_SCORES"
 
 
 def maybe_scanner(ssn) -> Optional["DeviceNodeScanner"]:
@@ -207,7 +213,7 @@ class DeviceNodeScanner:
 
     # -- the scan -----------------------------------------------------------
 
-    def scores(self, task: TaskInfo) -> Optional[np.ndarray]:
+    def scores(self, task: TaskInfo) -> Optional[np.ndarray]:  # frozen-after: scores
         """[N_real] int scores (SCORE_NEG_INF = predicate-rejected), or None
         when the task is outside the snapshot's candidate set.
 
@@ -217,9 +223,15 @@ class DeviceNodeScanner:
         Callers must consume it before their next ``scores()`` call and
         must never write to it (e.g. an in-place admissibility mask) —
         either silently corrupts or observes-mutated cached scores.
-        Retaining callers must copy (``scores(t).copy()``)."""
+        Retaining callers must copy (``scores(t).copy()``).  The contract
+        is machine-checked: the ``frozen-after: scores`` marker above
+        makes graftlint flag in-place mutation of any name bound from a
+        ``.scores(...)`` call (doc/LINT.md rule 4), and
+        ``KUBE_BATCH_TPU_SAFE_SCORES=1`` (tests' default) returns a
+        defensive copy so a contract hole corrupts nothing there."""
         import os
 
+        safe = os.environ.get(SAFE_SCORES_ENV) == "1"
         ti = self.task_index.get(task.uid)
         if ti is None:
             return None
@@ -234,7 +246,10 @@ class DeviceNodeScanner:
             out = np.asarray(best_scan_nodes(self.cfg, self.r, self.np_pad,
                                              self.ns_pad, self.statics,
                                              self.dyn, trow))
-            return out[:len(self.snap.node_names)]
+            view = out[:len(self.snap.node_names)]
+            # np.asarray of a jax array is a READ-ONLY view: safe mode
+            # promises a caller-mutable copy on this engine too.
+            return view.copy() if safe else view
         key = (int(self._task_sig[ti]), self._task_res[ti].tobytes(),
                self._task_ports[ti].tobytes(),
                self._task_aff[ti].tobytes(),
@@ -264,7 +279,8 @@ class DeviceNodeScanner:
             self._score_cache[key] = [out, len(log)]
             if len(self._score_cache) > _SCORE_CACHE_CAP:
                 self._score_cache.popitem(last=False)
-        return out[:len(self.snap.node_names)]
+        view = out[:len(self.snap.node_names)]
+        return view.copy() if safe else view
 
     def _scores_numpy(self, ti: int, rows=None) -> np.ndarray:
         """The exact integer math of ops/scan.py in numpy: the grid floor

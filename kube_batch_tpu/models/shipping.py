@@ -209,9 +209,15 @@ class DeviceResidentShipper:
         st.spec = spec
         st.treedef = treedef
         st.float_dtype = float_dtype
-        st.host_flat = flat
+        # The shipped image: dirty-block detection compares against these
+        # exact bytes, so in-place mutation after the ship silently breaks
+        # the delta ≡ full-ship bit-parity guarantee.  graftlint flags any
+        # in-place write (doc/LINT.md rule 4); rebinding stays legal.
+        st.host_flat = flat         # frozen-after: ship
         st.device_flat = jnp.asarray(flat.reshape(-1, _BLOCK))
-        st.inputs = jax.tree.unflatten(
+        # The reconstructed SolverInputs leaves are shared with every
+        # consumer of this session's solve — same no-mutate contract.
+        st.inputs = jax.tree.unflatten(  # frozen-after: ship
             treedef, _unpack_blocks(spec, float_dtype, st.device_flat))
         self._state = st
         self.last_mode = "full"

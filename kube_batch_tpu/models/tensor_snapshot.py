@@ -1214,7 +1214,7 @@ def tensorize_session(ssn) -> TensorSnapshot:
                         example, node)
                 try:
                     ssn.predicate_fn(stripped, node)
-                except Exception:
+                except Exception:  # lint: allow-swallow(predicate veto: any raise means infeasible, exactly like the host walk treats it)
                     continue
                 prof_mask[si, pi] = True
         if n_real:

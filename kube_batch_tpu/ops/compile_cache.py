@@ -131,7 +131,7 @@ def parse_warmup_buckets(spec: str) -> List[BucketSpec]:
 # ---------------------------------------------------------------------------
 
 _MANIFEST_NAME = "kube_batch_tpu_warmup_manifest.json"
-_cache_dir: Optional[str] = None
+_cache_dir: Optional[str] = None   # guarded-by: _cache_lock
 _cache_lock = threading.Lock()
 
 
@@ -155,7 +155,7 @@ def enable_persistent_cache(cache_dir: str) -> Optional[str]:
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
-    except Exception:
+    except Exception:  # lint: allow-swallow(jax builds without these config keys degrade to in-process warmup; None tells the caller)
         return None
     try:
         # JAX memoizes its cache-enabled decision at the first compile;
@@ -163,7 +163,7 @@ def enable_persistent_cache(cache_dir: str) -> Optional[str]:
         # the new dir would be silently ignored without a reset.
         from jax._src import compilation_cache as _cc
         _cc.reset_cache()
-    except Exception:
+    except Exception:  # lint: allow-swallow(private-API reset is an optimization; without it only pre-enable compiles miss the cache)
         pass
     with _cache_lock:
         _cache_dir = cache_dir
@@ -179,7 +179,7 @@ def _version_key() -> dict:
     from ..version import __version__
     try:
         backend = jax.default_backend()
-    except Exception:
+    except Exception:  # lint: allow-swallow(backend probe at manifest-read time; "unknown" just voids manifest trust)
         backend = "unknown"
     return {"jax": jax.__version__, "kube_batch_tpu": __version__,
             "backend": backend}
@@ -228,7 +228,7 @@ def record_warmed(cache_dir: str, entries: dict) -> None:
 # ---------------------------------------------------------------------------
 
 _seen_lock = threading.Lock()
-_seen: set = set()
+_seen: set = set()  # guarded-by: _seen_lock
 
 
 def solve_key(choice: str, inp, cfg) -> tuple:
@@ -409,7 +409,7 @@ def warm_bucket(spec: BucketSpec, cfg=None, family: Sequence[str] = ("auto",),
             else:  # pragma: no cover - _resolve_family guards
                 raise ValueError(name)
             fetch_result(result)  # forces completion + warms the pack jit
-        except Exception as exc:  # noqa: BLE001 - warmup is best-effort
+        except Exception as exc:  # lint: allow-swallow(warmup must never take down boot; failure is recorded in WarmupRecord.error)
             records.append(WarmupRecord(
                 spec, name, key,
                 round((time.perf_counter() - start) * 1e3, 1),
@@ -441,7 +441,7 @@ class SolverWarmup:
         self._cache_dir = cache_dir
         self._stop = threading.Event()
         self._lock = threading.Lock()
-        self._thread: Optional[threading.Thread] = None
+        self._thread: Optional[threading.Thread] = None  # guarded-by: _lock
         self.records: List[WarmupRecord] = []
         self.errors: List[str] = []
 

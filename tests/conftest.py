@@ -11,6 +11,12 @@ jax.config.update, which takes effect any time before backend init.
 
 import os
 
+# Harden the scanner's scores() contract under test: return defensive
+# copies so a no-retain/no-mutate violation in code under test corrupts
+# nothing (ADVICE r5 #3; the production fast path keeps the live view,
+# guarded statically by graftlint's frozen-after rule).
+os.environ.setdefault("KUBE_BATCH_TPU_SAFE_SCORES", "1")
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

@@ -616,6 +616,46 @@ class TestScanEngines:
         finally:
             close_session(ssn)
 
+    def test_safe_scores_env_returns_defensive_copy(self, monkeypatch):
+        """KUBE_BATCH_TPU_SAFE_SCORES=1 (the tests' default, set in
+        conftest.py) hardens the scores() no-retain/no-mutate contract:
+        the caller gets a copy, so mutating it cannot corrupt the LRU
+        score cache; =0 keeps the zero-copy live view (production fast
+        path, guarded statically by graftlint's frozen-after rule)."""
+        import numpy as np
+        monkeypatch.setenv("KUBE_BATCH_TPU_SCAN_MIN_NODES", "0")
+        from kube_batch_tpu.models.scanner import maybe_scanner
+        from kube_batch_tpu.scheduler import load_scheduler_conf
+        td = TestDeviceScanParity()
+        cache, _, _ = td._preempt_cluster()
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        try:
+            scanner = maybe_scanner(ssn)
+            task = scanner.snap.tasks[0]
+            monkeypatch.setenv("KUBE_BATCH_TPU_SAFE_SCORES", "1")
+            s = scanner.scores(task)
+            pristine = s.copy()
+            s[:] = -12345  # caller-side abuse: must not reach the cache
+            again = scanner.scores(task)
+            assert np.array_equal(again, pristine)
+            assert again is not s
+            # =0: the documented live view — same ints, shared buffer.
+            monkeypatch.setenv("KUBE_BATCH_TPU_SAFE_SCORES", "0")
+            live1 = scanner.scores(task)
+            live2 = scanner.scores(task)
+            assert np.array_equal(live1, pristine)
+            assert np.shares_memory(live1, live2)
+            # Device engine: np.asarray of a jax array is read-only, so
+            # safe mode must copy there too for the same promise.
+            monkeypatch.setenv("KUBE_BATCH_TPU_SAFE_SCORES", "1")
+            monkeypatch.setenv("KUBE_BATCH_TPU_SCAN_DEVICE", "1")
+            dev = scanner.scores(task)
+            assert np.array_equal(dev, pristine)
+            dev[:] = -1  # must be writable (defensive copy)
+        finally:
+            close_session(ssn)
+
 
 class TestBatchApplyVolumeFailure:
     def test_bad_volume_skips_only_that_task(self):

@@ -1,0 +1,721 @@
+"""Fixture-driven tests for every graftlint rule (tools/graftlint).
+
+Each rule gets at least one must-flag and one must-pass snippet, plus
+suppression-marker behavior.  The snippets are the executable
+specification of the annotation grammar in doc/LINT.md.
+"""
+
+import pathlib
+import sys
+import textwrap
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+from tools.graftlint.core import SourceFile, run_files  # noqa: E402
+
+
+def lint(src, path="fixture.py", extra=None):
+    files = [SourceFile(path, textwrap.dedent(src))]
+    if extra:
+        files.append(SourceFile("extra.py", textwrap.dedent(extra)))
+    findings, _markers = run_files(files)
+    return findings
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# (1) lock-discipline
+# ---------------------------------------------------------------------------
+
+class TestLockDiscipline:
+    def test_unlocked_write_flagged(self):
+        findings = lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                    self.jobs = {}  # guarded-by: lock
+
+                def bad(self, k, v):
+                    self.jobs[k] = v
+        """)
+        assert rules_of(findings) == {"lock-discipline"}
+        assert "jobs" in findings[0].message
+
+    def test_unlocked_content_read_flagged(self):
+        findings = lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                    self.jobs = {}  # guarded-by: lock
+
+                def bad(self, k):
+                    return self.jobs.get(k)
+        """)
+        assert rules_of(findings) == {"lock-discipline"}
+
+    def test_locked_access_passes(self):
+        findings = lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                    self.jobs = {}  # guarded-by: lock
+
+                def good(self, k, v):
+                    with self.lock:
+                        self.jobs[k] = v
+                        return self.jobs.get(k)
+        """)
+        assert findings == []
+
+    def test_bare_reference_load_passes(self):
+        # The documented safe idioms: local-copy publish, `is None` check.
+        findings = lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                    self.thread = None  # guarded-by: lock
+
+                def ok(self):
+                    t = self.thread
+                    return t is not None and self.thread is None
+        """)
+        assert findings == []
+
+    def test_membership_test_is_content(self):
+        findings = lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                    self.seen = set()  # guarded-by: lock
+
+                def bad(self, k):
+                    return k in self.seen
+        """)
+        assert rules_of(findings) == {"lock-discipline"}
+
+    def test_holds_lock_marker_covers_body_and_checks_callers(self):
+        findings = lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                    self.jobs = {}  # guarded-by: lock
+
+                def _helper(self, k):  # holds-lock: lock
+                    return self.jobs.get(k)
+
+                def good(self, k):
+                    with self.lock:
+                        return self._helper(k)
+
+                def bad(self, k):
+                    return self._helper(k)
+        """)
+        assert len(findings) == 1
+        assert "_helper" in findings[0].message
+
+    def test_module_level_holds_lock(self):
+        # holds-lock on a module-level def: body checks as locked, bare
+        # calls from other module-level code are flagged.
+        findings = lint("""
+            import threading
+
+            _lk = threading.Lock()
+            _seen = set()  # guarded-by: _lk
+
+            def _helper(k):  # holds-lock: _lk
+                _seen.add(k)
+
+            def good(k):
+                with _lk:
+                    _helper(k)
+
+            def bad(k):
+                _helper(k)
+        """)
+        assert len(findings) == 1
+        assert "_helper" in findings[0].message
+
+    def test_module_global_guarded(self):
+        findings = lint("""
+            import threading
+
+            _lock = threading.Lock()
+            _seen = set()  # guarded-by: _lock
+
+            def good(k):
+                with _lock:
+                    _seen.add(k)
+
+            def bad(k):
+                _seen.add(k)
+        """)
+        assert len(findings) == 1
+        assert findings[0].rule == "lock-discipline"
+
+    def test_init_stores_exempt(self):
+        findings = lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                    self.jobs = {}  # guarded-by: lock
+                    self.jobs["seed"] = 1
+        """)
+        assert findings == []
+
+
+class TestLockOrder:
+    def test_inconsistent_nesting_flagged(self):
+        findings = lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.a_lock = threading.Lock()
+                    self.b_lock = threading.Lock()
+
+                def one(self):
+                    with self.a_lock:
+                        with self.b_lock:
+                            pass
+
+                def two(self):
+                    with self.b_lock:
+                        with self.a_lock:
+                            pass
+        """)
+        assert rules_of(findings) == {"lock-order"}
+        assert len(findings) == 1  # one finding per unordered pair
+
+    def test_consistent_nesting_passes(self):
+        findings = lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.a_lock = threading.Lock()
+                    self.b_lock = threading.Lock()
+
+                def one(self):
+                    with self.a_lock:
+                        with self.b_lock:
+                            pass
+
+                def two(self):
+                    with self.a_lock:
+                        with self.b_lock:
+                            pass
+        """)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# (2) donation-safety
+# ---------------------------------------------------------------------------
+
+_DONATING = """\
+import functools
+import jax
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def scatter(buf, upd):
+    return buf.at[0].set(upd)
+"""
+
+
+class TestDonationSafety:
+    def test_read_after_donate_flagged(self):
+        findings = lint(_DONATING + textwrap.dedent("""
+            def bad(buf, upd):
+                out = scatter(buf, upd)
+                return buf.sum()
+        """))
+        assert rules_of(findings) == {"donation-safety"}
+
+    def test_rebind_pattern_passes(self):
+        # The sanctioned pattern: result assigned back to the donated path
+        # (models/shipping.py's _scatter_blocks call).
+        findings = lint(_DONATING + textwrap.dedent("""
+            def good(st, upd):
+                st.buf = scatter(st.buf, upd)
+                return st.buf.sum()
+        """))
+        assert findings == []
+
+    def test_loop_without_rebind_flagged(self):
+        findings = lint(_DONATING + textwrap.dedent("""
+            def bad(buf, upds):
+                outs = []
+                for u in upds:
+                    outs.append(scatter(buf, u))
+                return outs
+        """))
+        assert rules_of(findings) == {"donation-safety"}
+
+    def test_loop_with_rebind_passes(self):
+        findings = lint(_DONATING + textwrap.dedent("""
+            def good(buf, upds):
+                for u in upds:
+                    buf = scatter(buf, u)
+                return buf
+        """))
+        assert findings == []
+
+    def test_loop_with_fresh_buffer_each_iteration_passes(self):
+        # A buffer BUILT inside the loop before the donating call is live
+        # on every iteration — not a dead-buffer re-donation.
+        findings = lint(_DONATING + textwrap.dedent("""
+            def good(upds, make):
+                outs = []
+                for u in upds:
+                    buf = make()
+                    outs.append(scatter(buf, u))
+                return outs
+        """))
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# (3) tracer-hygiene
+# ---------------------------------------------------------------------------
+
+class TestTracerHygiene:
+    def test_if_on_traced_param_flagged(self):
+        findings = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """)
+        assert rules_of(findings) == {"tracer-hygiene"}
+
+    def test_static_arg_control_flow_passes(self):
+        findings = lint("""
+            import functools
+            import jax
+            import jax.numpy as jnp
+
+            @functools.partial(jax.jit, static_argnames=("cfg",))
+            def f(x, cfg):
+                if cfg.flag:
+                    return x * 2
+                for i in range(x.shape[0]):
+                    x = x + i
+                return x
+        """)
+        assert findings == []
+
+    def test_numpy_on_traced_param_flagged(self):
+        findings = lint("""
+            import jax
+            import numpy as np
+
+            @jax.jit
+            def f(x):
+                return np.asarray(x)
+        """)
+        assert rules_of(findings) == {"tracer-hygiene"}
+
+    def test_numpy_on_static_param_passes(self):
+        findings = lint("""
+            import functools
+            import jax
+            import numpy as np
+
+            @functools.partial(jax.jit, static_argnums=(1,))
+            def f(x, dtype):
+                width = np.dtype(dtype).itemsize
+                return x * width
+        """)
+        assert findings == []
+
+    def test_nonhashable_static_at_call_site_flagged(self):
+        findings = lint("""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnums=(0,))
+            def f(spec, x):
+                return x
+
+            def caller(x):
+                return f([1, 2], x)
+        """)
+        assert rules_of(findings) == {"tracer-hygiene"}
+
+    def test_module_level_invocation_flagged(self):
+        findings = lint("""
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                return x + 1
+
+            _PRIMED = f(jnp.zeros(4))
+        """)
+        assert rules_of(findings) == {"tracer-hygiene"}
+        assert "import" in findings[0].message
+
+    def test_wrap_form_statics_resolved(self):
+        # name = functools.partial(jax.jit, static_argnums=...)(fn):
+        # the wrapped body is checked with those statics (shipping.py form).
+        findings = lint("""
+            import functools
+            import jax
+
+            def _body(spec, x):
+                for kind, off in spec:
+                    x = x + off
+                return x
+
+            _unpack = functools.partial(jax.jit, static_argnums=(0,))(_body)
+        """)
+        assert findings == []
+
+    def test_same_named_jitted_fns_in_two_files_both_checked(self):
+        # A name collision across files must not mask either body check:
+        # the buggy `f` here traces-on-if even though another file defines
+        # a clean jitted `f` that is collected later.
+        findings = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """, extra="""
+            import functools
+            import jax
+
+            @functools.partial(jax.jit, static_argnames=("x",))
+            def f(x):
+                return 1 if x else 0
+        """)
+        assert rules_of(findings) == {"tracer-hygiene"}
+        assert findings[0].path == "fixture.py"
+
+    def test_len_and_shape_are_static_escapes(self):
+        findings = lint("""
+            import jax
+
+            @jax.jit
+            def f(x):
+                if len(x) > 2:
+                    return x
+                if x.shape[0] > 2:
+                    return x
+                return x
+        """)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# (4) frozen-after (ship/no-mutate)
+# ---------------------------------------------------------------------------
+
+class TestFrozenAfter:
+    def test_inplace_write_to_frozen_attr_flagged(self):
+        findings = lint("""
+            class Shipper:
+                def ship(self, flat):
+                    self.host_flat = flat  # frozen-after: ship
+
+                def corrupt(self, i, v):
+                    self.host_flat[i] = v
+        """)
+        assert rules_of(findings) == {"frozen-after"}
+
+    def test_rebind_of_frozen_attr_passes(self):
+        findings = lint("""
+            class Shipper:
+                def ship(self, flat):
+                    self.host_flat = flat  # frozen-after: ship
+
+                def reship(self, flat):
+                    self.host_flat = flat
+        """)
+        assert findings == []
+
+    def test_mutator_method_flagged(self):
+        findings = lint("""
+            class Shipper:
+                def ship(self, flat):
+                    self.host_flat = flat  # frozen-after: ship
+
+                def corrupt(self):
+                    self.host_flat.fill(0)
+        """)
+        assert rules_of(findings) == {"frozen-after"}
+
+    def test_frozen_return_mutation_flagged(self):
+        findings = lint("""
+            class Scanner:
+                def scores(self, task):  # frozen-after: scores
+                    return self._cache[task]
+
+            def bad(sc, task, mask):
+                s = sc.scores(task)
+                s[mask] = -1
+                return s
+        """)
+        assert rules_of(findings) == {"frozen-after"}
+
+    def test_frozen_return_copy_passes(self):
+        findings = lint("""
+            class Scanner:
+                def scores(self, task):  # frozen-after: scores
+                    return self._cache[task]
+
+            def good(sc, task, mask):
+                s = sc.scores(task).copy()
+                s[mask] = -1
+                return s
+        """)
+        assert findings == []
+
+    def test_same_line_double_assign_does_not_crash(self):
+        # Two single-target assigns on one physical line once crashed the
+        # bind sort (str/None tuple comparison).
+        findings = lint("""
+            class Scanner:
+                def scores(self, task):  # frozen-after: scores
+                    return self._cache[task]
+
+            def odd(sc, t):
+                s = sc.scores(t); s = None
+                return s
+        """)
+        assert findings == []
+
+    def test_taint_cleared_by_rebind(self):
+        findings = lint("""
+            class Scanner:
+                def scores(self, task):  # frozen-after: scores
+                    return self._cache[task]
+
+            def good(sc, task, mask):
+                s = sc.scores(task)
+                total = s.sum()
+                s = mask.copy()
+                s[0] = total
+                return s
+        """)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# (5) exception-policy
+# ---------------------------------------------------------------------------
+
+class TestExceptionPolicy:
+    def test_silent_swallow_flagged(self):
+        findings = lint("""
+            def f():
+                try:
+                    work()
+                except Exception:
+                    pass
+        """)
+        assert rules_of(findings) == {"exception-policy"}
+
+    def test_bare_except_flagged(self):
+        findings = lint("""
+            def f():
+                try:
+                    work()
+                except:
+                    return None
+        """)
+        assert rules_of(findings) == {"exception-policy"}
+
+    def test_reraise_passes(self):
+        findings = lint("""
+            def f():
+                try:
+                    work()
+                except Exception:
+                    cleanup()
+                    raise
+        """)
+        assert findings == []
+
+    def test_error_counter_passes(self):
+        findings = lint("""
+            def f(metrics):
+                try:
+                    work()
+                except Exception:
+                    metrics.inc_scheduler_loop_error("cycle")
+        """)
+        assert findings == []
+
+    def test_failure_collection_passes(self):
+        findings = lint("""
+            def f(failures):
+                try:
+                    work()
+                except Exception as exc:
+                    failures.append(exc)
+        """)
+        assert findings == []
+
+    def test_allow_swallow_marker_passes(self):
+        findings = lint("""
+            def f():
+                try:
+                    work()
+                except Exception:  # lint: allow-swallow(best-effort probe)
+                    pass
+        """)
+        assert findings == []
+
+    def test_narrow_handler_never_flagged(self):
+        findings = lint("""
+            def f():
+                try:
+                    work()
+                except (OSError, ValueError):
+                    pass
+        """)
+        assert findings == []
+
+
+# ---------------------------------------------------------------------------
+# (6) suppression mechanism + inventory
+# ---------------------------------------------------------------------------
+
+class TestSuppression:
+    SRC = """
+        import threading
+
+        class C:
+            def __init__(self):
+                self.lock = threading.Lock()
+                self.jobs = {}  # guarded-by: lock
+
+            def sanctioned(self, k):
+                return self.jobs.get(k)  # lint: disable=lock-discipline (read-only stats probe)
+    """
+
+    def test_disable_with_reason_suppresses(self):
+        assert lint(self.SRC) == []
+
+    def test_disable_without_reason_does_not_suppress_and_is_flagged(self):
+        src = self.SRC.replace(" (read-only stats probe)", "")
+        findings = lint(src)
+        assert rules_of(findings) == {"lock-discipline", "suppression"}
+
+    def test_trailing_disable_does_not_leak_to_next_line(self):
+        # A marker on the previous CODE line must not swallow this line's
+        # finding; only a comment-only line above suppresses.
+        findings = lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                    self.jobs = {}   # guarded-by: lock
+                    self.nodes = {}  # guarded-by: lock
+
+                def probe(self, k):
+                    a = self.jobs.get(k)  # lint: disable=lock-discipline (probe)
+                    b = self.nodes.get(k)
+                    return a, b
+        """)
+        assert len(findings) == 1
+        assert "nodes" in findings[0].message
+
+    def test_comment_only_line_above_suppresses(self):
+        findings = lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                    self.jobs = {}  # guarded-by: lock
+
+                def probe(self, k):
+                    # lint: disable=lock-discipline (read-only stats probe)
+                    return self.jobs.get(k)
+        """)
+        assert findings == []
+
+    def test_disable_wrong_rule_does_not_suppress(self):
+        src = self.SRC.replace("disable=lock-discipline",
+                               "disable=frozen-after")
+        findings = lint(src)
+        assert "lock-discipline" in rules_of(findings)
+
+    def test_unknown_rule_flagged(self):
+        findings = lint("""
+            x = 1  # lint: disable=no-such-rule (whatever)
+        """)
+        assert rules_of(findings) == {"suppression"}
+
+    def test_allow_swallow_without_reason_flagged(self):
+        findings = lint("""
+            def f():
+                try:
+                    work()
+                except Exception:  # lint: allow-swallow()
+                    pass
+        """)
+        assert "suppression" in rules_of(findings)
+
+    def test_inventory_lists_markers(self):
+        files = [SourceFile("fixture.py", textwrap.dedent(self.SRC))]
+        _findings, markers = run_files(files)
+        kinds = {m.kind for m in markers}
+        assert kinds == {"guarded-by", "disable"}
+        disable = [m for m in markers if m.kind == "disable"][0]
+        assert disable.reason == "read-only stats probe"
+        assert disable.detail == "lock-discipline"
+
+
+class TestCli:
+    def test_cli_inventory_and_exit_codes(self, tmp_path, capsys):
+        from tools.graftlint.__main__ import main
+        bad = tmp_path / "bad.py"
+        bad.write_text(
+            "def f():\n"
+            "    try:\n"
+            "        work()\n"
+            "    except Exception:\n"
+            "        pass\n")
+        assert main([str(bad)]) == 1
+        out = capsys.readouterr().out
+        assert "exception-policy" in out
+        good = tmp_path / "good.py"
+        good.write_text("x = 1\n")
+        assert main([str(good)]) == 0
+        assert main([str(bad), "--inventory"]) == 0
+
+    def test_cli_missing_target_fails_loudly(self, tmp_path, capsys):
+        # A typo'd lint target must not exit green having linted nothing.
+        from tools.graftlint.__main__ import main
+        assert main([str(tmp_path / "no_such_pkg")]) == 2
+        assert "no_such_pkg" in capsys.readouterr().err

@@ -1,0 +1,56 @@
+"""Tier-1 gate: the package has zero unsuppressed graftlint findings.
+
+This is the machine-checked form of the invariants the last two PRs
+documented in comments (delta-ship bit parity, scores() no-mutate,
+donate-after-read, guarded-by locking): ``make lint`` and this test run
+the same suite, so a refactor that breaks a contract fails tier-1 even
+when every behavioral test still passes.
+"""
+
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+from tools.graftlint.core import load_files, run_files  # noqa: E402
+
+LINT_TARGETS = [str(ROOT / "kube_batch_tpu"), str(ROOT / "bench.py")]
+
+
+def _run():
+    return run_files(load_files(LINT_TARGETS))
+
+
+def test_package_is_lint_clean():
+    findings, _markers = _run()
+    assert not findings, (
+        "graftlint found unsuppressed contract violations "
+        "(run `make lint`):\n" + "\n".join(str(f) for f in findings))
+
+
+def test_every_suppression_carries_a_reason():
+    _findings, markers = _run()
+    missing = [m for m in markers
+               if m.kind in ("disable", "allow-swallow") and not m.reason]
+    assert not missing, (
+        "suppressions without a reason string:\n"
+        + "\n".join(str(m) for m in missing))
+
+
+def test_contract_annotations_cover_the_known_invariants():
+    """The annotations this PR exists for must stay present: losing one
+    silently disables its rule for the whole tree."""
+    _findings, markers = _run()
+    by_kind = {}
+    for m in markers:
+        by_kind.setdefault(m.kind, []).append(m)
+    guarded_locks = {m.detail for m in by_kind.get("guarded-by", [])}
+    assert {"mutex", "lock", "_lock", "_seen_lock", "_cache_lock"} <= \
+        guarded_locks, f"guarded-by coverage shrank: {sorted(guarded_locks)}"
+    frozen = {m.detail for m in by_kind.get("frozen-after", [])}
+    assert {"ship", "scores"} <= frozen, \
+        f"frozen-after coverage shrank: {sorted(frozen)}"
+    # The except-audit markers stay greppable.
+    assert len(by_kind.get("allow-swallow", [])) >= 10

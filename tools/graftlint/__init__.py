@@ -1,0 +1,20 @@
+"""graftlint: contract-enforcing static analysis for kube-batch-tpu.
+
+Six repo-specific rules over stdlib ``ast`` (no runtime deps):
+
+1. lock-discipline  — ``# guarded-by:`` / ``# holds-lock:`` annotations
+2. lock-order       — inconsistent nested lock acquisition order
+3. donation-safety  — no read-after-donate of ``donate_argnums`` buffers
+4. tracer-hygiene   — np.*/Python control flow on traced jit params,
+                      non-hashable statics, compile-at-import
+5. frozen-after     — ship/no-mutate contracts on buffers and returns
+6. exception-policy — broad excepts must re-raise, count, or be marked
+                      ``# lint: allow-swallow(<reason>)``
+
+Run: ``python -m tools.graftlint kube_batch_tpu bench.py``
+(``make lint``); ``--inventory`` lists every marker.  doc/LINT.md is the
+catalogue; tests/test_lint_clean.py pins the clean baseline in tier-1.
+"""
+
+from .core import (Finding, Marker, RULES, SourceFile, load_files,  # noqa: F401
+                   run_files, run_paths)

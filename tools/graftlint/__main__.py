@@ -1,0 +1,54 @@
+"""CLI: ``python -m tools.graftlint [paths...] [--inventory]``.
+
+Exit 0 = no unsuppressed findings; exit 1 = findings (printed one per
+line as ``path:line: [rule] message``).  ``--inventory`` prints every
+contract/suppression marker instead (greppable audit trail) and always
+exits 0.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from .core import run_paths
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="graftlint",
+        description="contract-enforcing static analysis for kube-batch-tpu")
+    parser.add_argument("paths", nargs="*", default=["kube_batch_tpu"],
+                        help="files or directories to lint "
+                             "(default: kube_batch_tpu)")
+    parser.add_argument("--inventory", action="store_true",
+                        help="list every annotation/suppression marker "
+                             "instead of linting")
+    args = parser.parse_args(argv)
+    paths = args.paths or ["kube_batch_tpu"]
+
+    try:
+        findings, markers = run_paths(paths)
+    except FileNotFoundError as exc:
+        print(f"graftlint: {exc}", file=sys.stderr)
+        return 2
+    if args.inventory:
+        for marker in markers:
+            print(marker)
+        counts = {}
+        for marker in markers:
+            counts[marker.kind] = counts.get(marker.kind, 0) + 1
+        summary = ", ".join(f"{k}={v}" for k, v in sorted(counts.items()))
+        print(f"-- {len(markers)} markers ({summary or 'none'})")
+        return 0
+
+    for finding in findings:
+        print(finding)
+    if findings:
+        print(f"-- {len(findings)} finding(s)", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

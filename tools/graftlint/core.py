@@ -1,0 +1,393 @@
+"""graftlint core: source model, annotation parsing, suppressions, runner.
+
+The suite enforces contracts that exist only as comments elsewhere in the
+repo (doc/LINT.md is the rule catalogue).  Everything is stdlib ``ast`` +
+``tokenize`` — no runtime dependencies, importable without jax/numpy, so
+``make lint`` runs anywhere the repo checks out.
+
+Annotation grammar (all live in ordinary ``#`` comments):
+
+    # guarded-by: <lock>            field declaration: reads-that-touch-
+                                    contents and all writes of this
+                                    attribute require ``with self.<lock>:``
+                                    (``with <lock>:`` for module globals)
+    # holds-lock: <lock>            on a ``def``: callers must already hold
+                                    <lock>; the body is checked as if the
+                                    lock were held, and *calls* to the
+                                    function outside the lock are flagged
+    # frozen-after: <event>         on an attribute assignment: in-place
+                                    mutation of that attribute anywhere is
+                                    flagged; on a ``def``: the returned
+                                    value must never be mutated by callers
+    # lint: allow-swallow(<reason>) on/inside an ``except Exception`` body:
+                                    the swallow is a reviewed policy choice
+    # lint: disable=<rule> (<reason>)
+                                    suppress <rule> findings on this line
+                                    (or the line directly below a
+                                    comment-only line); reason mandatory
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Tuple
+
+#: Rule identifiers accepted by ``# lint: disable=``.
+RULES = (
+    "lock-discipline",
+    "lock-order",
+    "donation-safety",
+    "tracer-hygiene",
+    "frozen-after",
+    "exception-policy",
+    "suppression",
+)
+
+_GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
+_HOLDS_RE = re.compile(r"#\s*holds-lock:\s*([A-Za-z_][\w.]*)")
+_FROZEN_RE = re.compile(r"#\s*frozen-after:\s*([\w-]+)")
+_ALLOW_RE = re.compile(r"#\s*lint:\s*allow-swallow\(([^)]*)\)")
+_DISABLE_RE = re.compile(r"#\s*lint:\s*disable=([\w-]+)\s*(?:\(([^)]*)\))?")
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+@dataclass
+class Marker:
+    """One greppable suppression/contract marker (the inventory rows)."""
+    kind: str     # guarded-by | holds-lock | frozen-after | allow-swallow | disable
+    detail: str   # lock name, event, rule id...
+    reason: str   # empty for declaration markers
+    path: str
+    line: int
+
+    def __str__(self) -> str:
+        extra = f" reason={self.reason!r}" if self.reason else ""
+        return f"{self.path}:{self.line}: {self.kind}={self.detail}{extra}"
+
+
+class SourceFile:
+    """One parsed module plus its comment-borne annotations."""
+
+    def __init__(self, path: str, text: Optional[str] = None):
+        self.path = path
+        if text is None:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=path)
+        self.comments: Dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    # One comment token per physical line in CPython.
+                    self.comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:
+            pass  # ast.parse succeeded; comments stay best-effort
+
+        self.guarded_by: Dict[int, str] = {}
+        self.holds_lock: Dict[int, str] = {}
+        self.frozen_after: Dict[int, str] = {}
+        self.allow_swallow: Dict[int, str] = {}
+        self.disables: Dict[int, Dict[str, str]] = {}
+        for line, comment in self.comments.items():
+            m = _GUARDED_RE.search(comment)
+            if m:
+                self.guarded_by[line] = m.group(1).split(".")[-1]
+            m = _HOLDS_RE.search(comment)
+            if m:
+                self.holds_lock[line] = m.group(1).split(".")[-1]
+            m = _FROZEN_RE.search(comment)
+            if m:
+                self.frozen_after[line] = m.group(1)
+            m = _ALLOW_RE.search(comment)
+            if m:
+                self.allow_swallow[line] = m.group(1).strip()
+            m = _DISABLE_RE.search(comment)
+            if m:
+                self.disables.setdefault(line, {})[m.group(1)] = (
+                    m.group(2) or "").strip()
+
+    # -- annotation lookups with the "line or line above" convention --------
+
+    def annotation_near(self, table: Dict[int, str], lineno: int,
+                        end_lineno: Optional[int] = None) -> Optional[str]:
+        """Marker on any physical line of the statement, or on a
+        comment-only line directly above it."""
+        for ln in range(lineno, (end_lineno or lineno) + 1):
+            if ln in table:
+                return table[ln]
+        prev = lineno - 1
+        if prev in table and prev in self.comments and 0 < prev <= len(
+                self.lines):
+            if self.lines[prev - 1].strip().startswith("#"):
+                return table[prev]
+        return None
+
+    def markers(self) -> List[Marker]:
+        out: List[Marker] = []
+        for line, lock in sorted(self.guarded_by.items()):
+            out.append(Marker("guarded-by", lock, "", self.path, line))
+        for line, lock in sorted(self.holds_lock.items()):
+            out.append(Marker("holds-lock", lock, "", self.path, line))
+        for line, event in sorted(self.frozen_after.items()):
+            out.append(Marker("frozen-after", event, "", self.path, line))
+        for line, reason in sorted(self.allow_swallow.items()):
+            out.append(Marker("allow-swallow", "exception-policy", reason,
+                              self.path, line))
+        for line, rules in sorted(self.disables.items()):
+            for rule, reason in sorted(rules.items()):
+                out.append(Marker("disable", rule, reason, self.path, line))
+        return out
+
+
+class Context:
+    """Cross-file state shared by the checkers (two-phase run)."""
+
+    def __init__(self):
+        # tracer/donation: name -> [JitInfo] for every jit-wrapped
+        # callable.  A LIST per name: same-named jitted functions in
+        # different files must not mask each other's body checks (the
+        # call-site rules use jit_for_call, which goes conservative on
+        # ambiguous collisions).
+        self.jitted: Dict[str, List["JitInfo"]] = {}
+        # frozen-after registries.
+        self.frozen_attrs: Dict[str, str] = {}   # attr name -> event
+        self.frozen_funcs: Dict[str, str] = {}   # func name -> event
+        # lock-order: (outer, inner) -> first (path, line) observed.
+        self.lock_pairs: Dict[Tuple[str, str], Tuple[str, int]] = {}
+
+
+@dataclass
+class JitInfo:
+    name: str
+    path: str
+    line: int
+    params: List[str] = field(default_factory=list)
+    static_pos: frozenset = frozenset()
+    static_names: frozenset = frozenset()
+    donate_pos: frozenset = frozenset()
+    func: Optional[ast.FunctionDef] = None  # body, when resolvable
+
+    def static_params(self) -> frozenset:
+        names = set(self.static_names)
+        for i in self.static_pos:
+            if i < len(self.params):
+                names.add(self.params[i])
+        return frozenset(names)
+
+    def signature_key(self) -> tuple:
+        return (self.static_pos, self.static_names, self.donate_pos)
+
+
+def jit_for_call(ctx: "Context", name: Optional[str]) -> Optional["JitInfo"]:
+    """The JitInfo a call to ``name`` resolves to for CALL-SITE rules.
+    Unique name -> that info; same-named functions with identical
+    static/donate signatures -> any of them; conflicting signatures ->
+    None (bare-name resolution can't tell which one the call hits, so
+    the call-site rules stay silent rather than guess)."""
+    infos = ctx.jitted.get(name or "")
+    if not infos:
+        return None
+    if len({info.signature_key() for info in infos}) == 1:
+        return infos[0]
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Shared AST helpers
+# ---------------------------------------------------------------------------
+
+def attr_path(node: ast.AST) -> Optional[str]:
+    """Dotted path for Name/Attribute chains ('self.jobs', 'st.host_flat'),
+    None for anything with a non-trivial base."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    """Terminal name of the callee ('ship' for cache.shipper.ship(...))."""
+    func = node.func
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def parent_map(root: ast.AST) -> Dict[ast.AST, ast.AST]:
+    return {child: parent
+            for parent in ast.walk(root)
+            for child in ast.iter_child_nodes(parent)}
+
+
+def use_kind(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> str:
+    """Classify how a Name/Attribute is used.
+
+    'store'   — assignment/del/augassign target (incl. through subscript)
+    'content' — the use touches the object's CONTENTS: subscript base,
+                attribute base (method access), direct call argument,
+                callee, for/comprehension iterable, ``in`` membership test
+    'bare'    — a reference-only load (returned, compared with ``is``,
+                passed inside a wrapping expression, aliased); exempt from
+                lock discipline by design — see doc/LINT.md "limits"
+    """
+    ctx = getattr(node, "ctx", None)
+    if isinstance(ctx, (ast.Store, ast.Del)):
+        return "store"
+    parent = parents.get(node)
+    if parent is None:
+        return "bare"
+    if isinstance(parent, ast.Subscript) and parent.value is node:
+        if isinstance(getattr(parent, "ctx", None), (ast.Store, ast.Del)):
+            return "store"
+        return "content"
+    if isinstance(parent, ast.Attribute) and parent.value is node:
+        return "content"
+    if isinstance(parent, ast.Call):
+        if parent.func is node:
+            return "content"
+        if node in parent.args or node in [k.value for k in parent.keywords]:
+            return "content"
+    if isinstance(parent, ast.For) and parent.iter is node:
+        return "content"
+    if isinstance(parent, ast.comprehension) and parent.iter is node:
+        return "content"
+    if isinstance(parent, ast.Compare) and node in parent.comparators:
+        idx = parent.comparators.index(node)
+        if isinstance(parent.ops[idx], (ast.In, ast.NotIn)):
+            return "content"
+    return "bare"
+
+
+def iter_functions(tree: ast.AST):
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+# ---------------------------------------------------------------------------
+# Runner
+# ---------------------------------------------------------------------------
+
+def iter_py_files(paths: Iterable[str]) -> List[str]:
+    """Expand lint targets to .py files.  A target that is neither an
+    existing directory nor an existing .py file raises: a typo'd path
+    must fail the gate loudly, not lint zero files and exit green."""
+    out: List[str] = []
+    for path in paths:
+        if os.path.isdir(path):
+            for dirpath, dirnames, filenames in os.walk(path):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        elif path.endswith(".py") and os.path.isfile(path):
+            out.append(path)
+        else:
+            raise FileNotFoundError(
+                f"lint target {path!r} is neither a directory nor an "
+                f"existing .py file")
+    return out
+
+
+def load_files(paths: Iterable[str]) -> List[SourceFile]:
+    return [SourceFile(p) for p in iter_py_files(paths)]
+
+
+def run_files(files: List[SourceFile]):
+    """(unsuppressed findings, markers).  Two phases: every checker first
+    collects cross-file registries, then checks each file against them."""
+    from . import donation, exceptions, frozen, locks, tracer
+
+    checkers = (locks, donation, tracer, frozen, exceptions)
+    ctx = Context()
+    for module in checkers:
+        for sf in files:
+            module.collect(sf, ctx)
+    findings: List[Finding] = []
+    for module in checkers:
+        for sf in files:
+            findings.extend(module.check(sf, ctx))
+    findings.extend(locks.order_findings(ctx))
+
+    by_path = {sf.path: sf for sf in files}
+    kept: List[Finding] = []
+    for finding in findings:
+        sf = by_path.get(finding.path)
+        if sf is not None and _suppressed(sf, finding):
+            continue
+        kept.append(finding)
+    for sf in files:
+        kept.extend(_suppression_findings(sf))
+    kept.sort(key=lambda f: (f.path, f.line, f.rule))
+    markers = [m for sf in files for m in sf.markers()]
+    return kept, markers
+
+
+def run_paths(paths: Iterable[str]):
+    return run_files(load_files(paths))
+
+
+def _suppressed(sf: SourceFile, finding: Finding) -> bool:
+    rules = sf.disables.get(finding.line)
+    if rules and finding.rule in rules and rules[finding.rule]:
+        return True
+    # A marker on the line above suppresses ONLY from a comment-only
+    # line (same convention as annotation_near): a trailing marker on
+    # the previous code line must not swallow this line's finding too.
+    prev = finding.line - 1
+    rules = sf.disables.get(prev)
+    if rules and finding.rule in rules and rules[finding.rule] \
+            and 0 < prev <= len(sf.lines) \
+            and sf.lines[prev - 1].strip().startswith("#"):
+        return True
+    return False
+
+
+def _suppression_findings(sf: SourceFile) -> List[Finding]:
+    """The suppression mechanism polices itself: unknown rule ids and
+    reason-less markers are findings (and cannot be suppressed away —
+    a reason-less disable never matches in _suppressed)."""
+    out: List[Finding] = []
+    for line, rules in sorted(sf.disables.items()):
+        for rule, reason in sorted(rules.items()):
+            if rule not in RULES:
+                out.append(Finding(
+                    "suppression", sf.path, line,
+                    f"disable={rule} names no known rule "
+                    f"(known: {', '.join(RULES)})"))
+            if not reason:
+                out.append(Finding(
+                    "suppression", sf.path, line,
+                    f"disable={rule} carries no reason string — write "
+                    f"`# lint: disable={rule} (<why>)`"))
+    for line, reason in sorted(sf.allow_swallow.items()):
+        if not reason:
+            out.append(Finding(
+                "suppression", sf.path, line,
+                "allow-swallow() carries no reason string — write "
+                "`# lint: allow-swallow(<why>)`"))
+    return out

@@ -1,0 +1,119 @@
+"""Rule (2) donation-safety.
+
+``donate_argnums`` hands the argument's device buffer to XLA: after the
+call the caller's reference is a use-after-free (JAX surfaces it as a
+``deleted buffer`` error at best, silent garbage via aliasing at worst).
+The checker finds every call to a donating jitted callable (registry
+built by tracer.py's collect pass) and, for each donated argument that is
+a plain name or dotted path, flags:
+
+* any later read of that path in the same function, unless a rebind of
+  the exact path intervenes first — assigning the call's result back to
+  the donated path (``st.buf = f(st.buf, ...)``) is the sanctioned
+  pattern and is what models/shipping.py's scatter does;
+* a donating call inside a loop whose donated path is never rebound in
+  the function — iteration 2 would re-donate a dead buffer.
+
+Line-granular and syntactic: aliases (``tmp = st.buf``) are not tracked;
+the rule is scoped to the direct-path reads that caused ADVICE-class
+bugs.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from .core import (Context, Finding, SourceFile, attr_path, call_name,
+                   iter_functions, jit_for_call)
+
+RULE = "donation-safety"
+
+
+def collect(sf: SourceFile, ctx: Context) -> None:
+    pass  # uses ctx.jitted from tracer.collect
+
+
+def check(sf: SourceFile, ctx: Context) -> List[Finding]:
+    if not any(info.donate_pos for infos in ctx.jitted.values()
+               for info in infos):
+        return []
+    findings: List[Finding] = []
+    for fn in iter_functions(sf.tree):
+        findings.extend(_check_function(sf, fn, ctx))
+    return findings
+
+
+def _path_events(fn: ast.AST, path: str) -> List[Tuple[int, str]]:
+    """Sorted (lineno, 'load'|'store') events for exact-path references."""
+    events: List[Tuple[int, str]] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, (ast.Name, ast.Attribute)):
+            continue
+        if attr_path(node) != path:
+            continue
+        ctx = getattr(node, "ctx", None)
+        kind = "store" if isinstance(ctx, (ast.Store, ast.Del)) else "load"
+        events.append((node.lineno, kind))
+    events.sort()
+    return events
+
+
+def _enclosing_loop(fn: ast.AST, target: ast.AST) -> Optional[ast.AST]:
+    loops = [n for n in ast.walk(fn)
+             if isinstance(n, (ast.For, ast.AsyncFor, ast.While))
+             and n.lineno <= target.lineno
+             and (getattr(n, "end_lineno", n.lineno) or n.lineno)
+             >= target.lineno]
+    return loops[-1] if loops else None
+
+
+def _check_function(sf: SourceFile, fn, ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.Call):
+            continue
+        info = jit_for_call(ctx, call_name(node))
+        if info is None or not info.donate_pos:
+            continue
+        for pos in sorted(info.donate_pos):
+            if pos >= len(node.args):
+                continue
+            path = attr_path(node.args[pos])
+            if path is None:
+                continue  # expression argument: nothing nameable to reread
+            events = _path_events(fn, path)
+            call_line = node.lineno
+            # The donated-arg load at the call itself is not a violation.
+            later = [(ln, kind) for ln, kind in events if ln > call_line]
+            rebound_lines = [ln for ln, kind in events
+                             if kind == "store" and ln >= call_line]
+            for ln, kind in later:
+                if kind != "load":
+                    continue
+                if any(store_ln <= ln for store_ln in rebound_lines):
+                    break  # rebound before this read: reads see a live value
+                findings.append(Finding(
+                    RULE, sf.path, ln,
+                    f"{path} was donated to jitted {info.name} at line "
+                    f"{call_line} (donate_argnums={pos}) and read again "
+                    f"here — use-after-donate; rebind the result to "
+                    f"{path} or copy before the call"))
+                break  # one finding per donated arg is enough
+            loop = _enclosing_loop(fn, node)
+            if loop is not None:
+                # Any store within the loop body counts: a buffer built
+                # fresh each iteration (store before the call) is as live
+                # on iteration 2 as a rebind from the call's result.
+                loop_end = getattr(loop, "end_lineno", loop.lineno) or \
+                    loop.lineno
+                rebound_in_loop = any(
+                    loop.lineno <= ln <= loop_end
+                    for ln, kind in events if kind == "store")
+                if not rebound_in_loop:
+                    findings.append(Finding(
+                        RULE, sf.path, call_line,
+                        f"{path} is donated to jitted {info.name} inside "
+                        f"a loop and never rebound in the loop — the "
+                        f"second iteration donates a dead buffer"))
+    return findings

@@ -1,0 +1,116 @@
+"""Rule (5) exception-policy.
+
+Broad handlers (``except Exception``, bare ``except``, ``except
+BaseException``, or a tuple containing one of those) may not silently
+swallow.  A handler is compliant when it does at least one of:
+
+* re-raises (any ``raise`` in the handler body);
+* makes the failure countable — calls something whose dotted name
+  mentions an error/failure/swallow counter (``inc_scheduler_loop_error``,
+  ``metrics.note_swallowed``, ``_log_cycle_error``...), or appends/extends
+  a collection whose name mentions errors/failures (``failures.append``,
+  ``self.errors.append``);
+* carries an explicit ``# lint: allow-swallow(<reason>)`` marker on the
+  ``except`` line or inside the handler body — the reviewed "this swallow
+  is policy" escape hatch, inventoried by ``--inventory``.
+
+Narrow handlers (``except ValueError`` etc.) are never flagged: naming
+the exception type IS the policy.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .core import Context, Finding, SourceFile
+
+RULE = "exception-policy"
+
+_COUNTER_HINTS = ("error", "fail", "swallow")
+_SINK_METHODS = {"append", "extend", "add", "inc", "put", "record"}
+
+
+def collect(sf: SourceFile, ctx: Context) -> None:
+    pass
+
+
+def check(sf: SourceFile, ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        if not _is_broad(node.type):
+            continue
+        if _has_raise(node) or _counts_failure(node):
+            continue
+        if _allow_marker(sf, node) is not None:
+            continue
+        caught = "bare except" if node.type is None else "except Exception"
+        findings.append(Finding(
+            RULE, sf.path, node.lineno,
+            f"{caught} swallows silently — re-raise, count it (an "
+            f"*error*/*fail* counter or collection), or mark the policy "
+            f"with `# lint: allow-swallow(<reason>)`"))
+    return findings
+
+
+def _is_broad(type_expr: Optional[ast.AST]) -> bool:
+    if type_expr is None:
+        return True
+    if isinstance(type_expr, ast.Name):
+        return type_expr.id in ("Exception", "BaseException")
+    if isinstance(type_expr, ast.Tuple):
+        return any(_is_broad(e) for e in type_expr.elts)
+    return False
+
+
+def _has_raise(handler: ast.ExceptHandler) -> bool:
+    return any(isinstance(n, ast.Raise) for n in ast.walk(handler))
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts)).lower()
+
+
+def _counts_failure(handler: ast.ExceptHandler) -> bool:
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Call):
+            name = _dotted(node.func)
+            if name and any(hint in name for hint in _COUNTER_HINTS):
+                return True
+            # collection sink: <something err/fail-named>.append(...) etc.
+            if isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _SINK_METHODS:
+                target = _dotted(node.func.value)
+                if any(hint in target for hint in ("err", "fail")):
+                    return True
+        elif isinstance(node, ast.Assign):
+            # Recording the failure under an error-named key/name (the
+            # bench artifact pattern: out["stages_error"] = ...) makes it
+            # visible — that satisfies the policy too.
+            for target in node.targets:
+                text = _dotted(target)
+                if isinstance(target, ast.Subscript):
+                    text = _dotted(target.value)
+                    key = target.slice
+                    if isinstance(key, ast.Constant) and isinstance(
+                            key.value, str):
+                        text += "." + key.value.lower()
+                if text and any(h in text for h in ("error", "fail")):
+                    return True
+    return False
+
+
+def _allow_marker(sf: SourceFile, handler: ast.ExceptHandler):
+    end = getattr(handler, "end_lineno", handler.lineno) or handler.lineno
+    for line in range(handler.lineno, end + 1):
+        if line in sf.allow_swallow:
+            return sf.allow_swallow[line]
+    return None

@@ -1,0 +1,176 @@
+"""Rule (4) ship/no-mutate contracts (frozen-after).
+
+``# frozen-after: <event>`` declares that past the named event a value
+is an immutable image other machinery depends on:
+
+* On an attribute assignment (``st.host_flat = flat  # frozen-after:
+  ship``): the attribute name is registered globally, and any in-place
+  mutation of a matching attribute path anywhere in the tree —
+  ``x.host_flat[...] = v``, ``x.host_flat += v``, ``x.host_flat.fill(v)``,
+  ``.sort()`` and friends — is flagged.  Plain rebinding stays legal:
+  replacing the image is the sanctioned update, mutating it corrupts
+  dirty-block detection silently.
+* On a ``def`` (``def scores(...):  # frozen-after: scores``): every
+  caller-side name bound from a ``.scores(...)`` call is tracked within
+  its function, and in-place mutation of that name after the binding is
+  flagged (the live-view contract of ADVICE r5 #3, machine-checked).
+
+Intentional interior mutation (the cache-patch path inside the owner)
+stays possible via ``# lint: disable=frozen-after (<reason>)``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+from .core import (Context, Finding, SourceFile, attr_path, call_name,
+                   iter_functions)
+
+RULE = "frozen-after"
+
+_MUTATORS = {"fill", "sort", "put", "resize", "itemset", "partition",
+             "byteswap", "setflags"}
+
+
+def collect(sf: SourceFile, ctx: Context) -> None:
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            event = sf.annotation_near(sf.frozen_after, node.lineno)
+            if event:
+                ctx.frozen_funcs[node.name] = event
+        elif isinstance(node, (ast.Assign, ast.AnnAssign)):
+            event = sf.annotation_near(sf.frozen_after, node.lineno,
+                                       getattr(node, "end_lineno", None))
+            if not event:
+                continue
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Attribute):
+                    ctx.frozen_attrs[t.attr] = event
+
+
+def check(sf: SourceFile, ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    if ctx.frozen_attrs:
+        findings.extend(_check_attrs(sf, ctx))
+    if ctx.frozen_funcs:
+        for fn in iter_functions(sf.tree):
+            findings.extend(_check_frozen_returns(sf, ctx, fn))
+    return findings
+
+
+def _terminal_attr(node: ast.AST):
+    return node.attr if isinstance(node, ast.Attribute) else None
+
+
+def _check_attrs(sf: SourceFile, ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                base = t.value if isinstance(t, ast.Subscript) else (
+                    t if isinstance(node, ast.AugAssign) else None)
+                attr = _terminal_attr(base) if base is not None else None
+                if attr in ctx.frozen_attrs:
+                    op = ("augmented assignment"
+                          if isinstance(node, ast.AugAssign)
+                          else "subscript write")
+                    findings.append(Finding(
+                        RULE, sf.path, node.lineno,
+                        f".{attr} is frozen-after: "
+                        f"{ctx.frozen_attrs[attr]} — in-place {op} "
+                        f"violates the no-mutate contract (rebind or "
+                        f"copy instead)"))
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if (isinstance(func, ast.Attribute) and func.attr in _MUTATORS):
+                attr = _terminal_attr(func.value)
+                if attr in ctx.frozen_attrs:
+                    findings.append(Finding(
+                        RULE, sf.path, node.lineno,
+                        f".{attr} is frozen-after: "
+                        f"{ctx.frozen_attrs[attr]} — .{func.attr}() "
+                        f"mutates in place (copy first)"))
+    return findings
+
+
+def _check_frozen_returns(sf: SourceFile, ctx: Context, fn) -> List[Finding]:
+    """Track names bound from frozen-returning calls; flag later in-place
+    mutation.  Line-ordered: a rebind from a non-frozen source clears the
+    taint for subsequent lines."""
+    findings: List[Finding] = []
+    # name -> list of (line, frozen_event|None) assignment events
+    binds: Dict[str, List] = {}
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            value = node.value
+            event = None
+            if isinstance(value, ast.Call):
+                event = ctx.frozen_funcs.get(call_name(value) or "")
+            binds.setdefault(node.targets[0].id, []).append(
+                (node.lineno, event))
+    for name in binds:
+        # Key on the line only: the event field mixes str and None, which
+        # tuple comparison would crash on when one line assigns twice.
+        binds[name].sort(key=lambda e: e[0])
+
+    def frozen_at(name: str, line: int):
+        last = None
+        for ln, event in binds.get(name, ()):
+            if ln <= line:
+                last = event
+            else:
+                break
+        return last
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and isinstance(
+                        t.value, ast.Name):
+                    event = frozen_at(t.value.id, node.lineno)
+                    if event:
+                        findings.append(Finding(
+                            RULE, sf.path, node.lineno,
+                            f"{t.value.id} holds a frozen-after: {event} "
+                            f"return value — subscript write mutates the "
+                            f"shared cached array (copy it first)"))
+                elif (isinstance(t, ast.Subscript)
+                      and isinstance(t.value, ast.Call)
+                      and ctx.frozen_funcs.get(
+                          call_name(t.value) or "")):
+                    findings.append(Finding(
+                        RULE, sf.path, node.lineno,
+                        f"writing into the return of frozen-after "
+                        f"function {call_name(t.value)}() — the value is "
+                        f"a live cached view (copy it first)"))
+        elif isinstance(node, ast.AugAssign):
+            target = node.target
+            base = target.value if isinstance(target,
+                                              ast.Subscript) else target
+            if isinstance(base, ast.Name):
+                event = frozen_at(base.id, node.lineno)
+                if event:
+                    findings.append(Finding(
+                        RULE, sf.path, node.lineno,
+                        f"{base.id} holds a frozen-after: {event} return "
+                        f"value — augmented assignment mutates the shared "
+                        f"cached array (copy it first)"))
+        elif isinstance(node, ast.Call):
+            func = node.func
+            if (isinstance(func, ast.Attribute)
+                    and func.attr in _MUTATORS
+                    and isinstance(func.value, ast.Name)):
+                event = frozen_at(func.value.id, node.lineno)
+                if event:
+                    findings.append(Finding(
+                        RULE, sf.path, node.lineno,
+                        f"{func.value.id} holds a frozen-after: {event} "
+                        f"return value — .{func.attr}() mutates in place "
+                        f"(copy it first)"))
+    return findings

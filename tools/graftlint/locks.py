@@ -1,0 +1,308 @@
+"""Rule (1) lock-discipline + lock-order.
+
+``# guarded-by: <lock>`` on an attribute assignment (usually in
+``__init__``) declares that the field's contents are protected by
+``self.<lock>`` — any store, or any read that touches contents (subscript,
+method access, direct call argument, iteration, ``in`` test), outside a
+``with self.<lock>:`` scope in the same class is flagged.  Bare
+reference loads (``t = self._thread``, ``x is None`` checks) are exempt:
+they are the documented safe idioms (local-copy publish, double-checked
+init).  Module-level globals annotate the same way and check against
+``with <lock>:``.
+
+``# holds-lock: <lock>`` on a ``def`` declares a caller-holds-the-lock
+precondition: the body is analyzed with the lock held, and every call of
+the method from the same class outside the lock is flagged — the
+annotation is sound in both directions.
+
+lock-order: every textually nested acquisition records an (outer, inner)
+pair keyed by ``Class.lockname``; observing both (A, B) and (B, A)
+anywhere across the tree is a deadlock-shaped inconsistency and is
+reported once per unordered pair.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import Context, Finding, SourceFile, parent_map, use_kind
+
+RULE = "lock-discipline"
+ORDER_RULE = "lock-order"
+
+# Object construction happens-before sharing: the instance is not yet
+# visible to other threads inside these, so stores there are exempt.
+_CTOR_NAMES = {"__init__", "__new__", "__post_init__", "__init_subclass__"}
+
+
+def collect(sf: SourceFile, ctx: Context) -> None:
+    pass  # lock pairs are recorded during check() — single pass suffices
+
+
+def check(sf: SourceFile, ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    module_guarded = _module_guarded_fields(sf)
+    # Module-level functions support holds-lock the same way methods do:
+    # the body checks as locked, and bare calls from other module-level
+    # code are flagged.
+    module_holds: Dict[str, str] = {}
+    for node in sf.tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            lock = sf.annotation_near(sf.holds_lock, node.lineno)
+            if lock:
+                module_holds[node.name] = lock
+    for node in sf.tree.body:
+        if isinstance(node, ast.ClassDef):
+            findings.extend(_check_class(sf, ctx, node, module_guarded))
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            initial = set()
+            lock = module_holds.get(node.name)
+            if lock:
+                initial.add(lock)
+            findings.extend(_check_function(
+                sf, ctx, node, fields={}, module_fields=module_guarded,
+                holds=initial, scope=f"{_modname(sf)}",
+                module_holds=module_holds))
+    return findings
+
+
+def order_findings(ctx: Context) -> List[Finding]:
+    out: List[Finding] = []
+    seen: Set[frozenset] = set()
+    for (outer, inner), (path, line) in sorted(ctx.lock_pairs.items()):
+        if (inner, outer) not in ctx.lock_pairs:
+            continue
+        key = frozenset((outer, inner))
+        if key in seen:
+            continue
+        seen.add(key)
+        other_path, other_line = ctx.lock_pairs[(inner, outer)]
+        out.append(Finding(
+            ORDER_RULE, path, line,
+            f"inconsistent lock order: {outer} -> {inner} here but "
+            f"{inner} -> {outer} at {other_path}:{other_line} — pick one "
+            f"global order or drop one nesting"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+
+def _modname(sf: SourceFile) -> str:
+    import os
+    return os.path.splitext(os.path.basename(sf.path))[0]
+
+
+def _module_guarded_fields(sf: SourceFile) -> Dict[str, str]:
+    fields: Dict[str, str] = {}
+    for node in sf.tree.body:
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        lock = sf.annotation_near(sf.guarded_by, node.lineno,
+                                  getattr(node, "end_lineno", None))
+        if not lock:
+            continue
+        for t in targets:
+            if isinstance(t, ast.Name):
+                fields[t.id] = lock
+    return fields
+
+
+def _class_guarded_fields(sf: SourceFile, cls: ast.ClassDef) -> Dict[str, str]:
+    """attr -> lock, from annotated ``self.<attr> = ...`` statements in any
+    method, or annotated ``attr: T`` declarations in the class body."""
+    fields: Dict[str, str] = {}
+    for node in ast.walk(cls):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+        elif isinstance(node, ast.AnnAssign):
+            targets = [node.target]
+        else:
+            continue
+        lock = sf.annotation_near(sf.guarded_by, node.lineno,
+                                  getattr(node, "end_lineno", None))
+        if not lock:
+            continue
+        for t in targets:
+            if (isinstance(t, ast.Attribute) and isinstance(t.value, ast.Name)
+                    and t.value.id == "self"):
+                fields[t.attr] = lock
+            elif isinstance(t, ast.Name) and node in cls.body:
+                fields[t.id] = lock
+    return fields
+
+
+def _holds_methods(sf: SourceFile, cls: ast.ClassDef) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+    for node in cls.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            lock = sf.annotation_near(sf.holds_lock, node.lineno)
+            if lock:
+                out[node.name] = lock
+    return out
+
+
+def _check_class(sf: SourceFile, ctx: Context, cls: ast.ClassDef,
+                 module_fields: Dict[str, str]) -> List[Finding]:
+    fields = _class_guarded_fields(sf, cls)
+    holds = _holds_methods(sf, cls)
+    findings: List[Finding] = []
+    for node in cls.body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if node.name in _CTOR_NAMES:
+            continue
+        initial = set()
+        lock = sf.annotation_near(sf.holds_lock, node.lineno)
+        if lock:
+            initial.add(lock)
+        findings.extend(_check_function(
+            sf, ctx, node, fields=fields, module_fields=module_fields,
+            holds=initial, scope=cls.name, holds_methods=holds))
+    return findings
+
+
+def _lock_of(expr: ast.AST) -> Optional[str]:
+    """'mutex' for ``with self.mutex:``, '_seen_lock' for module locks,
+    'cluster.lock' for foreign-object locks (order tracking only)."""
+    if isinstance(expr, ast.Attribute):
+        if isinstance(expr.value, ast.Name):
+            if expr.value.id == "self":
+                return expr.attr
+            return f"{expr.value.id}.{expr.attr}"
+        return expr.attr
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return None
+
+
+def _looks_like_lock(name: Optional[str]) -> bool:
+    return bool(name) and ("lock" in name.lower() or "mutex" in name.lower())
+
+
+def _check_function(sf: SourceFile, ctx: Context, fn, fields, module_fields,
+                    holds: Set[str], scope: str,
+                    holds_methods: Optional[Dict[str, str]] = None,
+                    module_holds: Optional[Dict[str, str]] = None
+                    ) -> List[Finding]:
+    findings: List[Finding] = []
+    parents = parent_map(fn)
+    holds_methods = holds_methods or {}
+    module_holds = module_holds or {}
+    # Names known to BE guards from annotations: a `with` on one of these
+    # counts as holding it even when the name itself doesn't look
+    # lock-ish (e.g. `_lk`); the name heuristic only extends coverage to
+    # unannotated foreign locks for order tracking.
+    known_guards = (set(fields.values()) | set(module_fields.values())
+                    | set(holds_methods.values())
+                    | set(module_holds.values()) | set(holds))
+
+    def check_expr_tree(node: ast.AST, held: Set[str]) -> None:
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Attribute)
+                    and isinstance(sub.value, ast.Name)
+                    and sub.value.id == "self" and sub.attr in fields):
+                lock = fields[sub.attr]
+                if lock in held:
+                    continue
+                kind = use_kind(sub, parents)
+                if kind in ("store", "content"):
+                    findings.append(Finding(
+                        RULE, sf.path, sub.lineno,
+                        f"{scope}.{sub.attr} is guarded-by {lock} but "
+                        f"this {_kind_word(kind)} runs outside "
+                        f"`with self.{lock}:` (in {fn.name})"))
+            elif isinstance(sub, ast.Name) and sub.id in module_fields:
+                lock = module_fields[sub.id]
+                if lock in held:
+                    continue
+                kind = use_kind(sub, parents)
+                if kind in ("store", "content"):
+                    findings.append(Finding(
+                        RULE, sf.path, sub.lineno,
+                        f"module global {sub.id} is guarded-by {lock} but "
+                        f"this {_kind_word(kind)} runs outside "
+                        f"`with {lock}:` (in {fn.name})"))
+            elif (isinstance(sub, ast.Call)
+                  and isinstance(sub.func, ast.Attribute)
+                  and isinstance(sub.func.value, ast.Name)
+                  and sub.func.value.id == "self"
+                  and sub.func.attr in holds_methods):
+                lock = holds_methods[sub.func.attr]
+                if lock not in held:
+                    findings.append(Finding(
+                        RULE, sf.path, sub.lineno,
+                        f"self.{sub.func.attr}() declares holds-lock: "
+                        f"{lock} but is called outside `with self.{lock}:` "
+                        f"(in {fn.name})"))
+            elif (isinstance(sub, ast.Call)
+                  and isinstance(sub.func, ast.Name)
+                  and sub.func.id in module_holds):
+                lock = module_holds[sub.func.id]
+                if lock not in held:
+                    findings.append(Finding(
+                        RULE, sf.path, sub.lineno,
+                        f"{sub.func.id}() declares holds-lock: {lock} "
+                        f"but is called outside `with {lock}:` "
+                        f"(in {fn.name})"))
+
+    def scan_block(stmts, held: Set[str]) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                acquired: List[str] = []
+                for item in stmt.items:
+                    check_expr_tree(item.context_expr, held)
+                    name = _lock_of(item.context_expr)
+                    if name and (name in known_guards
+                                 or _looks_like_lock(name)):
+                        acquired.append(name)
+                new_held = set(held)
+                for name in acquired:
+                    inner = _qualify(scope, name)
+                    for outer_name in new_held:
+                        outer = _qualify(scope, outer_name)
+                        if outer != inner:
+                            ctx.lock_pairs.setdefault(
+                                (outer, inner), (sf.path, stmt.lineno))
+                    new_held.add(name)
+                scan_block(stmt.body, new_held)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # A closure may escape and run later, off-lock: analyze
+                # its body with nothing held (conservative).
+                scan_block(stmt.body, set())
+            elif isinstance(stmt, (ast.If, ast.While)):
+                check_expr_tree(stmt.test, held)
+                scan_block(stmt.body, held)
+                scan_block(stmt.orelse, held)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                check_expr_tree(stmt.target, held)
+                check_expr_tree(stmt.iter, held)
+                scan_block(stmt.body, held)
+                scan_block(stmt.orelse, held)
+            elif isinstance(stmt, ast.Try):
+                scan_block(stmt.body, held)
+                for handler in stmt.handlers:
+                    scan_block(handler.body, held)
+                scan_block(stmt.orelse, held)
+                scan_block(stmt.finalbody, held)
+            elif isinstance(stmt, ast.ClassDef):
+                continue
+            else:
+                check_expr_tree(stmt, held)
+
+    scan_block(fn.body, set(holds))
+    return findings
+
+
+def _qualify(scope: str, lock: str) -> str:
+    return lock if "." in lock else f"{scope}.{lock}"
+
+
+def _kind_word(kind: str) -> str:
+    return "write" if kind == "store" else "content access"

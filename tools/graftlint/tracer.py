@@ -1,0 +1,274 @@
+"""Rule (3) tracer/jit hygiene.
+
+For every ``jax.jit``-wrapped callable in the tree (decorator form,
+``functools.partial(jax.jit, ...)`` decorator form, and the wrap form
+``name = functools.partial(jax.jit, ...)(fn)`` / ``name = jax.jit(fn)``):
+
+* Python ``if``/``while``/``for`` control flow on a TRACED parameter in
+  the jitted body (including closures, which trace too) — flags the
+  ConcretizationTypeError class of bug at lint time.  References through
+  ``.shape``/``.ndim``/``.dtype``/``.size``/``.aval`` or ``len()`` are
+  static information and exempt; ``static_argnums``/``static_argnames``
+  parameters are exempt everywhere.
+* ``np.*`` / ``numpy.*`` calls whose arguments reference a traced
+  parameter — numpy silently forces the tracer to concretize (or traces
+  wrong); device code must use jnp/lax.
+* Non-hashable literals (list/dict/set/comprehension) passed in a static
+  argument position at any call site — jit raises at runtime; the padded
+  layout keys must stay tuples.
+* Module-level invocation of a jitted callable — an XLA compile at import
+  time, the exact cold-start failure mode ops/compile_cache.py exists to
+  prevent.
+
+This module also owns jit-signature parsing; ctx.jitted feeds the
+donation-safety rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from .core import (Context, Finding, JitInfo, SourceFile, call_name,
+                   iter_functions, jit_for_call)
+
+RULE = "tracer-hygiene"
+
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "itemsize"}
+_NONHASHABLE = (ast.List, ast.Dict, ast.Set, ast.ListComp, ast.DictComp,
+                ast.SetComp, ast.GeneratorExp)
+
+
+# ---------------------------------------------------------------------------
+# collect: find every jitted callable and its static/donated signature
+# ---------------------------------------------------------------------------
+
+def collect(sf: SourceFile, ctx: Context) -> None:
+    defs = {node.name: node for node in ast.walk(sf.tree)
+            if isinstance(node, ast.FunctionDef)}
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.FunctionDef):
+            for deco in node.decorator_list:
+                info = _parse_jit_expr(deco)
+                if info is not None:
+                    info.name = node.name
+                    info.path = sf.path
+                    info.line = node.lineno
+                    info.params = [a.arg for a in node.args.args]
+                    info.func = node
+                    ctx.jitted.setdefault(node.name, []).append(info)
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            if not isinstance(target, ast.Name):
+                continue
+            info, wrapped = _parse_jit_wrap(node.value)
+            if info is None:
+                continue
+            info.name = target.id
+            info.path = sf.path
+            info.line = node.lineno
+            fn = defs.get(wrapped) if wrapped else None
+            if fn is not None:
+                info.params = [a.arg for a in fn.args.args]
+                info.func = fn
+            ctx.jitted.setdefault(target.id, []).append(info)
+
+
+def _is_jax_jit(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Attribute) and expr.attr == "jit":
+        return True
+    return isinstance(expr, ast.Name) and expr.id == "jit"
+
+
+def _int_elts(expr: Optional[ast.AST]) -> frozenset:
+    if expr is None:
+        return frozenset()
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, int):
+        return frozenset((expr.value,))
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return frozenset(e.value for e in expr.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, int))
+    return frozenset()
+
+
+def _str_elts(expr: Optional[ast.AST]) -> frozenset:
+    if expr is None:
+        return frozenset()
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return frozenset((expr.value,))
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return frozenset(e.value for e in expr.elts
+                         if isinstance(e, ast.Constant)
+                         and isinstance(e.value, str))
+    return frozenset()
+
+
+def _parse_jit_expr(expr: ast.AST) -> Optional[JitInfo]:
+    """JitInfo for ``jax.jit`` / ``functools.partial(jax.jit, **kw)`` /
+    ``jax.jit(..., **kw)`` decorator expressions, else None."""
+    if _is_jax_jit(expr):
+        return JitInfo(name="", path="", line=0)
+    if not isinstance(expr, ast.Call):
+        return None
+    callee = expr.func
+    is_partial = (isinstance(callee, ast.Attribute)
+                  and callee.attr == "partial") or (
+        isinstance(callee, ast.Name) and callee.id == "partial")
+    if is_partial:
+        if not (expr.args and _is_jax_jit(expr.args[0])):
+            return None
+    elif not _is_jax_jit(callee):
+        return None
+    kw = {k.arg: k.value for k in expr.keywords}
+    return JitInfo(
+        name="", path="", line=0,
+        static_pos=_int_elts(kw.get("static_argnums")),
+        static_names=_str_elts(kw.get("static_argnames")),
+        donate_pos=_int_elts(kw.get("donate_argnums")))
+
+
+def _parse_jit_wrap(expr: ast.AST):
+    """(JitInfo, wrapped_fn_name) for ``partial(jax.jit, ...)(fn)`` and
+    ``jax.jit(fn, ...)`` value expressions, else (None, None)."""
+    if not isinstance(expr, ast.Call):
+        return None, None
+    # partial(jax.jit, ...)(fn)
+    inner = _parse_jit_expr(expr.func)
+    if inner is not None and isinstance(expr.func, ast.Call):
+        wrapped = expr.args[0].id if (
+            expr.args and isinstance(expr.args[0], ast.Name)) else None
+        return inner, wrapped
+    # jax.jit(fn, static_argnums=...)
+    if _is_jax_jit(expr.func) and expr.args:
+        info = JitInfo(name="", path="", line=0)
+        kw = {k.arg: k.value for k in expr.keywords}
+        info.static_pos = _int_elts(kw.get("static_argnums"))
+        info.static_names = _str_elts(kw.get("static_argnames"))
+        info.donate_pos = _int_elts(kw.get("donate_argnums"))
+        wrapped = expr.args[0].id if isinstance(expr.args[0],
+                                                ast.Name) else None
+        return info, wrapped
+    return None, None
+
+
+# ---------------------------------------------------------------------------
+# check
+# ---------------------------------------------------------------------------
+
+def check(sf: SourceFile, ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for infos in ctx.jitted.values():
+        for info in infos:
+            if info.path == sf.path and info.func is not None:
+                findings.extend(_check_body(sf, info))
+    findings.extend(_check_call_sites(sf, ctx))
+    findings.extend(_check_module_level(sf, ctx))
+    return findings
+
+
+def _contains_traced(expr: ast.AST, traced: Set[str]) -> Optional[str]:
+    """Name of a traced param referenced by ``expr`` outside the static
+    escape hatches (.shape/.dtype/..., len()), or None."""
+    if isinstance(expr, ast.Attribute) and expr.attr in _STATIC_ATTRS:
+        return None  # x.shape[...] etc: static info, prune the subtree
+    if (isinstance(expr, ast.Call) and isinstance(expr.func, ast.Name)
+            and expr.func.id == "len"):
+        return None  # len(traced) is the static leading dim
+    if isinstance(expr, ast.Name):
+        return expr.id if expr.id in traced else None
+    for child in ast.iter_child_nodes(expr):
+        hit = _contains_traced(child, traced)
+        if hit:
+            return hit
+    return None
+
+
+def _check_body(sf: SourceFile, info: JitInfo) -> List[Finding]:
+    findings: List[Finding] = []
+    traced = set(info.params) - set(info.static_params())
+    if info.func is None or not traced:
+        return findings
+    for node in ast.walk(info.func):
+        if isinstance(node, (ast.If, ast.While)):
+            hit = _contains_traced(node.test, traced)
+            if hit:
+                kw = "if" if isinstance(node, ast.If) else "while"
+                findings.append(Finding(
+                    RULE, sf.path, node.lineno,
+                    f"Python `{kw}` on traced parameter {hit!r} inside "
+                    f"jitted {info.name} — concretizes a tracer; use "
+                    f"lax.cond/jnp.where or make the arg static"))
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            hit = _contains_traced(node.iter, traced)
+            if hit:
+                findings.append(Finding(
+                    RULE, sf.path, node.lineno,
+                    f"Python `for` over traced parameter {hit!r} inside "
+                    f"jitted {info.name} — unrolls/concretizes; use "
+                    f"lax.fori_loop/scan or iterate static structure"))
+        elif isinstance(node, ast.Call):
+            root = node.func
+            while isinstance(root, ast.Attribute):
+                root = root.value
+            if isinstance(root, ast.Name) and root.id in ("np", "numpy"):
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    hit = _contains_traced(arg, traced)
+                    if hit:
+                        findings.append(Finding(
+                            RULE, sf.path, node.lineno,
+                            f"numpy call on traced parameter {hit!r} "
+                            f"inside jitted {info.name} — numpy "
+                            f"concretizes tracers; use jnp"))
+                        break
+    return findings
+
+
+def _check_call_sites(sf: SourceFile, ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        info = jit_for_call(ctx, call_name(node))
+        if info is None:
+            continue
+        for i, arg in enumerate(node.args):
+            static = i in info.static_pos or (
+                i < len(info.params) and info.params[i] in info.static_names)
+            if static and isinstance(arg, _NONHASHABLE):
+                findings.append(Finding(
+                    RULE, sf.path, node.lineno,
+                    f"non-hashable literal in static argument {i} of "
+                    f"jitted {info.name} — jit requires hashable statics "
+                    f"(use a tuple)"))
+        for kwarg in node.keywords:
+            if kwarg.arg in info.static_names and isinstance(
+                    kwarg.value, _NONHASHABLE):
+                findings.append(Finding(
+                    RULE, sf.path, node.lineno,
+                    f"non-hashable literal for static argument "
+                    f"{kwarg.arg!r} of jitted {info.name} (use a tuple)"))
+    return findings
+
+
+def _check_module_level(sf: SourceFile, ctx: Context) -> List[Finding]:
+    """Calls to jitted callables at module scope compile at import."""
+    findings: List[Finding] = []
+
+    def scan(expr: ast.AST) -> None:
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                name = call_name(node)
+                if name in ctx.jitted:
+                    findings.append(Finding(
+                        RULE, sf.path, node.lineno,
+                        f"module-level invocation of jitted {name} — XLA "
+                        f"compiles at import; move the call into a "
+                        f"function or the warmup path"))
+
+    for stmt in sf.tree.body:
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef, ast.Import, ast.ImportFrom)):
+            continue
+        scan(stmt)
+    return findings

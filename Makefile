@@ -2,7 +2,8 @@
 PYTHON ?= python
 COMPILE_CACHE ?= $(CURDIR)/.compile-cache
 
-.PHONY: lint lint-inventory test bench bench-cached bench-steady clean-cache
+.PHONY: lint lint-inventory test bench bench-cached bench-steady \
+	trace-demo clean-cache
 
 # graftlint: the repo's contract-enforcing static analysis (doc/LINT.md)
 # — lock discipline, donation safety, tracer hygiene, ship/no-mutate
@@ -43,6 +44,12 @@ bench-steady:
 	env JAX_PLATFORMS=cpu BENCH_STEADY_ONLY=1 BENCH_STEADY_ROUNDS=8 \
 		BENCH_TASKS=2000 BENCH_NODES=256 BENCH_JOBS=80 \
 		BENCH_QUEUES=4 $(PYTHON) bench.py
+
+# Record a small live session with the flight recorder on and write its
+# Chrome trace-event JSON (doc/OBSERVABILITY.md): open doc/trace_demo.json
+# in https://ui.perfetto.dev.  CI uploads it as a build artifact.
+trace-demo:
+	env JAX_PLATFORMS=cpu $(PYTHON) tools/trace_demo.py doc/trace_demo.json
 
 clean-cache:
 	rm -rf $(COMPILE_CACHE)

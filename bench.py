@@ -199,13 +199,27 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
         for t in job.tasks.values():
             podmap[pod_key(t.pod)] = t.pod
 
+    from kube_batch_tpu.trace import spans as tspans
+
+    trace_sids = []
+
     def session_ms():
+        # Flight-recorder spans per round: phase p50/p95 lands in the
+        # artifact so a BENCH trajectory shows WHERE time went, and a
+        # KUBE_BATCH_TPU_TRACE=0 vs =1 A/B of this loop measures the
+        # tracing overhead itself (doc/OBSERVABILITY.md).
+        sid = tspans.begin_session(bench="steady")
         start = time.perf_counter()
-        ssn = open_session(cache, tiers)
         try:
-            action.execute(ssn)
+            ssn = open_session(cache, tiers)
+            try:
+                action.execute(ssn)
+            finally:
+                close_session(ssn)
         finally:
-            close_session(ssn)
+            tspans.end_session()
+        if sid is not None:
+            trace_sids.append(sid)
         return (time.perf_counter() - start) * 1e3
 
     def echo():
@@ -299,6 +313,35 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
             round_wall.append(time.perf_counter() - round_start)
     ship1 = ship_counts()
     window = round_wall[1:]
+    # Per-phase span summaries over the steady window: trace_sids[0] is
+    # the cold session, trace_sids[1] the re-absorb round, so [2:]
+    # matches the rounds[1:] window every other stat reports.
+    phase_ms = None
+    if trace_sids:
+        import sys as _sys
+
+        from kube_batch_tpu.trace import export as texport
+        from kube_batch_tpu.trace import flight_recorder
+        steady_sids = trace_sids[2:]
+        traces = [t for t in (flight_recorder.get(s) for s in steady_sids)
+                  if t is not None]
+        dropped = len(steady_sids) - len(traces)
+        if dropped:
+            # No silent caps: more steady rounds than the recorder ring
+            # holds (KUBE_BATCH_TPU_TRACE_RING, default 64) means the
+            # percentiles cover only the ring's tail.
+            print(f"bench: phase_ms covers {len(traces)}/{len(steady_sids)}"
+                  " steady rounds (flight-recorder ring evicted the rest; "
+                  "raise KUBE_BATCH_TPU_TRACE_RING to cover all)",
+                  file=_sys.stderr)
+        if traces:
+            # "solve" is the sequential KUBE_BATCH_TPU_PIPELINE=0 path's
+            # span (the A/B control) — without it that artifact's
+            # breakdown would omit its dominant phase.
+            phase_ms = texport.phase_percentiles(
+                traces, names=("tensorize", "ship", "dispatch",
+                               "host_overlap", "device_wait", "solve",
+                               "apply", "fit_deltas"))
     stats = {
         # Whole-round pace: injection + session + echo back-to-back —
         # the sustained cycle rate, not just 1e3/session_ms.
@@ -309,6 +352,7 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
         "ship": {mode: [ship1[mode][0] - ship0[mode][0],
                         ship1[mode][1] - ship0[mode][1]]
                  for mode in ship1},
+        "phase_ms": phase_ms,
     }
     return round(cold, 1), steady[1:], stats
 
@@ -667,6 +711,10 @@ def _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline,
         out["device_wait_ms"], out["device_wait_p90"] = _stats(
             steady_stats["device_wait_ms"])
     out["ship"] = steady_stats["ship"]
+    # Flight-recorder span summaries: p50/p95 per phase over the steady
+    # window — WHERE the steady milliseconds went, not just the total
+    # (null when KUBE_BATCH_TPU_TRACE=0).
+    out["phase_ms"] = steady_stats.get("phase_ms")
 
     if not steady_only:
         _, steady_het_rounds, _het_stats = measure_steady_session(
@@ -731,6 +779,9 @@ def main():
         "host_overlap_ms": None,
         "device_wait_ms": None,
         "ship": None,
+        # Per-phase span summaries from the session flight recorder
+        # (trace/): {phase: {p50, p95, n}} over the steady rounds.
+        "phase_ms": None,
     }
 
     import threading

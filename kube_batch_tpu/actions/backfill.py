@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from ..api import FitError, TaskStatus
 from ..framework import Action
+from ..trace import spans as trace
 from ..utils import get_node_list
 
 
@@ -28,44 +29,51 @@ class BackfillAction(Action):
         # question from the tensorizer's BestEffort rows during its
         # device-wait window (ssn.prescan); only sessions it didn't see
         # (host fallback, different pipeline) pay the O(pending) walk.
-        has_best_effort = ssn.prescan.get("has_best_effort")
-        if has_best_effort is None:
-            has_best_effort = any(
-                t.init_resreq.is_empty()
-                for job in ssn.jobs.values()
-                for t in job.task_status_index.get(TaskStatus.Pending,
-                                                   {}).values())
-        scanner = maybe_scanner(ssn) if has_best_effort else None
-        for job in list(ssn.jobs.values()):
-            pending = list(job.task_status_index.get(TaskStatus.Pending,
-                                                     {}).values())
-            for task in pending:
-                if not task.init_resreq.is_empty():
-                    continue  # only BestEffort tasks backfill
-                if scanner is not None:
-                    candidates = scanner.candidate_nodes(task, scored=False)
-                    if candidates is not None:
-                        for name, _score in candidates:
-                            try:
-                                ssn.allocate(task, name)
-                            except Exception:  # lint: allow-swallow(per-node probe: allocate failure means try the next scanned candidate)
-                                continue
-                            # Membership occupancy (count/ports/selcnt)
-                            # for subsequent scans; resource `used` rides
-                            # the allocate event (empty here anyway).
-                            scanner.apply_pipeline(task, name)
-                            break
-                        continue
-                for node in get_node_list(ssn.nodes):
-                    try:
-                        ssn.predicate_fn(task, node)
-                    except FitError:
-                        continue
-                    try:
-                        ssn.allocate(task, node.name)
-                    except Exception:  # lint: allow-swallow(per-node probe on the host walk: failure means try the next node)
-                        continue
-                    break
+        with trace.span("backfill.discover") as sp:
+            has_best_effort = ssn.prescan.get("has_best_effort")
+            prescanned = has_best_effort is not None
+            if not prescanned:
+                has_best_effort = any(
+                    t.init_resreq.is_empty()
+                    for job in ssn.jobs.values()
+                    for t in job.task_status_index.get(TaskStatus.Pending,
+                                                       {}).values())
+            sp.annotate(prescanned=prescanned,
+                        has_best_effort=bool(has_best_effort))
+            scanner = maybe_scanner(ssn) if has_best_effort else None
+        with trace.span("backfill.place"):
+            for job in list(ssn.jobs.values()):
+                pending = list(job.task_status_index.get(TaskStatus.Pending,
+                                                         {}).values())
+                for task in pending:
+                    if not task.init_resreq.is_empty():
+                        continue  # only BestEffort tasks backfill
+                    if scanner is not None:
+                        candidates = scanner.candidate_nodes(task,
+                                                             scored=False)
+                        if candidates is not None:
+                            for name, _score in candidates:
+                                try:
+                                    ssn.allocate(task, name)
+                                except Exception:  # lint: allow-swallow(per-node probe: allocate failure means try the next scanned candidate)
+                                    continue
+                                # Membership occupancy (count/ports/
+                                # selcnt) for subsequent scans; resource
+                                # `used` rides the allocate event (empty
+                                # here anyway).
+                                scanner.apply_pipeline(task, name)
+                                break
+                            continue
+                    for node in get_node_list(ssn.nodes):
+                        try:
+                            ssn.predicate_fn(task, node)
+                        except FitError:
+                            continue
+                        try:
+                            ssn.allocate(task, node.name)
+                        except Exception:  # lint: allow-swallow(per-node probe on the host walk: failure means try the next node)
+                            continue
+                        break
 
 
 def new() -> BackfillAction:

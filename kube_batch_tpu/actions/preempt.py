@@ -18,6 +18,7 @@ from typing import Dict, List
 from ..api import Resource, TaskInfo, TaskStatus
 from ..framework import Action
 from ..metrics import metrics
+from ..trace import spans as trace
 from ..utils import (PriorityQueue, get_node_list, predicate_nodes,
                      prioritize_nodes, sort_nodes)
 
@@ -48,19 +49,23 @@ class PreemptAction(Action):
 
         if not preemptors_map:
             return
-        # Tensorize only when there is work: the scanner costs a session
-        # flatten, pure overhead on healthy clusters.
-        from ..models.scanner import maybe_scanner
-        scanner = maybe_scanner(ssn)
-        # One pass over residents: lets the walk skip nodes (and whole
-        # preemptors) that provably cannot yield a victim — the starved
-        # queue's O(tasks x nodes) empty walk collapses to O(tasks).
-        # Session-shared: reclaim (which runs first in the shipped
-        # pipeline) already built and live-updated it.
-        from ..models.victim_index import VictimIndex
-        vindex = VictimIndex.for_session(ssn)
-        if scanner is not None:
-            vindex.attach_nodes(scanner.snap.node_names)
+        # The expensive pre-work (tensorize + resident index) gets its
+        # own span: on big clusters it is the phase that stalls.
+        with trace.span("preempt.prepare",
+                        preemptor_jobs=len(under_request)):
+            # Tensorize only when there is work: the scanner costs a
+            # session flatten, pure overhead on healthy clusters.
+            from ..models.scanner import maybe_scanner
+            scanner = maybe_scanner(ssn)
+            # One pass over residents: lets the walk skip nodes (and
+            # whole preemptors) that provably cannot yield a victim —
+            # the starved queue's O(tasks x nodes) empty walk collapses
+            # to O(tasks).  Session-shared: reclaim (which runs first in
+            # the shipped pipeline) already built and live-updated it.
+            from ..models.victim_index import VictimIndex
+            vindex = VictimIndex.for_session(ssn)
+            if scanner is not None:
+                vindex.attach_nodes(scanner.snap.node_names)
 
         # Preemption between jobs within a queue (preempt.go:76-134).
         for queue in queues.values():

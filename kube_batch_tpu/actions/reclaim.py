@@ -11,6 +11,7 @@ from typing import Dict, List
 
 from ..api import FitError, Resource, TaskStatus
 from ..framework import Action
+from ..trace import spans as trace
 from ..utils import PriorityQueue, get_node_list
 
 
@@ -59,14 +60,15 @@ class ReclaimAction(Action):
             assigned = False
             if not scanner_built:
                 # Tensorize lazily: only when a starving task actually
-                # needs a node walk.
-                from ..models.scanner import maybe_scanner
-                scanner = maybe_scanner(ssn)
-                scanner_built = True
-                from ..models.victim_index import VictimIndex
-                vindex = VictimIndex.for_session(ssn)
-                if scanner is not None:
-                    vindex.attach_nodes(scanner.snap.node_names)
+                # needs a node walk (span: the stallable phase).
+                with trace.span("reclaim.prepare"):
+                    from ..models.scanner import maybe_scanner
+                    scanner = maybe_scanner(ssn)
+                    scanner_built = True
+                    from ..models.victim_index import VictimIndex
+                    vindex = VictimIndex.for_session(ssn)
+                    if scanner is not None:
+                        vindex.attach_nodes(scanner.snap.node_names)
             if not vindex.any_for_other_queues(job.queue):
                 continue  # no node anywhere holds a reclaimable victim
             # Candidate walk in node order; the device scan answers the

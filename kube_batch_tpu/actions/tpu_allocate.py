@@ -21,6 +21,7 @@ import time
 
 from ..framework import Action
 from ..metrics import metrics
+from ..trace import spans as trace
 
 # Set to a directory path to capture an XLA profiler trace of each session
 # solve (the sidecar profiling hook, SURVEY.md §5).
@@ -52,7 +53,8 @@ class TpuAllocateAction(Action):
         from ..models.tensor_snapshot import tensorize_session
 
         start = time.time()
-        snap = tensorize_session(ssn)
+        with trace.span("tensorize"):
+            snap = tensorize_session(ssn)
         if snap.needs_fallback:
             if self._fallback is None:
                 from .allocate import AllocateAction
@@ -84,8 +86,11 @@ class TpuAllocateAction(Action):
         import numpy as np
         ship_start = time.time()
         # Device-resident delta shipping: steady cycles move only the
-        # dirty blocks of the packed buffer (models/shipping.py).
-        inputs = resident_shipper(ssn.cache).ship(snap.inputs, snap.config)
+        # dirty blocks of the packed buffer (models/shipping.py; the
+        # shipper annotates this span with mode and bytes).
+        with trace.span("ship"):
+            inputs = resident_shipper(ssn.cache).ship(snap.inputs,
+                                                      snap.config)
         metrics.observe_tpu_transfer_latency(time.time() - ship_start)
 
         from ..models.tensor_snapshot import (build_apply_aggregates,
@@ -99,18 +104,22 @@ class TpuAllocateAction(Action):
                 # block only when the result is actually consumed.  The
                 # packed readback also forces completion
                 # (block_until_ready is unreliable on the axon tunnel).
-                pending = dispatch_solve(inputs, snap.config)
+                with trace.span("dispatch"):
+                    pending = dispatch_solve(inputs, snap.config)
                 overlap_start = time.perf_counter()
-                scaffold = prepare_apply_scaffold(snap)
+                with trace.span("host_overlap"):
+                    scaffold = prepare_apply_scaffold(snap)
                 metrics.observe_host_overlap_latency(
                     time.perf_counter() - overlap_start)
                 wait_start = time.perf_counter()
-                assignment, kind, order, ordered = fetch_solve(pending)
+                with trace.span("device_wait"):
+                    assignment, kind, order, ordered = fetch_solve(pending)
                 metrics.observe_device_wait_latency(
                     time.perf_counter() - wait_start)
             else:
-                result = best_solve_allocate(inputs, snap.config)
-                assignment, kind, order = fetch_result(result)
+                with trace.span("solve"):
+                    result = best_solve_allocate(inputs, snap.config)
+                    assignment, kind, order = fetch_result(result)
                 placed = np.nonzero(kind > 0)[0]
                 ordered = placed[np.argsort(order[placed], kind="stable")]
                 scaffold = None
@@ -121,18 +130,73 @@ class TpuAllocateAction(Action):
         # dispatch) is identical to per-task ssn.allocate/pipeline calls,
         # at one vector op per node instead of seven per task.
         apply_start = time.time()
-        if scaffold is None:
-            scaffold = prepare_apply_scaffold(snap)
-        agg = build_apply_aggregates(snap, assignment, kind, ordered,
-                                     scaffold=scaffold)
-        kinds = kind[ordered].tolist()
-        hostnames = scaffold.node_names_arr[assignment[ordered]].tolist()
-        ssn.batch_apply(
-            zip(scaffold.tasks_arr[ordered].tolist(), hostnames, kinds),
-            agg=agg)
-        self._record_fit_deltas(ssn, snap, kind, assignment, order,
-                                scaffold=scaffold)
+        with trace.span("apply", placed=int(ordered.size)):
+            if scaffold is None:
+                scaffold = prepare_apply_scaffold(snap)
+            agg = build_apply_aggregates(snap, assignment, kind, ordered,
+                                         scaffold=scaffold)
+            kinds = kind[ordered].tolist()
+            hostnames = scaffold.node_names_arr[assignment[ordered]].tolist()
+            ssn.batch_apply(
+                zip(scaffold.tasks_arr[ordered].tolist(), hostnames, kinds),
+                agg=agg)
+        with trace.span("fit_deltas"):
+            self._record_fit_deltas(ssn, snap, kind, assignment, order,
+                                    scaffold=scaffold)
         metrics.observe_tpu_apply_latency(time.time() - apply_start)
+        # After the latency observation: the tally walk must not inflate
+        # the histogram the recorder's spans are validated against.
+        if trace.current_session_id() is not None:
+            self._record_why_tallies(ssn, snap, kind)
+
+    @staticmethod
+    def _record_why_tallies(ssn, snap, kind) -> None:
+        """Why-pending tallies from the solver's own outputs: per job with
+        unplaced candidates, how many tasks allocated/pipelined/stalled,
+        and — from the static [S, N] predicate mask — whether ANY node
+        passed the first stalled task's static predicates.  Distinguishes
+        "no node admits this task at all" (selector/taint mismatch) from
+        "admissible nodes had no room" without re-running anything; the
+        flight recorder serves it via /debug/why."""
+        import numpy as np
+
+        inp = snap.inputs
+        nj = len(snap.job_uids)
+        job_start = np.asarray(inp.job_start)[:nj].astype(np.int64)
+        job_count = np.asarray(inp.job_count)[:nj].astype(np.int64)
+        # Vectorized per-job kind counts via cumulative sums (job blocks
+        # are contiguous): O(P + J) host work, then a Python iteration
+        # over STALLED jobs only — a healthy cluster pays two cumsums.
+        ends = job_start + job_count
+        cum0 = np.concatenate(([0], np.cumsum(kind == 0)))
+        cum1 = np.concatenate(([0], np.cumsum(kind == 1)))
+        cum2 = np.concatenate(([0], np.cumsum(kind == 2)))
+        unplaced_per_job = cum0[ends] - cum0[job_start]
+        stalled = np.nonzero((job_count > 0) & (unplaced_per_job > 0))[0]
+        if stalled.size == 0:
+            return
+        # One [S, N] pass for the static-mask node counts, indexed per
+        # stalled task below (not one mask reduction per job).
+        task_sig = np.asarray(inp.task_sig)
+        node_exists = np.asarray(inp.node_exists)
+        sig_feasible = np.count_nonzero(
+            np.asarray(inp.sig_mask) & node_exists[None, :], axis=1)
+        for ji in (int(j) for j in stalled):
+            job = ssn.jobs.get(snap.job_uids[ji])
+            if job is None:
+                continue
+            start, end = job_start[ji], ends[ji]
+            first = start + int(np.argmax(kind[start:end] == 0))
+            feasible = int(sig_feasible[int(task_sig[first])])
+            trace.note_tally(
+                f"{job.namespace}/{job.name}",
+                candidates=int(job_count[ji]),
+                allocated=int(cum1[end] - cum1[start]),
+                pipelined=int(cum2[end] - cum2[start]),
+                unplaced=int(unplaced_per_job[ji]),
+                static_feasible_nodes=feasible,
+                reason=("PredicateMismatch" if feasible == 0
+                        else "NoFeasibleNode"))
 
     @staticmethod
     def _record_fit_deltas(ssn, snap, kind, assignment, order,

@@ -42,6 +42,10 @@ class ServerOption:
     # compiles survive restarts and leader failover.
     warmup_buckets: str = ""
     compile_cache_dir: str = ""
+    # Observability (doc/OBSERVABILITY.md): direct the XLA profiler at a
+    # directory to capture a device trace around every session's solve
+    # window (actions/tpu_allocate.PROFILE_ENV hook).
+    jax_profile_dir: str = ""
 
     def check_option_or_die(self) -> None:
         """options.go:81-88: leader election requires a lock namespace."""
@@ -96,6 +100,11 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         help="Directory for JAX's persistent compilation "
                              "cache; solver compiles survive process "
                              "restarts and leader failover")
+    parser.add_argument("--jax-profile-dir", default="",
+                        help="Capture a jax.profiler trace of each "
+                             "session's device solve window into this "
+                             "directory (TensorBoard/Perfetto-loadable); "
+                             "empty disables profiling")
 
 
 def parse_options(argv=None) -> ServerOption:
@@ -113,4 +122,5 @@ def parse_options(argv=None) -> ServerOption:
         file_lock_same_host_ok=ns.file_lock,
         cluster_state=ns.cluster_state,
         warmup_buckets=ns.warmup_buckets,
-        compile_cache_dir=ns.compile_cache_dir)
+        compile_cache_dir=ns.compile_cache_dir,
+        jax_profile_dir=ns.jax_profile_dir)

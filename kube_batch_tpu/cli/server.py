@@ -1,8 +1,16 @@
-"""Server runtime: scheduler startup, /metrics endpoint, leader election.
+"""Server runtime: scheduler startup, /metrics + /debug endpoints,
+leader election.
 
 Mirrors /root/reference/cmd/kube-batch/app/server.go:63-139 — Run() builds
 the cache and scheduler, serves Prometheus metrics over HTTP, and wraps the
-scheduling loop in leader election when enabled.
+scheduling loop in leader election when enabled.  The flight-recorder
+endpoints (doc/OBSERVABILITY.md) ride the same server:
+
+  /debug/sessions            recent session summaries (JSON)
+  /debug/trace?session=<id>  one session as Chrome trace-event JSON
+                             (open in Perfetto / chrome://tracing)
+  /debug/why?job=<name>      the gating predicate/quota/gang reason for a
+                             Pending job, answered from the recorder
 """
 
 from __future__ import annotations
@@ -11,10 +19,14 @@ import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
+from urllib.parse import parse_qs, urlsplit
 
 from ..cache import Cluster, new_scheduler_cache
+from ..metrics import metrics
 from ..metrics.metrics import registry
 from ..scheduler import Scheduler
+from ..trace import export as trace_export
+from ..trace import flight_recorder
 from .leader_election import (LeaderElectionConfig, LeaderElector,
                               StoreLock)
 from .options import ServerOption
@@ -22,24 +34,84 @@ from .options import ServerOption
 
 class _MetricsHandler(BaseHTTPRequestHandler):
     def do_GET(self):
-        if self.path == "/metrics":
+        parts = urlsplit(self.path)
+        path = parts.path
+        if path == "/metrics":
             body = registry.expose().encode()
             self.send_response(200)
             self.send_header("Content-Type", "text/plain; version=0.0.4")
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
-        elif self.path == "/healthz":
+        elif path == "/healthz":
             self.send_response(200)
             self.send_header("Content-Length", "2")
             self.end_headers()
             self.wfile.write(b"ok")
+        elif path.startswith("/debug/"):
+            try:
+                self._debug(path, parse_qs(parts.query))
+            except Exception:  # a debug read must never kill the server
+                metrics.note_swallowed("debug_endpoint")
+                self._send_json({"error": "internal error"}, 500)
         else:
             self.send_response(404)
             self.end_headers()
 
+    def _debug(self, path: str, query: dict) -> None:
+        """The flight-recorder read endpoints.  Read-only: everything is
+        answered from recorded traces, nothing re-runs."""
+        if path == "/debug/sessions":
+            self._send_json({"sessions": flight_recorder.summaries(),
+                             "capacity": flight_recorder.capacity,
+                             "tracing_enabled":
+                                 _trace_enabled()})
+        elif path == "/debug/trace":
+            raw = (query.get("session") or [""])[0]
+            trace = None
+            if raw == "latest":
+                trace = flight_recorder.latest()
+            elif raw.isdigit():
+                trace = flight_recorder.get(int(raw))
+            if trace is None:
+                self._send_json(
+                    {"error": "unknown session; pass ?session=<id> from "
+                              "/debug/sessions (or session=latest)"}, 404)
+                return
+            self._send_json(trace_export.to_chrome_trace(trace))
+        elif path == "/debug/why":
+            job = (query.get("job") or [""])[0]
+            if not job:
+                self._send_json({"error": "pass ?job=<name>"}, 400)
+                return
+            answer = flight_recorder.why(job)
+            if answer is None:
+                self._send_json(
+                    {"job": job,
+                     "error": "no recorded verdict: the job was absent, "
+                              "schedulable, or tracing is disabled "
+                              "(KUBE_BATCH_TPU_TRACE=0)"}, 404)
+                return
+            self._send_json(answer)
+        else:
+            self.send_response(404)
+            self.end_headers()
+
+    def _send_json(self, obj, status: int = 200) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def log_message(self, *args):  # quiet
         pass
+
+
+def _trace_enabled() -> bool:
+    from ..trace import spans
+    return spans.enabled()
 
 
 def start_metrics_server(listen_address: str) -> ThreadingHTTPServer:
@@ -113,6 +185,13 @@ class ServerRuntime:
         if opt.warmup_buckets:
             from ..ops.compile_cache import parse_warmup_buckets
             self._warmup_buckets = parse_warmup_buckets(opt.warmup_buckets)
+        if opt.jax_profile_dir:
+            # The solve-window profiler hook reads PROFILE_ENV per
+            # session (actions/tpu_allocate._maybe_profile): the flag is
+            # just its configuration surface.
+            import os
+            from ..actions.tpu_allocate import PROFILE_ENV
+            os.environ[PROFILE_ENV] = opt.jax_profile_dir
         # Whether the backing store is SHARED with other standbys — the
         # precondition for a store-hosted election lock.  An injected
         # cluster is shared by construction (the embedder hands the same

@@ -22,6 +22,7 @@ from ..api.pod_group_info import (PodGroupCondition, PodGroupPending,
                                   PodGroupUnschedulableType)
 from ..metrics import metrics
 from ..native import apply_placements as native_apply
+from ..trace import spans as trace
 from ..utils.priority_queue import PriorityQueue, SortedDrainQueue
 from .events import AllocateBatch, Event, EventHandler
 from .interface import Plugin
@@ -702,6 +703,15 @@ class Session:
         if job is None:
             raise KeyError(f"failed to find job {job_info.namespace}/{job_info.name}")
         self._dirty_job(job.uid)
+        if cond.type == PodGroupUnschedulableType and cond.status == "True":
+            # Every unschedulable verdict (job_valid gate at open, gang's
+            # close pass) flows through here: record it in the session
+            # trace so /debug/why answers from the flight recorder.
+            # Namespace-qualified: job names are only unique per
+            # namespace, and a bare-name key would let ns-b/train
+            # clobber ns-a/train's reason.
+            trace.note_verdict(f"{job.namespace}/{job.name}",
+                               cond.reason, cond.message)
         conditions = job.pod_group.status.conditions
         for i, c in enumerate(conditions):
             if c.type == cond.type:
@@ -718,7 +728,8 @@ def open_session(cache, tiers: List[Tier],
     from .registry import get_plugin_builder
 
     ssn = Session(cache)
-    snapshot: ClusterInfo = cache.snapshot()
+    with trace.span("snapshot"):
+        snapshot: ClusterInfo = cache.snapshot()
     ssn.jobs = snapshot.jobs
     ssn.nodes = snapshot.nodes
     ssn.queues = snapshot.queues
@@ -740,7 +751,8 @@ def open_session(cache, tiers: List[Tier],
 
     for plugin in ssn.plugins.values():
         start = time.time()
-        plugin.on_session_open(ssn)
+        with trace.span("plugin." + plugin.name(), on="open"):
+            plugin.on_session_open(ssn)
         metrics.observe_plugin_latency(plugin.name(), "OnSessionOpen",
                                        time.time() - start)
 
@@ -769,7 +781,8 @@ def open_session(cache, tiers: List[Tier],
 def close_session(ssn: Session) -> None:
     for plugin in ssn.plugins.values():
         start = time.time()
-        plugin.on_session_close(ssn)
+        with trace.span("plugin." + plugin.name(), on="close"):
+            plugin.on_session_close(ssn)
         metrics.observe_plugin_latency(plugin.name(), "OnSessionClose",
                                        time.time() - start)
 

@@ -25,6 +25,25 @@ def _exp_buckets(start: float, factor: float, count: int) -> List[float]:
     return out
 
 
+def _escape_label(value) -> str:
+    """Prometheus text-format label-value escaping (backslash, double
+    quote, newline).  Label values here are user-influenced — job names
+    and error-site strings flow in verbatim — so raw interpolation would
+    let one adversarial name break the whole scrape."""
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping (backslash and newline; quotes are legal)."""
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _label_str(names, values) -> str:
+    return ",".join(f'{n}="{_escape_label(v)}"'
+                    for n, v in zip(names, values))
+
+
 class Histogram:
     def __init__(self, name: str, help_: str, buckets: List[float],
                  label_names: Tuple[str, ...] = ()):
@@ -68,12 +87,11 @@ class Histogram:
             self._totals[labels] += int(arr.size)
 
     def expose(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}",
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
                  f"# TYPE {self.name} histogram"]
         with self._lock:
             for labels, counts in self._counts.items():
-                label_str = ",".join(
-                    f'{n}="{v}"' for n, v in zip(self.label_names, labels))
+                label_str = _label_str(self.label_names, labels)
                 cumulative = 0
                 for bound, c in zip(self.buckets, counts):
                     cumulative += c
@@ -92,6 +110,11 @@ class Histogram:
 
 
 class Counter:
+    # The exposition TYPE keyword; Gauge overrides it.  A class attribute
+    # (not string surgery on the rendered output) so a HELP text that
+    # happens to contain the word "counter" cannot corrupt the format.
+    TYPE = "counter"
+
     def __init__(self, name: str, help_: str, label_names: Tuple[str, ...] = ()):
         self.name = name
         self.help = help_
@@ -108,27 +131,24 @@ class Counter:
             return self._values.get(labels, 0.0)
 
     def expose(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}",
-                 f"# TYPE {self.name} counter"]
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
+                 f"# TYPE {self.name} {self.TYPE}"]
         with self._lock:
             if not self._values:
                 lines.append(f"{self.name} 0")
             for labels, v in self._values.items():
-                label_str = ",".join(
-                    f'{n}="{val}"' for n, val in zip(self.label_names, labels))
+                label_str = _label_str(self.label_names, labels)
                 braces = f"{{{label_str}}}" if label_str else ""
                 lines.append(f"{self.name}{braces} {v}")
         return "\n".join(lines)
 
 
 class Gauge(Counter):
+    TYPE = "gauge"
+
     def set(self, value: float, *labels: str) -> None:
         with self._lock:
             self._values[labels] = value
-
-    def expose(self) -> str:
-        return super().expose().replace("TYPE", "TYPE", 1).replace(
-            " counter", " gauge", 1)
 
 
 class Registry:

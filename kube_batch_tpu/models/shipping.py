@@ -170,6 +170,7 @@ class DeviceResidentShipper:
     def ship(self, inp: SolverInputs, cfg=None,
              float_dtype=None) -> SolverInputs:
         from ..metrics import metrics
+        from ..trace import spans as trace
 
         if float_dtype is None:
             float_dtype = _default_float_dtype()
@@ -180,6 +181,7 @@ class DeviceResidentShipper:
                 treedef, _unpack(spec, float_dtype, jnp.asarray(flat)))
             self.last_mode = "full"
             metrics.note_ship("full", flat.nbytes)
+            trace.note_ship("full", flat.nbytes)
             return out
 
         spec, flat, treedef = _pack_host(inp, float_dtype, pad_to=_BLOCK)
@@ -190,6 +192,7 @@ class DeviceResidentShipper:
             if idx.size == 0:
                 self.last_mode = "clean"
                 metrics.note_ship("clean", 0)
+                trace.note_ship("clean", 0)
                 return st.inputs
             if idx.size * _BLOCK <= _DELTA_MAX_FRACTION * flat.nbytes:
                 return self._ship_delta(st, flat, idx)
@@ -203,6 +206,7 @@ class DeviceResidentShipper:
     def _ship_full(self, layout, spec, treedef, float_dtype,
                    flat: np.ndarray) -> SolverInputs:
         from ..metrics import metrics
+        from ..trace import spans as trace
 
         st = _ShipState()
         st.layout = layout
@@ -222,11 +226,13 @@ class DeviceResidentShipper:
         self._state = st
         self.last_mode = "full"
         metrics.note_ship("full", flat.nbytes)
+        trace.note_ship("full", flat.nbytes)
         return st.inputs
 
     def _ship_delta(self, st: _ShipState, flat: np.ndarray,
                     idx: np.ndarray) -> SolverInputs:
         from ..metrics import metrics
+        from ..trace import spans as trace
 
         k = idx.size
         # Pad the update to a bucketed row count so the scatter compiles
@@ -251,6 +257,7 @@ class DeviceResidentShipper:
             _unpack_blocks(st.spec, st.float_dtype, st.device_flat))
         self.last_mode = "delta"
         metrics.note_ship("delta", upd.nbytes + idx_p.nbytes)
+        trace.note_ship("delta", upd.nbytes + idx_p.nbytes)
         return st.inputs
 
 

@@ -407,8 +407,11 @@ def fetch_result(result: "SolveResult"):
     the TPU tunnel charges fixed latency per transfer, so three np.asarray
     calls cost 3x (models/shipping.py is the mirror-image on the way in)."""
     import numpy as np
-    packed = np.asarray(_pack_result(result.assignment, result.kind,
-                                     result.order))
+
+    from ..trace import spans as trace
+    with trace.span("solver.fetch"):
+        packed = np.asarray(_pack_result(result.assignment, result.kind,
+                                         result.order))
     return packed[0], packed[1], packed[2]
 
 
@@ -438,9 +441,11 @@ def dispatch_solve(inp: SolverInputs, cfg: SolverConfig) -> PendingSolve:
     """Route and dispatch the solve without blocking on its result.  All
     solver family members dispatch asynchronously (JAX async dispatch on
     every backend), so this returns as soon as the programs are enqueued."""
-    result = best_solve_allocate(inp, cfg)
-    return PendingSolve(_pack_result_ordered(result.assignment, result.kind,
-                                             result.order))
+    from ..trace import spans as trace
+    with trace.span("solver.dispatch"):
+        result = best_solve_allocate(inp, cfg)
+        return PendingSolve(_pack_result_ordered(result.assignment,
+                                                 result.kind, result.order))
 
 
 def fetch_solve(pending: PendingSolve):
@@ -450,7 +455,10 @@ def fetch_solve(pending: PendingSolve):
     placed task ids in placement order — the device-computed equivalent of
     ``placed[np.argsort(order[placed], kind="stable")]``."""
     import numpy as np
-    packed = np.asarray(pending.packed)
+
+    from ..trace import spans as trace
+    with trace.span("solver.fetch"):
+        packed = np.asarray(pending.packed)
     assignment, kind, order, perm = packed
     n_placed = int(np.count_nonzero(kind > 0))
     return assignment, kind, order, perm[:n_placed]

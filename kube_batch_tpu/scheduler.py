@@ -18,6 +18,7 @@ from .conf import (SchedulerConfiguration, Tier, apply_plugin_conf_defaults,
                    configuration_from_dict)
 from .framework import (Action, close_session, get_action, open_session)
 from .metrics import metrics
+from .trace import spans as trace
 
 # The shipped default pipeline puts the flagship device action first:
 # tpu-allocate solves the allocate loop on TPU and falls back to the host
@@ -112,6 +113,9 @@ class Scheduler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._seen_errors: set = set()
+        # Log<->trace correlation: every loop record carries [s=<id>]
+        # while a traced session is active (doc/OBSERVABILITY.md).
+        trace.install_log_correlation()
 
     def _log_cycle_error(self, stage: str) -> None:
         """Count and log a swallowed loop exception.  The counter moves on
@@ -150,17 +154,25 @@ class Scheduler:
         if gc_was_enabled:
             gc.disable()
         start = time.time()
+        trace.begin_session(actions=[a.name() for a in self.actions])
         try:
-            ssn = open_session(self.cache, self.tiers)
+            with trace.span("open_session"):
+                ssn = open_session(self.cache, self.tiers)
+            trace.set_uid(ssn.uid)
+            trace.set_meta(jobs=len(ssn.jobs), nodes=len(ssn.nodes),
+                           queues=len(ssn.queues))
             try:
                 for action in self.actions:
                     action_start = time.time()
-                    action.execute(ssn)
+                    with trace.span("action." + action.name()):
+                        action.execute(ssn)
                     metrics.observe_action_latency(
                         action.name(), time.time() - action_start)
             finally:
-                close_session(ssn)
+                with trace.span("close_session"):
+                    close_session(ssn)
         finally:
+            trace.end_session()
             if gc_was_enabled:
                 gc.enable()
         metrics.observe_e2e_latency(time.time() - start)

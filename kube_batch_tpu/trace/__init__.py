@@ -1,0 +1,19 @@
+"""Session flight recorder: span tracing, why-pending explainability,
+Chrome trace-event export (doc/OBSERVABILITY.md).
+
+``spans``   — thread-local span stack + session lifecycle (the hot-path
+              API; no-op under ``KUBE_BATCH_TPU_TRACE=0``).
+``recorder``— lock-guarded ring buffer of the last N session traces.
+``export``  — Perfetto-loadable trace-event JSON + phase summaries.
+"""
+
+from . import export, recorder, spans
+from .recorder import FlightRecorder
+
+# The process-wide recorder instance, exported under a name that does NOT
+# shadow the ``recorder`` submodule (kube_batch_tpu.trace.recorder stays
+# the module; patch ITS ``recorder`` attribute to redirect end_session).
+flight_recorder = recorder.recorder
+
+__all__ = ["spans", "export", "recorder", "flight_recorder",
+           "FlightRecorder"]

@@ -1,0 +1,146 @@
+"""Flight recorder: a lock-guarded ring buffer of completed session traces.
+
+Holds the last N ``SessionTrace``s (N = ``KUBE_BATCH_TPU_TRACE_RING``,
+default 64) so a slow cycle or a stuck-Pending job is diagnosable AFTER
+the fact, without re-running anything: each trace carries its span tree
+(trace/spans.py), the session's unschedulable verdicts (the
+``vr.reason``/``message`` pairs Session.update_job_condition recorded),
+and the solver-mask rejection tallies from tpu-allocate.  Served over
+HTTP by the metrics server's ``/debug`` endpoints (cli/server.py).
+
+Traces are immutable once recorded (the session thread drops its
+reference at end_session), so readers copy the ring under the mutex and
+compute summaries outside it.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+_RING_ENV = "KUBE_BATCH_TPU_TRACE_RING"
+_DEFAULT_RING = 64
+
+
+class FlightRecorder:
+
+    def __init__(self, capacity: Optional[int] = None):
+        if capacity is None:
+            try:
+                capacity = int(os.environ.get(_RING_ENV, _DEFAULT_RING))
+            except ValueError:
+                capacity = _DEFAULT_RING
+        self.capacity = max(1, capacity)
+        self._lock = threading.Lock()
+        self._traces: List = []            # guarded-by: _lock  (oldest first)
+        self._by_sid: Dict[int, object] = {}  # guarded-by: _lock
+
+    def record(self, trace) -> None:
+        """Append a completed trace, evicting the oldest beyond capacity.
+
+        Verdict/tally values identical to the previous session's are
+        deduplicated to the previous OBJECTS: a cluster with thousands of
+        persistently stuck jobs re-records the same reasons every cycle,
+        and without sharing, the ring would pin capacity x stuck-jobs
+        copies of identical dicts and message strings."""
+        with self._lock:
+            prev = self._traces[-1] if self._traces else None
+            if prev is not None:
+                for table, prev_table in ((trace.verdicts, prev.verdicts),
+                                          (trace.tallies, prev.tallies)):
+                    for key, value in table.items():
+                        prev_value = prev_table.get(key)
+                        if prev_value is not None and prev_value == value:
+                            table[key] = prev_value
+            self._traces.append(trace)
+            self._by_sid[trace.sid] = trace
+            while len(self._traces) > self.capacity:
+                old = self._traces.pop(0)
+                self._by_sid.pop(old.sid, None)
+
+    def get(self, sid: int):
+        with self._lock:
+            return self._by_sid.get(sid)
+
+    def latest(self):
+        with self._lock:
+            return self._traces[-1] if self._traces else None
+
+    def traces(self) -> List:
+        """Snapshot copy, oldest first."""
+        with self._lock:
+            return list(self._traces)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._traces.clear()
+            self._by_sid.clear()
+
+    # ------------------------------------------------------------------
+    # read API for the /debug endpoints
+
+    def summaries(self) -> List[dict]:
+        """Recent session summaries, newest first (/debug/sessions)."""
+        from .export import summarize_phases
+        out = []
+        for tr in reversed(self.traces()):
+            out.append({
+                "session": tr.sid,
+                "uid": tr.uid,
+                "start": round(tr.start_time, 3),
+                "duration_ms": round(tr.duration_ms, 3),
+                "phases_ms": summarize_phases(tr),
+                "spans": len(tr.spans),
+                "verdicts": len(tr.verdicts),
+                "tallies": len(tr.tallies),
+                "meta": dict(tr.meta),
+            })
+        return out
+
+    @staticmethod
+    def _lookup(table: dict, job_name: str):
+        """Verdicts/tallies are keyed ``namespace/name`` (names are only
+        unique per namespace).  A qualified query matches exactly; a bare
+        name matches any namespace — ambiguous across namespaces, but
+        the returned entry carries its full key."""
+        if "/" in job_name:
+            hit = table.get(job_name)
+            return (job_name, hit) if hit is not None else (None, None)
+        for key, value in table.items():
+            if key.rpartition("/")[2] == job_name:
+                return key, value
+        return None, None
+
+    def why(self, job_name: str) -> Optional[dict]:
+        """Answer "why is job X pending" from the most recent session that
+        recorded a verdict or rejection tally for it (/debug/why).
+        ``job_name`` may be bare or ``namespace/name``-qualified.
+
+        Precedence within that session: the plugin verdict (gang/job_valid
+        — the gating reason with its human message) leads; the solver
+        tally rides along as corroborating detail when present.
+
+        ``sessions_ago`` flags staleness: 0 means the newest recorded
+        session still found the job unschedulable; N > 0 means N newer
+        sessions recorded nothing for it — it likely scheduled (or left
+        the cluster) since."""
+        for age, tr in enumerate(reversed(self.traces())):
+            vkey, verdict = self._lookup(tr.verdicts, job_name)
+            tkey, tally = self._lookup(tr.tallies, job_name)
+            if verdict is None and tally is None:
+                continue
+            out = {"job": vkey or tkey, "session": tr.sid,
+                   "session_start": round(tr.start_time, 3),
+                   "sessions_ago": age}
+            if verdict is not None:
+                out.update(verdict)
+            if tally is not None:
+                out["solver"] = tally
+                if verdict is None:
+                    out["reason"] = tally.get("reason", "Unschedulable")
+            return out
+        return None
+
+
+recorder = FlightRecorder()

@@ -52,5 +52,14 @@ def test_contract_annotations_cover_the_known_invariants():
     frozen = {m.detail for m in by_kind.get("frozen-after", [])}
     assert {"ship", "scores"} <= frozen, \
         f"frozen-after coverage shrank: {sorted(frozen)}"
+    # The flight recorder's ring fields (trace/recorder.py) stay under
+    # lock discipline: losing these annotations silently exempts the
+    # recorder from rule 1 while /debug readers race end_session.
+    recorder_guarded = [m for m in by_kind.get("guarded-by", [])
+                        if m.path.replace("\\", "/").endswith(
+                            "trace/recorder.py")]
+    assert len(recorder_guarded) >= 2, (
+        "flight-recorder guarded-by coverage shrank: "
+        f"{[str(m) for m in recorder_guarded]}")
     # The except-audit markers stay greppable.
     assert len(by_kind.get("allow-swallow", [])) >= 10

@@ -1,0 +1,221 @@
+"""Strict Prometheus text-format (0.0.4) validation of registry.expose().
+
+The satellite fix this pins: label values are user-influenced (job names,
+error sites) and were interpolated raw — one backslash, quote, or newline
+broke the whole scrape — and Gauge's TYPE line was derived by replacing
+the first " counter" substring in the rendered output, which corrupted
+any gauge whose HELP text contained the word "counter".  The parser here
+implements the exposition grammar strictly (escaping, label syntax,
+HELP/TYPE placement, ``le`` ordering with +Inf last, bucket monotonicity,
+_sum/_count presence) and the tests feed it adversarial label values.
+"""
+
+import math
+import re
+
+from kube_batch_tpu.metrics.metrics import (Counter, Gauge, Histogram,
+                                            Registry, registry)
+
+METRIC_NAME = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_NAME = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*$")
+# A label VALUE in the exposition: any run of non-quote/backslash chars
+# or valid escapes (\\, \", \n).  A raw newline can never appear (the
+# line split happens first), and a raw quote ends the value.
+LABEL_PAIR = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\[\\"n])*)"')
+SAMPLE = re.compile(
+    r'([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*\})?\s+(-?[0-9.eE+]+|\+Inf|-Inf|NaN)$')
+
+
+def _unescape(value: str) -> str:
+    out, i = [], 0
+    while i < len(value):
+        c = value[i]
+        if c == "\\":
+            nxt = value[i + 1]  # LABEL_PAIR guarantees a valid escape
+            out.append({"\\": "\\", '"': '"', "n": "\n"}[nxt])
+            i += 2
+        else:
+            out.append(c)
+            i += 1
+    return "".join(out)
+
+
+def parse_exposition(text: str):
+    """Parse strictly; raise AssertionError on any grammar violation.
+
+    Returns {metric_name: {"help": str, "type": str,
+                           "samples": [(full_name, {label: value}, float)]}}
+    keyed by the METRIC FAMILY name (histogram _bucket/_sum/_count samples
+    attach to their family).
+    """
+    families = {}
+    assert text.endswith("\n"), "exposition must end with a newline"
+    for line in text.split("\n")[:-1]:
+        assert line == line.strip("\r"), f"stray carriage return: {line!r}"
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):]
+            name, _, help_text = rest.partition(" ")
+            assert METRIC_NAME.match(name), f"bad HELP name: {name!r}"
+            fam = families.setdefault(name, {"help": None, "type": None,
+                                             "samples": []})
+            assert fam["help"] is None, f"duplicate HELP for {name}"
+            assert "\n" not in help_text
+            fam["help"] = (help_text.replace("\\n", "\n")
+                           .replace("\\\\", "\\"))
+        elif line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):]
+            name, _, type_name = rest.partition(" ")
+            assert METRIC_NAME.match(name), f"bad TYPE name: {name!r}"
+            assert type_name in ("counter", "gauge", "histogram", "summary",
+                                 "untyped"), f"bad type: {type_name!r}"
+            fam = families.setdefault(name, {"help": None, "type": None,
+                                             "samples": []})
+            assert fam["type"] is None, f"duplicate TYPE for {name}"
+            assert not fam["samples"], f"TYPE after samples for {name}"
+            fam["type"] = type_name
+        elif line.startswith("#"):
+            continue  # free-form comment
+        else:
+            m = SAMPLE.match(line)
+            assert m, f"unparseable sample line: {line!r}"
+            full_name, label_blob, value_str = m.groups()
+            labels = {}
+            if label_blob is not None:
+                inner = label_blob[1:-1]
+                pos = 0
+                while pos < len(inner):
+                    pm = LABEL_PAIR.match(inner, pos)
+                    assert pm, f"bad label syntax at {inner[pos:]!r}"
+                    lname, lvalue = pm.group(1), _unescape(pm.group(2))
+                    assert LABEL_NAME.match(lname)
+                    assert lname not in labels, f"duplicate label {lname}"
+                    labels[lname] = lvalue
+                    pos = pm.end()
+                    if pos < len(inner):
+                        assert inner[pos] == ",", \
+                            f"expected ',' at {inner[pos:]!r}"
+                        pos += 1
+            value = float(value_str.replace("+Inf", "inf")
+                          .replace("-Inf", "-inf").replace("NaN", "nan"))
+            family = full_name
+            for suffix in ("_bucket", "_sum", "_count"):
+                base = full_name[:-len(suffix)]
+                if full_name.endswith(suffix) and base in families:
+                    family = base
+                    break
+            assert family in families, \
+                f"sample {full_name} without HELP/TYPE"
+            families[family]["samples"].append((full_name, labels, value))
+
+    for name, fam in families.items():
+        assert fam["help"] is not None, f"{name} missing HELP"
+        assert fam["type"] is not None, f"{name} missing TYPE"
+        if fam["type"] == "histogram":
+            _check_histogram(name, fam["samples"])
+    return families
+
+
+def _check_histogram(name, samples):
+    series = {}
+    sums, counts = set(), set()
+    for full_name, labels, value in samples:
+        key = tuple(sorted((k, v) for k, v in labels.items() if k != "le"))
+        if full_name == f"{name}_bucket":
+            assert "le" in labels, "bucket sample without le"
+            series.setdefault(key, []).append((labels["le"], value))
+        elif full_name == f"{name}_sum":
+            sums.add(key)
+        elif full_name == f"{name}_count":
+            counts.add(key)
+        else:
+            raise AssertionError(f"unexpected histogram sample {full_name}")
+    for key, buckets in series.items():
+        les = [float(le.replace("+Inf", "inf")) for le, _ in buckets]
+        assert les == sorted(les), f"le not ascending for {key}: {les}"
+        assert les and math.isinf(les[-1]), f"+Inf bucket missing for {key}"
+        assert len(set(les)) == len(les), f"duplicate le for {key}"
+        cumulative = [v for _, v in buckets]
+        assert cumulative == sorted(cumulative), \
+            f"bucket counts not cumulative for {key}"
+        assert key in sums and key in counts, \
+            f"missing _sum/_count for {key}"
+
+
+ADVERSARIAL = 'we"ird\\job\nname{with="everything"}'
+
+
+def test_global_registry_parses_strictly():
+    parsed = parse_exposition(registry.expose())
+    assert "kube_batch_e2e_scheduling_latency_milliseconds" in parsed
+    assert parsed["kube_batch_schedule_attempts_total"]["type"] == "counter"
+    assert parsed["kube_batch_unschedule_job_count"]["type"] == "gauge"
+
+
+def test_global_registry_with_adversarial_job_name():
+    from kube_batch_tpu.metrics import metrics
+    metrics.update_unschedule_task_count(ADVERSARIAL, 7)
+    metrics.register_job_retries(ADVERSARIAL)
+    parsed = parse_exposition(registry.expose())
+    samples = parsed["kube_batch_unschedule_task_count"]["samples"]
+    values = {labels["job"]: v for _name, labels, v in samples
+              if "job" in labels}
+    assert values[ADVERSARIAL] == 7.0  # round-trips through escaping
+
+
+def test_histogram_label_escaping_roundtrip():
+    reg = Registry()
+    h = reg.register(Histogram("t_hist", "adversarial histogram",
+                               [1.0, 2.0, 4.0], ("job",)))
+    h.observe(0.5, ADVERSARIAL)
+    h.observe(3.0, ADVERSARIAL)
+    h.observe(9.0, "plain")
+    parsed = parse_exposition(reg.expose())
+    fam = parsed["t_hist"]
+    assert fam["type"] == "histogram"
+    jobs = {labels["job"] for _n, labels, _v in fam["samples"]}
+    assert jobs == {ADVERSARIAL, "plain"}
+    # +Inf cumulative count equals _count for the adversarial series
+    inf = [v for n, labels, v in fam["samples"]
+           if n == "t_hist_bucket" and labels["job"] == ADVERSARIAL
+           and labels["le"] == "+Inf"]
+    cnt = [v for n, labels, v in fam["samples"]
+           if n == "t_hist_count" and labels["job"] == ADVERSARIAL]
+    assert inf == cnt == [2.0]
+
+
+def test_gauge_type_line_survives_counter_in_help():
+    reg = Registry()
+    g = reg.register(Gauge(
+        "t_gauge",
+        "A gauge whose help mentions the word counter twice: counter",
+        ("site",)))
+    g.set(3.0, 'a"b\\c\nd')
+    parsed = parse_exposition(reg.expose())
+    fam = parsed["t_gauge"]
+    assert fam["type"] == "gauge"
+    # the old .replace(" counter", " gauge", 1) hack corrupted this text
+    assert fam["help"] == ("A gauge whose help mentions the word counter "
+                           "twice: counter")
+    (_n, labels, value), = fam["samples"]
+    assert labels["site"] == 'a"b\\c\nd'
+    assert value == 3.0
+
+
+def test_counter_help_escaping():
+    reg = Registry()
+    c = reg.register(Counter("t_counter", "line one\nline two \\ end"))
+    c.inc(2.0)
+    text = reg.expose()
+    assert "\n# TYPE" in text  # HELP newline did not split the line
+    parsed = parse_exposition(text)
+    assert parsed["t_counter"]["help"] == "line one\nline two \\ end"
+    (_n, labels, value), = parsed["t_counter"]["samples"]
+    assert labels == {} and value == 2.0
+
+
+def test_empty_counter_exposes_zero_sample():
+    reg = Registry()
+    reg.register(Counter("t_zero", "never incremented"))
+    parsed = parse_exposition(reg.expose())
+    (_n, _labels, value), = parsed["t_zero"]["samples"]
+    assert value == 0.0

@@ -1,0 +1,450 @@
+"""Session flight recorder: span tracing, recorder semantics, export,
+/debug endpoints, why-pending (doc/OBSERVABILITY.md).
+
+Covers the ISSUE 4 acceptance surface: span nesting, ring eviction under
+concurrent sessions, the KUBE_BATCH_TPU_TRACE=0 kill switch (zero spans
+AND zero recorder-lock acquisitions on the hot path), trace-event JSON
+schema, device-wait span vs histogram agreement, and the why-pending
+answer for a deliberately unschedulable job — through the recorder and
+over HTTP.
+"""
+
+import json
+import logging
+import threading
+import urllib.request
+
+import pytest
+
+from kube_batch_tpu.trace import export as texport
+from kube_batch_tpu.trace import flight_recorder as trecorder
+from kube_batch_tpu.trace import spans as tspans
+from kube_batch_tpu.trace.recorder import FlightRecorder
+from kube_batch_tpu.trace.spans import SessionTrace
+
+
+@pytest.fixture(autouse=True)
+def _trace_env(monkeypatch):
+    """Tracing ON by default, empty ring, no leaked session state."""
+    monkeypatch.delenv("KUBE_BATCH_TPU_TRACE", raising=False)
+    while tspans.current_trace() is not None:
+        tspans.end_session()
+    trecorder.clear()
+    yield
+    while tspans.current_trace() is not None:
+        tspans.end_session()
+    trecorder.clear()
+
+
+def _small_cluster(n_tasks=200, n_nodes=32, n_jobs=10, n_queues=2):
+    from kube_batch_tpu.models.synthetic import make_synthetic_cache
+    return make_synthetic_cache(n_tasks, n_nodes, n_jobs, n_queues)
+
+
+def _scheduler(cache):
+    from kube_batch_tpu.scheduler import Scheduler
+    return Scheduler(cache)
+
+
+# ----------------------------------------------------------------------
+# span mechanics
+
+
+def test_span_nesting_depth_track_and_containment():
+    sid = tspans.begin_session(kind="test")
+    assert sid is not None
+    with tspans.span("phase_a"):
+        with tspans.span("inner", detail=1):
+            pass
+    with tspans.span("phase_b"):
+        tspans.instant("marker", note="x")
+    tspans.end_session()
+
+    tr = trecorder.get(sid)
+    assert tr is not None and tr.sid == sid
+    by_name = {sp.name: sp for sp in tr.spans}
+    assert set(by_name) == {"phase_a", "inner", "phase_b", "marker"}
+    assert by_name["phase_a"].depth == 0
+    assert by_name["phase_a"].track == "phase_a"
+    assert by_name["inner"].depth == 1
+    assert by_name["inner"].track == "phase_a"
+    assert by_name["inner"].args == {"detail": 1}
+    assert by_name["marker"].dur == 0.0
+    # containment: inner starts after and ends before its parent
+    a, i = by_name["phase_a"], by_name["inner"]
+    assert i.ts >= a.ts
+    assert i.ts + i.dur <= a.ts + a.dur + 1.0  # 1 us slack
+    assert tr.duration_ms >= 0.0
+
+
+def test_annotate_and_counters_land_on_open_span():
+    sid = tspans.begin_session()
+    with tspans.span("s") as sp:
+        tspans.annotate(mode="full")
+        tspans.counter("bytes", 123)
+        assert sp.args["mode"] == "full"
+    tspans.end_session()
+    tr = trecorder.get(sid)
+    (rec,) = [sp for sp in tr.spans if sp.name == "s"]
+    assert rec.args == {"mode": "full"}
+    assert tr.counters == [("bytes", tr.counters[0][1], 123)]
+
+
+def test_note_verdict_and_tally_recorded_and_capped():
+    sid = tspans.begin_session()
+    tspans.note_verdict("j1", "NotEnoughTasks", "0/5 ready")
+    tspans.note_tally("j1", unplaced=3, reason="NoFeasibleNode")
+    tspans.end_session()
+    why = trecorder.why("j1")
+    assert why["session"] == sid
+    assert why["reason"] == "NotEnoughTasks"
+    assert why["solver"]["unplaced"] == 3
+    assert trecorder.why("no-such-job") is None
+
+
+def test_repeated_verdicts_dedupe_across_ring():
+    """A stuck cluster re-records identical reasons every cycle; the ring
+    shares the value objects instead of pinning N copies."""
+    for _ in range(3):
+        tspans.begin_session()
+        tspans.note_verdict("ns/stuck", "NotEnoughTasks", "1/50 ready")
+        tspans.note_tally("ns/stuck", unplaced=49, reason="NoFeasibleNode")
+        tspans.end_session()
+    traces = trecorder.traces()
+    assert len(traces) == 3
+    assert traces[0].verdicts["ns/stuck"] is traces[1].verdicts["ns/stuck"]
+    assert traces[1].verdicts["ns/stuck"] is traces[2].verdicts["ns/stuck"]
+    assert traces[0].tallies["ns/stuck"] is traces[2].tallies["ns/stuck"]
+    # a CHANGED verdict is not shared
+    tspans.begin_session()
+    tspans.note_verdict("ns/stuck", "NotEnoughTasks", "2/50 ready")
+    tspans.end_session()
+    newest = trecorder.latest()
+    assert newest.verdicts["ns/stuck"] is not traces[2].verdicts["ns/stuck"]
+    assert trecorder.why("ns/stuck")["message"] == "2/50 ready"
+
+
+def test_nested_begin_session_keeps_outer_alive():
+    sid = tspans.begin_session()
+    assert tspans.begin_session() is None  # nested: traces into the outer
+    assert tspans.current_session_id() == sid
+    tspans.end_session()                   # balances the nested begin
+    assert tspans.current_session_id() == sid
+    tspans.end_session()
+    assert tspans.current_session_id() is None
+    assert trecorder.get(sid) is not None
+
+
+# ----------------------------------------------------------------------
+# kill switch
+
+
+class _CountingLock:
+    def __init__(self, inner):
+        self.inner = inner
+        self.acquisitions = 0
+
+    def __enter__(self):
+        self.acquisitions += 1
+        return self.inner.__enter__()
+
+    def __exit__(self, *exc):
+        return self.inner.__exit__(*exc)
+
+
+def test_kill_switch_zero_spans_zero_recorder_locks(monkeypatch):
+    monkeypatch.setenv("KUBE_BATCH_TPU_TRACE", "0")
+    counting = _CountingLock(threading.Lock())
+    monkeypatch.setattr(trecorder, "_lock", counting)
+
+    assert tspans.begin_session() is None
+    # span() hands back the shared no-op singleton: no per-span state.
+    assert tspans.span("x") is tspans._NOOP
+    with tspans.span("x"):
+        tspans.annotate(a=1)
+        tspans.counter("c", 1)
+        tspans.note_verdict("j", "r", "m")
+        tspans.note_tally("j", unplaced=1)
+        tspans.note_ship("full", 10)
+    tspans.end_session()
+
+    # A full scheduling cycle with tracing off: still zero recorder-lock
+    # acquisitions and nothing recorded.
+    cache, _ = _small_cluster()
+    _scheduler(cache).run_once()
+    assert counting.acquisitions == 0
+    assert trecorder.traces() == []  # (this read itself takes the lock)
+
+
+# ----------------------------------------------------------------------
+# recorder ring
+
+
+def test_ring_eviction_keeps_last_n():
+    rec = FlightRecorder(capacity=4)
+    for i in range(10):
+        tr = SessionTrace(i + 1, {})
+        rec.record(tr)
+    sids = [t.sid for t in rec.traces()]
+    assert sids == [7, 8, 9, 10]
+    assert rec.get(1) is None
+    assert rec.get(10).sid == 10
+
+
+def test_recorder_under_concurrent_sessions(monkeypatch):
+    import kube_batch_tpu.trace.recorder as recorder_mod
+    rec = FlightRecorder(capacity=16)
+    # end_session resolves the recorder through the module attribute, so
+    # patching it redirects every thread's push.
+    monkeypatch.setattr(recorder_mod, "recorder", rec)
+
+    n_threads, per_thread = 4, 20
+    seen = []
+    seen_lock = threading.Lock()
+
+    def worker():
+        for _ in range(per_thread):
+            sid = tspans.begin_session()
+            with tspans.span("work"):
+                pass
+            tspans.end_session()
+            with seen_lock:
+                seen.append(sid)
+
+    threads = [threading.Thread(target=worker) for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    assert len(seen) == n_threads * per_thread
+    assert len(set(seen)) == len(seen), "session ids must be unique"
+    ring = rec.traces()
+    assert len(ring) == 16
+    ring_sids = [t.sid for t in ring]
+    assert len(set(ring_sids)) == 16
+    for tr in ring:
+        assert rec.get(tr.sid) is tr
+        assert len(tr.spans) == 1
+
+
+# ----------------------------------------------------------------------
+# live sessions: export schema, device-wait agreement, ship annotation
+
+
+@pytest.fixture(scope="module")
+def traced_cycle():
+    """One traced scheduler cycle on a small synthetic cluster with a
+    deliberately unschedulable gang job; shared by the read-only tests."""
+    import os
+
+    from kube_batch_tpu.api import ObjectMeta
+    from kube_batch_tpu.apis.scheduling import v1alpha1
+    from kube_batch_tpu.metrics.metrics import overlap_split_totals
+
+    os.environ.pop("KUBE_BATCH_TPU_TRACE", None)
+    while tspans.current_trace() is not None:
+        tspans.end_session()
+    trecorder.clear()
+    cache, _ = _small_cluster()
+    cache.add_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name="stuck-gang", namespace="t"),
+        spec=v1alpha1.PodGroupSpec(min_member=10_000, queue="q0")))
+    sched = _scheduler(cache)
+    h0, w0, _ = overlap_split_totals()
+    sched.run_once()
+    h1, w1, _ = overlap_split_totals()
+    trace = trecorder.latest()
+    assert trace is not None
+    return {"trace": trace, "device_wait_metric_ms": w1 - w0,
+            "host_overlap_metric_ms": h1 - h0}
+
+
+def test_chrome_export_schema(traced_cycle):
+    doc = texport.to_chrome_trace(traced_cycle["trace"])
+    # Round-trips through JSON (the HTTP endpoint serves exactly this).
+    doc = json.loads(json.dumps(doc))
+    events = doc["traceEvents"]
+    assert isinstance(events, list) and events
+    named_tids = set()
+    for ev in events:
+        assert set(ev) >= {"name", "ph", "pid", "tid"}
+        assert ev["ph"] in ("M", "X", "C")
+        if ev["ph"] == "M":
+            if ev["name"] == "thread_name":
+                named_tids.add(ev["tid"])
+        elif ev["ph"] == "X":
+            assert isinstance(ev["ts"], (int, float)) and ev["ts"] >= 0
+            assert isinstance(ev["dur"], (int, float)) and ev["dur"] >= 0
+        else:  # counter
+            assert all(isinstance(v, (int, float))
+                       for v in ev["args"].values())
+    # every span/counter tid has a thread_name track (tid 0 = session)
+    used = {ev["tid"] for ev in events if ev["ph"] in ("X", "C")}
+    assert used - {0} <= named_tids
+    # one track per phase: the cycle's top-level phases all have tracks
+    span_names = {ev["name"] for ev in events if ev["ph"] == "X"}
+    assert {"open_session", "action.tpu-allocate", "close_session",
+            "tensorize", "ship", "dispatch", "host_overlap",
+            "device_wait", "apply"} <= span_names
+
+
+def test_device_wait_span_agrees_with_histogram(traced_cycle):
+    totals = texport.span_totals(traced_cycle["trace"])
+    span_ms = totals.get("device_wait", 0.0)
+    metric_ms = traced_cycle["device_wait_metric_ms"]
+    assert span_ms > 0 and metric_ms > 0
+    # Same interval measured twice (the span nests directly inside the
+    # histogram's perf_counter pair): within 5% or 0.5 ms slack.
+    assert abs(span_ms - metric_ms) <= max(0.05 * metric_ms, 0.5), \
+        (span_ms, metric_ms)
+
+
+def test_ship_span_carries_mode_and_bytes(traced_cycle):
+    tr = traced_cycle["trace"]
+    (ship,) = [sp for sp in tr.spans if sp.name == "ship"]
+    assert ship.args.get("ship_mode") in ("full", "delta", "clean")
+    assert isinstance(ship.args.get("ship_bytes"), int)
+    assert any(name == "ship_bytes" for name, _ts, _v in tr.counters)
+
+
+def test_why_pending_for_unschedulable_gang(traced_cycle):
+    # The per-test autouse cleaner empties the global ring (the module
+    # fixture ran before it); re-record the immutable trace.
+    trecorder.record(traced_cycle["trace"])
+    why = trecorder.why("stuck-gang")
+    assert why is not None
+    assert why["session"] == traced_cycle["trace"].sid
+    assert why["reason"]  # NotEnoughTasks from the job_valid gate
+    assert "10000" in why["message"] or "min" in why["message"]
+    # verdicts are namespace-qualified (names unique per namespace only)
+    assert why["job"] == "t/stuck-gang"
+    assert trecorder.why("t/stuck-gang") is not None
+    assert trecorder.why("other-ns/stuck-gang") is None
+
+
+def test_summaries_shape(traced_cycle):
+    trecorder.record(traced_cycle["trace"])
+    summaries = trecorder.summaries()
+    assert summaries, "at least the traced cycle"
+    s = summaries[0]
+    assert s["session"] == traced_cycle["trace"].sid
+    assert s["uid"] == traced_cycle["trace"].uid
+    assert s["duration_ms"] > 0
+    assert "action.tpu-allocate" in s["phases_ms"]
+    assert s["verdicts"] >= 1
+    assert s["meta"]["jobs"] >= 1
+
+
+def test_phase_percentiles():
+    sids = []
+    for _ in range(5):
+        sid = tspans.begin_session()
+        with tspans.span("phase"):
+            pass
+        tspans.end_session()
+        sids.append(sid)
+    traces = [trecorder.get(s) for s in sids]
+    pct = texport.phase_percentiles(traces, names=("phase",))
+    assert pct["phase"]["n"] == 5
+    assert pct["phase"]["p50"] <= pct["phase"]["p95"]
+
+
+# ----------------------------------------------------------------------
+# solver-mask tallies
+
+
+def test_solver_tally_for_unplaceable_task():
+    from kube_batch_tpu.api import (Container, ObjectMeta, Pod, PodSpec,
+                                    PodStatus)
+    from kube_batch_tpu.apis.scheduling import v1alpha1
+    from kube_batch_tpu.apis.scheduling.v1alpha1 import GroupNameAnnotationKey
+
+    cache, _ = _small_cluster()
+    cache.add_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name="hog", namespace="t"),
+        spec=v1alpha1.PodGroupSpec(min_member=1, queue="q0")))
+    # 999 CPUs fits no 16-CPU node: the solver leaves it unplaced and the
+    # tally explains the stall as NoFeasibleNode (mask passed, no room).
+    cache.add_pod(Pod(
+        metadata=ObjectMeta(name="hog-0", namespace="t", uid="hog-0",
+                            annotations={GroupNameAnnotationKey: "hog"},
+                            creation_timestamp=1.0),
+        spec=PodSpec(containers=[Container(requests={"cpu": "999",
+                                                     "memory": "1Gi"})]),
+        status=PodStatus(phase="Pending")))
+    _scheduler(cache).run_once()
+    why = trecorder.why("hog")
+    assert why is not None, "tally for the stalled job must be recorded"
+    solver = why.get("solver") or why
+    assert solver["unplaced"] >= 1
+    assert solver["static_feasible_nodes"] > 0
+    assert solver["reason"] == "NoFeasibleNode"
+
+
+# ----------------------------------------------------------------------
+# /debug endpoints over HTTP
+
+
+def _get(url):
+    with urllib.request.urlopen(url, timeout=10) as resp:
+        return resp.status, json.loads(resp.read().decode())
+
+
+def test_debug_endpoints_http(traced_cycle):
+    from kube_batch_tpu.cli.server import start_metrics_server
+
+    trecorder.record(traced_cycle["trace"])
+    server = start_metrics_server("127.0.0.1:0")
+    try:
+        port = server.server_address[1]
+        base = f"http://127.0.0.1:{port}"
+
+        status, sessions = _get(f"{base}/debug/sessions")
+        assert status == 200
+        assert sessions["tracing_enabled"] is True
+        sid = traced_cycle["trace"].sid
+        assert any(s["session"] == sid for s in sessions["sessions"])
+
+        status, doc = _get(f"{base}/debug/trace?session={sid}")
+        assert status == 200
+        assert {"open_session", "device_wait"} <= {
+            ev["name"] for ev in doc["traceEvents"] if ev["ph"] == "X"}
+
+        status, latest = _get(f"{base}/debug/trace?session=latest")
+        assert status == 200
+
+        status, why = _get(f"{base}/debug/why?job=stuck-gang")
+        assert status == 200
+        assert why["job"] == "t/stuck-gang" and why["reason"]
+
+        for bad in ("/debug/trace?session=99999999", "/debug/trace",
+                    "/debug/why?job=definitely-not-a-job",
+                    "/debug/nope"):
+            try:
+                with urllib.request.urlopen(f"{base}{bad}", timeout=10) as r:
+                    assert False, f"{bad} should not return {r.status}"
+            except urllib.error.HTTPError as e:
+                assert e.code in (400, 404)
+    finally:
+        server.shutdown()
+
+
+# ----------------------------------------------------------------------
+# log correlation
+
+
+def test_log_records_carry_session_id(caplog):
+    tspans.install_log_correlation()
+    logger = logging.getLogger("kube_batch_tpu.test_trace")
+    with caplog.at_level(logging.INFO, logger="kube_batch_tpu.test_trace"):
+        logger.info("outside any session")
+        sid = tspans.begin_session()
+        logger.info("inside the session")
+        tspans.end_session()
+        logger.info("after the session")
+    msgs = [r.getMessage() for r in caplog.records]
+    assert msgs[0] == "outside any session"
+    assert msgs[1] == f"[s={sid}] inside the session"
+    assert msgs[2] == "after the session"
+    assert caplog.records[1].session_id == sid

@@ -1,0 +1,65 @@
+"""trace-demo: record a small live session and write its Chrome trace.
+
+Runs two scheduler cycles on a synthetic in-process cluster (cold +
+steady, so the delta-ship path and a realistic span tree both appear),
+plus one deliberately unschedulable gang job so the flight recorder has
+a why-pending verdict to show, then writes the newest session's
+trace-event JSON to the given path (default doc/trace_demo.json) —
+drag-and-drop it into https://ui.perfetto.dev to browse the span tree.
+
+Usage: python tools/trace_demo.py [out.json]   (CI runs `make trace-demo`
+and uploads the artifact.)
+"""
+
+import json
+import os
+import pathlib
+import sys
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["KUBE_BATCH_TPU_TRACE"] = "1"
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "doc/trace_demo.json"
+
+    from kube_batch_tpu.api import ObjectMeta
+    from kube_batch_tpu.apis.scheduling import v1alpha1
+    from kube_batch_tpu.models.synthetic import make_synthetic_cache
+    from kube_batch_tpu.scheduler import Scheduler
+    from kube_batch_tpu.trace import export, flight_recorder as recorder
+
+    cache, _binder = make_synthetic_cache(400, 64, 16, 4)
+    # A gang that can never be ready: its why-pending verdict lands in
+    # the recorder (try /debug/why?job=demo-stuck on a live server).
+    cache.add_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name="demo-stuck", namespace="demo"),
+        spec=v1alpha1.PodGroupSpec(min_member=10_000, queue="q0")))
+
+    sched = Scheduler(cache)
+    sched.run_once()   # cold: full ship, XLA compile
+    sched.run_once()   # steady: delta/clean ship
+
+    trace = recorder.latest()
+    if trace is None:
+        print("no trace recorded (is KUBE_BATCH_TPU_TRACE=0 leaking in?)",
+              file=sys.stderr)
+        return 1
+    doc = export.to_chrome_trace(trace)
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=1)
+    why = recorder.why("demo-stuck")
+    print(f"wrote {out_path}: session {trace.sid}, "
+          f"{len(trace.spans)} spans, {len(doc['traceEvents'])} events, "
+          f"{trace.duration_ms:.1f} ms")
+    print("phases:", json.dumps(export.summarize_phases(trace)))
+    print("why demo-stuck:", json.dumps(why))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

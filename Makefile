@@ -3,7 +3,7 @@ PYTHON ?= python
 COMPILE_CACHE ?= $(CURDIR)/.compile-cache
 
 .PHONY: lint lint-inventory test bench bench-cached bench-steady \
-	trace-demo clean-cache
+	bench-evict trace-demo clean-cache
 
 # graftlint: the repo's contract-enforcing static analysis (doc/LINT.md)
 # — lock discipline, donation safety, tracer hygiene, ship/no-mutate
@@ -44,6 +44,18 @@ bench-steady:
 	env JAX_PLATFORMS=cpu BENCH_STEADY_ONLY=1 BENCH_STEADY_ROUNDS=8 \
 		BENCH_TASKS=2000 BENCH_NODES=256 BENCH_JOBS=80 \
 		BENCH_QUEUES=4 $(PYTHON) bench.py
+
+# Batched-vs-sequential eviction A/B smoke at a small CPU shape
+# (doc/EVICTION.md): runs the 4-action storm pipeline with
+# KUBE_BATCH_TPU_BATCH_EVICT on and off, asserts bit-identical victims
+# and binds, and prints both arms' preempt/reclaim timings.  The checker
+# exits nonzero on a parity break (bench.py itself always exits 0), so
+# CI fails loudly.
+bench-evict:
+	env JAX_PLATFORMS=cpu BENCH_EVICT_AB=1 BENCH_TASKS=2000 \
+		BENCH_NODES=256 BENCH_JOBS=80 BENCH_QUEUES=4 \
+		KUBE_BATCH_TPU_SCAN_MIN_NODES=0 $(PYTHON) bench.py \
+		| $(PYTHON) tools/check_evict_ab.py
 
 # Record a small live session with the flight recorder on and write its
 # Chrome trace-event JSON (doc/OBSERVABILITY.md): open doc/trace_demo.json

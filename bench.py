@@ -37,9 +37,18 @@ time blocked on the result), and the full/delta/clean input-shipment
 counters.  BENCH_STEADY_ONLY=1 runs only this measurement (the
 ``make bench-steady`` mode).
 
+The 4-action scenario is measured as a same-box counterbalanced A/B of
+the batched eviction engine (doc/EVICTION.md): ``actions_ms`` is the
+batched arm (the shipped default), ``actions_seq_ms`` the
+KUBE_BATCH_TPU_BATCH_EVICT=0 sequential control, ``evict_ab`` the
+preempt/reclaim speedups, ``evict_parity`` the bit-identical
+victims-and-binds verdict, and ``evictions_by_action`` splits the
+formerly opaque ``pipeline_evictions`` total.  BENCH_EVICT_AB=1 runs
+ONLY this A/B (the ``make bench-evict`` smoke).
+
 Env overrides: BENCH_TASKS, BENCH_NODES, BENCH_JOBS, BENCH_QUEUES;
 BENCH_PIPELINE=0 skips the 4-action scenario, BENCH_COLD_N (default 5);
-BENCH_STEADY_ONLY=1, BENCH_STEADY_ROUNDS (default 5);
+BENCH_STEADY_ONLY=1, BENCH_STEADY_ROUNDS (default 5); BENCH_EVICT_AB=1;
 BENCH_PROBE_TIMEOUT (s, default 150), BENCH_PROBE_BACKOFF (s, default
 2 — the probe retries once after this backoff), BENCH_DEADLINE (s,
 default 5400 — wall-clock backstop that emits whatever was measured and
@@ -450,11 +459,26 @@ def measure_action_pipeline(n_tasks, n_nodes, n_jobs, n_queues,
     (config/kube-batch-conf.yaml mirroring the reference's
     kube-batch-conf.yaml:1-8) — on a FULL cluster with a high-priority
     pending wave (preempt does real evictions; the starved queue drives
-    reclaim's cross-queue path).  One warm cache absorbs jit compiles;
-    each timed cycle runs on its own fresh cache (the scenario is
-    consumed by its own evictions).  Returns ({action: (med, p90)},
-    evictions)."""
+    reclaim's cross-queue path), measured as a same-box counterbalanced
+    A/B of the batched eviction engine (doc/EVICTION.md): per pair of
+    ``cycles`` one cycle runs KUBE_BATCH_TPU_BATCH_EVICT=0 (the
+    sequential control) and one =1, in off/on/on/off order.  One warm
+    cache per arm absorbs jit compiles; each timed cycle runs on its own
+    fresh cache (the scenario is consumed by its own evictions, and the
+    synthetic build is deterministic, so the two arms face identical
+    clusters).  Returns a dict:
+
+      actions      {action: (med, p90)} — batched arm (the shipped mode)
+      actions_seq  {action: (med, p90)} — sequential control
+      evictions    eviction count of one cycle
+      evictions_by_action  {action: count} split of one batched cycle
+      parity       True iff both arms evicted the IDENTICAL victim
+                   sequence and produced identical binds (the engine's
+                   bit-parity contract, checked on real storm traffic)
+    """
     from kube_batch_tpu.framework import close_session, open_session
+    from kube_batch_tpu.metrics.metrics import evictions_by_action
+    from kube_batch_tpu.models.scanner import BATCH_EVICT_ENV
     from kube_batch_tpu.models.synthetic import make_churn_cache
     from kube_batch_tpu.scheduler import load_scheduler_conf
 
@@ -468,9 +492,8 @@ def measure_action_pipeline(n_tasks, n_nodes, n_jobs, n_queues,
                                  '"reclaim, tpu-allocate, backfill, '
                                  'preempt"')
     actions, tiers = load_scheduler_conf(conf)
-    per_action: dict = {}
-    evictions = 0
-    for cycle in range(cycles + 1):
+
+    def one_cycle(batched: bool):
         cache, binder = make_churn_cache(n_tasks, n_nodes, n_jobs, n_queues)
         with _gc_posture():
             ssn = open_session(cache, tiers)
@@ -480,24 +503,63 @@ def measure_action_pipeline(n_tasks, n_nodes, n_jobs, n_queues,
                 a.execute(ssn)
                 cycle_ms[a.name()] = (time.perf_counter() - t0) * 1e3
             close_session(ssn)
-        if cycle == 0:
-            continue  # compile-warm cycle
-        for name, ms in cycle_ms.items():
-            per_action.setdefault(name, []).append(ms)
-        evictions = len(cache.evictor.evicts)
+        return cycle_ms, list(cache.evictor.evicts), dict(binder.binds)
+
+    prior = os.environ.get(BATCH_EVICT_ENV)
+    per_arm: dict = {True: {}, False: {}}
+    footprint: dict = {}
+    evictions = 0
+    split: dict = {}
+    try:
+        # Warm both arms (jit shapes + clone pools), then counterbalance.
+        for arm in (True, False):
+            os.environ[BATCH_EVICT_ENV] = "1" if arm else "0"
+            one_cycle(arm)
+        arms = [False, True, True, False] * ((cycles + 1) // 2)
+        for arm in arms[:2 * cycles]:
+            os.environ[BATCH_EVICT_ENV] = "1" if arm else "0"
+            before = evictions_by_action() if arm else None
+            cycle_ms, evicts, binds = one_cycle(arm)
+            for name, ms in cycle_ms.items():
+                per_arm[arm].setdefault(name, []).append(ms)
+            if arm and not split:
+                after = evictions_by_action()
+                split = {k: after.get(k, 0) - (before or {}).get(k, 0)
+                         for k in after}
+                split = {k: v for k, v in split.items() if v}
+            evictions = len(evicts)
+            footprint.setdefault(arm, (evicts, binds))
+    finally:
+        if prior is None:
+            os.environ.pop(BATCH_EVICT_ENV, None)
+        else:
+            os.environ[BATCH_EVICT_ENV] = prior
     assert evictions > 0, "pipeline evicted nothing"
-    return ({name: _stats(runs) for name, runs in per_action.items()},
-            evictions)
+    parity = footprint.get(True) == footprint.get(False)
+    return {
+        "actions": {name: _stats(runs)
+                    for name, runs in per_arm[True].items()},
+        "actions_seq": {name: _stats(runs)
+                        for name, runs in per_arm[False].items()},
+        "evictions": evictions,
+        "evictions_by_action": split,
+        "parity": parity,
+    }
 
 
 def _probe_backend(timeout_s: float):
     """Initialize the default JAX backend in a SUBPROCESS and run one op.
 
-    Returns (platform, None) on success or (None, error_str) on any
-    failure — nonzero exit, crash, or hang past ``timeout_s``.  Isolating
-    init in a child means a wedged device tunnel (which hangs
-    ``jax.devices()`` indefinitely and is unrecoverable in-process)
-    cannot take this process with it; the child is SIGKILLed on timeout.
+    Returns (platform, error, stderr_tail): error is None on success and
+    otherwise a string CLASSIFIED BY EXIT CODE (nonzero exit, crash, or
+    hang past ``timeout_s``); the child's stderr tail travels SEPARATELY
+    so a warning-only stderr (e.g. "Platform 'axon' is experimental")
+    never masquerades as the failure reason inside ``error`` — BENCH_r05
+    embedded exactly that warning as the probe "error" (the artifact now
+    carries it under ``probe_stderr``).  Isolating init in a child means
+    a wedged device tunnel (which hangs ``jax.devices()`` indefinitely
+    and is unrecoverable in-process) cannot take this process with it;
+    the child is SIGKILLed on timeout.
     """
     import subprocess
     import sys
@@ -551,15 +613,22 @@ def _probe_backend(timeout_s: float):
             stdout, stderr = p.communicate()
             tail = (stderr or stdout or "").strip()[-400:]
             return None, (f"backend probe timed out after {timeout_s:.0f}s "
-                          "(device tunnel hung)"
-                          + (f"; child stderr tail: {tail}" if tail else ""))
+                          "(device tunnel hung; child SIGKILLed)"), tail
     except Exception as exc:  # lint: allow-swallow(probe failure is returned as the artifact's error string, not raised past the emit guarantee)
-        return None, f"backend probe could not run: {exc!r}"  # pragma: no cover
+        return None, f"backend probe could not run: {exc!r}", ""  # pragma: no cover
+    tail = (stderr or "").strip()[-400:]
     if p.returncode != 0:
-        tail = (stderr or stdout or "").strip()[-400:]
-        return None, f"backend probe exited {p.returncode}: {tail}"
+        # Classify by EXIT CODE only; stderr rides the separate channel.
+        if p.returncode == 3:
+            why = ("probe child watchdog fired (exit 3): backend init "
+                   "exceeded its deadline")
+        elif p.returncode < 0:
+            why = f"backend probe killed by signal {-p.returncode}"
+        else:
+            why = f"backend probe exited {p.returncode}"
+        return None, why, tail
     lines = stdout.strip().splitlines()
-    return (lines[-1] if lines else "unknown"), None
+    return (lines[-1] if lines else "unknown"), None, tail
 
 
 def _probe_backend_with_retry(timeout_s: float):
@@ -567,19 +636,22 @@ def _probe_backend_with_retry(timeout_s: float):
 
     BENCH_r05 recorded only "probe exited 3" because the axon tunnel was
     transiently wedged at capture time; a single retry rides out that
-    class of failure, and the combined error keeps BOTH attempts' stderr
-    tails so the next capture failure is attributable from the artifact
-    alone."""
-    platform, err = _probe_backend(timeout_s)
+    class of failure.  Returns (platform, error, stderr_tail): the error
+    combines both attempts' exit-code classifications, while the stderr
+    tails travel separately (the artifact's ``probe_stderr``) so warning
+    noise never pollutes the failure reason."""
+    platform, err, tail = _probe_backend(timeout_s)
     if err is None:
-        return platform, None
+        return platform, None, tail
     backoff = float(os.environ.get("BENCH_PROBE_BACKOFF", 2.0))
     time.sleep(backoff)
-    platform, err2 = _probe_backend(timeout_s)
+    platform, err2, tail2 = _probe_backend(timeout_s)
     if err2 is None:
-        return platform, None
+        return platform, None, tail2
+    tails = "; ".join(f"attempt {i}: {t}" for i, t in
+                      enumerate((tail, tail2), 1) if t)
     return None, (f"attempt 1: {err}; attempt 2 after {backoff:.1f}s "
-                  f"backoff: {err2}")
+                  f"backoff: {err2}"), tails
 
 
 class _Interrupted(BaseException):
@@ -615,8 +687,48 @@ def _ignore_signals():
             pass
 
 
+def _fill_action_ab(out, n_tasks, n_nodes, n_jobs, n_queues,
+                    cycles: int = 2) -> None:
+    """Run the 4-action storm pipeline as a batched-vs-sequential A/B and
+    record per-action medians for BOTH arms, the per-action eviction
+    split, and the bit-parity verdict (doc/EVICTION.md)."""
+    pa = measure_action_pipeline(n_tasks, n_nodes, n_jobs, n_queues,
+                                 cycles=cycles)
+    out["actions_ms"] = {name: med
+                         for name, (med, _p90) in pa["actions"].items()}
+    out["actions_p90"] = {name: p90
+                          for name, (_med, p90) in pa["actions"].items()}
+    out["actions_seq_ms"] = {
+        name: med for name, (med, _p90) in pa["actions_seq"].items()}
+    out["pipeline_evictions"] = pa["evictions"]
+    out["evictions_by_action"] = pa["evictions_by_action"]
+    out["evict_parity"] = pa["parity"]
+    evict_ab = {}
+    for action in ("preempt", "reclaim"):
+        on = out["actions_ms"].get(action)
+        off = out["actions_seq_ms"].get(action)
+        if on and off:
+            evict_ab[action] = {"batched_ms": on, "sequential_ms": off,
+                                "speedup": round(off / on, 2)}
+    out["evict_ab"] = evict_ab or None
+
+
 def _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline,
-         steady_only=False, steady_rounds_n=5):
+         steady_only=False, steady_rounds_n=5, evict_only=False):
+    if evict_only:
+        # BENCH_EVICT_AB=1 (`make bench-evict`): ONLY the batched-vs-
+        # sequential eviction A/B at the configured (small) shape — the
+        # parity + speedup smoke CI runs per push.
+        import jax as _jax
+        out["platform"] = _jax.default_backend()
+        _fill_action_ab(out, n_tasks, n_nodes, n_jobs, n_queues)
+        return
+    _run_full(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n,
+              with_pipeline, steady_only, steady_rounds_n)
+
+
+def _run_full(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n,
+              with_pipeline, steady_only=False, steady_rounds_n=5):
     """Fill ``out`` incrementally; a failure partway leaves every
     completed measurement in place for the caller to emit.
 
@@ -739,13 +851,7 @@ def _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline,
             out["stages_error"] = f"{type(exc).__name__}: {exc}"
 
         if with_pipeline:
-            per_action, evictions = measure_action_pipeline(
-                n_tasks, n_nodes, n_jobs, n_queues)
-            out["actions_ms"] = {name: med
-                                 for name, (med, _p90) in per_action.items()}
-            out["actions_p90"] = {name: p90
-                                  for name, (_med, p90) in per_action.items()}
-            out["pipeline_evictions"] = evictions
+            _fill_action_ab(out, n_tasks, n_nodes, n_jobs, n_queues)
 
     # Session-level compile-cache split over everything measured above:
     # hits = solves served by an already-compiled (bucket, cfg)
@@ -765,6 +871,9 @@ def main():
         "vs_baseline": None,
         "platform": None,
         "parity": None,  # null when the check does not apply (non-TPU)
+        # Probe stderr tail (warnings included), SEPARATE from `error`:
+        # a warning-only stderr is not a probe failure.
+        "probe_stderr": None,
         # Compile-ahead attribution (null until measured): the warm-up
         # call's wall clock, its compile share, and the hit/miss split.
         "first_solve_ms": None,
@@ -817,10 +926,12 @@ def main():
         deadline_s = float(os.environ.get("BENCH_DEADLINE", 5400))
         with_pipeline = os.environ.get("BENCH_PIPELINE", "1") != "0"
         steady_only = os.environ.get("BENCH_STEADY_ONLY") == "1"
+        evict_only = os.environ.get("BENCH_EVICT_AB") == "1"
         steady_rounds_n = int(os.environ.get("BENCH_STEADY_ROUNDS", 5))
         out["metric"] = (f"sched-session solve latency @ {n_tasks} tasks "
                          f"x {n_nodes} nodes (gang+DRF+proportion)"
-                         + (" [steady-only]" if steady_only else ""))
+                         + (" [steady-only]" if steady_only else "")
+                         + (" [evict-ab]" if evict_only else ""))
 
         # Wall-clock backstop for hangs the signal guard cannot reach
         # (a device call blocked in an extension never returns to the
@@ -836,7 +947,12 @@ def main():
         watchdog.daemon = True
         watchdog.start()
 
-        platform, probe_err = _probe_backend_with_retry(probe_timeout)
+        platform, probe_err, probe_tail = _probe_backend_with_retry(
+            probe_timeout)
+        if probe_tail:
+            # Warning-only stderr (experimental-platform notices etc.)
+            # is recorded but is NOT an error (BENCH_r05 conflated them).
+            out["probe_stderr"] = probe_tail
         if probe_err is not None:
             # The default backend is unusable.  Pin CPU and measure
             # anyway: a degraded, CPU-marked artifact beats the rc=1
@@ -851,7 +967,8 @@ def main():
         else:
             out["platform"] = platform
         _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline,
-             steady_only=steady_only, steady_rounds_n=steady_rounds_n)
+             steady_only=steady_only, steady_rounds_n=steady_rounds_n,
+             evict_only=evict_only)
         # Last statement INSIDE the try: a signal landing here is still
         # caught below — no handlerless gap before the emit.
         _ignore_signals()

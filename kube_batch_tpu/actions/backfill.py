@@ -40,7 +40,11 @@ class BackfillAction(Action):
                                                        {}).values())
             sp.annotate(prescanned=prescanned,
                         has_best_effort=bool(has_best_effort))
-            scanner = maybe_scanner(ssn) if has_best_effort else None
+            # shared=True: reuse the batched eviction engine's session
+            # scanner (dirty-node refreshed) when reclaim already built
+            # it, instead of paying a third tensorize this cycle.
+            scanner = (maybe_scanner(ssn, shared=True)
+                       if has_best_effort else None)
         with trace.span("backfill.place"):
             for job in list(ssn.jobs.values()):
                 pending = list(job.task_status_index.get(TaskStatus.Pending,

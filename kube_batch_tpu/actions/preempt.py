@@ -55,8 +55,12 @@ class PreemptAction(Action):
                         preemptor_jobs=len(under_request)):
             # Tensorize only when there is work: the scanner costs a
             # session flatten, pure overhead on healthy clusters.
+            # shared=True: under the batched eviction engine this reuses
+            # (and dirty-refreshes) the session scanner reclaim already
+            # built and batch-seeded — no second tensorize, no second
+            # per-profile solve (doc/EVICTION.md).
             from ..models.scanner import maybe_scanner
-            scanner = maybe_scanner(ssn)
+            scanner = maybe_scanner(ssn, shared=True)
             # One pass over residents: lets the walk skip nodes (and
             # whole preemptors) that provably cannot yield a victim —
             # the starved queue's O(tasks x nodes) empty walk collapses
@@ -197,13 +201,16 @@ def _preempt(ssn, stmt, preemptor: TaskInfo, nodes, filter_fn,
             continue
 
         # Lowest-priority victims evicted first: reversed task order
-        # (preempt.go:213-218).
-        victims_queue = ssn.victims_queue(victims)
+        # (preempt.go:213-218).  The batched engine precomputed that
+        # order for every Running resident (one ranking in the session's
+        # single eviction dispatch); per-preemptor the sort collapses to
+        # an index lookup — bit-identical because the key is total (uid
+        # fallback) and immutable within the session.
+        ordered_victims = _order_victims(ssn, victims, scanner)
 
         preempted = Resource.empty()
         resreq = preemptor.init_resreq.clone()
-        while not victims_queue.empty():
-            preemptee = victims_queue.pop()
+        for preemptee in ordered_victims:
             stmt.evict(preemptee, "preempt")
             if vindex is not None:
                 vjob = ssn.jobs.get(preemptee.job)
@@ -223,6 +230,26 @@ def _preempt(ssn, stmt, preemptor: TaskInfo, nodes, filter_fn,
             break
 
     return assigned
+
+
+def _order_victims(ssn, victims: List[TaskInfo], scanner) -> List[TaskInfo]:
+    """Victims in eviction order (reversed task order, lowest priority
+    first — Session.victims_queue semantics).  Prefers the batched
+    engine's precomputed per-resident ranking; a victim outside it (or
+    no ranking at all) falls back to the session queue, which is always
+    exact."""
+    rank = getattr(scanner, "victim_rank", None) if scanner is not None \
+        else None
+    if rank is not None:
+        try:
+            return sorted(victims, key=lambda t: rank[t.uid])
+        except KeyError:
+            pass  # a victim the ranking never saw: use the exact queue
+    queue = ssn.victims_queue(victims)
+    ordered: List[TaskInfo] = []
+    while not queue.empty():
+        ordered.append(queue.pop())
+    return ordered
 
 
 def _validate_victims(victims: List[TaskInfo], resreq: Resource) -> bool:

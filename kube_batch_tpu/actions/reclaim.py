@@ -62,8 +62,13 @@ class ReclaimAction(Action):
                 # Tensorize lazily: only when a starving task actually
                 # needs a node walk (span: the stallable phase).
                 with trace.span("reclaim.prepare"):
+                    # shared=True: the batched eviction engine tensorizes
+                    # and batch-seeds ONCE here (reclaim runs first in
+                    # the shipped pipeline); preempt and backfill then
+                    # re-attach with a dirty-node refresh instead of
+                    # re-tensorizing (doc/EVICTION.md).
                     from ..models.scanner import maybe_scanner
-                    scanner = maybe_scanner(ssn)
+                    scanner = maybe_scanner(ssn, shared=True)
                     scanner_built = True
                     from ..models.victim_index import VictimIndex
                     vindex = VictimIndex.for_session(ssn)

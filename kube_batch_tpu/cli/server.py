@@ -64,6 +64,8 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         if path == "/debug/sessions":
             self._send_json({"sessions": flight_recorder.summaries(),
                              "capacity": flight_recorder.capacity,
+                             "evictions_total":
+                                 metrics.evictions_by_action(),
                              "tracing_enabled":
                                  _trace_enabled()})
         elif path == "/debug/trace":

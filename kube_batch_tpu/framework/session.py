@@ -686,6 +686,8 @@ class Session:
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
         """Evict through the cache, then mirror in-session (session.go:317-345)."""
         self.cache.evict(reclaimee, reason)
+        metrics.note_eviction(reason)  # "reclaim" on the direct path
+        trace.note_evict(reason)
         job = self.jobs.get(reclaimee.job)
         if job is None:
             raise KeyError(f"failed to find job {reclaimee.job}")

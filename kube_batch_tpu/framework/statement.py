@@ -97,6 +97,8 @@ class Statement:
     def commit(self) -> None:
         """Replay evictions against the cluster; pipelines stay session-only
         (go:210-220)."""
+        from ..metrics import metrics
+        from ..trace import spans as trace
         for name, args in self.operations:
             if name == "evict":
                 reclaimee, reason = args
@@ -104,4 +106,9 @@ class Statement:
                     self.ssn.cache.evict(reclaimee, reason)
                 except Exception:  # lint: allow-swallow(commit continues past one failed evict; _unevict restores session state and cache.evict queued the resync)
                     self._unevict(reclaimee)  # also restores VictimIndex
+                else:
+                    # Per-action eviction attribution (the reason string
+                    # IS the deciding action: "preempt" here).
+                    metrics.note_eviction(reason)
+                    trace.note_evict(reason)
         self.operations.clear()

@@ -130,6 +130,11 @@ class Counter:
         with self._lock:
             return self._values.get(labels, 0.0)
 
+    def values(self) -> Dict[tuple, float]:
+        """Snapshot of every labeled value (bench/debug readers)."""
+        with self._lock:
+            return dict(self._values)
+
     def expose(self) -> str:
         lines = [f"# HELP {self.name} {_escape_help(self.help)}",
                  f"# TYPE {self.name} {self.TYPE}"]
@@ -272,6 +277,17 @@ swallowed_exceptions = registry.register(Counter(
     f"{SUBSYSTEM}_swallowed_exceptions_total",
     "Exceptions swallowed by reviewed best-effort paths, by site",
     ("site",)))
+# Batched eviction engine (doc/EVICTION.md): cluster-committed evictions
+# split by the action that decided them (the bench artifact's opaque
+# ``pipeline_evictions`` total, made attributable), and the VictimIndex's
+# life-cycle events (matrix rebuilds, live evict/restore invalidations).
+evictions_total = registry.register(Counter(
+    f"{SUBSYSTEM}_evictions_total",
+    "Cluster-committed evictions, by deciding action", ("action",)))
+victim_index_events = registry.register(Counter(
+    f"{SUBSYSTEM}_victim_index_events_total",
+    "VictimIndex life-cycle events (rebuild | evict | restore)",
+    ("kind",)))
 
 
 # Helper API (metrics.go:123-191).
@@ -391,6 +407,22 @@ def note_swallowed(site: str) -> None:
     """Count one reviewed exception swallow at ``site`` (the
     exception-policy counter route — see doc/LINT.md rule 5)."""
     swallowed_exceptions.inc(1.0, site)
+
+
+def note_eviction(action: str) -> None:
+    """Count one cluster-committed eviction for ``action`` ("preempt" |
+    "reclaim" — the reason string every evict path already carries)."""
+    evictions_total.inc(1.0, action)
+
+
+def evictions_by_action() -> Dict[str, int]:
+    """{action: count} so far — bench artifact + /debug/sessions."""
+    return {labels[0]: int(v)
+            for labels, v in evictions_total.values().items() if labels}
+
+
+def note_victim_index(kind: str) -> None:
+    victim_index_events.inc(1.0, kind)
 
 
 def set_session_mutations(jobs: int, nodes: int) -> None:

@@ -44,13 +44,40 @@ _SCORE_CACHE_CAP = 64
 # no-retain/no-mutate contract is machine-checked by graftlint's
 # frozen-after rule instead — and on in tests (tests/conftest.py).
 SAFE_SCORES_ENV = "KUBE_BATCH_TPU_SAFE_SCORES"
+# Batched eviction engine (doc/EVICTION.md): =0 restores the sequential
+# control — one scanner per action, one score solve per preemptor, host
+# victim sorts — with bit-identical placements and victim choices.
+BATCH_EVICT_ENV = "KUBE_BATCH_TPU_BATCH_EVICT"
+# Whether the batched engine stages its device statics through the
+# DeviceResidentShipper (delta against the resident SolverInputs buffer).
+# Default auto: on for real accelerators (the tunnel charges fixed
+# latency per transfer, so reusing the resident buffer beats six leaf
+# transfers), off on CPU where a ship is just a large memcpy that the
+# plain per-leaf asarray path undercuts.  =1/=0 force.
+EVICT_SHIP_ENV = "KUBE_BATCH_TPU_EVICT_SHIP"
+# Dirty-row patches at or under this many rows take the scalar Python
+# scorer (_score_rows_py) instead of numpy: the per-call numpy overhead
+# (slicing eight statics, ~20 tiny-array ops) dominates 1-4 row patches,
+# which is exactly what one preemptor's statement dirties.
+_PY_PATCH_MAX = 8
 
 
-def maybe_scanner(ssn) -> Optional["DeviceNodeScanner"]:
-    """Build a scanner for this session, or None (fallback to host walk).
-    Registers session event handlers so the scoring mirror tracks every
-    allocate/deallocate — including Statement rollback and the
-    commit-failure unevict path — exactly as nodeorder's GridUsage does."""
+def batch_evict_enabled() -> bool:
+    import os
+    return os.environ.get(BATCH_EVICT_ENV, "1") != "0"
+
+
+def _shipper_wanted() -> bool:
+    import os
+    forced = os.environ.get(EVICT_SHIP_ENV)
+    if forced is not None:
+        return forced == "1"
+    import jax
+    return jax.default_backend() != "cpu"
+
+
+def _build_scanner(ssn, use_shipper: bool = False
+                   ) -> Optional["DeviceNodeScanner"]:
     import os
 
     from .tensor_snapshot import tensorize_session
@@ -61,7 +88,17 @@ def maybe_scanner(ssn) -> Optional["DeviceNodeScanner"]:
     snap = tensorize_session(ssn)
     if snap.needs_fallback or not (snap.tasks or snap.tasks_extra):
         return None
-    scanner = DeviceNodeScanner(snap)
+    device_inputs = None
+    if use_shipper and _shipper_wanted():
+        # Ship the snapshot through the DeviceResidentShipper (a delta
+        # against the previous cycle's image on steady clusters): the
+        # batched dispatch's statics then read the already-resident
+        # SolverInputs buffer, and tpu-allocate's own ship later this
+        # cycle delta-ships against this staging — no extra full ship.
+        from .shipping import resident_shipper
+        device_inputs = resident_shipper(ssn.cache).ship(snap.inputs,
+                                                         snap.config)
+    scanner = DeviceNodeScanner(snap, device_inputs=device_inputs)
     from ..framework.events import EventHandler
     ssn.add_event_handler(EventHandler(
         allocate_func=lambda e: scanner._used_delta(e.task, +1),
@@ -69,9 +106,47 @@ def maybe_scanner(ssn) -> Optional["DeviceNodeScanner"]:
     return scanner
 
 
+def maybe_shared_scanner(ssn) -> Optional["DeviceNodeScanner"]:
+    """The batched eviction engine's entry point: ONE scanner per
+    session, tensorized/seeded at first use and re-attached (dirty-node
+    refresh) by every later eviction action.  Falls back to a fresh
+    per-action scanner when the engine is disabled."""
+    cached = getattr(ssn, "_shared_scanner", False)
+    if cached is not False:
+        if cached is not None:
+            cached.refresh(ssn)
+        return cached
+    scanner = _build_scanner(ssn, use_shipper=True)
+    ssn._shared_scanner = scanner
+    if scanner is not None:
+        scanner.batch_seed(ssn)
+    return scanner
+
+
+def maybe_scanner(ssn, shared: bool = False
+                  ) -> Optional["DeviceNodeScanner"]:
+    """Build a scanner for this session, or None (fallback to host walk).
+    Registers session event handlers so the scoring mirror tracks every
+    allocate/deallocate — including Statement rollback and the
+    commit-failure unevict path — exactly as nodeorder's GridUsage does.
+
+    ``shared``: under the batched eviction engine the reclaim, backfill
+    and preempt actions reuse ONE session scanner instead of
+    re-tensorizing per action.  The reuse is exact: node membership is
+    fixed for the session, node STATIC state (labels, taints,
+    allocatable — the [S, N] mask inputs) is never session-mutated, and
+    ``refresh`` re-derives the dynamic rows of every session-mutated
+    node from live truth at attach time, which is precisely what a fresh
+    tensorize would stage for them (Session.mutated_nodes is complete by
+    the delta-shipping contract, framework/session.py)."""
+    if shared and batch_evict_enabled():
+        return maybe_shared_scanner(ssn)
+    return _build_scanner(ssn)
+
+
 class DeviceNodeScanner:
 
-    def __init__(self, snap):
+    def __init__(self, snap, device_inputs=None):
         import jax.numpy as jnp
 
         self.snap = snap
@@ -80,13 +155,19 @@ class DeviceNodeScanner:
         self.np_pad = inp.task_ports.shape[1]
         self.ns_pad = inp.task_aff_req.shape[1]
         self.cfg = snap.config
+        # ``device_inputs``: the session's SolverInputs as shipped by the
+        # DeviceResidentShipper (batched eviction engine) — the statics
+        # below are then views of the already-device-resident buffer, so
+        # building the scanner moves no static bytes.  Without it (the
+        # sequential control) each leaf transfers here as before.
+        src = device_inputs if device_inputs is not None else inp
         self.statics = ScanStatics(
-            sig_mask=jnp.asarray(inp.sig_mask),
-            sig_bonus=jnp.asarray(inp.sig_bonus),
-            node_alloc=jnp.asarray(inp.node_alloc),
-            node_max_tasks=jnp.asarray(inp.node_max_tasks),
-            node_exists=jnp.asarray(inp.node_exists),
-            score_shift=jnp.asarray(inp.score_shift))
+            sig_mask=jnp.asarray(src.sig_mask),
+            sig_bonus=jnp.asarray(src.sig_bonus),
+            node_alloc=jnp.asarray(src.node_alloc),
+            node_max_tasks=jnp.asarray(src.node_max_tasks),
+            node_exists=jnp.asarray(src.node_exists),
+            score_shift=jnp.asarray(src.score_shift))
         n_pad = inp.node_idle.shape[0]
         # Packed mutable state: used | count | ports | selcnt (scan.py).
         self.dyn = np.concatenate(
@@ -133,6 +214,161 @@ class DeviceNodeScanner:
         # touched since last seen) per profile.
         self._edit_log: List[int] = []
         self._score_cache: "OrderedDict[tuple, list]" = OrderedDict()
+        self._axis = snap.resource_names
+        # Batched eviction engine state (doc/EVICTION.md): uid -> position
+        # in the precomputed victim order (None until batch_seed ran with
+        # the stock task order), and the engine's observability counters
+        # (tests + trace assertions read these).
+        self.victim_rank: Optional[Dict[str, int]] = None
+        self._batched = False  # True once batch_seed ran (engine active)
+        self.stats = {"batch_dispatches": 0, "seeded_profiles": 0,
+                      "dirty_rows_patched": 0, "full_recomputes": 0,
+                      "refresh_rows": 0, "refreshes": 0}
+
+    # -- batched eviction engine (doc/EVICTION.md) --------------------------
+
+    def _profile_key(self, ti: int) -> tuple:
+        return (int(self._task_sig[ti]), self._task_res[ti].tobytes(),
+                self._task_ports[ti].tobytes(),
+                self._task_aff[ti].tobytes(),
+                self._task_anti[ti].tobytes(),
+                self._task_paffw[ti].tobytes(),
+                self._task_pantiw[ti].tobytes())
+
+    def _profile_trow(self, ti: int) -> np.ndarray:
+        return np.concatenate(
+            [np.asarray([self._task_sig[ti]], np.int32),
+             self._task_res[ti],
+             self._task_ports[ti], self._task_aff[ti],
+             self._task_anti[ti],
+             self._task_paffw[ti], self._task_pantiw[ti]]
+        ).astype(np.int32)
+
+    def batch_seed(self, ssn) -> None:
+        """ONE device dispatch computing the candidate-node answer for
+        every distinct pending-task profile of the session (the whole
+        preemptor/reclaimer universe: snap.tasks, plus the BestEffort
+        rows backfill sweeps) AND the victim-candidate ranking — seeded
+        into the score cache, so the host walk's scores() calls become
+        cache hits patched only for rows that went dirty since.
+
+        Parity: the batched kernel vmaps the exact per-row scan body, so
+        a seeded row equals what scores() would have computed; seeding
+        can therefore never change a placement or victim choice."""
+        import jax.numpy as jnp
+
+        from ..ops import evict_solver
+        from ..ops.compile_cache import bucket, note_solve_key
+        from ..trace import spans as trace
+        from .victim_index import VictimIndex
+
+        n_candidates = len(self.snap.tasks) + len(self.snap.tasks_extra)
+        if not n_candidates:
+            return
+        # Distinct profiles via one vectorized row-dedup over the packed
+        # trow matrix (the candidate rows concatenated column-wise —
+        # exactly the per-profile trow layout), instead of a per-task
+        # Python key loop over a 50k-candidate storm.
+        all_rows = np.concatenate(
+            [self._task_sig[:n_candidates, None].astype(np.int32),
+             self._task_res[:n_candidates].astype(np.int32),
+             self._task_ports[:n_candidates],
+             self._task_aff[:n_candidates],
+             self._task_anti[:n_candidates],
+             self._task_paffw[:n_candidates],
+             self._task_pantiw[:n_candidates]], axis=1).astype(np.int32)
+        _uniq, rep = np.unique(all_rows, axis=0, return_index=True)
+        if len(rep) > _SCORE_CACHE_CAP:
+            # Profiles beyond the cache cap would be LRU-evicted
+            # unconsumed; they fall back to the per-profile path.
+            rep = rep[:_SCORE_CACHE_CAP]
+        tis = [int(i) for i in rep]
+        keys = [self._profile_key(ti) for ti in tis]
+        kb = bucket(len(keys))
+        trows = np.zeros((kb, 1 + self.r + self.np_pad + 4 * self.ns_pad),
+                         np.int32)
+        trows[:len(tis)] = all_rows[rep]
+        # The precomputed ranking encodes the STOCK victim-order key
+        # (priority asc, ts desc, uid desc), which is the host's order
+        # only when the ENABLED task-order chain is exactly the priority
+        # plugin — enablement, not registration: a conf with
+        # `enableTaskOrder: false` leaves the fn registered while
+        # victims_queue ignores it (Session.task_sort_key walks the same
+        # tier flags).  Anything else keeps victim_rank None and the
+        # walk falls back to the exact session queue.
+        enabled_order = [p.name for tier in ssn.tiers for p in tier.plugins
+                         if p.enabled_task_order
+                         and p.name in ssn.task_order_fns]
+        stock_order = bool(enabled_order) and set(enabled_order) == {
+            "priority"}
+        vic_node, vic_rank, vic_uids = VictimIndex.for_session(
+            ssn).victim_tensors(self.node_index)
+        m = len(vic_uids)
+        mb = bucket(max(m, 1))
+        node_p = np.full((mb,), self.dyn.shape[0], np.int32)
+        rank_p = np.full((mb,), mb, np.int32)
+        node_p[:m] = vic_node
+        rank_p[:m] = vic_rank
+        solve_key = evict_solver.evict_solve_key(
+            self.cfg, self.r, self.np_pad, self.ns_pad,
+            self.dyn.shape[0], kb, mb, int(self.statics.sig_mask.shape[0]))
+        with trace.span("evict.batch_solve", profiles=len(keys),
+                        victims=m, nodes=len(self.snap.node_names)):
+            scores, perm = evict_solver.evict_batch_solve(
+                self.cfg, self.r, self.np_pad, self.ns_pad, self.statics,
+                jnp.asarray(self.dyn), jnp.asarray(trows),
+                jnp.asarray(node_p), jnp.asarray(rank_p))
+            mat = np.asarray(scores).astype(np.int64)
+            perm = np.asarray(perm)
+        note_solve_key(solve_key)
+        pos = len(self._edit_log)
+        for i, key in enumerate(keys):
+            self._score_cache[key] = [mat[i], pos]
+        if stock_order:
+            # perm orders residents (node asc, victim order); a victim
+            # list sorted by global position is therefore in exactly the
+            # order victims_queue would drain (uids make the key total,
+            # and victims always share one node per walk step).
+            rank_map: Dict[str, int] = {}
+            for p, j in enumerate(perm.tolist()):
+                if j < m:
+                    rank_map[vic_uids[j]] = p
+            self.victim_rank = rank_map
+        self._batched = True
+        self.stats["batch_dispatches"] += 1
+        self.stats["seeded_profiles"] += len(keys)
+
+    def refresh(self, ssn) -> None:
+        """Re-derive the dynamic row of every session-mutated node from
+        live truth — the batched engine's dirty-node invalidation.  Run
+        at action attach (between actions, so no Statement transaction
+        is open): a recomputed row is exactly what a fresh tensorize
+        would stage for that node (same quantization, same membership
+        walk), and untouched nodes cannot have drifted (every session
+        mutation path routes through Session._dirty_node), so after
+        refresh the shared scanner's dyn equals the per-action rebuild
+        the sequential control pays."""
+        from ..trace import spans as trace
+        from .tensor_snapshot import stage_node_dyn_row
+
+        if self._checkpoints:
+            raise RuntimeError(
+                "scanner.refresh inside an open transaction (checkpoint "
+                "frames present) — attach must happen between actions")
+        names = sorted(n for n in ssn.mutated_nodes if n in self.node_index)
+        self.stats["refreshes"] += 1
+        if not names:
+            return
+        with trace.span("evict.recompute", rows=len(names)):
+            for name in names:
+                nix = self.node_index[name]
+                self.dyn[nix] = stage_node_dyn_row(
+                    ssn.nodes[name], self._axis, self.snap.port_index,
+                    self.snap.selectors, self.np_pad,
+                    self.ns_pad).astype(np.int32)
+                self._edit_log.append(nix)
+        self.stats["refresh_rows"] += len(names)
+        trace.counter("evict.refresh_rows", len(names))
 
     # -- transaction mirror (Statement commit/discard) ----------------------
     # Copy-on-write: a checkpoint is a {row -> saved row copy} undo log
@@ -235,14 +471,15 @@ class DeviceNodeScanner:
         ti = self.task_index.get(task.uid)
         if ti is None:
             return None
-        if os.environ.get(SCAN_DEVICE_ENV) == "1":
-            trow = np.concatenate(
-                [np.asarray([self._task_sig[ti]], np.int32),
-                 self._task_res[ti],
-                 self._task_ports[ti], self._task_aff[ti],
-                 self._task_anti[ti],
-                 self._task_paffw[ti], self._task_pantiw[ti]]
-            ).astype(np.int32)
+        key = self._profile_key(ti)
+        log = self._edit_log
+        entry = self._score_cache.get(key)
+        if entry is None and os.environ.get(SCAN_DEVICE_ENV) == "1":
+            # Per-row device scan (opt-in env).  A batch-seeded profile
+            # skips this: its row already came back from the ONE batched
+            # dispatch and only dirty rows need the numpy patch —
+            # identical ints either way (the engines share the math).
+            trow = self._profile_trow(ti)
             out = np.asarray(best_scan_nodes(self.cfg, self.r, self.np_pad,
                                              self.ns_pad, self.statics,
                                              self.dyn, trow))
@@ -250,14 +487,6 @@ class DeviceNodeScanner:
             # np.asarray of a jax array is a READ-ONLY view: safe mode
             # promises a caller-mutable copy on this engine too.
             return view.copy() if safe else view
-        key = (int(self._task_sig[ti]), self._task_res[ti].tobytes(),
-               self._task_ports[ti].tobytes(),
-               self._task_aff[ti].tobytes(),
-               self._task_anti[ti].tobytes(),
-               self._task_paffw[ti].tobytes(),
-               self._task_pantiw[ti].tobytes())
-        log = self._edit_log
-        entry = self._score_cache.get(key)
         if entry is not None:
             out, pos = entry
             gap = len(log) - pos
@@ -268,19 +497,115 @@ class DeviceNodeScanner:
                 # profile revisited after a long storm hits this).
                 out[:] = self._scores_numpy(ti)
                 entry[1] = len(log)
+                self.stats["full_recomputes"] += 1
             elif gap:  # patch rows touched since last seen
-                rows = np.unique(np.fromiter(
-                    log[pos:], dtype=np.int64, count=gap))
-                out[rows] = self._scores_numpy(ti, rows)
+                if self._batched and gap <= _PY_PATCH_MAX:
+                    # The engine's dirty-row patcher: one preemptor's
+                    # statement dirties 1-4 rows; the scalar scorer
+                    # computes the identical integers without numpy's
+                    # per-tiny-op overhead.  Only under the batched
+                    # engine so the =0 control stays the unmodified
+                    # sequential path.
+                    touched = sorted(set(log[pos:]))
+                    for nix, v in zip(touched,
+                                      self._score_rows_py(ti, touched)):
+                        out[nix] = v
+                    self.stats["dirty_rows_patched"] += len(touched)
+                else:
+                    rows = np.unique(np.fromiter(
+                        log[pos:], dtype=np.int64, count=gap))
+                    out[rows] = self._scores_numpy(ti, rows)
+                    self.stats["dirty_rows_patched"] += int(rows.size)
                 entry[1] = len(log)
             self._score_cache.move_to_end(key)
         else:
             out = self._scores_numpy(ti)
             self._score_cache[key] = [out, len(log)]
+            self.stats["full_recomputes"] += 1
             if len(self._score_cache) > _SCORE_CACHE_CAP:
                 self._score_cache.popitem(last=False)
         view = out[:len(self.snap.node_names)]
         return view.copy() if safe else view
+
+    def _score_rows_py(self, ti: int, rows) -> List[int]:
+        """Scalar-Python scoring of a few node rows: the exact integers
+        of _scores_numpy/_scan_body (every operation is integer — grid
+        shifts, floor divisions, weighted sums — and Python ints cannot
+        overflow), without numpy's fixed per-op cost.  Used only for the
+        tiny dirty-row patches of the incremental-rescore path; parity
+        with _scores_numpy is pinned by tests/test_evict_batch.py."""
+        from ..ops.resources import SCORE_GRID_K
+        cfg = self.cfg
+        r = self.r
+        sig = int(self._task_sig[ti])
+        sig_row = self._np_sig_mask[sig]
+        bonus_row = self._np_bonus[sig]
+        exists = self._np_exists
+        maxt = self._np_maxt
+        alloc = self._np_alloc
+        dyn = self.dyn
+        sh0 = int(self._np_shift[0])
+        sh1 = int(self._np_shift[1])
+        res = self._task_res[ti]
+        res0, res1 = int(res[0]), int(res[1])
+        w = cfg.weights
+        wl = int(w.least_requested)
+        wm = int(w.most_requested)
+        wb = int(w.balanced_resource)
+        neg = int(SCORE_NEG_INF)
+        has_ports = cfg.has_ports
+        has_aff = cfg.has_pod_affinity
+        has_paff = cfg.has_pod_affinity_score
+        tports = self._task_ports[ti] if has_ports else None
+        taff = self._task_aff[ti] if has_aff else None
+        tanti = self._task_anti[ti] if has_aff else None
+        if has_paff:
+            wdiff = (self._task_paffw[ti].astype(np.int64)
+                     - self._task_pantiw[ti])
+        out: List[int] = []
+        for nix in rows:
+            row = dyn[nix]
+            feasible = (bool(sig_row[nix]) and bool(exists[nix])
+                        and int(row[r]) < int(maxt[nix]))
+            if feasible and has_ports:
+                for j in range(self.np_pad):
+                    if tports[j] > 0 and row[r + 1 + j] > 0:
+                        feasible = False
+                        break
+            if feasible and has_aff:
+                base = r + 1 + self.np_pad
+                for j in range(self.ns_pad):
+                    have = row[base + j] > 0
+                    if (taff[j] != 0 and not have) \
+                            or (tanti[j] != 0 and have):
+                        feasible = False
+                        break
+            if not feasible:
+                out.append(neg)
+                continue
+            cs0 = int(alloc[nix, 0]) >> sh0
+            cs1 = int(alloc[nix, 1]) >> sh1
+            xs0 = min((int(row[0]) + res0) >> sh0, cs0)
+            xs1 = min((int(row[1]) + res1) >> sh1, cs1)
+            gc = ((xs0 * SCORE_GRID_K) // max(cs0, 1) if cs0 > 0
+                  else SCORE_GRID_K)
+            gm = ((xs1 * SCORE_GRID_K) // max(cs1, 1) if cs1 > 0
+                  else SCORE_GRID_K)
+            score = 0
+            if wl:
+                score += wl * 5 * (2 * SCORE_GRID_K - gc - gm)
+            if wm:
+                score += wm * 5 * (gc + gm)
+            if wb:
+                score += wb * (10 * SCORE_GRID_K - 10 * abs(gc - gm))
+            if has_paff:
+                base = r + 1 + self.np_pad
+                acc = 0
+                for j in range(self.ns_pad):
+                    acc += int(wdiff[j]) * int(row[base + j])
+                score += SCORE_GRID_K * acc
+            out.append(score + int(bonus_row[nix]))
+        return out
 
     def _scores_numpy(self, ti: int, rows=None) -> np.ndarray:
         """The exact integer math of ops/scan.py in numpy: the grid floor

@@ -580,6 +580,38 @@ def _node_row_vectors(node, axis):
             _vec(node.used, axis), _vec(node.allocatable, axis))
 
 
+def stage_node_dyn_row(node, axis, port_index, selectors,
+                       np_pad: int, ns_pad: int) -> np.ndarray:
+    """One node's mutable scanner row — used | count | ports | selcnt —
+    staged exactly as tensorize_session stages the full cluster: the
+    used columns are the quantized _vec row (the pack's used matrix per
+    column), count is the resident total, and the port/selector
+    occupancy walks ALL residents against the session's compacted
+    port_index/selectors (the node_ports0/node_selcnt0 loops in
+    tensorize_session below).  The batched eviction engine's dirty-node
+    refresh (models/scanner.DeviceNodeScanner.refresh) re-derives
+    mutated rows through THIS function so the two stagings cannot
+    drift: change the tensorizer's occupancy loops and this together
+    (doc/EVICTION.md "dirty-node invalidation contract")."""
+    from ..ops.resources import quantize_columns
+
+    r = len(axis)
+    row = np.zeros((r + 1 + np_pad + ns_pad,), np.int64)
+    row[:r] = quantize_columns(_vec(node.used, axis))
+    row[r] = len(node.tasks)
+    for rt in node.tasks.values():
+        for pk in _task_port_keys(rt):
+            pid = port_index.get(pk)
+            if pid is not None:
+                row[r + 1 + pid] = 1
+        if selectors:
+            labels = rt.pod.metadata.labels
+            for si, sel in enumerate(selectors):
+                if all(labels.get(k) == v for k, v in sel.items()):
+                    row[r + 1 + np_pad + si] += 1
+    return row
+
+
 def _fill_node_row(pack: _NodePack, ix: int, node, axis) -> None:
     from ..ops.resources import quantize_columns
     rows = np.stack(_node_row_vectors(node, axis))

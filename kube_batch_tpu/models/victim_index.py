@@ -18,15 +18,73 @@ index still counts, keeping the superset property).
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+import threading
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
 from ..api import TaskStatus
 
 
+def _rank_victim_columns(node_names: List[str], prio: List[float],
+                         ts: List[float], uids: List[str],
+                         node_index: Dict[str, int]
+                         ) -> Tuple[np.ndarray, np.ndarray, List[str]]:
+    """Exact int32 victim-order ranks for the batched eviction dispatch:
+    reversed task order — priority ascending, creation-time descending,
+    uid descending (preempt.go:213-218 via Session.victims_queue) — via
+    one vectorized host lexsort over exact f64/str columns, so device
+    float width can never reorder a tie; the device then only groups by
+    node (ops/evict_solver.evict_batch_solve)."""
+    keep = [i for i, name in enumerate(node_names) if name in node_index]
+    m = len(keep)
+    if m == 0:
+        return np.zeros(0, np.int32), np.zeros(0, np.int32), []
+    if m != len(node_names):
+        prio = [prio[i] for i in keep]
+        ts = [ts[i] for i in keep]
+        uids = [uids[i] for i in keep]
+        node_names = [node_names[i] for i in keep]
+    node_ix = np.asarray([node_index[n] for n in node_names], np.int32)
+    prio_a = np.asarray(prio, np.float64)
+    ts_a = np.asarray(ts, np.float64)
+    order = np.lexsort((-ts_a, prio_a))
+    # uid ranks (an O(M log M) string sort) are the tie-break of last
+    # resort; compute them only inside actual (priority, ts) tie runs —
+    # rare outside adversarial fixtures, so the common storm pays two
+    # float lexsort keys and nothing else.
+    op, ot = prio_a[order], ts_a[order]
+    tie = (op[1:] == op[:-1]) & (ot[1:] == ot[:-1])
+    if tie.any():
+        order = order.tolist()
+        i = 0
+        while i < m - 1:
+            if not tie[i]:
+                i += 1
+                continue
+            j = i + 1
+            while j < m - 1 and tie[j]:
+                j += 1
+            run = order[i:j + 1]
+            run.sort(key=lambda k: uids[k], reverse=True)  # uid descending
+            order[i:j + 1] = run
+            i = j + 1
+        order = np.asarray(order)
+    rank = np.empty(m, np.int32)
+    rank[order] = np.arange(m, dtype=np.int32)
+    return node_ix, rank, uids
+
+
 class VictimIndex:
-    """Counts of Running residents per node, by queue and by job."""
+    """Counts of Running residents per node, by queue and by job.
+
+    Thread discipline: a VictimIndex belongs to ONE session and is
+    mutated only by that session's action thread.  The vectorized
+    admissibility matrix is nevertheless ``# guarded-by: _mutex`` so the
+    contract is machine-checked (graftlint rule 1, doc/LINT.md): any new
+    code path touching the matrix off the documented mutation sites —
+    e.g. a /debug reader or a background repair walking live sessions —
+    fails ``make lint`` instead of racing silently."""
 
     @classmethod
     def for_session(cls, ssn):
@@ -56,8 +114,24 @@ class VictimIndex:
         self._names = None
         self._row: Dict[str, int] = {}
         self._qcol: Dict[str, int] = {}
-        self._mat: Optional[np.ndarray] = None
-        self._tot: Optional[np.ndarray] = None
+        self._mutex = threading.Lock()
+        self._mat: Optional[np.ndarray] = None   # guarded-by: _mutex
+        self._tot: Optional[np.ndarray] = None   # guarded-by: _mutex
+        # Observability (tests + /metrics): how often the matrix was
+        # (re)built and how many live evict/restore updates it absorbed.
+        self.rebuilds = 0
+        self.invalidations = 0
+        self.restores = 0
+        # Victim-candidate columns for the batched eviction dispatch,
+        # collected in the SAME resident walk (a second O(residents)
+        # pass cost more than the per-preemptor sorts it replaced).
+        # Only under the engine: the sequential control pays nothing.
+        from .scanner import batch_evict_enabled
+        collect = batch_evict_enabled()
+        self._vic_node: List[str] = []
+        self._vic_prio: List[float] = []
+        self._vic_ts: List[float] = []
+        self._vic_uid: List[str] = []
         jobs_get = ssn.jobs.get
         running = TaskStatus.Running
         for name, node in ssn.nodes.items():
@@ -71,6 +145,11 @@ class VictimIndex:
                     continue
                 nq[j.queue] = nq.get(j.queue, 0) + 1
                 nj[t.job] = nj.get(t.job, 0) + 1
+                if collect:
+                    self._vic_node.append(name)
+                    self._vic_prio.append(t.priority)
+                    self._vic_ts.append(t.pod.metadata.creation_timestamp)
+                    self._vic_uid.append(t.uid)
             if nq:
                 self.node_queue[name] = nq
                 self.node_job[name] = nj
@@ -81,6 +160,24 @@ class VictimIndex:
                     self.queue_total[q] = self.queue_total.get(q, 0) + c
                 for ju, c in nj.items():
                     self.job_total[ju] = self.job_total.get(ju, 0) + c
+
+    def victim_tensors(self, node_index: Dict[str, int]
+                       ) -> Tuple[np.ndarray, np.ndarray, List[str]]:
+        """[M] (node row, victim-order rank, uid) of every job-backed
+        Running resident, in the scanner's node order — the victim side
+        of the batched eviction dispatch (residents without a session
+        job can never be chosen by any victim filter, so omitting them
+        is exact).  Cached per node_index identity (one ranking per
+        session; the ranking is open-state by design — live evictions
+        only shrink the candidate set, never reorder it)."""
+        cached = getattr(self, "_vic_cache", None)
+        if cached is not None and cached[0] is node_index:
+            return cached[1]
+        out = _rank_victim_columns(self._vic_node, self._vic_prio,
+                                   self._vic_ts, self._vic_uid,
+                                   node_index)
+        self._vic_cache = (node_index, out)
+        return out
 
     # -- per-node admissibility ---------------------------------------------
 
@@ -128,8 +225,12 @@ class VictimIndex:
             for q, c in nq.items():
                 mat[r, self._qcol[q]] = c
             tot[r] = self.node_total.get(name, 0)
-        self._mat = mat
-        self._tot = tot
+        with self._mutex:
+            self._mat = mat
+            self._tot = tot
+        self.rebuilds += 1
+        from ..metrics import metrics
+        metrics.note_victim_index("rebuild")
 
     def queue_mask(self, queue: str, exclude_job: str):
         """bool[N] admissibility for inter-job preempt, or None when the
@@ -141,7 +242,8 @@ class VictimIndex:
         col = self._qcol.get(queue)
         if col is None or self.job_total.get(exclude_job, 0):
             return None
-        return self._mat[:, col] > 0
+        with self._mutex:
+            return self._mat[:, col] > 0
 
     def other_queues_mask(self, queue: str):
         """bool[N] of nodes with a Running resident outside ``queue``
@@ -149,8 +251,9 @@ class VictimIndex:
         if self._mat is None:
             return None
         col = self._qcol.get(queue)
-        mine = self._mat[:, col] if col is not None else 0
-        return self._tot > mine
+        with self._mutex:
+            mine = self._mat[:, col] if col is not None else 0
+            return self._tot > mine
 
     # -- live updates (keep the index exact as the actions evict) -----------
 
@@ -167,7 +270,11 @@ class VictimIndex:
             self.total -= 1
             self.queue_total[queue] = self.queue_total.get(queue, 1) - 1
             self.job_total[job] = self.job_total.get(job, 1) - 1
-            self._mat_delta(node, queue, -1)
+            self.invalidations += 1
+            from ..metrics import metrics
+            metrics.note_victim_index("evict")
+            with self._mutex:
+                self._mat_delta(node, queue, -1)
 
     def on_restore(self, node: str, queue: str, job: str) -> None:
         """Inverse of on_evict (Statement.discard rolled the evict back)."""
@@ -179,9 +286,13 @@ class VictimIndex:
         self.total += 1
         self.queue_total[queue] = self.queue_total.get(queue, 0) + 1
         self.job_total[job] = self.job_total.get(job, 0) + 1
-        self._mat_delta(node, queue, +1)
+        self.restores += 1
+        from ..metrics import metrics
+        metrics.note_victim_index("restore")
+        with self._mutex:
+            self._mat_delta(node, queue, +1)
 
-    def _mat_delta(self, node: str, queue: str, sign: int) -> None:
+    def _mat_delta(self, node: str, queue: str, sign: int) -> None:  # holds-lock: _mutex
         if self._mat is None:
             return
         r = self._row.get(node)

@@ -263,6 +263,19 @@ def note_solve(choice: str, inp, cfg) -> bool:
     return hit
 
 
+def note_solve_key(key: tuple) -> bool:
+    """note_solve for callers that build their own executable identity
+    (the batched eviction dispatch, ops/evict_solver.evict_solve_key):
+    same seen-set, same hit/miss counters."""
+    from ..metrics import metrics
+
+    with _seen_lock:
+        hit = key in _seen
+        _seen.add(key)
+    metrics.note_compile_cache(hit)
+    return hit
+
+
 def note_warmed(key: tuple) -> None:
     """Mark a signature as compiled (warmup path) WITHOUT counting it as
     a live hit or miss — warmup is setup, not traffic."""
@@ -419,7 +432,60 @@ def warm_bucket(spec: BucketSpec, cfg=None, family: Sequence[str] = ("auto",),
         records.append(WarmupRecord(
             spec, name, key,
             round((time.perf_counter() - start) * 1e3, 1)))
+    records.append(_warm_evict_batch(spec, cfg, inp_np, inp))
     return records
+
+
+def _warm_evict_batch(spec: BucketSpec, cfg, inp_np, inp) -> WarmupRecord:
+    """Warm the batched eviction kernel (ops/evict_solver.py) at this
+    bucket: the storm path's single dispatch should never pay its XLA
+    compile inside a live session either.  Warmed at the smallest
+    profile bucket (storms interleave a handful of preemptor profiles)
+    and the node/victim buckets this spec implies."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from .evict_solver import evict_batch_solve, evict_solve_key
+    from .scan import ScanStatics
+
+    r = inp_np.task_req.shape[1]
+    np_pad = inp_np.task_ports.shape[1]
+    ns_pad = inp_np.task_aff_req.shape[1]
+    n_pad = inp_np.node_idle.shape[0]
+    kb = bucket(1)
+    mb = bucket(max(spec.tasks, 1))
+    key = evict_solve_key(cfg, r, np_pad, ns_pad, n_pad, kb, mb,
+                          int(inp_np.sig_mask.shape[0]))
+    start = time.perf_counter()
+    try:
+        statics = ScanStatics(
+            sig_mask=jnp.asarray(inp.sig_mask),
+            sig_bonus=jnp.asarray(inp.sig_bonus),
+            node_alloc=jnp.asarray(inp.node_alloc),
+            node_max_tasks=jnp.asarray(inp.node_max_tasks),
+            node_exists=jnp.asarray(inp.node_exists),
+            score_shift=jnp.asarray(inp.score_shift))
+        dyn = np.concatenate(
+            [np.asarray(inp_np.node_used),
+             np.asarray(inp_np.node_count)[:, None],
+             np.asarray(inp_np.node_ports).astype(np.int32),
+             np.asarray(inp_np.node_selcnt)], axis=1).astype(np.int32)
+        trows = np.zeros((kb, 1 + r + np_pad + 4 * ns_pad), np.int32)
+        scores, perm = evict_batch_solve(
+            cfg, r, np_pad, ns_pad, statics, jnp.asarray(dyn),
+            jnp.asarray(trows), jnp.asarray(np.full((mb,), n_pad, np.int32)),
+            jnp.asarray(np.full((mb,), mb, np.int32)))
+        np.asarray(scores)
+        np.asarray(perm)
+    except Exception as exc:  # lint: allow-swallow(warmup must never take down boot; failure is recorded in WarmupRecord.error)
+        return WarmupRecord(
+            spec, "evict_batch", key,
+            round((time.perf_counter() - start) * 1e3, 1),
+            f"{type(exc).__name__}: {exc}")
+    note_warmed(key)
+    return WarmupRecord(
+        spec, "evict_batch", key,
+        round((time.perf_counter() - start) * 1e3, 1))
 
 
 class SolverWarmup:

@@ -1,0 +1,99 @@
+"""Batched eviction solve: every preemptor's node walk in ONE dispatch.
+
+The paper's design says the preempt/reclaim/backfill actions "reuse the
+same feasibility tensor" as tpu-allocate, but ops/scan.py only batched
+the per-NODE axis: models/scanner.py still issued one device call (or one
+numpy pass) per preemptor.  BENCH_r05 prices that loop: preempt is the
+most expensive action at 1281.5 ms/cycle.  This module batches the
+per-PREEMPTOR axis too — ``batch_scan_nodes`` vmaps the exact scan body
+over a ``[K, L]`` request tensor (K distinct preemptor profiles, L the
+packed trow layout ops/scan.py documents) so the whole session's
+eviction feasibility + scoring lands in one ``[K, N]`` tensor from one
+device dispatch, and ``evict_batch_solve`` fuses the device-side
+victim-candidate ranking (per-node Running residents ordered by the
+host's victim-order key, shipped as exact int32 rank columns) into the
+same dispatch.
+
+Eviction itself stays inherently sequential — each commit changes state
+for the next preemptor — so the host actions consume these rows
+optimistically and recompute only dirty rows (models/scanner.py's
+edit-log patch path).  Bit-parity contract: ``_scan_body`` is the SAME
+function the per-preemptor device scan jits, and the numpy mirror
+(``DeviceNodeScanner._scores_numpy``) computes the same integers, so a
+batched row equals the sequential engines exactly (pinned by
+tests/test_evict_batch.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from .scan import ScanStatics, _scan_body
+
+# The batched-profile axis is bucketed like every other tensor axis so
+# the kernel compiles once per (K, M) bucket pair, not once per storm
+# shape; the warmup (compile_cache.warm_bucket) pre-builds the smallest
+# bucket, which covers the common few-profile storm.
+EVICT_SOLVE_CHOICE = "evict_batch"
+
+
+def _batch_body(cfg, r: int, np_pad: int, ns_pad: int,
+                statics: ScanStatics, dyn: jnp.ndarray,
+                trows: jnp.ndarray) -> jnp.ndarray:
+    """[K, N] i32 scores: _scan_body vmapped over the profile axis.  The
+    scan math is per-node elementwise, so the vmap is a pure batching of
+    identical per-row programs — row k equals scan_nodes(.., trows[k])
+    bit for bit."""
+    return jax.vmap(
+        lambda trow: _scan_body(cfg, r, np_pad, ns_pad, statics, dyn, trow)
+    )(trows)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "r", "np_pad", "ns_pad"))
+def batch_scan_nodes(cfg, r: int, np_pad: int, ns_pad: int,
+                     statics: ScanStatics, dyn: jnp.ndarray,
+                     trows: jnp.ndarray) -> jnp.ndarray:
+    """One dispatch answering EVERY preemptor profile's candidate-node
+    question; SCORE_NEG_INF marks predicate-rejected nodes, exactly like
+    ops/scan.scan_nodes per row."""
+    return _batch_body(cfg, r, np_pad, ns_pad, statics, dyn, trows)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "r", "np_pad", "ns_pad"))
+def evict_batch_solve(cfg, r: int, np_pad: int, ns_pad: int,
+                      statics: ScanStatics, dyn: jnp.ndarray,
+                      trows: jnp.ndarray, vic_node: jnp.ndarray,
+                      vic_rank: jnp.ndarray):
+    """The session's whole eviction pre-solve as ONE device program:
+
+    * ``[K, N]`` feasibility+score rows for all K preemptor profiles
+      (the vmapped scan), and
+    * the victim-candidate permutation: ``vic_node`` ([M] i32 node row
+      of each Running resident) and ``vic_rank`` ([M] i32, the resident's
+      position in the host's victim-order key — reversed task order:
+      priority ascending, creation-time descending, uid descending —
+      staged as exact integer ranks so float-precision never reorders a
+      tie) sorted to (node ascending, victim order) in one lexsort.
+
+    Padding contract: trow padding rows are all-zero (their output rows
+    are ignored); victim padding carries node = N (sorts after every
+    real node) and rank = M (after every real resident).
+    """
+    scores = _batch_body(cfg, r, np_pad, ns_pad, statics, dyn, trows)
+    perm = jnp.lexsort((vic_rank, vic_node))
+    return scores, perm
+
+
+def evict_solve_key(cfg, r: int, np_pad: int, ns_pad: int, n_pad: int,
+                    k_pad: int, m_pad: int, s_real: int) -> tuple:
+    """Compile-cache identity of one batched eviction executable — the
+    jit-relevant degrees of freedom (static args + every traced shape),
+    in the same spirit as compile_cache.solve_key for the allocate
+    family."""
+    return (EVICT_SOLVE_CHOICE, r, np_pad, ns_pad, n_pad, k_pad, m_pad,
+            s_real, cfg)

@@ -85,6 +85,11 @@ class FlightRecorder:
         from .export import summarize_phases
         out = []
         for tr in reversed(self.traces()):
+            evictions: Dict[str, int] = {}
+            for name, _ts, _value in tr.counters:
+                if name.startswith("evictions."):
+                    action = name[len("evictions."):]
+                    evictions[action] = evictions.get(action, 0) + 1
             out.append({
                 "session": tr.sid,
                 "uid": tr.uid,
@@ -94,6 +99,7 @@ class FlightRecorder:
                 "spans": len(tr.spans),
                 "verdicts": len(tr.verdicts),
                 "tallies": len(tr.tallies),
+                "evictions": evictions,
                 "meta": dict(tr.meta),
             })
         return out

@@ -244,6 +244,16 @@ def note_ship(mode: str, nbytes: int) -> None:
     tr.counters.append(("ship_bytes", tr.now_us(), int(nbytes)))
 
 
+def note_evict(action: str) -> None:
+    """Count one cluster-committed eviction in the active session trace
+    (Statement.commit / Session.evict call this beside
+    metrics.note_eviction): /debug/sessions summaries aggregate these
+    into per-action eviction counts per session."""
+    tr = getattr(_tls, "trace", None)
+    if tr is not None:
+        tr.counters.append((f"evictions.{action}", tr.now_us(), 1))
+
+
 def set_meta(**kv) -> None:
     tr = getattr(_tls, "trace", None)
     if tr is not None:

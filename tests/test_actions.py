@@ -517,8 +517,8 @@ class TestDeviceScanParity:
         captured = []
         real = scanner_mod.maybe_scanner
 
-        def capture(ssn):
-            s = real(ssn)
+        def capture(ssn, **kwargs):
+            s = real(ssn, **kwargs)
             captured.append(s)
             return s
 

@@ -132,7 +132,10 @@ class TestWarmupAndHits:
         assert w.start() is w  # second start: same thread, no second run
         w.join(120)
         assert w.done
-        assert len(w.records) == 1  # one bucket x one routed solver
+        # One bucket x (one routed allocate solver + the batched
+        # eviction kernel, which warms alongside the family).
+        assert len(w.records) == 2
+        assert {r.solver for r in w.records} >= {"evict_batch"}
         assert w.errors == []
         w.stop()  # after completion: no-op, returns immediately
 

@@ -1,0 +1,416 @@
+"""Batched eviction engine: bit-parity and machinery tests (doc/EVICTION.md).
+
+The engine's contract is that ``KUBE_BATCH_TPU_BATCH_EVICT=1`` (default)
+produces EXACTLY the placements, victim choices and victim ORDER of the
+``=0`` sequential control — one batched device dispatch plus dirty-row
+recompute replaces the per-preemptor solves without changing a single
+decision.  These tests pin that on fixtures where the interesting paths
+fire: cross-preemptor feasibility changes (dirty-row recompute),
+Statement discard/restore, victim-order ties, and the whole 4-action
+storm pipeline.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu.actions.preempt import PreemptAction
+from kube_batch_tpu.actions.reclaim import ReclaimAction
+from kube_batch_tpu.api import ObjectMeta, TaskStatus
+from kube_batch_tpu.api.queue_info import Queue
+from kube_batch_tpu.apis.scheduling import v1alpha1
+from kube_batch_tpu.cache import (FakeBinder, FakeEvictor, FakeStatusUpdater,
+                                  FakeVolumeBinder, SchedulerCache)
+from kube_batch_tpu.framework import close_session, open_session
+from kube_batch_tpu.scheduler import (DEFAULT_SCHEDULER_CONF,
+                                      load_scheduler_conf)
+from tests.test_utils import build_node, build_pod, build_resource_list
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _register(monkeypatch):
+    from kube_batch_tpu.actions.factory import register_default_actions
+    from kube_batch_tpu.plugins.factory import register_default_plugins
+    register_default_actions()
+    register_default_plugins()
+    monkeypatch.setenv("KUBE_BATCH_TPU_SCAN_MIN_NODES", "0")
+
+
+def _storm_cache(n_nodes=3, lows_per_node=2, highs=2, high_min=2):
+    """Full nodes of low-priority Running pods + a high-priority Pending
+    gang: successive preemptors interact (one preemptor's evictions and
+    pipeline change the next one's feasibility and scores)."""
+    binder = FakeBinder()
+    evictor = FakeEvictor()
+    cache = SchedulerCache(binder=binder, evictor=evictor,
+                           status_updater=FakeStatusUpdater(),
+                           volume_binder=FakeVolumeBinder())
+    cache.add_queue(Queue(metadata=ObjectMeta(name="q1"), weight=1))
+    for i in range(n_nodes):
+        cache.add_node(build_node(
+            f"n{i}", build_resource_list(str(2 * lows_per_node),
+                                         f"{4 * lows_per_node}Gi",
+                                         pods=110)))
+    cache.add_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name="low", namespace="ns"),
+        spec=v1alpha1.PodGroupSpec(min_member=1, queue="q1")))
+    cache.add_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name="high", namespace="ns"),
+        spec=v1alpha1.PodGroupSpec(min_member=high_min, queue="q1")))
+    k = 0
+    for i in range(n_nodes):
+        for _ in range(lows_per_node):
+            cache.add_pod(build_pod("ns", f"lo{k}", f"n{i}", "Running",
+                                    build_resource_list("2", "4Gi"), "low",
+                                    priority=1, ts=float(k)))
+            k += 1
+    for i in range(highs):
+        cache.add_pod(build_pod("ns", f"hi{i}", "", "Pending",
+                                build_resource_list("2", "4Gi"), "high",
+                                priority=100, ts=float(100 + i)))
+    for job in cache.jobs.values():
+        for t in job.tasks.values():
+            t.priority = 100 if t.name.startswith("hi") else 1
+    cache.jobs["ns/high"].priority = 100
+    cache.jobs["ns/low"].priority = 1
+    return cache, binder, evictor
+
+
+def _session_state(ssn):
+    """Comparable end-state fingerprint: per-task status + node name."""
+    return sorted((t.uid, t.status.name, t.node_name)
+                  for job in ssn.jobs.values() for t in job.tasks.values())
+
+
+def _run_actions(cache, actions, trace_session=False):
+    _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+    from kube_batch_tpu.trace import spans as tspans
+    sid = tspans.begin_session(test="evict-batch") if trace_session else None
+    ssn = open_session(cache, tiers)
+    try:
+        for a in actions:
+            a.execute(ssn)
+        state = _session_state(ssn)
+        scanner = getattr(ssn, "_shared_scanner", None)
+    finally:
+        close_session(ssn)
+        if trace_session:
+            tspans.end_session()
+    return state, scanner, sid
+
+
+class TestParity:
+    def _both_arms(self, monkeypatch, make_cache, actions_fn):
+        results = {}
+        for arm in ("0", "1"):
+            monkeypatch.setenv("KUBE_BATCH_TPU_BATCH_EVICT", arm)
+            cache, binder, evictor = make_cache()
+            state, scanner, _ = _run_actions(cache, actions_fn())
+            results[arm] = (state, list(evictor.evicts), dict(binder.binds),
+                            scanner)
+        return results
+
+    def test_preempt_storm_parity_and_dirty_recompute(self, monkeypatch):
+        """Preemptor k's evictions/pipeline change preemptor k+1's
+        feasibility: the batched arm must answer from the seeded rows
+        plus dirty-row recompute and still match the control's victim
+        SEQUENCE exactly."""
+        res = self._both_arms(
+            monkeypatch, _storm_cache,
+            lambda: [ReclaimAction(), PreemptAction()])
+        state0, ev0, binds0, _ = res["0"]
+        state1, ev1, binds1, scanner = res["1"]
+        assert ev1, "storm must evict"
+        assert ev1 == ev0          # identical victims, identical ORDER
+        assert binds1 == binds0
+        assert state1 == state0
+        assert scanner is not None
+        assert scanner.stats["batch_dispatches"] == 1
+        assert scanner.stats["dirty_rows_patched"] > 0, \
+            "cross-preemptor fixture must exercise the dirty-row path"
+
+    def test_discard_restore_parity(self, monkeypatch):
+        """A gang preemptor that cannot fully pipeline discards its
+        statement; the engine's restore path (checkpoint + VictimIndex +
+        dirty rows) must leave exactly the control's end state."""
+        def make():
+            # min_member=3 but only 2 high tasks exist -> never
+            # JobPipelined -> every statement discards.
+            return _storm_cache(high_min=3, highs=2)
+        res = self._both_arms(monkeypatch, make,
+                              lambda: [PreemptAction()])
+        state0, ev0, binds0, _ = res["0"]
+        state1, ev1, binds1, _ = res["1"]
+        assert ev1 == ev0 == []    # discard: nothing committed
+        assert state1 == state0
+        # every low pod is still Running (the restore really happened)
+        running = [s for s in state1 if s[1] == "Running"]
+        assert len(running) == 6
+
+    def test_churn_pipeline_parity(self, monkeypatch):
+        """The shipped 4-action pipeline on the synthetic storm cluster:
+        identical victim sequence, binds, and session end state."""
+        from kube_batch_tpu.models.synthetic import make_churn_cache
+        conf_path = os.path.join(REPO, "config", "kube-batch-conf.yaml")
+        with open(conf_path) as fh:
+            conf = fh.read().replace(
+                '"reclaim, allocate, backfill, preempt"',
+                '"reclaim, tpu-allocate, backfill, preempt"')
+        actions, tiers = load_scheduler_conf(conf)
+        results = {}
+        for arm in ("0", "1"):
+            monkeypatch.setenv("KUBE_BATCH_TPU_BATCH_EVICT", arm)
+            cache, binder = make_churn_cache(600, 100, 30, 4)
+            ssn = open_session(cache, tiers)
+            try:
+                for a in actions:
+                    a.execute(ssn)
+                state = _session_state(ssn)
+            finally:
+                close_session(ssn)
+            results[arm] = (state, list(cache.evictor.evicts),
+                            dict(binder.binds))
+        assert results["1"][1], "churn storm must evict"
+        assert results["1"] == results["0"]
+
+
+class TestEngineMachinery:
+    def test_one_batch_dispatch_per_session(self, monkeypatch):
+        """Exactly one evict.batch_solve span per session when reclaim,
+        backfill and preempt all run (the acceptance criterion)."""
+        monkeypatch.setenv("KUBE_BATCH_TPU_BATCH_EVICT", "1")
+        from kube_batch_tpu.actions.backfill import BackfillAction
+        from kube_batch_tpu.trace import flight_recorder
+        cache, _, _ = _storm_cache()
+        _, scanner, sid = _run_actions(
+            cache, [ReclaimAction(), BackfillAction(), PreemptAction()],
+            trace_session=True)
+        assert scanner is not None
+        assert scanner.stats["batch_dispatches"] == 1
+        tr = flight_recorder.get(sid)
+        assert tr is not None
+        batch_spans = [s for s in tr.spans if s.name == "evict.batch_solve"]
+        assert len(batch_spans) == 1
+        # the re-attach refresh records a recompute span iff rows
+        # actually went dirty (one per dirty re-attach, never more than
+        # the attach count)
+        rec = [s for s in tr.spans if s.name == "evict.recompute"]
+        assert (len(rec) == 0) == (scanner.stats["refresh_rows"] == 0)
+        assert len(rec) <= scanner.stats["refreshes"]
+
+    def test_seeded_rows_equal_numpy_engine(self, monkeypatch):
+        """The one batched dispatch must return, row for row, the exact
+        integers the per-preemptor numpy engine computes."""
+        monkeypatch.setenv("KUBE_BATCH_TPU_BATCH_EVICT", "1")
+        from kube_batch_tpu.models.scanner import maybe_scanner
+        cache, _, _ = _storm_cache(n_nodes=4, lows_per_node=3, highs=3)
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        try:
+            scanner = maybe_scanner(ssn, shared=True)
+            assert scanner is not None and scanner._batched
+            assert scanner.stats["seeded_profiles"] >= 1
+            for key, (row, _pos) in list(scanner._score_cache.items()):
+                ti = next(
+                    i for i in range(len(scanner.snap.tasks)
+                                     + len(scanner.snap.tasks_extra))
+                    if scanner._profile_key(i) == key)
+                expect = scanner._scores_numpy(ti)
+                assert np.array_equal(row, expect)
+        finally:
+            close_session(ssn)
+
+    def test_scalar_patch_scorer_matches_numpy(self, monkeypatch):
+        """_score_rows_py (the engine's dirty-row patcher) computes the
+        same integers as _scores_numpy on randomized node state."""
+        monkeypatch.setenv("KUBE_BATCH_TPU_BATCH_EVICT", "1")
+        from kube_batch_tpu.models.scanner import maybe_scanner
+        cache, _, _ = _storm_cache(n_nodes=5, lows_per_node=2, highs=2)
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        try:
+            scanner = maybe_scanner(ssn, shared=True)
+            assert scanner is not None
+            rng = np.random.RandomState(7)
+            n = len(scanner.snap.node_names)
+            r = scanner.r
+            # Randomize the mutable rows (used/count) within plausible
+            # magnitudes, including zero-capacity corner rows.
+            scanner.dyn[:n, :r] = rng.randint(0, 50_000, size=(n, r))
+            scanner.dyn[:n, r] = rng.randint(0, 5, size=n)
+            rows = list(range(n))
+            for ti in range(len(scanner.snap.tasks)):
+                expect = scanner._scores_numpy(ti)
+                got = scanner._score_rows_py(ti, rows)
+                assert np.array_equal(np.asarray(got), expect[:n])
+        finally:
+            close_session(ssn)
+
+    def test_victim_rank_matches_queue_order_with_ties(self, monkeypatch):
+        """The precomputed victim order must equal Session.victims_queue
+        drain order, including (priority, ts) ties resolved by uid."""
+        monkeypatch.setenv("KUBE_BATCH_TPU_BATCH_EVICT", "1")
+        from kube_batch_tpu.models.scanner import maybe_scanner
+        binder = FakeBinder()
+        cache = SchedulerCache(binder=binder, evictor=FakeEvictor(),
+                               status_updater=FakeStatusUpdater(),
+                               volume_binder=FakeVolumeBinder())
+        cache.add_queue(Queue(metadata=ObjectMeta(name="q1"), weight=1))
+        cache.add_node(build_node("n0",
+                                  build_resource_list("16", "32Gi",
+                                                      pods=110)))
+        cache.add_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name="low", namespace="ns"),
+            spec=v1alpha1.PodGroupSpec(min_member=1, queue="q1")))
+        cache.add_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name="high", namespace="ns"),
+            spec=v1alpha1.PodGroupSpec(min_member=1, queue="q1")))
+        # Ties everywhere: same priority, same ts, distinct uids; plus a
+        # couple of distinct (priority, ts) residents.
+        specs = [("a", 1, 0.0), ("b", 1, 0.0), ("c", 1, 0.0),
+                 ("d", 5, 0.0), ("e", 1, 2.0)]
+        for name, prio, ts in specs:
+            cache.add_pod(build_pod("ns", name, "n0", "Running",
+                                    build_resource_list("1", "1Gi"), "low",
+                                    priority=prio, ts=ts))
+        cache.add_pod(build_pod("ns", "hi", "", "Pending",
+                                build_resource_list("1", "1Gi"), "high",
+                                priority=100, ts=9.0))
+        for job in cache.jobs.values():
+            for t in job.tasks.values():
+                t.priority = 100 if t.name == "hi" else \
+                    dict((n, p) for n, p, _ in specs).get(t.name, 1)
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        try:
+            scanner = maybe_scanner(ssn, shared=True)
+            assert scanner is not None and scanner.victim_rank
+            job = ssn.jobs["ns/low"]
+            victims = [t for t in job.tasks.values()
+                       if t.status is TaskStatus.Running]
+            queue = ssn.victims_queue(list(victims))
+            want = []
+            while not queue.empty():
+                want.append(queue.pop().uid)
+            got = [t.uid for t in sorted(
+                victims, key=lambda t: scanner.victim_rank[t.uid])]
+            assert got == want
+        finally:
+            close_session(ssn)
+
+    def test_victim_rank_gated_on_task_order_ENABLEMENT(self, monkeypatch):
+        """A conf that registers the priority plugin but disables its
+        task order (`enableTaskOrder: false`) makes victims_queue ignore
+        priority — the precomputed ranking (priority-first) would then
+        diverge, so batch_seed must leave victim_rank None and the walk
+        must fall back to the exact session queue (parity preserved)."""
+        conf = DEFAULT_SCHEDULER_CONF.replace(
+            "- name: priority",
+            "- name: priority\n    enableTaskOrder: false")
+        assert "enableTaskOrder" in conf  # the replace really applied
+        from kube_batch_tpu.models.scanner import maybe_scanner
+        results = {}
+        for arm in ("0", "1"):
+            monkeypatch.setenv("KUBE_BATCH_TPU_BATCH_EVICT", arm)
+            cache, binder, evictor = _storm_cache()
+            _, tiers = load_scheduler_conf(conf)
+            ssn = open_session(cache, tiers)
+            try:
+                if arm == "1":
+                    scanner = maybe_scanner(ssn, shared=True)
+                    assert scanner is not None
+                    assert scanner.victim_rank is None
+                PreemptAction().execute(ssn)
+                state = _session_state(ssn)
+            finally:
+                close_session(ssn)
+            results[arm] = (state, list(evictor.evicts),
+                            dict(binder.binds))
+        assert results["1"][1], "storm must still evict"
+        assert results["1"] == results["0"]
+
+    def test_refresh_equals_fresh_tensorize(self, monkeypatch):
+        """After session mutations, refresh() must stage exactly the dyn
+        rows a fresh per-action tensorize would (the dirty-node
+        invalidation contract)."""
+        monkeypatch.setenv("KUBE_BATCH_TPU_BATCH_EVICT", "1")
+        from kube_batch_tpu.models.scanner import maybe_scanner
+        cache, _, evictor = _storm_cache()
+        # An unplaceable pending pod keeps the candidate set non-empty
+        # after preempt pipelines the high gang, so a fresh tensorize at
+        # "next action" time still builds a scanner to compare against.
+        cache.add_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name="whale", namespace="ns"),
+            spec=v1alpha1.PodGroupSpec(min_member=1, queue="q1")))
+        cache.add_pod(build_pod("ns", "whale0", "", "Pending",
+                                build_resource_list("999", "999Gi"),
+                                "whale", priority=1, ts=50.0))
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        try:
+            shared = maybe_scanner(ssn, shared=True)
+            assert shared is not None
+            PreemptAction().execute(ssn)
+            assert evictor.evicts
+            shared2 = maybe_scanner(ssn, shared=True)
+            assert shared2 is shared  # one scanner per session
+            monkeypatch.setenv("KUBE_BATCH_TPU_BATCH_EVICT", "0")
+            fresh = maybe_scanner(ssn)
+            assert fresh is not None and fresh is not shared
+            r = shared.r
+            # used + count columns must agree exactly row for row
+            n = len(shared.snap.node_names)
+            assert np.array_equal(shared.dyn[:n, :r + 1],
+                                  fresh.dyn[:n, :r + 1])
+        finally:
+            close_session(ssn)
+
+
+class TestEvictionCounters:
+    def test_per_action_counters_and_debug_summary(self, monkeypatch):
+        monkeypatch.setenv("KUBE_BATCH_TPU_BATCH_EVICT", "1")
+        from kube_batch_tpu.metrics.metrics import evictions_by_action
+        from kube_batch_tpu.trace import flight_recorder
+        before = evictions_by_action()
+        cache, _, evictor = _storm_cache()
+        _, _, sid = _run_actions(
+            cache, [ReclaimAction(), PreemptAction()], trace_session=True)
+        after = evictions_by_action()
+        delta = {k: after.get(k, 0) - before.get(k, 0) for k in after}
+        assert sum(delta.values()) == len(evictor.evicts) > 0
+        assert delta.get("preempt", 0) > 0
+        # /debug/sessions summary carries the same per-action split
+        summary = next(s for s in flight_recorder.summaries()
+                       if s["session"] == sid)
+        assert summary["evictions"] == {k: v for k, v in delta.items() if v}
+
+    def test_victim_index_counters(self, monkeypatch):
+        monkeypatch.setenv("KUBE_BATCH_TPU_BATCH_EVICT", "1")
+        from kube_batch_tpu.models.victim_index import VictimIndex
+        cache, _, evictor = _storm_cache()
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        try:
+            PreemptAction().execute(ssn)
+            vindex = VictimIndex.for_session(ssn)
+            assert evictor.evicts
+            assert vindex.invalidations >= len(evictor.evicts)
+            assert vindex.rebuilds >= 1
+        finally:
+            close_session(ssn)
+
+
+class TestBenchAB:
+    def test_measure_action_pipeline_ab(self, monkeypatch):
+        """The bench A/B helper: both arms measured, parity verified,
+        eviction split recorded."""
+        import bench
+        pa = bench.measure_action_pipeline(300, 48, 15, 4, cycles=1)
+        assert pa["parity"] is True
+        assert pa["evictions"] > 0
+        for rec in (pa["actions"], pa["actions_seq"]):
+            assert {"reclaim", "preempt"} <= set(rec)
+        assert sum(pa["evictions_by_action"].values()) == pa["evictions"]

@@ -407,10 +407,17 @@ class TestBenchSatellites:
 
         monkeypatch.setenv("BENCH_FORCE_PROBE_FAIL", "1")
         monkeypatch.setenv("BENCH_PROBE_BACKOFF", "0.05")
-        platform, err = bench._probe_backend_with_retry(30)
+        platform, err, stderr = bench._probe_backend_with_retry(30)
         assert platform is None
         assert "attempt 1" in err and "attempt 2" in err
-        assert "forced probe failure" in err  # the child's stderr tail
+        # Classified by exit code; the stderr tail travels SEPARATELY so
+        # warning noise never masquerades as the failure reason
+        # (BENCH_r05 embedded an experimental-platform warning as the
+        # probe "error").
+        assert "exited 1" in err
+        assert "forced probe failure" not in err
+        assert "attempt 1" in stderr and "attempt 2" in stderr
+        assert "forced probe failure" in stderr
 
     def test_sustained_stats_record(self):
         import bench

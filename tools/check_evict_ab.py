@@ -1,0 +1,52 @@
+"""CI gate for `make bench-evict`: read the bench artifact line from
+stdin, assert the batched eviction engine's bit-parity verdict, and
+print the two arms' preempt/reclaim timings.
+
+bench.py deliberately always exits 0 (the artifact-always-emits
+contract), so the smoke's pass/fail lives here: a parity break or a
+missing/failed A/B exits nonzero and fails the CI job.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> int:
+    line = ""
+    for raw in sys.stdin:
+        raw = raw.strip()
+        if raw.startswith("{"):
+            line = raw  # last JSON-looking line wins (the artifact)
+    if not line:
+        print("check_evict_ab: no artifact line on stdin", file=sys.stderr)
+        return 1
+    out = json.loads(line)
+    if out.get("error"):
+        print(f"check_evict_ab: bench reported error: {out['error']}",
+              file=sys.stderr)
+        return 1
+    if out.get("evict_parity") is not True:
+        print("check_evict_ab: PARITY FAILURE — batched eviction engine "
+              "diverged from the sequential control "
+              f"(evict_parity={out.get('evict_parity')!r})",
+              file=sys.stderr)
+        return 1
+    ab = out.get("evict_ab") or {}
+    if not ab:
+        print("check_evict_ab: artifact carries no evict_ab measurements",
+              file=sys.stderr)
+        return 1
+    print("batched eviction A/B: parity OK "
+          f"({out.get('pipeline_evictions')} evictions, by action: "
+          f"{out.get('evictions_by_action')})")
+    for action, rec in ab.items():
+        print(f"  {action:8s} batched {rec['batched_ms']:8.1f} ms   "
+              f"sequential {rec['sequential_ms']:8.1f} ms   "
+              f"({rec['speedup']}x)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -3,7 +3,7 @@ PYTHON ?= python
 COMPILE_CACHE ?= $(CURDIR)/.compile-cache
 
 .PHONY: lint lint-inventory test bench bench-cached bench-steady \
-	bench-evict trace-demo clean-cache
+	bench-evict chaos chaos-smoke trace-demo clean-cache
 
 # graftlint: the repo's contract-enforcing static analysis (doc/LINT.md)
 # — lock discipline, donation safety, tracer hygiene, ship/no-mutate
@@ -56,6 +56,24 @@ bench-evict:
 		BENCH_NODES=256 BENCH_JOBS=80 BENCH_QUEUES=4 \
 		KUBE_BATCH_TPU_SCAN_MIN_NODES=0 $(PYTHON) bench.py \
 		| $(PYTHON) tools/check_evict_ab.py
+
+# Chaos soak (doc/CHAOS.md): seeded fault storms at every injection site
+# vs the fault-free convergence oracle — the loop must survive 100% of
+# cycles, no pod may double-bind, no eviction may be lost, and the
+# post-drain bind map must match the oracle (bit-identical on the fake
+# cluster; schedule-equivalent over the --edge watch/bind wire).  The
+# full run also measures the chaos-off injection-branch overhead A/B.
+chaos:
+	env JAX_PLATFORMS=cpu $(PYTHON) tools/chaos_soak.py --seeds 5 \
+		--cycles 12 --edge --ab
+	env JAX_PLATFORMS=cpu $(PYTHON) tools/chaos_soak.py --seeds 5 \
+		--cycles 12
+
+# Small-shape seeded soak for CI (a few minutes of storm against the
+# fake cluster): exits nonzero on any invariant violation.
+chaos-smoke:
+	env JAX_PLATFORMS=cpu $(PYTHON) tools/chaos_soak.py --seeds 2 \
+		--cycles 10
 
 # Record a small live session with the flight recorder on and write its
 # Chrome trace-event JSON (doc/OBSERVABILITY.md): open doc/trace_demo.json

@@ -16,12 +16,15 @@ action transparently.
 from __future__ import annotations
 
 import contextlib
+import logging
 import os
 import time
 
 from ..framework import Action
 from ..metrics import metrics
 from ..trace import spans as trace
+
+log = logging.getLogger(__name__)
 
 # Set to a directory path to capture an XLA profiler trace of each session
 # solve (the sidecar profiling hook, SURVEY.md §5).
@@ -44,22 +47,92 @@ def _maybe_profile():
 class TpuAllocateAction(Action):
 
     def __init__(self):
-        self._fallback = None
+        self._fallback_action = None
 
     def name(self) -> str:
         return "tpu-allocate"
 
+    def _run_host_fallback(self, ssn) -> None:
+        """The host allocate oracle: placement-identical to the device
+        path by the parity suite, only the engine differs."""
+        if self._fallback_action is None:
+            from .allocate import AllocateAction
+            self._fallback_action = AllocateAction()
+        self._fallback_action.execute(ssn)
+
+    def _fallback_on_failure(self, ssn, breaker, stage: str, exc) -> None:
+        """Graceful degradation for a device-pipeline failure BEFORE any
+        session mutation: feed the breaker (repeated failures trip it
+        open — doc/CHAOS.md "Breaker semantics"), invalidate the resident
+        ship image (a partial ship must not serve as the next delta
+        baseline), surface the degraded cycle, and run the host path."""
+        from ..models.shipping import resident_shipper
+        breaker.failure()
+        metrics.note_device_failure(stage)
+        trace.note_degraded(
+            f"device {stage} failed ({type(exc).__name__}: {exc}); "
+            "host allocate fallback")
+        log.warning("tpu-allocate degraded to the host path after a "
+                    "device %s failure: %s", stage, exc)
+        resident_shipper(ssn.cache).invalidate()
+        self._run_host_fallback(ssn)
+
+    @staticmethod
+    def _validate_result(snap, assignment, kind, order, ordered) -> None:
+        """Reject a malformed device result BEFORE it touches the session:
+        a poisoned readback (wrong row count, out-of-range indices) must
+        degrade to the host path, never corrupt placements."""
+        import numpy as np
+
+        p = int(snap.inputs.task_req.shape[0])
+        shapes = (assignment.shape, kind.shape, order.shape)
+        if shapes != ((p,), (p,), (p,)):
+            raise RuntimeError(
+                f"malformed device solve result: expected [P={p}] "
+                f"vectors, got {shapes}")
+        if ordered.size:
+            if int(ordered.min()) < 0 or int(ordered.max()) >= p:
+                raise RuntimeError(
+                    "malformed device solve result: placement "
+                    "permutation out of range")
+            sel = assignment[ordered]
+            if (int(sel.min()) < 0
+                    or int(sel.max()) >= len(snap.node_names)):
+                raise RuntimeError(
+                    "malformed device solve result: node index out of "
+                    "range")
+            if np.any(kind[ordered] <= 0):
+                raise RuntimeError(
+                    "malformed device solve result: permutation selects "
+                    "unplaced tasks")
+
     def execute(self, ssn) -> None:
+        from ..chaos.breaker import device_breaker, solve_deadline_s
         from ..models.tensor_snapshot import tensorize_session
 
+        breaker = device_breaker()
+        if not breaker.allow():
+            # OPEN within cooldown: the device path is quarantined and
+            # the host oracle serves this cycle.  Once the cooldown
+            # elapses, allow() turns the breaker half-open and the next
+            # cycle probes the device path again.
+            trace.note_degraded(
+                "device breaker open: tpu-allocate ran the host path")
+            self._run_host_fallback(ssn)
+            return
+
         start = time.time()
-        with trace.span("tensorize"):
-            snap = tensorize_session(ssn)
+        try:
+            with trace.span("tensorize"):
+                snap = tensorize_session(ssn)
+        except Exception as exc:
+            self._fallback_on_failure(ssn, breaker, "tensorize", exc)
+            return
         if snap.needs_fallback:
-            if self._fallback is None:
-                from .allocate import AllocateAction
-                self._fallback = AllocateAction()
-            self._fallback.execute(ssn)
+            # A tensorization GAP, not a device failure: the breaker
+            # stays untouched (needs_fallback is the expressiveness
+            # boundary, the breaker is the health boundary).
+            self._run_host_fallback(ssn)
             return
         metrics.observe_tpu_transfer_latency(time.time() - start)
 
@@ -84,46 +157,74 @@ class TpuAllocateAction(Action):
                                   fetch_result, fetch_solve)
 
         import numpy as np
-        ship_start = time.time()
-        # Device-resident delta shipping: steady cycles move only the
-        # dirty blocks of the packed buffer (models/shipping.py; the
-        # shipper annotates this span with mode and bytes).
-        with trace.span("ship"):
-            inputs = resident_shipper(ssn.cache).ship(snap.inputs,
-                                                      snap.config)
-        metrics.observe_tpu_transfer_latency(time.time() - ship_start)
 
-        from ..models.tensor_snapshot import (build_apply_aggregates,
-                                              prepare_apply_scaffold)
-        pipelined = os.environ.get(PIPELINE_ENV, "1") != "0"
-        solve_start = time.time()
-        with _maybe_profile():
-            if pipelined:
-                # Dispatch, overlap the result-independent apply
-                # preparation with the executing device program, then
-                # block only when the result is actually consumed.  The
-                # packed readback also forces completion
-                # (block_until_ready is unreliable on the axon tunnel).
-                with trace.span("dispatch"):
-                    pending = dispatch_solve(inputs, snap.config)
-                overlap_start = time.perf_counter()
-                with trace.span("host_overlap"):
-                    scaffold = prepare_apply_scaffold(snap)
-                metrics.observe_host_overlap_latency(
-                    time.perf_counter() - overlap_start)
-                wait_start = time.perf_counter()
-                with trace.span("device_wait"):
-                    assignment, kind, order, ordered = fetch_solve(pending)
-                metrics.observe_device_wait_latency(
-                    time.perf_counter() - wait_start)
-            else:
-                with trace.span("solve"):
-                    result = best_solve_allocate(inputs, snap.config)
-                    assignment, kind, order = fetch_result(result)
-                placed = np.nonzero(kind > 0)[0]
-                ordered = placed[np.argsort(order[placed], kind="stable")]
-                scaffold = None
-        metrics.observe_tpu_solve_latency(time.time() - solve_start)
+        # Ship -> dispatch -> fetch -> validate is the degradation
+        # boundary: no session state is mutated inside it, so any failure
+        # (device error, poisoned readback, dead tunnel) safely degrades
+        # this cycle to the host path and feeds the breaker.  From the
+        # apply phase on, failures propagate as before — the session is
+        # mutated and a re-run would double-place.
+        try:
+            ship_start = time.time()
+            # Device-resident delta shipping: steady cycles move only the
+            # dirty blocks of the packed buffer (models/shipping.py; the
+            # shipper annotates this span with mode and bytes).
+            with trace.span("ship"):
+                inputs = resident_shipper(ssn.cache).ship(snap.inputs,
+                                                          snap.config)
+            metrics.observe_tpu_transfer_latency(time.time() - ship_start)
+
+            from ..models.tensor_snapshot import (build_apply_aggregates,
+                                                  prepare_apply_scaffold)
+            pipelined = os.environ.get(PIPELINE_ENV, "1") != "0"
+            solve_start = time.time()
+            with _maybe_profile():
+                if pipelined:
+                    # Dispatch, overlap the result-independent apply
+                    # preparation with the executing device program, then
+                    # block only when the result is actually consumed.  The
+                    # packed readback also forces completion
+                    # (block_until_ready is unreliable on the axon tunnel).
+                    with trace.span("dispatch"):
+                        pending = dispatch_solve(inputs, snap.config)
+                    overlap_start = time.perf_counter()
+                    with trace.span("host_overlap"):
+                        scaffold = prepare_apply_scaffold(snap)
+                    metrics.observe_host_overlap_latency(
+                        time.perf_counter() - overlap_start)
+                    wait_start = time.perf_counter()
+                    with trace.span("device_wait"):
+                        assignment, kind, order, ordered = \
+                            fetch_solve(pending)
+                    metrics.observe_device_wait_latency(
+                        time.perf_counter() - wait_start)
+                else:
+                    with trace.span("solve"):
+                        result = best_solve_allocate(inputs, snap.config)
+                        assignment, kind, order = fetch_result(result)
+                    placed = np.nonzero(kind > 0)[0]
+                    ordered = placed[np.argsort(order[placed],
+                                                kind="stable")]
+                    scaffold = None
+            solve_elapsed = time.time() - solve_start
+            metrics.observe_tpu_solve_latency(solve_elapsed)
+            self._validate_result(snap, assignment, kind, order, ordered)
+        except Exception as exc:
+            self._fallback_on_failure(ssn, breaker, "solve", exc)
+            return
+
+        deadline = solve_deadline_s()
+        if deadline and solve_elapsed > deadline:
+            # Detective, not preemptive: the (valid) late result is still
+            # applied, but a repeatedly-slow device trips the breaker to
+            # the host path exactly like an erroring one.
+            breaker.failure()
+            metrics.note_solve_deadline()
+            trace.note_degraded(
+                f"session solve exceeded deadline "
+                f"({solve_elapsed * 1e3:.0f} ms > {deadline * 1e3:.0f} ms)")
+        else:
+            breaker.success()
 
         # Apply placements in device-solve order through the batched path:
         # end state (status indexes, node accounting, plugin shares, gang

@@ -10,6 +10,8 @@ shadow groups.
 
 from __future__ import annotations
 
+import os
+import random
 import threading
 import time
 from typing import Dict, List, Optional
@@ -20,8 +22,55 @@ from ..api import (ClusterInfo, JobInfo, NodeInfo, Pod, PodGroup, QueueInfo,
 from ..api.job_info import TaskInfo as _TaskInfo
 from ..api.queue_info import Queue, queue_from_versioned
 from ..api.pod_group_info import from_versioned
-from .interface import Binder, Cache, Evictor, StatusUpdater, VolumeBinder
+from ..chaos import plan as chaos_plan
+from ..metrics import metrics
+from .interface import (AmbiguousOutcomeError, Binder, Cache, Evictor,
+                        StatusUpdater, VolumeBinder)
 from .shadow import create_shadow_pod_group, shadow_group_key, shadow_pod_group
+
+# Bind-egress retry policy (doc/CHAOS.md "Graceful degradation"):
+# transient, UNAMBIGUOUS failures (timeout before send, 5xx) retry with
+# bounded exponential backoff + full jitter; ambiguous outcomes (the POST
+# was delivered, the outcome unproven) are never retried — a duplicate
+# Binding POST is not idempotent — and route through resync instead.
+BIND_RETRIES_ENV = "KUBE_BATCH_TPU_BIND_RETRIES"
+_DEF_BIND_RETRIES = 2
+_BIND_BACKOFF_BASE_S = 0.05
+_BIND_BACKOFF_CAP_S = 0.5
+
+
+def _bind_retries() -> int:
+    raw = os.environ.get(BIND_RETRIES_ENV)
+    if raw:
+        try:
+            return max(0, int(raw))
+        except ValueError:
+            pass
+    return _DEF_BIND_RETRIES
+
+
+def _backoff_sleep(delay: float) -> float:
+    """Sleep one backoff step with full jitter; returns the next delay.
+    Jitter decorrelates retry waves across schedulers sharing one
+    apiserver — it never influences a scheduling decision."""
+    time.sleep(min(delay, _BIND_BACKOFF_CAP_S) * (0.5 + random.random() / 2))
+    return delay * 2.0
+
+
+def _retryable_bind_error(exc: Exception) -> bool:
+    """Transient-only retry classification.  Permanent rejections —
+    store conflicts (the simulator's already-assigned ValueError, the
+    edge's 4xx responses) — cannot heal on a re-POST; retrying them just
+    sleeps on the scheduling thread before the same resync.  Ambiguous
+    outcomes are handled separately (never retried)."""
+    if isinstance(exc, AmbiguousOutcomeError):
+        return False
+    if isinstance(exc, ValueError):
+        return False  # store conflict (e.g. nodeName already set)
+    status = getattr(exc, "status", None)
+    if status is not None and 400 <= int(status) < 500 and status != 429:
+        return False  # the request itself is rejected; 5xx/429 retry
+    return True
 
 
 from collections import deque as _deque
@@ -467,6 +516,71 @@ class SchedulerCache(Cache):
                 "leadership lost: refusing cluster write (a standby may "
                 "already be leading)")
 
+    def _binder_bind(self, pod, hostname: str) -> None:
+        """One bind through the effector, with the chaos engine's egress
+        fault sites threaded in (doc/CHAOS.md sites ``bind.timeout``,
+        ``bind.http5xx``, ``bind.ambiguous``) — a single no-op branch
+        when the chaos engine is off."""
+        plan = chaos_plan.PLAN
+        if plan is None:
+            self.binder.bind(pod, hostname)
+            return
+        if plan.fire("bind.timeout"):
+            raise TimeoutError(
+                "chaos: bind request timed out before send (injected)")
+        if plan.fire("bind.http5xx"):
+            raise KeyError("chaos: POST bind: 503 injected")
+        ambiguous = plan.fire("bind.ambiguous")
+        self.binder.bind(pod, hostname)
+        if ambiguous is not None:
+            # The bind LANDED server-side; the caller only sees a dead
+            # connection — the landed-or-not ambiguity the resync
+            # machinery must repair without a blind re-POST.
+            raise AmbiguousOutcomeError(
+                "chaos: connection lost after the bind POST was "
+                "delivered (injected)")
+
+    def _bind_with_backoff(self, pod, hostname: str) -> None:
+        """Single-bind form of the egress retry policy (see module
+        constants): bounded exponential backoff with jitter for
+        transient, unambiguous failures; ambiguous outcomes propagate
+        immediately (never re-POST)."""
+        retries = _bind_retries()
+        delay = _BIND_BACKOFF_BASE_S
+        for attempt in range(retries + 1):
+            try:
+                self._binder_bind(pod, hostname)
+                return
+            except Exception as exc:
+                if attempt >= retries or not _retryable_bind_error(exc):
+                    raise
+                metrics.note_bind_retry()
+                delay = _backoff_sleep(delay)
+
+    def _assume_bound(self, task: TaskInfo, hostname: str) -> None:
+        """Mirror our own successful bind into cache truth AHEAD of the
+        watch echo (kube-scheduler's assume semantics).  On a remote edge
+        the echo lags the POST; until it lands, snapshots would still see
+        the pod Pending, and the next session would re-place it — a
+        duplicate (409-rejected) Binding POST at best, a double-bind at
+        worst.  Re-ingests a node-stamped copy of the pod through the
+        exact update path the echo will later take, so the echo itself is
+        an idempotent replacement.  On the in-process cluster the
+        informer echo is synchronous and this early-returns."""
+        import copy
+        with self.mutex:
+            job = self.jobs.get(task.job)
+            cached = job.tasks.get(task.uid) if job is not None else None
+            if cached is None or cached.node_name:
+                return  # echo already landed, or the task is gone
+            self.epoch += 1
+            pod = copy.deepcopy(cached.pod)
+            pod.spec.node_name = hostname
+            self._delete_task(cached)
+            ti = self._task_info(pod)
+            if ti is not None:
+                self._add_task(ti)
+
     def bind(self, task: TaskInfo, hostname: str) -> None:
         """Delegate to the Binder; revert task status and queue a resync on
         failure (cache.go:491-535)."""
@@ -474,32 +588,82 @@ class SchedulerCache(Cache):
             raise RuntimeError("no binder configured")
         self._check_write_fence()
         try:
-            self.binder.bind(task.pod, hostname)
+            self._bind_with_backoff(task.pod, hostname)
+            self._assume_bound(task, hostname)
             self.events.append(("Scheduled", pod_key(task.pod), hostname))
+        except AmbiguousOutcomeError:
+            # Delivered but unproven: don't guess — the resync worker
+            # refetches ground truth and repairs whichever way it landed
+            # (cache.go:602-624), before the next cycle can re-place.
+            metrics.note_bind_ambiguous("unproven")
+            self._resync_task(task)
+            raise
         except Exception:
             self._resync_task(task)
             raise
 
+    def _bind_many(self, pairs) -> list:
+        """binder.bind_many, or — when a chaos plan is active — a
+        per-bind loop through the instrumented single-bind path so the
+        egress fault sites see every bind (outcome-equivalent: bind_many
+        is per-task isolated either way)."""
+        if chaos_plan.PLAN is None:
+            return self.binder.bind_many(pairs)
+        failures = []
+        for pod, hostname in pairs:
+            try:
+                self._binder_bind(pod, hostname)
+            except Exception as exc:  # per-task failure isolation
+                failures.append((pod, hostname, exc))
+        return failures
+
     def bind_batch(self, tasks: List[TaskInfo]) -> None:
         """Bulk bind with per-task failure isolation: failed tasks queue a
         resync exactly as bind() does; the rest proceed (the reference's
-        per-bind goroutines give the same isolation)."""
+        per-bind goroutines give the same isolation).  Transient failures
+        retry in bounded backoff waves; ambiguous outcomes never retry
+        and always resync (doc/CHAOS.md)."""
         if self.binder is None:
             raise RuntimeError("no binder configured")
         self._check_write_fence()
-        failures = self.binder.bind_many(
-            [(t.pod, t.node_name) for t in tasks])
-        if not failures:  # one bulk event write for the whole batch
+        pending = [(t.pod, t.node_name) for t in tasks]
+        retries = _bind_retries()
+        delay = _BIND_BACKOFF_BASE_S
+        ambiguous: list = []
+        final_failures: list = []
+        for attempt in range(retries + 1):
+            failures = self._bind_many(pending)
+            retryable = []
+            for pod, hostname, exc in failures:
+                if isinstance(exc, AmbiguousOutcomeError):
+                    ambiguous.append((pod, hostname, exc))
+                elif _retryable_bind_error(exc):
+                    retryable.append((pod, hostname, exc))
+                else:
+                    final_failures.append((pod, hostname, exc))
+            if not retryable or attempt >= retries:
+                final_failures.extend(retryable)
+                break
+            metrics.note_bind_retry()
+            delay = _backoff_sleep(delay)
+            pending = [(pod, hostname) for pod, hostname, _ in retryable]
+        failed_uids = set()
+        for pod, _hostname, _exc in ambiguous:
+            metrics.note_bind_ambiguous("unproven")
+            failed_uids.add(pod.metadata.uid)
+        for pod, _hostname, _exc in final_failures:
+            failed_uids.add(pod.metadata.uid)
+        if not failed_uids:  # one bulk event write for the whole batch
+            for t in tasks:
+                self._assume_bound(t, t.node_name)
             self.events.extend(("Scheduled", pod_key(t.pod), t.node_name)
                                for t in tasks)
             return
-        failed_uids = set()
-        for pod, hostname, _exc in failures:
-            failed_uids.add(pod.metadata.uid)
         for t in tasks:
             if t.uid in failed_uids:
                 self._resync_task(t)
             else:
+                self._assume_bound(t, t.node_name)
                 self.events.append(("Scheduled", pod_key(t.pod),
                                     t.node_name))
 
@@ -514,7 +678,23 @@ class SchedulerCache(Cache):
         with self.mutex:
             job = self.jobs.get(task.job)
         try:
+            # Chaos sites (doc/CHAOS.md): ``evict.error`` fails before
+            # the DELETE is sent; ``evict.ambiguous`` lets it land and
+            # then drops the connection — the resync worker must observe
+            # the pod already gone and reconcile (no eviction is ever
+            # lost or double-guessed).  No-op branch when chaos is off.
+            plan = chaos_plan.PLAN
+            ambiguous = None
+            if plan is not None:
+                if plan.fire("evict.error"):
+                    raise OSError(
+                        "chaos: evict DELETE failed before send (injected)")
+                ambiguous = plan.fire("evict.ambiguous")
             self.evictor.evict(task.pod)
+            if ambiguous is not None:
+                raise AmbiguousOutcomeError(
+                    "chaos: connection lost after the evict DELETE was "
+                    "delivered (injected)")
             self.events.append(("Evict", pod_key(task.pod), reason))
         except Exception:
             self._resync_task(task)
@@ -548,8 +728,18 @@ class SchedulerCache(Cache):
                 if not self.err_tasks:
                     return
                 task = self.err_tasks.pop()
-            cluster_pod = cluster.get_pod(task.namespace, task.name) \
-                if cluster is not None else None
+            try:
+                cluster_pod = cluster.get_pod(task.namespace, task.name) \
+                    if cluster is not None else None
+            except Exception:
+                # Ground truth unreachable: re-queue and retry next
+                # period — dropping the task would leave the failed
+                # effect unrepaired forever (and the rest of the queue
+                # faces the same dead edge right now).
+                with self.mutex:
+                    self.err_tasks.append(task)
+                metrics.note_swallowed("resync_fetch")
+                return
             self.sync_task(task, cluster_pod)
 
     def process_cleanup_jobs(self) -> None:

@@ -266,6 +266,15 @@ class Cluster:
             pod = self.pods.get(key)
             if pod is None:
                 raise KeyError(f"pod {key} not found")
+            if pod.spec.node_name:
+                # Real-apiserver semantics (409 Conflict at the edge):
+                # nodeName is immutable once set.  A stale-mirror
+                # scheduler re-POSTing a bind must be REJECTED, never
+                # silently re-assigned — the truth store enforces the
+                # no-double-bind invariant, resync heals the sender.
+                raise ValueError(
+                    f"pod {key} is already assigned to node "
+                    f"{pod.spec.node_name}")
             if hostname not in self.nodes:
                 raise KeyError(f"node {hostname} not found")
             old = copy.deepcopy(pod)

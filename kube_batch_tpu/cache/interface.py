@@ -10,6 +10,15 @@ import abc
 from ..api import ClusterInfo, JobInfo, TaskInfo
 
 
+class AmbiguousOutcomeError(RuntimeError):
+    """A cluster write was DELIVERED but its outcome is unproven — the
+    connection died between send and response, and the read-back probe
+    could not confirm either way.  Non-idempotent verbs (bind) must never
+    blind-retry on this: the caller routes the task through the resync
+    machinery instead of guessing (cache.go:602-624; doc/CHAOS.md
+    "Ambiguous outcomes")."""
+
+
 class Cache(abc.ABC):
     """Cluster-state mirror consumed by the session (interface.go:26-55)."""
 

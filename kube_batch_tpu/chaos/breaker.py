@@ -1,0 +1,164 @@
+"""Circuit breaker + solve deadline: graceful degradation for the device
+path.
+
+``needs_fallback`` (models/tensor_snapshot.py) only covers *tensorization
+gaps* — sessions the device engine cannot express.  Runtime device
+failures (a dead tunnel, a poisoned readback, a wedged solve) previously
+had no degradation story: the cycle died and the loop retried the same
+broken path at full period.  The breaker gives the device path the
+standard closed/open/half-open state machine (doc/CHAOS.md "Breaker
+semantics"):
+
+* CLOSED — healthy; every failure increments a consecutive counter, and
+  ``threshold`` consecutive failures trip to OPEN.
+* OPEN — the device path is quarantined: ``allow()`` refuses, and the
+  tpu-allocate action / eviction scanner run the host-path oracle
+  instead (placement-identical by the parity suite, only slower).  After
+  ``cooldown`` seconds the next ``allow()`` turns the breaker HALF_OPEN.
+* HALF_OPEN — probe traffic is admitted until the first outcome: a
+  ``success()`` closes the breaker, a ``failure()`` re-opens it and
+  restarts the cooldown.  (No probe-in-flight latch: the scheduling loop
+  is effectively single-threaded per cycle, and "admit until first
+  outcome" keeps a probe that never dispatches — e.g. a session with no
+  pending tasks — from wedging the state machine.)
+
+The per-session *solve deadline* (``KUBE_BATCH_TPU_SOLVE_DEADLINE_MS``)
+is detective, not preemptive — an executing device program cannot be
+cancelled from the host — so a solve that overruns it still has its
+(valid) result applied, but counts as a breaker failure: repeatedly-slow
+devices degrade to the host path exactly like erroring ones.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+THRESHOLD_ENV = "KUBE_BATCH_TPU_BREAKER_THRESHOLD"
+COOLDOWN_ENV = "KUBE_BATCH_TPU_BREAKER_COOLDOWN_S"
+SOLVE_DEADLINE_ENV = "KUBE_BATCH_TPU_SOLVE_DEADLINE_MS"
+_DEF_THRESHOLD = 3
+_DEF_COOLDOWN_S = 30.0
+
+CLOSED = "closed"
+HALF_OPEN = "half-open"
+OPEN = "open"
+_STATE_CODE = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
+
+
+def _env_number(name: str, default: float, cast=float) -> float:
+    """Tuning-knob parse that cannot take down a degradation chokepoint:
+    a malformed value falls back to the default instead of raising."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return cast(raw)
+    except ValueError:
+        return default
+
+
+def solve_deadline_s() -> float:
+    """The per-session solve deadline in seconds; 0.0 = disabled."""
+    return max(0.0, _env_number(SOLVE_DEADLINE_ENV, 0.0) / 1e3)
+
+
+class CircuitBreaker:
+
+    def __init__(self, name: str, threshold: Optional[int] = None,
+                 cooldown: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.name = name
+        self.threshold = (threshold if threshold is not None
+                          else int(_env_number(THRESHOLD_ENV,
+                                               _DEF_THRESHOLD, int)))
+        self.cooldown = (cooldown if cooldown is not None
+                         else _env_number(COOLDOWN_ENV, _DEF_COOLDOWN_S))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._state = CLOSED     # guarded-by: _lock
+        self._failures = 0       # guarded-by: _lock
+        self._opened_at = 0.0    # guarded-by: _lock
+        self._publish(CLOSED)
+
+    # -- state reads --------------------------------------------------------
+
+    def state(self) -> str:
+        with self._lock:
+            return self._state
+
+    def closed(self) -> bool:
+        with self._lock:
+            return self._state == CLOSED
+
+    def allow(self) -> bool:
+        """May the caller attempt the protected operation?  CLOSED and
+        HALF_OPEN: yes.  OPEN: no, until the cooldown elapses — then the
+        breaker turns HALF_OPEN and admits probe traffic."""
+        with self._lock:
+            if self._state == CLOSED:
+                return True
+            if (self._state == OPEN
+                    and self._clock() - self._opened_at >= self.cooldown):
+                self._transition(HALF_OPEN)
+            return self._state == HALF_OPEN
+
+    # -- outcomes -----------------------------------------------------------
+
+    def success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            if self._state != CLOSED:
+                self._transition(CLOSED)
+
+    def failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._state == HALF_OPEN or (
+                    self._state == CLOSED
+                    and self._failures >= self.threshold):
+                self._opened_at = self._clock()
+                self._transition(OPEN)
+            elif self._state == OPEN:
+                # Stragglers failing while open restart the cooldown: the
+                # dependency is demonstrably still down.
+                self._opened_at = self._clock()
+
+    def reset(self) -> None:
+        """Force-close (tests / operator intervention)."""
+        with self._lock:
+            self._failures = 0
+            self._opened_at = 0.0
+            if self._state != CLOSED:
+                self._transition(CLOSED)
+
+    # -- internals ----------------------------------------------------------
+
+    def _transition(self, to: str) -> None:  # holds-lock: _lock
+        self._state = to
+        from ..metrics import metrics
+        metrics.note_breaker_transition(self.name, to)
+        self._publish(to)
+
+    def _publish(self, state: str) -> None:
+        from ..metrics import metrics
+        metrics.set_breaker_state(self.name, _STATE_CODE[state])
+        metrics.set_degraded(f"breaker:{self.name}", state != CLOSED)
+
+
+# The device-solve breaker shared by the tpu-allocate action and the
+# eviction scanner: both consume the same device, so their failures feed
+# one state machine and one quarantine decision.
+_device_breaker: Optional[CircuitBreaker] = None
+_singleton_lock = threading.Lock()
+
+
+def device_breaker() -> CircuitBreaker:
+    global _device_breaker
+    if _device_breaker is None:
+        with _singleton_lock:
+            if _device_breaker is None:
+                _device_breaker = CircuitBreaker("device_solve")
+    return _device_breaker

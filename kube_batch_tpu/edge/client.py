@@ -19,7 +19,16 @@ import urllib.request
 from typing import Dict
 
 from ..cache.cluster import Informer
+from ..cache.interface import AmbiguousOutcomeError
+from ..chaos import plan as chaos_plan
+from ..metrics import metrics
 from . import codec, codec_k8s
+
+# Watch reconnect backoff (doc/CHAOS.md "Graceful degradation"): a
+# flapping or erroring stream backs off exponentially instead of
+# hammering the server twice a second forever; a successful sync resets.
+_WATCH_BACKOFF_BASE_S = 0.1
+_WATCH_BACKOFF_CAP_S = 5.0
 
 
 class _NodelayConnection(http.client.HTTPConnection):
@@ -178,6 +187,7 @@ class RemoteCluster:
         key_of = _key_fn(resource)
         base = f"{self.base_url}{self._collection(resource)}?watch=1"
         last_rv = 0
+        backoff = _WATCH_BACKOFF_BASE_S
         while not self._stop.is_set():
             replay_seen = set()
             replaying = True
@@ -190,6 +200,26 @@ class RemoteCluster:
                     for raw in resp:
                         if self._stop.is_set():
                             return
+                        # Chaos sites (doc/CHAOS.md): stream disconnect,
+                        # stale-resume forcing a full relist, and a
+                        # truncated frame (exercises the malformed-frame
+                        # relist below).  Site names carry the resource
+                        # qualifier so each reflector consumes its own
+                        # deterministic decision stream.  One no-op
+                        # branch when the chaos engine is off.
+                        plan = chaos_plan.PLAN
+                        if plan is not None:
+                            if plan.fire(f"watch.disconnect:{resource}"):
+                                raise OSError(
+                                    "chaos: watch stream disconnected "
+                                    "(injected)")
+                            if plan.fire(f"watch.stale:{resource}"):
+                                last_rv = 0
+                                raise OSError(
+                                    "chaos: stale watch resume, forcing "
+                                    "full relist (injected)")
+                            if plan.fire(f"watch.truncate:{resource}"):
+                                raw = raw[:max(1, len(raw) // 2)]
                         event = json.loads(raw)
                         etype = event["type"]
                         # NOTE: last_rv advances only AFTER a frame is
@@ -205,6 +235,7 @@ class RemoteCluster:
                                     informer.fire_delete(store.pop(stale))
                             replaying = False
                             self._synced[resource].set()
+                            backoff = _WATCH_BACKOFF_BASE_S  # healthy again
                             if frame_rv is not None:
                                 last_rv = max(last_rv, int(frame_rv))
                             continue
@@ -213,6 +244,7 @@ class RemoteCluster:
                             # current, no reconciliation needed.
                             replaying = False
                             self._synced[resource].set()
+                            backoff = _WATCH_BACKOFF_BASE_S  # healthy again
                             continue
                         if etype == "ERROR":
                             # 410 Gone: fall back to a full relist.
@@ -244,12 +276,27 @@ class RemoteCluster:
                                 informer.fire_delete(obj)
                         if frame_rv is not None:  # applied successfully
                             last_rv = max(last_rv, int(frame_rv))
-            except (OSError, http.client.HTTPException, ValueError):
-                # Connection loss (incl. IncompleteRead mid-chunk) or a
-                # malformed frame: reconnect and relist.
+            except (OSError, http.client.HTTPException):
+                # Connection loss (incl. IncompleteRead mid-chunk):
+                # reconnect with bounded exponential backoff (reset by
+                # the next successful sync) and resume from last_rv.
                 if self._stop.is_set():
                     return
-                self._stop.wait(0.5)
+                metrics.note_watch_reconnect(resource, "disconnect")
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2.0, _WATCH_BACKOFF_CAP_S)
+            except ValueError:
+                # Malformed frame (truncated chunk, undecodable object):
+                # the frame was never applied and last_rv did not
+                # advance, so resuming would replay the same poisoned
+                # frame forever — drop the resume point and relist from
+                # scratch instead.
+                if self._stop.is_set():
+                    return
+                last_rv = 0
+                metrics.note_watch_reconnect(resource, "malformed")
+                self._stop.wait(backoff)
+                backoff = min(backoff * 2.0, _WATCH_BACKOFF_CAP_S)
 
     def start(self, timeout: float = 30.0) -> "RemoteCluster":
         for resource in _WATCHED:
@@ -261,7 +308,21 @@ class RemoteCluster:
             self._threads.append(t)
         for resource in _WATCHED:
             if not self._synced[resource].wait(timeout):
-                raise TimeoutError(f"watch sync timeout for {resource}")
+                # Don't leak six reflector threads into a caller that
+                # will retry or give up: each holds a socket and keeps
+                # mutating the mirrors.  Stop and join them before
+                # surfacing WHICH resources never synced.
+                unsynced = [r for r in _WATCHED
+                            if not self._synced[r].is_set()]
+                self._stop.set()
+                for t in self._threads:
+                    t.join(timeout=2.0)
+                alive = [t.name for t in self._threads if t.is_alive()]
+                raise TimeoutError(
+                    f"watch sync timeout after {timeout:.1f}s; resources "
+                    f"never synced: {', '.join(unsynced)}"
+                    + (f" (reflectors still draining a blocked read: "
+                       f"{', '.join(alive)})" if alive else ""))
         self._refresh_pvcs()
         return self
 
@@ -312,7 +373,9 @@ class RemoteCluster:
                 return json.loads(resp.read())
         except urllib.error.HTTPError as exc:
             detail = exc.read().decode(errors="replace")
-            raise KeyError(f"{method} {path}: {exc.code} {detail}") from exc
+            err = KeyError(f"{method} {path}: {exc.code} {detail}")
+            err.status = exc.code  # type: ignore[attr-defined]
+            raise err from exc
 
     # effectors the SchedulerCache wiring uses (cluster.py effectors):
     def _bind_request(self, namespace: str, name: str, hostname: str):
@@ -357,13 +420,27 @@ class RemoteCluster:
                     # been applied server-side — don't blind-retry
                     resp = conn.getresponse()
                     data = resp.read()
-                except (http.client.HTTPException, OSError):
+                except (http.client.HTTPException, OSError) as exc:
                     conn.close()  # next request auto-reconnects
                     if attempt or sent:
                         # After delivery, binds are non-idempotent —
                         # check the pod instead of re-POSTing.
                         if sent and self._pod_bound_to(pod, hostname):
+                            # Ambiguity resolved by the read-back: it
+                            # landed, the skipped retry was correct.
+                            metrics.note_bind_ambiguous("landed")
                             return
+                        if sent:
+                            # Delivered but unproven either way (the
+                            # read-back probe could not confirm): surface
+                            # the ambiguity explicitly — the cache routes
+                            # it through resync instead of assuming the
+                            # bind failed (counted there as "unproven").
+                            raise AmbiguousOutcomeError(
+                                f"bind POST for "
+                                f"{pod.metadata.namespace}/"
+                                f"{pod.metadata.name} was delivered but "
+                                f"its outcome is unproven") from exc
                         raise
                     # Send-phase failure: the bytes PROBABLY never
                     # reached the server, but TCP cannot prove it (an
@@ -372,13 +449,20 @@ class RemoteCluster:
                     # if the first POST landed, skip the retry rather
                     # than lean on duplicate binds being idempotent.
                     if self._pod_bound_to(pod, hostname):
+                        metrics.note_bind_ambiguous("landed")
                         return
                     continue
                 if resp.status >= 400:
                     if attempt and self._pod_bound_to(pod, hostname):
-                        return  # first attempt did land; 409-shaped echo
-                    raise KeyError(f"POST {path}: {resp.status} "
+                        # First attempt did land; 409-shaped echo.
+                        metrics.note_bind_ambiguous("landed")
+                        return
+                    err = KeyError(f"POST {path}: {resp.status} "
                                    f"{data.decode(errors='replace')}")
+                    # Status carried for the cache's retry classifier:
+                    # 4xx rejections are permanent, 5xx are transient.
+                    err.status = resp.status  # type: ignore[attr-defined]
+                    raise err
                 return
 
         def run(chunk):
@@ -480,6 +564,26 @@ class RemoteCluster:
             {"volume": volume_name})
 
     def get_pod(self, namespace: str, name: str):
+        """Authoritative ground-truth fetch — the resync path's read
+        (cache.go:602-611 queries the apiserver, not an informer store).
+        Resync exists precisely because the mirror may LAG the effect
+        being repaired; answering from the mirror can resurrect a stale
+        Pending for a bind that actually landed, and the re-placement
+        then double-books the node (found by tools/chaos_soak.py under
+        watch faults).  404 -> None (the pod is truly gone); transport
+        errors propagate — the resync worker re-queues the task."""
+        try:
+            doc = self._request(
+                "GET", self._object_path("pods", namespace, name))
+        except KeyError as exc:
+            if getattr(exc, "status", None) == 404:
+                return None
+            raise
+        return self._decode(doc)
+
+    def get_mirror_pod(self, namespace: str, name: str):
+        """The local mirror's view (may lag truth): the zero-round-trip
+        read for callers that only need informer-consistent state."""
         with self.lock:
             return self.pods.get(f"{namespace}/{name}")
 

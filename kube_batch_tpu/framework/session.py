@@ -20,6 +20,7 @@ from ..api import (ClusterInfo, FitError, JobInfo, NodeInfo, QueueInfo,
 from ..api.pod_group_info import (PodGroupCondition, PodGroupPending,
                                   PodGroupRunning, PodGroupUnknown,
                                   PodGroupUnschedulableType)
+from ..chaos import plan as chaos_plan
 from ..metrics import metrics
 from ..native import apply_placements as native_apply
 from ..trace import spans as trace
@@ -731,6 +732,13 @@ def open_session(cache, tiers: List[Tier],
 
     ssn = Session(cache)
     with trace.span("snapshot"):
+        # Chaos site: a session-open snapshot failure is the whole cycle
+        # dying at its first step — the loop must swallow it and back off
+        # (doc/CHAOS.md site ``session.snapshot``; no-op branch when the
+        # chaos engine is off).
+        plan = chaos_plan.PLAN
+        if plan is not None and plan.fire("session.snapshot"):
+            raise RuntimeError("chaos: session snapshot failed (injected)")
         snapshot: ClusterInfo = cache.snapshot()
     ssn.jobs = snapshot.jobs
     ssn.nodes = snapshot.nodes

@@ -288,6 +288,53 @@ victim_index_events = registry.register(Counter(
     f"{SUBSYSTEM}_victim_index_events_total",
     "VictimIndex life-cycle events (rebuild | evict | restore)",
     ("kind",)))
+# Chaos engine + graceful degradation (doc/CHAOS.md): the injected-fault
+# ledger, the degraded-mode surface (which degradation source is active
+# and what the device-solve breaker is doing), and the failure counters
+# that drive backoff — a cluster limping through faults is fully visible
+# on /metrics instead of just slower.
+chaos_injected = registry.register(Counter(
+    f"{SUBSYSTEM}_chaos_injected_total",
+    "Faults injected by the chaos engine, by site", ("site",)))
+chaos_cycles_survived = registry.register(Counter(
+    f"{SUBSYSTEM}_chaos_cycles_survived_total",
+    "Scheduling cycles completed while a chaos fault plan was active"))
+degraded_mode = registry.register(Gauge(
+    f"{SUBSYSTEM}_degraded_mode",
+    "1 while the named degradation source is active (0 = healthy)",
+    ("source",)))
+breaker_state = registry.register(Gauge(
+    f"{SUBSYSTEM}_breaker_state",
+    "Circuit-breaker state (0 closed | 1 half-open | 2 open)",
+    ("breaker",)))
+breaker_transitions = registry.register(Counter(
+    f"{SUBSYSTEM}_breaker_transitions_total",
+    "Circuit-breaker state transitions, by target state",
+    ("breaker", "to")))
+cycle_failures = registry.register(Counter(
+    f"{SUBSYSTEM}_cycle_failures_total",
+    "Failed scheduler-loop stages (consecutive cycle failures drive the "
+    "crash-loop backoff)", ("stage",)))
+device_solve_failures = registry.register(Counter(
+    f"{SUBSYSTEM}_device_solve_failures_total",
+    "Device-path failures degraded to the host path, by stage",
+    ("stage",)))
+bind_ambiguous = registry.register(Counter(
+    f"{SUBSYSTEM}_bind_ambiguous_total",
+    "Binds whose POST was delivered but whose outcome needed proof, by "
+    "resolution (landed = read-back proved it; unproven = routed to "
+    "resync)", ("outcome",)))
+bind_retries = registry.register(Counter(
+    f"{SUBSYSTEM}_bind_retries_total",
+    "Bind-egress retry waves after transient, unambiguous failures"))
+watch_reconnects = registry.register(Counter(
+    f"{SUBSYSTEM}_watch_reconnects_total",
+    "Reflector watch-stream reconnects, by resource and cause "
+    "(disconnect | malformed)", ("resource", "cause")))
+solve_deadline_exceeded = registry.register(Counter(
+    f"{SUBSYSTEM}_solve_deadline_exceeded_total",
+    "Session solves that overran the per-session deadline (counted as "
+    "breaker failures; the late result is still applied)"))
 
 
 # Helper API (metrics.go:123-191).
@@ -432,3 +479,51 @@ def set_session_mutations(jobs: int, nodes: int) -> None:
 
 def set_bucket_pad_waste(axis: str, ratio: float) -> None:
     bucket_pad_waste.set(round(float(ratio), 4), axis)
+
+
+def note_chaos_injected(site: str) -> None:
+    chaos_injected.inc(1.0, site)
+
+
+def note_chaos_survived() -> None:
+    chaos_cycles_survived.inc()
+
+
+def set_degraded(source: str, active: bool) -> None:
+    degraded_mode.set(1.0 if active else 0.0, source)
+
+
+def set_breaker_state(breaker: str, code: float) -> None:
+    breaker_state.set(code, breaker)
+
+
+def note_breaker_transition(breaker: str, to: str) -> None:
+    breaker_transitions.inc(1.0, breaker, to)
+
+
+def note_cycle_failure(stage: str) -> None:
+    cycle_failures.inc(1.0, stage)
+
+
+def note_device_failure(stage: str) -> None:
+    """Count one device-path failure degraded to the host path (the
+    breaker's feed — stage is tensorize | solve | evict_solve)."""
+    device_solve_failures.inc(1.0, stage)
+
+
+def note_bind_ambiguous(outcome: str) -> None:
+    """Count one delivered-but-needed-proof bind ("landed" when the
+    read-back proved it; "unproven" when it was routed to resync)."""
+    bind_ambiguous.inc(1.0, outcome)
+
+
+def note_bind_retry() -> None:
+    bind_retries.inc()
+
+
+def note_watch_reconnect(resource: str, cause: str) -> None:
+    watch_reconnects.inc(1.0, resource, cause)
+
+
+def note_solve_deadline() -> None:
+    solve_deadline_exceeded.inc()

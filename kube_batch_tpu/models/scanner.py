@@ -80,12 +80,32 @@ def _build_scanner(ssn, use_shipper: bool = False
                    ) -> Optional["DeviceNodeScanner"]:
     import os
 
+    from ..chaos.breaker import device_breaker
     from .tensor_snapshot import tensorize_session
     min_nodes = int(os.environ.get(SCAN_MIN_NODES_ENV,
                                    DEFAULT_SCAN_MIN_NODES))
     if len(ssn.nodes) < min_nodes:
         return None
-    snap = tensorize_session(ssn)
+    breaker = device_breaker()
+    if not breaker.allow():
+        # Device path quarantined (doc/CHAOS.md): the eviction actions
+        # fall back to the pure-host walk they already support — the
+        # scanner only accelerates, it never decides.
+        from ..trace import spans as trace
+        trace.note_degraded(
+            "device breaker open: eviction actions ran the host walk")
+        return None
+    try:
+        snap = tensorize_session(ssn)
+    except Exception as exc:
+        breaker.failure()
+        from ..metrics import metrics
+        metrics.note_device_failure("tensorize")
+        from ..trace import spans as trace
+        trace.note_degraded(
+            f"scanner tensorize failed ({type(exc).__name__}); eviction "
+            "actions ran the host walk")
+        return None
     if snap.needs_fallback or not (snap.tasks or snap.tasks_extra):
         return None
     device_inputs = None
@@ -312,14 +332,40 @@ class DeviceNodeScanner:
         solve_key = evict_solver.evict_solve_key(
             self.cfg, self.r, self.np_pad, self.ns_pad,
             self.dyn.shape[0], kb, mb, int(self.statics.sig_mask.shape[0]))
+        from ..chaos.breaker import device_breaker
         with trace.span("evict.batch_solve", profiles=len(keys),
                         victims=m, nodes=len(self.snap.node_names)):
-            scores, perm = evict_solver.evict_batch_solve(
-                self.cfg, self.r, self.np_pad, self.ns_pad, self.statics,
-                jnp.asarray(self.dyn), jnp.asarray(trows),
-                jnp.asarray(node_p), jnp.asarray(rank_p))
-            mat = np.asarray(scores).astype(np.int64)
-            perm = np.asarray(perm)
+            try:
+                scores, perm = evict_solver.dispatch_evict_batch_solve(
+                    self.cfg, self.r, self.np_pad, self.ns_pad,
+                    self.statics, jnp.asarray(self.dyn),
+                    jnp.asarray(trows), jnp.asarray(node_p),
+                    jnp.asarray(rank_p))
+                mat = np.asarray(scores).astype(np.int64)
+                perm = np.asarray(perm)
+            except Exception as exc:
+                # Degrade, don't die: an unseeded scanner still answers
+                # every scores() call through the per-profile numpy path
+                # and the victim order falls back to the exact session
+                # queue — decisions identical, the batching is only an
+                # accelerator.  The failure feeds the shared device
+                # breaker (doc/CHAOS.md).
+                device_breaker().failure()
+                from ..metrics import metrics
+                metrics.note_device_failure("evict_solve")
+                trace.note_degraded(
+                    f"batched eviction solve failed "
+                    f"({type(exc).__name__}); per-profile host scoring")
+                return
+        breaker = device_breaker()
+        if not breaker.closed():
+            # Resolve a half-open probe: this dispatch IS the recovery
+            # evidence.  A success while CLOSED is deliberately not
+            # recorded — it would reset the consecutive-failure count
+            # the allocate solve is accumulating in the same cycles, and
+            # a small evict solve succeeding must not mask an allocate
+            # solve that errors or overruns its deadline every session.
+            breaker.success()
         note_solve_key(solve_key)
         pos = len(self._edit_log)
         for i, key in enumerate(keys):

@@ -167,6 +167,14 @@ class DeviceResidentShipper:
         self._state: _ShipState | None = None
         self.last_mode: str = ""  # "full" | "delta" | "clean" (tests/obs)
 
+    def invalidate(self) -> None:
+        """Drop the resident image so the next ship is a full one.  The
+        degradation paths call this after any device-pipeline failure: a
+        ship that died midway (or a device left in an unknown state by an
+        injected fault) must not serve as the delta baseline, or the
+        bit-parity guarantee silently breaks (doc/CHAOS.md)."""
+        self._state = None
+
     def ship(self, inp: SolverInputs, cfg=None,
              float_dtype=None) -> SolverInputs:
         from ..metrics import metrics

@@ -774,6 +774,13 @@ def solver_config_from_tiers(tiers):
 def tensorize_session(ssn) -> TensorSnapshot:
     """Flatten the session into SolverInputs (cpu-staged numpy; device put
     happens in the action)."""
+    # Chaos site: tensorize is the device pipeline's first failure surface
+    # (doc/CHAOS.md site ``session.tensorize``); its consumers degrade to
+    # the host path and feed the device breaker.  No-op branch when off.
+    from ..chaos import plan as _chaos_plan
+    plan = _chaos_plan.PLAN
+    if plan is not None and plan.fire("session.tensorize"):
+        raise RuntimeError("chaos: session tensorize failed (injected)")
     import jax.numpy as jnp
     from ..ops.resources import (EPS_QUANTA, quantize_columns,
                                  score_shift_for)

@@ -89,6 +89,26 @@ def evict_batch_solve(cfg, r: int, np_pad: int, ns_pad: int,
     return scores, perm
 
 
+def dispatch_evict_batch_solve(cfg, r: int, np_pad: int, ns_pad: int,
+                               statics: ScanStatics, dyn: jnp.ndarray,
+                               trows: jnp.ndarray, vic_node: jnp.ndarray,
+                               vic_rank: jnp.ndarray):
+    """Host-side dispatch chokepoint for the jitted batched eviction
+    solve — the seam the chaos engine injects device faults into
+    (doc/CHAOS.md site ``evict_solve.device_error``; the branch cannot
+    live inside the jitted program).  A no-op single branch when the
+    chaos engine is off.  The scanner degrades a failure here to
+    per-profile host scoring and feeds the device breaker
+    (models/scanner.py batch_seed)."""
+    from ..chaos import plan as chaos_plan
+    plan = chaos_plan.PLAN
+    if plan is not None and plan.fire("evict_solve.device_error"):
+        raise RuntimeError(
+            "chaos: batched eviction solve failed (injected)")
+    return evict_batch_solve(cfg, r, np_pad, ns_pad, statics, dyn, trows,
+                             vic_node, vic_rank)
+
+
 def evict_solve_key(cfg, r: int, np_pad: int, ns_pad: int, n_pad: int,
                     k_pad: int, m_pad: int, s_real: int) -> tuple:
     """Compile-cache identity of one batched eviction executable — the

@@ -34,6 +34,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from ..chaos import plan as chaos_plan
 from .fairness import queue_shares, safe_share
 from .resources import less_equal_vec
 from .scoring import (SCORE_NEG_INF, ScoreWeights, grid_score, score_nodes,
@@ -402,6 +403,23 @@ def _pack_result(assignment, kind, order):
     return jnp.stack([assignment, kind, order])
 
 
+def _chaos_fetch(packed):
+    """Readback fault sites (doc/CHAOS.md): a slow device (``solve.slow``
+    sleeps before the transfer is consumed) and a poisoned readback
+    (``solve.poison`` truncates a column, the shape every consumer must
+    validate before applying).  One no-op branch when chaos is off."""
+    plan = chaos_plan.PLAN
+    if plan is None:
+        return packed, None
+    slow = plan.fire("solve.slow")
+    if slow is not None:
+        import time
+        time.sleep(0.01 + 0.05 * slow.magnitude)
+    if plan.fire("solve.poison") and packed.shape[-1]:
+        return packed[:, :-1], slow
+    return packed, slow
+
+
 def fetch_result(result: "SolveResult"):
     """Device->host readback of (assignment, kind, order) as ONE transfer:
     the TPU tunnel charges fixed latency per transfer, so three np.asarray
@@ -412,6 +430,7 @@ def fetch_result(result: "SolveResult"):
     with trace.span("solver.fetch"):
         packed = np.asarray(_pack_result(result.assignment, result.kind,
                                          result.order))
+    packed, _ = _chaos_fetch(packed)
     return packed[0], packed[1], packed[2]
 
 
@@ -459,6 +478,7 @@ def fetch_solve(pending: PendingSolve):
     from ..trace import spans as trace
     with trace.span("solver.fetch"):
         packed = np.asarray(pending.packed)
+    packed, _ = _chaos_fetch(packed)
     assignment, kind, order, perm = packed
     n_placed = int(np.count_nonzero(kind > 0))
     return assignment, kind, order, perm[:n_placed]
@@ -540,6 +560,12 @@ def best_solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
     single-kernel Pallas solve on TPU (ops/pallas_solver.py), the two-level
     XLA solve elsewhere.  All are placement-identical (parity suite)."""
     choice, mesh = choose_solver_mesh(inp)
+    # Chaos site: the device dispatch chokepoint every solver family
+    # member routes through (doc/CHAOS.md site ``solve.device_error``);
+    # a no-op single branch when the chaos engine is off.
+    plan = chaos_plan.PLAN
+    if plan is not None and plan.fire("solve.device_error"):
+        raise RuntimeError("chaos: device solve dispatch failed (injected)")
     from .compile_cache import note_solve
     note_solve(choice, inp, cfg)  # compile-cache hit/miss observability
     if choice == "sharded":

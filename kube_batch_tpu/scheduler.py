@@ -8,17 +8,25 @@ schedule-period) and util.go (YAML conf loading with the default
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
 from typing import List, Optional, Tuple
 
 log = logging.getLogger(__name__)
 
+from .chaos import plan as chaos_plan
 from .conf import (SchedulerConfiguration, Tier, apply_plugin_conf_defaults,
                    configuration_from_dict)
 from .framework import (Action, close_session, get_action, open_session)
 from .metrics import metrics
 from .trace import spans as trace
+
+# Crash-loop backoff cap (seconds): consecutive failing cycles double the
+# loop delay up to this bound, so a persistently bad cycle (dead
+# apiserver, wedged device tunnel) cannot hot-loop at schedule_period.
+MAX_CYCLE_BACKOFF_ENV = "KUBE_BATCH_TPU_MAX_CYCLE_BACKOFF_S"
+_DEF_MAX_CYCLE_BACKOFF_S = 30.0
 
 # The shipped default pipeline puts the flagship device action first:
 # tpu-allocate solves the allocate loop on TPU and falls back to the host
@@ -113,6 +121,14 @@ class Scheduler:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._seen_errors: set = set()
+        # Crash-loop backoff state (loop thread only): consecutive failed
+        # run_once calls; resets to 0 on the first healthy cycle.
+        self._consecutive_failures = 0
+        try:
+            self._max_backoff = float(os.environ.get(
+                MAX_CYCLE_BACKOFF_ENV, _DEF_MAX_CYCLE_BACKOFF_S))
+        except ValueError:
+            self._max_backoff = _DEF_MAX_CYCLE_BACKOFF_S
         # Log<->trace correlation: every loop record carries [s=<id>]
         # while a traced session is active (doc/OBSERVABILITY.md).
         trace.install_log_correlation()
@@ -177,6 +193,58 @@ class Scheduler:
                 gc.enable()
         metrics.observe_e2e_latency(time.time() - start)
 
+    def cycle(self) -> bool:
+        """One protected loop iteration: run_once + the repair workers,
+        never raising — the loop-survival contract (scheduler.go:63-86),
+        driven directly by the loop thread and by tools/chaos_soak.py.
+        Returns False when the scheduling cycle itself failed; consecutive
+        failures drive the crash-loop backoff (_cycle_delay)."""
+        ok = True
+        try:
+            self.run_once()
+        except Exception:  # loop must survive a bad cycle
+            ok = False
+            metrics.register_schedule_attempt("error")
+            metrics.note_cycle_failure("cycle")
+            self._log_cycle_error("cycle")
+        # Repair workers (cache.go:357-378: resync + cleanup run
+        # alongside the scheduling loop).
+        try:
+            self.cache.process_cleanup_jobs()
+            self.cache.process_resync_tasks(
+                getattr(self.cache.binder, "cluster", None))
+        except Exception:  # repair must survive too — but visibly
+            metrics.note_cycle_failure("repair")
+            self._log_cycle_error("repair")
+        if ok:
+            if self._consecutive_failures:
+                self._consecutive_failures = 0
+                metrics.set_degraded("cycle_backoff", False)
+        else:
+            self._consecutive_failures += 1
+            metrics.set_degraded("cycle_backoff", True)
+        if chaos_plan.PLAN is not None:
+            # The soak's survival ledger: this cycle completed (healthy
+            # or degraded) with a fault plan active.
+            metrics.note_chaos_survived()
+        return ok
+
+    def _cycle_delay(self, elapsed: float) -> float:
+        """Delay before the next cycle: schedule_period normally; doubled
+        per consecutive failed cycle, capped at MAX_CYCLE_BACKOFF (and
+        never below schedule_period), reset by the next success."""
+        period = self.schedule_period
+        if self._consecutive_failures:
+            cap = max(self._max_backoff, period)
+            # Exponent clamped: 2.0**n raises OverflowError past ~1024,
+            # and an unbounded counter WOULD get there (~9 h of a dead
+            # apiserver at the 30 s cap) — killing the loop thread from
+            # inside the backoff calculation would break the exact
+            # loop-survival contract this path exists for.
+            doubling = 2.0 ** min(self._consecutive_failures, 32)
+            period = min(period * doubling, cap)
+        return period - elapsed
+
     def run(self) -> None:
         """Start the wait.Until-style loop in a background thread
         (scheduler.go:63-86)."""
@@ -191,20 +259,8 @@ class Scheduler:
         def loop():
             while not self._stop.is_set():
                 cycle_start = time.time()
-                try:
-                    self.run_once()
-                except Exception:  # loop must survive a bad cycle
-                    metrics.register_schedule_attempt("error")
-                    self._log_cycle_error("cycle")
-                # Repair workers (cache.go:357-378: resync + cleanup run
-                # alongside the scheduling loop).
-                try:
-                    self.cache.process_cleanup_jobs()
-                    self.cache.process_resync_tasks(
-                        getattr(self.cache.binder, "cluster", None))
-                except Exception:  # repair must survive too — but visibly
-                    self._log_cycle_error("repair")
-                delay = self.schedule_period - (time.time() - cycle_start)
+                self.cycle()
+                delay = self._cycle_delay(time.time() - cycle_start)
                 if delay > 0:
                     self._stop.wait(delay)
 

@@ -100,7 +100,12 @@ class FlightRecorder:
                 "verdicts": len(tr.verdicts),
                 "tallies": len(tr.tallies),
                 "evictions": evictions,
-                "meta": dict(tr.meta),
+                # Degraded-mode reasons (trace.note_degraded): which
+                # cycles ran on a fallback path and why (doc/CHAOS.md).
+                # Excluded from the meta copy below — one source of truth.
+                "degraded": list(tr.meta.get("degraded", ())),
+                "meta": {k: v for k, v in tr.meta.items()
+                         if k != "degraded"},
             })
         return out
 

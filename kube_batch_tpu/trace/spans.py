@@ -254,6 +254,24 @@ def note_evict(action: str) -> None:
         tr.counters.append((f"evictions.{action}", tr.now_us(), 1))
 
 
+# Degraded-mode reasons are bounded per session (a pathological cycle
+# could otherwise append one note per failing task).
+_MAX_DEGRADED_NOTES = 16
+
+
+def note_degraded(reason: str) -> None:
+    """Record that the active session ran degraded and why (breaker open,
+    device fault fallback, deadline overrun): lands in the trace's meta,
+    so /debug/sessions shows which cycles ran degraded and the reason
+    (doc/CHAOS.md)."""
+    tr = getattr(_tls, "trace", None)
+    if tr is None:
+        return
+    notes = tr.meta.setdefault("degraded", [])
+    if len(notes) < _MAX_DEGRADED_NOTES:
+        notes.append(reason)
+
+
 def set_meta(**kv) -> None:
     tr = getattr(_tls, "trace", None)
     if tr is not None:

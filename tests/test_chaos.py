@@ -1,0 +1,458 @@
+"""Chaos engine + graceful degradation (doc/CHAOS.md).
+
+Pins the four contracts the chaos PR introduces:
+
+* the fault plan is SEED-DETERMINISTIC — same seed, byte-identical
+  schedule, per site, preview == live — and fully inert when
+  ``KUBE_BATCH_TPU_CHAOS`` is unset (zero decision-path activations
+  during a whole scheduling cycle, like the trace kill switch);
+* the device-solve circuit breaker trips repeated device failures to the
+  host-path oracle and half-open-probes back, with the degraded cycles
+  visible in the flight recorder;
+* the bind/evict egress backs off on transient failures and routes
+  ambiguous outcomes through resync (never a blind re-POST), counted
+  under ``kube_batch_bind_ambiguous_total``;
+* the scheduler loop crash-backs-off on consecutive failures, and the
+  edge watch stream survives disconnect/truncation with backoff + full
+  relist.
+
+The end-to-end storm (every site at once vs the convergence oracle)
+lives in tools/chaos_soak.py; a small fake-cluster soak runs here so the
+property is tier-1-gated.
+"""
+
+import time
+
+import pytest
+
+from kube_batch_tpu.cache.interface import AmbiguousOutcomeError
+from kube_batch_tpu.chaos import plan as chaos_plan
+from kube_batch_tpu.chaos import breaker as breaker_mod
+from kube_batch_tpu.chaos.breaker import CircuitBreaker, device_breaker
+from kube_batch_tpu.metrics import metrics
+from kube_batch_tpu.trace import flight_recorder
+
+from tests.test_e2e import CONF_TPU, Harness
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos_plan.disable()
+    device_breaker().reset()
+    yield
+    chaos_plan.disable()
+    device_breaker().reset()
+
+
+# ----------------------------------------------------------------------
+# fault-plan determinism
+
+
+class TestFaultPlanDeterminism:
+    def test_same_seed_byte_identical_schedule(self):
+        a = chaos_plan.FaultPlan(seed=42, rate=0.3)
+        b = chaos_plan.FaultPlan(seed=42, rate=0.3)
+        for site in ("watch.disconnect:pods", "bind.ambiguous",
+                     "solve.device_error"):
+            assert a.preview(site, 512) == b.preview(site, 512)
+
+    def test_live_fire_sequence_matches_preview(self):
+        plan = chaos_plan.FaultPlan(seed=7, rate=0.5)
+        preview = chaos_plan.FaultPlan(seed=7, rate=0.5).preview("s", 64)
+        fired = [plan.fire("s") is not None for _ in range(64)]
+        assert fired == [bool(preview[i * 5]) for i in range(64)]
+        assert any(fired) and not all(fired)
+
+    def test_different_seeds_differ(self):
+        a = chaos_plan.FaultPlan(seed=1, rate=0.5).preview("s", 256)
+        b = chaos_plan.FaultPlan(seed=2, rate=0.5).preview("s", 256)
+        assert a != b
+
+    def test_sites_consume_independent_streams(self):
+        # Thread interleaving across sites cannot perturb a site's
+        # schedule: each site's decisions depend only on its own
+        # activation index.
+        interleaved = chaos_plan.FaultPlan(seed=9, rate=0.5)
+        alone = chaos_plan.FaultPlan(seed=9, rate=0.5)
+        got, want = [], []
+        for i in range(64):
+            got.append(interleaved.fire("a") is not None)
+            interleaved.fire(f"noise:{i % 7}")
+            want.append(alone.fire("a") is not None)
+        assert got == want
+
+    def test_budget_drains_schedule(self):
+        plan = chaos_plan.FaultPlan(seed=3, rate=1.0, budget=3)
+        fired = [plan.fire("x") is not None for _ in range(10)]
+        assert fired == [True] * 3 + [False] * 7
+        assert plan.drained()
+        assert plan.total_injected() == 3
+
+    def test_site_filter_and_rate_overrides(self):
+        plan = chaos_plan.FaultPlan(seed=1, rate=1.0,
+                                    sites=("watch.*", "bind.timeout"),
+                                    rates=(("bind.*", 0.0),))
+        assert plan.fire("watch.disconnect:pods") is not None
+        assert plan.fire("solve.device_error") is None  # filtered out
+        assert plan.fire("bind.timeout") is None        # rate override 0
+
+    def test_spec_grammar_round_trip(self, monkeypatch):
+        monkeypatch.setenv(
+            chaos_plan.CHAOS_ENV,
+            "seed=5, rate=0.4, sites=watch.*|bind.*, "
+            "rates=bind.*:0.9|watch.truncate:0.1, budget=7")
+        plan = chaos_plan.reload_from_env()
+        assert (plan.seed, plan.rate, plan.budget) == (5, 0.4, 7)
+        assert plan.sites == ("watch.*", "bind.*")
+        assert plan._rate_for("bind.timeout") == 0.9
+        assert plan._rate_for("watch.truncate:pods") == 0.1
+        assert plan._rate_for("watch.disconnect") == 0.4
+        monkeypatch.delenv(chaos_plan.CHAOS_ENV)
+        assert chaos_plan.reload_from_env() is None
+
+    def test_spec_rejects_malformed(self):
+        with pytest.raises(ValueError):
+            chaos_plan.plan_from_spec("seed=1,bogus=2")
+        with pytest.raises(ValueError):
+            chaos_plan.plan_from_spec("seed=1,rate=1.5")
+        with pytest.raises(ValueError):
+            chaos_plan.plan_from_spec("just-a-word")
+        assert chaos_plan.plan_from_spec("") is None
+        assert chaos_plan.plan_from_spec("off") is None
+
+
+class TestChaosOffIsInert:
+    def test_unset_means_zero_site_activations(self, monkeypatch):
+        """Like the trace kill switch: with no plan installed, a full
+        scheduling cycle must never enter the decision path."""
+        assert chaos_plan.PLAN is None
+        calls = []
+        orig = chaos_plan.FaultPlan.fire
+        monkeypatch.setattr(
+            chaos_plan.FaultPlan, "fire",
+            lambda self, site: (calls.append(site), orig(self, site))[1])
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2)
+        h.create_job("j", 2, 2)
+        h.cycle()
+        assert len(h.bound("j")) == 2  # the cycle really scheduled
+        assert calls == []
+
+    def test_new_collectors_expose(self):
+        from kube_batch_tpu.metrics.metrics import registry
+        text = registry.expose()
+        for name in ("kube_batch_chaos_injected_total",
+                     "kube_batch_degraded_mode",
+                     "kube_batch_breaker_state",
+                     "kube_batch_cycle_failures_total",
+                     "kube_batch_bind_ambiguous_total",
+                     "kube_batch_watch_reconnects_total"):
+            assert name in text
+
+
+# ----------------------------------------------------------------------
+# circuit breaker
+
+
+class TestCircuitBreaker:
+    def test_state_machine(self):
+        clk = [0.0]
+        br = CircuitBreaker("t", threshold=3, cooldown=10.0,
+                            clock=lambda: clk[0])
+        assert br.state() == "closed" and br.allow()
+        br.failure()
+        br.failure()
+        assert br.state() == "closed"  # below threshold
+        br.failure()
+        assert br.state() == "open" and not br.allow()
+        clk[0] = 9.9
+        assert not br.allow()
+        clk[0] = 10.0
+        assert br.allow() and br.state() == "half-open"
+        br.failure()  # probe failed: re-open, cooldown restarts
+        assert br.state() == "open" and not br.allow()
+        clk[0] = 20.0
+        assert br.allow() and br.state() == "half-open"
+        br.success()
+        assert br.state() == "closed" and br.allow()
+
+    def test_success_resets_consecutive_count(self):
+        br = CircuitBreaker("t2", threshold=2, cooldown=10.0)
+        br.failure()
+        br.success()
+        br.failure()
+        assert br.state() == "closed"  # never 2 consecutive
+
+    def test_breaker_trips_to_host_path_and_recovers(self, monkeypatch):
+        """The acceptance demo: repeated device-solve failures degrade
+        cycles to the host path (which still schedules), trip the
+        breaker OPEN (device path no longer attempted), and a half-open
+        probe after cooldown closes it once the device heals."""
+        clk = [0.0]
+        br = CircuitBreaker("device_solve", threshold=2, cooldown=30.0,
+                            clock=lambda: clk[0])
+        monkeypatch.setattr(breaker_mod, "_device_breaker", br)
+        plan = chaos_plan.install(chaos_plan.FaultPlan(
+            seed=1, rate=1.0, sites=("solve.device_error",)))
+
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2, cpu="4")
+        h.create_job("fit", 2, 2)
+        h.create_job("hog", 1, 1, cpu="64")  # never fits: keeps a
+        # pending candidate in every cycle so the solve is attempted
+        h.cycle()
+        # Cycle 1: device solve failed, host fallback still bound the gang.
+        assert len(h.bound("fit")) == 2
+        assert br.state() == "closed"
+        h.cycle()
+        assert br.state() == "open"  # threshold consecutive failures
+        # Breaker open: the device path is not even attempted.
+        before = plan.injected().get("solve.device_error", 0)
+        h.cycle()
+        assert plan.injected().get("solve.device_error", 0) == before
+        assert br.state() == "open"
+        # The degraded cycle and its reason are on the flight recorder.
+        tr = flight_recorder.latest()
+        assert any("breaker open" in note
+                   for note in tr.meta.get("degraded", []))
+        # Device heals; cooldown elapses; the half-open probe closes it.
+        chaos_plan.disable()
+        clk[0] = 31.0
+        h.cycle()
+        assert br.state() == "closed"
+
+    def test_solve_deadline_counts_as_breaker_failure(self, monkeypatch):
+        clk = [0.0]
+        br = CircuitBreaker("device_solve", threshold=1, cooldown=30.0,
+                            clock=lambda: clk[0])
+        monkeypatch.setattr(breaker_mod, "_device_breaker", br)
+        monkeypatch.setenv(breaker_mod.SOLVE_DEADLINE_ENV, "1")
+        chaos_plan.install(chaos_plan.FaultPlan(
+            seed=2, rate=1.0, sites=("solve.slow",)))
+        before = metrics.solve_deadline_exceeded.value()
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2)
+        h.create_job("j", 2, 2)
+        h.cycle()
+        # The (late, valid) result was still applied...
+        assert len(h.bound("j")) == 2
+        # ...but the overrun counted and tripped the threshold-1 breaker.
+        assert metrics.solve_deadline_exceeded.value() > before
+        assert br.state() == "open"
+
+
+# ----------------------------------------------------------------------
+# bind egress: ambiguity + backoff
+
+
+class TestBindFaults:
+    def test_ambiguous_bind_lands_counts_and_resyncs(self):
+        chaos_plan.install(chaos_plan.FaultPlan(
+            seed=3, rate=1.0, sites=("bind.ambiguous",)))
+        before = metrics.bind_ambiguous.value("unproven")
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2)
+        h.create_job("j", 2, 2)
+        h.cycle()
+        # Every bind LANDED server-side even though the cache only saw a
+        # dead connection...
+        assert len(h.bound("j")) == 2
+        # ...was counted as ambiguous, and queued for resync instead of
+        # being guessed at.
+        assert metrics.bind_ambiguous.value("unproven") - before == 2
+        assert len(h.cache.err_tasks) == 2
+        h.cache.process_resync_tasks(h.cache.binder.cluster)
+        assert not h.cache.err_tasks
+        # Ground truth won: the cache sees the pods bound (no re-place,
+        # no duplicate POST next cycle).
+        chaos_plan.disable()
+        binds_before = len(h.cluster.pods)
+        h.cycle()
+        assert len(h.bound("j")) == 2
+        assert len(h.cluster.pods) == binds_before
+
+    def test_transient_bind_failure_retries_with_backoff(self):
+        # budget=1: exactly one injected timeout; the backoff retry wave
+        # must land every bind anyway.
+        chaos_plan.install(chaos_plan.FaultPlan(
+            seed=4, rate=1.0, sites=("bind.timeout",), budget=1))
+        before = metrics.bind_retries.value()
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2)
+        h.create_job("j", 2, 2)
+        h.cycle()
+        assert len(h.bound("j")) == 2
+        assert metrics.bind_retries.value() > before
+
+    def test_truth_store_rejects_rebind(self):
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2)
+        h.create_job("j", 2, 2)
+        h.cycle()
+        (key, node), *_ = h.bound("j").items()
+        ns, name = key.split("/", 1)
+        with pytest.raises(ValueError, match="already assigned"):
+            h.cluster.bind_pod(ns, name, node)
+
+    def test_ambiguous_error_is_not_retried(self, monkeypatch):
+        """A delivered-but-unproven outcome must never be re-POSTed."""
+        calls = []
+
+        class OneShotBinder:
+            def bind(self, pod, hostname):
+                calls.append(pod.metadata.name)
+                raise AmbiguousOutcomeError("delivered, unproven")
+
+        from kube_batch_tpu.cache.cache import SchedulerCache
+        cache = SchedulerCache(binder=OneShotBinder())
+        task = type("T", (), {})()
+        task.pod = type("P", (), {})()
+        task.pod.metadata = type("M", (), {})()
+        task.pod.metadata.name = "p0"
+        task.pod.metadata.namespace = "ns"
+        task.pod.metadata.uid = "u0"
+        task.job = "ns/j"
+        with pytest.raises(AmbiguousOutcomeError):
+            cache._bind_with_backoff(task.pod, "n0")
+        assert calls == ["p0"]  # exactly one attempt
+
+
+# ----------------------------------------------------------------------
+# scheduler crash-loop backoff + session fault sites
+
+
+class TestSchedulerBackoff:
+    def test_consecutive_failures_double_delay_capped_reset(self,
+                                                            monkeypatch):
+        h = Harness(conf=CONF_TPU)
+        sched = h.scheduler
+        sched.schedule_period = 0.1
+        sched._max_backoff = 0.8
+        before = metrics.cycle_failures.value("cycle")
+        boom = [True]
+        orig_run_once = sched.run_once
+
+        def run_once_maybe():
+            if boom[0]:
+                raise RuntimeError("boom")
+            orig_run_once()
+
+        monkeypatch.setattr(sched, "run_once", run_once_maybe)
+        delays = []
+        for _ in range(4):
+            assert sched.cycle() is False
+            delays.append(round(sched._cycle_delay(0.0), 3))
+        assert delays == [0.2, 0.4, 0.8, 0.8]  # doubled, then capped
+        assert metrics.cycle_failures.value("cycle") - before == 4
+        assert metrics.degraded_mode.value("cycle_backoff") == 1.0
+        boom[0] = False
+        assert sched.cycle() is True  # success resets
+        assert round(sched._cycle_delay(0.0), 3) == 0.1
+        assert metrics.degraded_mode.value("cycle_backoff") == 0.0
+
+    def test_backoff_never_overflows_after_long_outages(self):
+        """2.0**n raises OverflowError past ~1024; a dead apiserver
+        reaches that in ~9h at the 30s cap — the delay math must never
+        be able to kill the loop thread."""
+        h = Harness(conf=CONF_TPU)
+        sched = h.scheduler
+        sched.schedule_period = 0.1
+        sched._max_backoff = 30.0
+        sched._consecutive_failures = 100_000
+        assert sched._cycle_delay(0.0) == 30.0  # capped, no raise
+
+    def test_permanent_bind_rejections_are_not_retried(self):
+        from kube_batch_tpu.cache.cache import _retryable_bind_error
+        err_409 = KeyError("POST /bind: 409 conflict")
+        err_409.status = 409
+        err_503 = KeyError("POST /bind: 503 unavailable")
+        err_503.status = 503
+        assert not _retryable_bind_error(ValueError("already assigned"))
+        assert not _retryable_bind_error(err_409)
+        assert not _retryable_bind_error(
+            AmbiguousOutcomeError("delivered"))
+        assert _retryable_bind_error(err_503)
+        assert _retryable_bind_error(TimeoutError("timed out"))
+        assert _retryable_bind_error(OSError("conn reset"))
+
+    def test_snapshot_fault_fails_cycle_but_loop_survives(self):
+        chaos_plan.install(chaos_plan.FaultPlan(
+            seed=5, rate=1.0, sites=("session.snapshot",), budget=2))
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2)
+        h.create_job("j", 2, 2)
+        assert h.scheduler.cycle() is False  # cycle died, loop survived
+        assert h.scheduler.cycle() is False
+        assert h.scheduler.cycle() is True   # budget drained
+        assert len(h.bound("j")) == 2
+
+
+# ----------------------------------------------------------------------
+# edge watch stream under faults
+
+
+class TestWatchFaults:
+    def test_watch_survives_faults_and_reconverges(self):
+        from kube_batch_tpu.apis.scheduling import v1alpha1
+        from kube_batch_tpu.api import ObjectMeta
+        from kube_batch_tpu.cache import Cluster
+        from kube_batch_tpu.edge import ApiServer, RemoteCluster
+        from tests.test_e2e import mk_pod
+
+        cluster = Cluster()
+        cluster.create_queue(v1alpha1.Queue(
+            metadata=ObjectMeta(name="q1"),
+            spec=v1alpha1.QueueSpec(weight=1)))
+        for i in range(4):
+            cluster.create_pod(mk_pod(f"seed-{i}", "g"))
+        server = ApiServer(cluster).start()
+        before = sum(metrics.watch_reconnects.values().values())
+        chaos_plan.install(chaos_plan.FaultPlan(
+            seed=6, rate=0.25, sites=("watch.*",), budget=24))
+        remote = None
+        try:
+            remote = RemoteCluster(server.url).start(timeout=60)
+            for i in range(4):
+                cluster.create_pod(mk_pod(f"late-{i}", "g"))
+            deadline = time.time() + 20
+            want = set(cluster.pods)
+            while time.time() < deadline:
+                with remote.lock:
+                    got = set(remote.pods)
+                if got == want:
+                    break
+                time.sleep(0.05)
+            assert got == want, f"mirror never converged: {got ^ want}"
+            # The storm actually exercised the reconnect path.
+            assert sum(metrics.watch_reconnects.values().values()) > before
+        finally:
+            chaos_plan.disable()
+            if remote is not None:
+                remote.stop()
+            server.stop()
+
+    def test_start_timeout_names_resource_and_joins_reflectors(self):
+        from kube_batch_tpu.edge import RemoteCluster
+        remote = RemoteCluster("http://127.0.0.1:9", timeout=0.2)
+        with pytest.raises(TimeoutError) as excinfo:
+            remote.start(timeout=0.6)
+        assert "pods" in str(excinfo.value)  # names what never synced
+        for t in remote._threads:
+            assert not t.is_alive()  # stopped and joined, not leaked
+
+
+# ----------------------------------------------------------------------
+# the soak property, tier-1-gated at a small shape
+
+
+class TestSoakSmoke:
+    def test_fake_cluster_soak_converges_to_oracle(self):
+        from tools.chaos_soak import run_soak
+        # Single-seed smoke: the convergence + survival invariants are
+        # gated here; all-sites coverage is the multi-seed sweep's job
+        # (make chaos-smoke / make chaos).
+        result = run_soak([11], nodes=6, cycles=6, rate=0.3, budget=30,
+                          require_all_sites=False)
+        assert result["ok"], result["problems"]
+        seed = result["seeds"][0]
+        assert seed["injected_total"] > 0  # the storm actually fired

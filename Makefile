@@ -3,7 +3,7 @@ PYTHON ?= python
 COMPILE_CACHE ?= $(CURDIR)/.compile-cache
 
 .PHONY: lint lint-inventory test bench bench-cached bench-steady \
-	bench-evict chaos chaos-smoke trace-demo clean-cache
+	bench-evict bench-churn chaos chaos-smoke trace-demo clean-cache
 
 # graftlint: the repo's contract-enforcing static analysis (doc/LINT.md)
 # — lock discipline, donation safety, tracer hygiene, ship/no-mutate
@@ -56,6 +56,17 @@ bench-evict:
 		BENCH_NODES=256 BENCH_JOBS=80 BENCH_QUEUES=4 \
 		KUBE_BATCH_TPU_SCAN_MIN_NODES=0 $(PYTHON) bench.py \
 		| $(PYTHON) tools/check_evict_ab.py
+
+# Incremental-vs-control churn sweep at a small CPU shape
+# (doc/INCREMENTAL.md): runs 0.1% / 1% / 10% churn with
+# KUBE_BATCH_TPU_INCREMENTAL on and off over identical deterministic
+# churn schedules, asserts bit-identical binds and events at every
+# level, and prints both arms' timings.  The checker exits nonzero on a
+# parity break (bench.py itself always exits 0), so CI fails loudly.
+bench-churn:
+	env JAX_PLATFORMS=cpu BENCH_CHURN_SWEEP=1 BENCH_TASKS=2000 \
+		BENCH_NODES=256 BENCH_JOBS=80 BENCH_QUEUES=4 \
+		$(PYTHON) bench.py | $(PYTHON) tools/check_churn_ab.py
 
 # Chaos soak (doc/CHAOS.md): seeded fault storms at every injection site
 # vs the fault-free convergence oracle — the loop must survive 100% of

@@ -46,9 +46,19 @@ victims-and-binds verdict, and ``evictions_by_action`` splits the
 formerly opaque ``pipeline_evictions`` total.  BENCH_EVICT_AB=1 runs
 ONLY this A/B (the ``make bench-evict`` smoke).
 
+The churn sweep (O(churn) incremental sessions, doc/INCREMENTAL.md):
+``BENCH_CHURN_SWEEP=1`` runs ONLY a counterbalanced incremental-vs-
+control A/B at 0.1% / 1% / 10% churn (``make bench-churn``): per-level
+steady medians, whole-round sessions/sec, the micro/full/fallback
+session split, the generation-reuse counters, and a bind/event
+bit-parity verdict vs the ``KUBE_BATCH_TPU_INCREMENTAL=0`` arm
+(``churn_sweep`` / ``churn_parity`` artifact keys; BENCH_CHURN_ROUNDS
+rounds per arm, default 6).
+
 Env overrides: BENCH_TASKS, BENCH_NODES, BENCH_JOBS, BENCH_QUEUES;
 BENCH_PIPELINE=0 skips the 4-action scenario, BENCH_COLD_N (default 5);
 BENCH_STEADY_ONLY=1, BENCH_STEADY_ROUNDS (default 5); BENCH_EVICT_AB=1;
+BENCH_CHURN_SWEEP=1, BENCH_CHURN_ROUNDS (default 6);
 BENCH_PROBE_TIMEOUT (s, default 150), BENCH_PROBE_BACKOFF (s, default
 2 — the probe retries once after this backoff), BENCH_DEADLINE (s,
 default 5400 — wall-clock backstop that emits whatever was measured and
@@ -547,6 +557,200 @@ def measure_action_pipeline(n_tasks, n_nodes, n_jobs, n_queues,
     }
 
 
+def measure_churn_sweep(n_tasks, n_nodes, n_jobs, n_queues,
+                        rounds: int = 6,
+                        churns=(0.001, 0.01, 0.1)):
+    """Same-box counterbalanced A/B of the O(churn) incremental session
+    engine (models/incremental.py, doc/INCREMENTAL.md) at three churn
+    levels.  Per level, four fresh-cache arms run in
+    control/incremental/incremental/control order over an IDENTICAL
+    deterministic churn schedule (new podgroups arrive, two-round-old
+    ones retire, binds echo back Running); the artifact records each
+    arm's steady-round medians, whole-round sessions/sec, the
+    micro/full/fallback session split and the generation-reuse counters
+    — and the PARITY verdict: the incremental arm's per-round binds and
+    cluster events must be bit-identical to the control's
+    (tools/check_churn_ab.py gates CI on it via ``make bench-churn``).
+
+    Returns (sweep dict keyed by churn label, parity_all bool)."""
+    import dataclasses as dc
+
+    from kube_batch_tpu.actions.tpu_allocate import TpuAllocateAction
+    from kube_batch_tpu.api import (Container, ObjectMeta, Pod, PodSpec,
+                                    PodStatus, pod_key)
+    from kube_batch_tpu.apis.scheduling import v1alpha1
+    from kube_batch_tpu.apis.scheduling.v1alpha1 import \
+        GroupNameAnnotationKey
+    from kube_batch_tpu.framework import close_session, open_session
+    from kube_batch_tpu.metrics.metrics import (generation_reuse_counts,
+                                                incremental_session_counts)
+    from kube_batch_tpu.models.incremental import INCREMENTAL_ENV
+    from kube_batch_tpu.models.synthetic import make_synthetic_cache
+
+    _register()
+    tiers = _tiers()
+
+    def run_arm(incremental: bool, churn: float):
+        os.environ[INCREMENTAL_ENV] = "1" if incremental else "0"
+        cache, binder = make_synthetic_cache(n_tasks, n_nodes, n_jobs,
+                                             n_queues)
+        action = TpuAllocateAction()
+        podmap = {}
+        for job in cache.jobs.values():
+            for t in job.tasks.values():
+                podmap[pod_key(t.pod)] = t.pod
+
+        def session_ms():
+            start = time.perf_counter()
+            ssn = open_session(cache, tiers)
+            try:
+                action.execute(ssn)
+            finally:
+                close_session(ssn)
+            return (time.perf_counter() - start) * 1e3
+
+        def echo():
+            binds = dict(binder.binds)
+            binder.binds.clear()
+            for key, node in binds.items():
+                old = podmap.get(key)
+                if old is None:
+                    continue
+                new = dc.replace(old,
+                                 spec=dc.replace(old.spec, node_name=node),
+                                 status=PodStatus(phase="Running"))
+                podmap[key] = new
+                cache.update_pod(old, new)
+            updater = cache.status_updater
+            if getattr(updater, "pod_groups", None):
+                for pg in updater.pod_groups:
+                    cache.add_pod_group(pg)
+                updater.pod_groups.clear()
+
+        with _gc_posture():
+            session_ms()  # cold (compile-warm process, fresh cache)
+            fingerprints = [tuple(sorted(binder.binds.items()))]
+            echo()
+            session_ms()  # settle: absorb the mass echo
+            fingerprints.append(tuple(sorted(binder.binds.items())))
+            echo()
+            k = max(1, int(n_tasks * churn))
+            per_group = 25
+            next_uid = n_tasks
+            retire = []
+            times, walls = [], []
+            counts0 = incremental_session_counts()
+            reuse0 = generation_reuse_counts()
+            events_mark = len(cache.events)
+            for rnd in range(rounds):
+                round_start = time.perf_counter()
+                new_keys, pgs = [], []
+                remaining, g = k, 0
+                while remaining > 0:
+                    size = min(per_group, remaining)
+                    pg_name = f"churn-{rnd}-{g}"
+                    pgs.append(pg_name)
+                    cache.add_pod_group(v1alpha1.PodGroup(
+                        metadata=ObjectMeta(name=pg_name,
+                                            namespace="bench"),
+                        spec=v1alpha1.PodGroupSpec(
+                            min_member=max(1, size * 4 // 5),
+                            queue=f"q{g % n_queues}")))
+                    for _ in range(size):
+                        uid = next_uid
+                        next_uid += 1
+                        pod = Pod(
+                            metadata=ObjectMeta(
+                                name=f"c{uid}", namespace="bench",
+                                uid=f"c{uid}",
+                                annotations={
+                                    GroupNameAnnotationKey: pg_name},
+                                creation_timestamp=float(uid)),
+                            spec=PodSpec(containers=[Container(
+                                requests={"cpu": "500m",
+                                          "memory": "1Gi"})]),
+                            status=PodStatus(phase="Pending"))
+                        podmap[pod_key(pod)] = pod
+                        new_keys.append(pod_key(pod))
+                        cache.add_pod(pod)
+                    remaining -= size
+                    g += 1
+                if len(retire) >= 2:
+                    old_pgs, old_keys = retire.pop(0)
+                    for key in old_keys:
+                        pod = podmap.pop(key, None)
+                        if pod is not None:
+                            cache.delete_pod(pod)
+                    for pg_name in old_pgs:
+                        cache.delete_pod_group(v1alpha1.PodGroup(
+                            metadata=ObjectMeta(name=pg_name,
+                                                namespace="bench"),
+                            spec=v1alpha1.PodGroupSpec(min_member=1)))
+                times.append(session_ms())
+                fingerprints.append(tuple(sorted(binder.binds.items())))
+                echo()
+                retire.append((pgs, new_keys))
+                walls.append(time.perf_counter() - round_start)
+            counts1 = incremental_session_counts()
+            reuse1 = generation_reuse_counts()
+        # A deque at capacity may have evicted the mark: skip the event
+        # comparison rather than compare misaligned slices — and FLAG
+        # it, so the CI gate can say the event half of parity was not
+        # verified instead of silently narrowing to binds-only.
+        truncated = len(cache.events) >= cache.events.maxlen
+        events = None if truncated else list(cache.events)[events_mark:]
+        window = walls[1:]
+        return {
+            "times": times,
+            "fingerprints": fingerprints,
+            "events": events,
+            "events_truncated": truncated,
+            "sessions_per_sec": (round(len(window) / sum(window), 3)
+                                 if window and sum(window) > 0 else None),
+            "kinds": {kk: counts1.get(kk, 0) - counts0.get(kk, 0)
+                      for kk in ("micro", "full", "fallback")},
+            "reuse": {kk: reuse1.get(kk, 0) - reuse0.get(kk, 0)
+                      for kk in ("hit", "miss")},
+        }
+
+    prior = os.environ.get(INCREMENTAL_ENV)
+    sweep = {}
+    parity_all = True
+    try:
+        for churn in churns:
+            arms = [run_arm(inc, churn)
+                    for inc in (False, True, True, False)]
+            control = arms[0]["times"][1:] + arms[3]["times"][1:]
+            incr = arms[1]["times"][1:] + arms[2]["times"][1:]
+            parity = all(
+                arm["fingerprints"] == arms[0]["fingerprints"]
+                and (arm["events"] is None or arms[0]["events"] is None
+                     or arm["events"] == arms[0]["events"])
+                for arm in arms[1:])
+            parity_all = parity_all and parity
+            med_i, p90_i = _stats(incr)
+            med_c, p90_c = _stats(control)
+            label = f"{churn * 100:g}%"
+            sweep[label] = {
+                "events_verified": not any(a["events_truncated"]
+                                           for a in arms),
+                "incremental_ms": med_i, "incremental_p90": p90_i,
+                "control_ms": med_c, "control_p90": p90_c,
+                "speedup": (round(med_c / med_i, 2) if med_i else None),
+                "sessions_per_sec": arms[1]["sessions_per_sec"],
+                "control_sessions_per_sec": arms[0]["sessions_per_sec"],
+                "kinds": arms[1]["kinds"],
+                "generation_reuse": arms[1]["reuse"],
+                "parity": parity,
+            }
+    finally:
+        if prior is None:
+            os.environ.pop(INCREMENTAL_ENV, None)
+        else:
+            os.environ[INCREMENTAL_ENV] = prior
+    return sweep, parity_all
+
+
 def _probe_backend(timeout_s: float):
     """Initialize the default JAX backend in a SUBPROCESS and run one op.
 
@@ -714,7 +918,8 @@ def _fill_action_ab(out, n_tasks, n_nodes, n_jobs, n_queues,
 
 
 def _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline,
-         steady_only=False, steady_rounds_n=5, evict_only=False):
+         steady_only=False, steady_rounds_n=5, evict_only=False,
+         churn_only=False):
     if evict_only:
         # BENCH_EVICT_AB=1 (`make bench-evict`): ONLY the batched-vs-
         # sequential eviction A/B at the configured (small) shape — the
@@ -722,6 +927,17 @@ def _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline,
         import jax as _jax
         out["platform"] = _jax.default_backend()
         _fill_action_ab(out, n_tasks, n_nodes, n_jobs, n_queues)
+        return
+    if churn_only:
+        # BENCH_CHURN_SWEEP=1 (`make bench-churn`): ONLY the
+        # incremental-vs-control churn sweep — per-level medians,
+        # sessions/sec, micro/full/fallback split, and the bind/event
+        # parity verdict tools/check_churn_ab.py gates CI on.
+        import jax as _jax
+        out["platform"] = _jax.default_backend()
+        out["churn_sweep"], out["churn_parity"] = measure_churn_sweep(
+            n_tasks, n_nodes, n_jobs, n_queues,
+            rounds=int(os.environ.get("BENCH_CHURN_ROUNDS", 6)))
         return
     _run_full(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n,
               with_pipeline, steady_only, steady_rounds_n)
@@ -891,6 +1107,11 @@ def main():
         # Per-phase span summaries from the session flight recorder
         # (trace/): {phase: {p50, p95, n}} over the steady rounds.
         "phase_ms": None,
+        # O(churn) incremental-session A/B (BENCH_CHURN_SWEEP=1 /
+        # `make bench-churn`): per-churn-level medians and the
+        # bit-parity verdict vs the KUBE_BATCH_TPU_INCREMENTAL=0 arm.
+        "churn_sweep": None,
+        "churn_parity": None,
     }
 
     import threading
@@ -927,11 +1148,13 @@ def main():
         with_pipeline = os.environ.get("BENCH_PIPELINE", "1") != "0"
         steady_only = os.environ.get("BENCH_STEADY_ONLY") == "1"
         evict_only = os.environ.get("BENCH_EVICT_AB") == "1"
+        churn_only = os.environ.get("BENCH_CHURN_SWEEP") == "1"
         steady_rounds_n = int(os.environ.get("BENCH_STEADY_ROUNDS", 5))
         out["metric"] = (f"sched-session solve latency @ {n_tasks} tasks "
                          f"x {n_nodes} nodes (gang+DRF+proportion)"
                          + (" [steady-only]" if steady_only else "")
-                         + (" [evict-ab]" if evict_only else ""))
+                         + (" [evict-ab]" if evict_only else "")
+                         + (" [churn-sweep]" if churn_only else ""))
 
         # Wall-clock backstop for hangs the signal guard cannot reach
         # (a device call blocked in an extension never returns to the
@@ -968,7 +1191,7 @@ def main():
             out["platform"] = platform
         _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline,
              steady_only=steady_only, steady_rounds_n=steady_rounds_n,
-             evict_only=evict_only)
+             evict_only=evict_only, churn_only=churn_only)
         # Last statement INSIDE the try: a signal landing here is still
         # caught below — no handlerless gap before the emit.
         _ignore_signals()

@@ -168,6 +168,18 @@ class SchedulerCache(Cache):
         # it first and refuses once leadership is gone.
         self.write_fence = None  # Optional[Callable[[], bool]]
 
+        # Churn notification for the event-driven scheduler loop
+        # (scheduler.py, doc/INCREMENTAL.md "micro-sessions"): the
+        # scheduler installs a threading.Event here and every EXTERNAL
+        # ingestion path (informer callbacks, resync repair) sets it —
+        # the loop then wakes immediately instead of sleeping out its
+        # schedule_period.  Deliberately NOT fired by the scheduler's
+        # own writes (_assume_bound, the evict truth mirror): waking on
+        # self-inflicted churn would spin the loop one no-op cycle per
+        # bind.  threading.Event.set is atomic, so the field needs no
+        # lock of its own; it is installed once before cache.run().
+        self.churn_event = None  # Optional[threading.Event]
+
     # ------------------------------------------------------------------
     # epoch stamping + clone pool
 
@@ -190,6 +202,32 @@ class SchedulerCache(Cache):
     def discard_pooled_node(self, name: str) -> None:
         with self.mutex:
             self._pooled_nodes.pop(name, None)
+
+    def _note_churn(self) -> None:
+        """Wake the scheduler loop: external cluster state changed."""
+        ev = self.churn_event
+        if ev is not None:
+            ev.set()
+
+    @staticmethod
+    def _pg_fingerprint(pg) -> tuple:
+        """PodGroup identity for self-echo detection: the spec fields the
+        scheduler reads plus the full status.  Conditions carry the
+        session-unique transition_id, so two different sessions' writes
+        never collide."""
+        spec = getattr(pg, "spec", None)
+        status = getattr(pg, "status", None)
+        return (
+            getattr(spec, "min_member", None),
+            getattr(spec, "queue", None),
+            getattr(spec, "priority_class_name", None),
+            getattr(status, "phase", None),
+            getattr(status, "running", None),
+            getattr(status, "failed", None),
+            getattr(status, "succeeded", None),
+            tuple((c.type, c.status, c.reason, c.message,
+                   getattr(c, "transition_id", None))
+                  for c in (getattr(status, "conditions", None) or ())))
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -288,6 +326,7 @@ class SchedulerCache(Cache):
             ti = self._task_info(pod)
             if ti is not None:
                 self._add_task(ti)
+        self._note_churn()
 
     def update_pod(self, old_pod: Pod, new_pod: Pod) -> None:
         with self.mutex:
@@ -298,6 +337,7 @@ class SchedulerCache(Cache):
             ti = self._task_info(new_pod)
             if ti is not None:
                 self._add_task(ti)
+        self._note_churn()
 
     def delete_pod(self, pod: Pod) -> None:
         with self.mutex:
@@ -305,6 +345,7 @@ class SchedulerCache(Cache):
             ti = self._task_info(pod)
             if ti is not None:
                 self._delete_task(ti)
+        self._note_churn()
 
     def sync_task(self, old_task: TaskInfo, cluster_pod: Optional[Pod]) -> None:
         """Refetch ground truth for a task whose effect failed
@@ -316,6 +357,7 @@ class SchedulerCache(Cache):
                 ti = self._task_info(cluster_pod)
                 if ti is not None:
                     self._add_task(ti)
+        self._note_churn()
 
     # ------------------------------------------------------------------
     # node ingestion (event_handlers.go:296-365)
@@ -328,6 +370,7 @@ class SchedulerCache(Cache):
             else:
                 self.nodes[node.name] = NodeInfo(node)
             self._touch_node(self.nodes[node.name])
+        self._note_churn()
 
     def update_node(self, old_node, new_node) -> None:
         with self.mutex:
@@ -337,12 +380,14 @@ class SchedulerCache(Cache):
             else:
                 self.nodes[new_node.name] = NodeInfo(new_node)
             self._touch_node(self.nodes[new_node.name])
+        self._note_churn()
 
     def delete_node(self, node) -> None:
         with self.mutex:
             self.epoch += 1
             self.nodes.pop(node.name, None)
             self._pooled_nodes.pop(node.name, None)
+        self._note_churn()
 
     # ------------------------------------------------------------------
     # PodGroup / Queue / PriorityClass ingestion
@@ -357,10 +402,25 @@ class SchedulerCache(Cache):
             if key not in self.jobs:
                 self.jobs[key] = JobInfo(key)
             job = self.jobs[key]
+            # Self-echo detection: the watch echo of OUR OWN PodGroup
+            # status write (update_job_status records the pushed
+            # fingerprint below) must not wake the scheduler loop — a
+            # persistently unschedulable gang gets a fresh condition
+            # (new transition_id) written every session, and counting
+            # its echo as churn would spin the event-driven loop at the
+            # coalesce cadence forever.  The epoch still bumps (content
+            # did change; tensors must refresh), only the WAKE is
+            # suppressed.  Sticky until the next push: a repeat echo of
+            # the identical object is a no-op for scheduling either way.
+            self_echo = (getattr(job, "_pushed_status_fp", None)
+                         == self._pg_fingerprint(internal)
+                         and job._pushed_status_fp is not None)
             job.set_pod_group(internal)
             if not job.queue:
                 job.queue = self.default_queue
             self._touch_job(job)
+        if not self_echo:
+            self._note_churn()
 
     def update_pod_group(self, old_pg, new_pg) -> None:
         self.add_pod_group(new_pg)
@@ -380,11 +440,13 @@ class SchedulerCache(Cache):
                 self._pooled_jobs.pop(key, None)
             else:
                 self.deleted_jobs.append(job)
+        self._note_churn()
 
     def add_queue(self, queue) -> None:
         q = queue if isinstance(queue, Queue) else queue_from_versioned(queue)
         with self.mutex:
             self.queues[q.metadata.name] = q
+        self._note_churn()
 
     def update_queue(self, old_queue, new_queue) -> None:
         self.add_queue(new_queue)
@@ -393,6 +455,7 @@ class SchedulerCache(Cache):
         name = queue.metadata.name if hasattr(queue, "metadata") else str(queue)
         with self.mutex:
             self.queues.pop(name, None)
+        self._note_churn()
 
     def add_pdb(self, pdb) -> None:
         """Legacy gang source; PDB jobs land in the default queue
@@ -406,6 +469,7 @@ class SchedulerCache(Cache):
             job.set_pdb(pdb)
             job.queue = self.default_queue
             self._touch_job(job)
+        self._note_churn()
 
     def update_pdb(self, old_pdb, new_pdb) -> None:
         self.add_pdb(new_pdb)
@@ -424,6 +488,7 @@ class SchedulerCache(Cache):
                 self._pooled_jobs.pop(key, None)
             else:
                 self.deleted_jobs.append(job)
+        self._note_churn()
 
     def add_priority_class(self, pc) -> None:
         if not self.priority_class_enabled:
@@ -432,6 +497,11 @@ class SchedulerCache(Cache):
             self.priority_classes[pc.metadata.name] = pc
             if pc.global_default:
                 self.default_priority_class = pc
+        # PriorityClass changes alter job priorities without bumping any
+        # job epoch (snapshot() re-resolves priority every cycle), so
+        # the wake is the only thing making the loop react before the
+        # period floor.
+        self._note_churn()
 
     def delete_priority_class(self, pc) -> None:
         with self.mutex:
@@ -440,6 +510,7 @@ class SchedulerCache(Cache):
                     and self.default_priority_class.metadata.name
                     == pc.metadata.name):
                 self.default_priority_class = None
+        self._note_churn()
 
     # ------------------------------------------------------------------
     # snapshot (cache.go:627-683)
@@ -567,15 +638,22 @@ class SchedulerCache(Cache):
         exact update path the echo will later take, so the echo itself is
         an idempotent replacement.  On the in-process cluster the
         informer echo is synchronous and this early-returns."""
-        import copy
+        import dataclasses
         with self.mutex:
             job = self.jobs.get(task.job)
             cached = job.tasks.get(task.uid) if job is not None else None
             if cached is None or cached.node_name:
                 return  # echo already landed, or the task is gone
             self.epoch += 1
-            pod = copy.deepcopy(cached.pod)
-            pod.spec.node_name = hostname
+            # Shallow replace, not deepcopy: only spec.node_name changes;
+            # containers/metadata are shared with the replaced pod, which
+            # is safe under the PodSpec immutability contract
+            # (api/objects.py) and the old pod is discarded here anyway.
+            # deepcopy was ~0.3 ms PER BOUND POD — O(binds) of pure
+            # overhead on every steady cycle's assume path.
+            pod = dataclasses.replace(
+                cached.pod, spec=dataclasses.replace(cached.pod.spec,
+                                                     node_name=hostname))
             self._delete_task(cached)
             ti = self._task_info(pod)
             if ti is not None:
@@ -761,6 +839,19 @@ class SchedulerCache(Cache):
             # events — they must survive a failed status write.
             self._check_write_fence()
             if self.status_updater is not None and not shadow_pod_group(job.pod_group):
+                # Record what we are about to push so its watch echo is
+                # not mistaken for external churn (see add_pod_group) —
+                # BEFORE the push: on the in-process cluster the
+                # informer echo fires synchronously inside it.  A spec
+                # change by an external controller carries different
+                # spec fields and still wakes the loop; a failed push
+                # leaves a fingerprint no echo will ever match... except
+                # an identical external write, which is a no-op anyway.
+                with self.mutex:
+                    truth = self.jobs.get(job.uid)
+                    if truth is not None:
+                        truth._pushed_status_fp = \
+                            self._pg_fingerprint(job.pod_group)
                 self.status_updater.update_pod_group(job.pod_group)
         finally:
             # Events + pod conditions must survive a failed status write

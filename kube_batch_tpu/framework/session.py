@@ -830,9 +830,14 @@ def close_session(ssn: Session) -> None:
             ssn.cache.record_job_status_event(job)
 
     # Publish the cycle's mutation footprint: the dirty-set sizes that
-    # bound the next cycle's incremental staging and delta ship.
+    # bound the next cycle's incremental staging and delta ship.  The
+    # incremental session state accumulates the same footprint as the
+    # churn the NEXT cycle's plan reports (models/incremental.py).
     metrics.set_session_mutations(len(ssn.mutated_jobs),
                                   len(ssn.mutated_nodes))
+    from ..models import incremental
+    incremental.note_session_mutations(ssn.cache, len(ssn.mutated_jobs),
+                                       len(ssn.mutated_nodes))
 
     ssn.jobs = {}
     ssn.nodes = {}

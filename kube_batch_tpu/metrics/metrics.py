@@ -335,6 +335,25 @@ solve_deadline_exceeded = registry.register(Counter(
     f"{SUBSYSTEM}_solve_deadline_exceeded_total",
     "Session solves that overran the per-session deadline (counted as "
     "breaker failures; the late result is still applied)"))
+# O(churn) incremental sessions (models/incremental.py,
+# doc/INCREMENTAL.md): how each session classified (micro = persistent
+# state patched, full = periodic floor / first build, fallback = a micro
+# attempt invalidated by a layout/cfg change or >50% dirty), the dirty
+# footprint the micro path actually restaged, and whether the device
+# solve was served from the generation-keyed result cache (a byte-clean
+# ship reuses the previous deterministic solve without a round-trip).
+incremental_sessions = registry.register(Counter(
+    f"{SUBSYSTEM}_incremental_sessions_total",
+    "Scheduling sessions by incremental kind (micro | full | fallback)",
+    ("kind",)))
+incremental_dirty = registry.register(Gauge(
+    f"{SUBSYSTEM}_incremental_dirty_rows",
+    "Dirty rows the last incremental session restaged, per axis",
+    ("axis",)))
+incremental_generation_reuse = registry.register(Counter(
+    f"{SUBSYSTEM}_incremental_generation_reuse_total",
+    "Device solves served from (hit) or missing (miss) the "
+    "generation-keyed result cache", ("result",)))
 
 
 # Helper API (metrics.go:123-191).
@@ -527,3 +546,31 @@ def note_watch_reconnect(resource: str, cause: str) -> None:
 
 def note_solve_deadline() -> None:
     solve_deadline_exceeded.inc()
+
+
+def note_incremental_session(kind: str) -> None:
+    """Count one session by incremental kind (micro | full | fallback;
+    classified once per session by the first tensorize build)."""
+    incremental_sessions.inc(1.0, kind)
+
+
+def set_incremental_dirty(nodes: int, jobs: int) -> None:
+    incremental_dirty.set(float(nodes), "nodes")
+    incremental_dirty.set(float(jobs), "jobs")
+
+
+def note_generation_reuse(hit: bool) -> None:
+    incremental_generation_reuse.inc(1.0, "hit" if hit else "miss")
+
+
+def incremental_session_counts() -> Dict[str, int]:
+    """{kind: count} so far — bench churn-sweep artifact."""
+    return {labels[0]: int(v)
+            for labels, v in incremental_sessions.values().items()
+            if labels}
+
+
+def generation_reuse_counts() -> Dict[str, int]:
+    return {labels[0]: int(v)
+            for labels, v in incremental_generation_reuse.values().items()
+            if labels}

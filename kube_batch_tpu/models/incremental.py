@@ -1,0 +1,514 @@
+"""O(churn) incremental sessions: persistent, generation-keyed solver state.
+
+The steady-cycle cost model this module attacks (ROADMAP open item #2,
+doc/INCREMENTAL.md): a 1% churn cycle used to pay O(cluster) four times —
+the ``_resource_axis`` full-task scan, the drf/proportion plugin opens
+(one Resource.add per allocated task), the [S, N] static predicate mask,
+and a fresh device solve even when the shipped bytes were identical to
+the previous cycle's.  The dirty set is already computed exactly (the
+cache's ``mod_epoch`` stamps, the TensorCache's block/pack epochs,
+``Session.mutated_nodes``); this module extends that invalidation
+contract to the remaining O(cluster) stages:
+
+* ``begin_tensorize`` — the per-session *plan*: decides micro vs full vs
+  fallback from the dirty sets BEFORE any heavy work, revalidates the
+  resource axis by scanning only dirty objects, and hands the
+  precomputed dirty-node rows to the tensorizer so the epoch walk runs
+  once.  Full-rebuild fallback mirrors the delta shipper's policy
+  (models/shipping.py): layout/config change, >50% dirty, or the
+  periodic full-session floor.
+* persistent ``sig_mask``/``sig_bonus`` — the [S, N] static predicate
+  mask survives across sessions; only dirty node COLUMNS re-enter the
+  predicate chain (the per-(signature, node) evaluation is a pure
+  function, so a patched column equals the profile build's bit for bit).
+* generation-keyed solve reuse — ``DeviceResidentShipper.generation``
+  moves whenever shipped bytes change; a *clean* ship at an unchanged
+  generation means the solver inputs are byte-identical to the previous
+  dispatch, so the deterministic solve result is reused without a device
+  round-trip (actions/tpu_allocate.py).
+* plugin-open aggregate caches — drf/proportion per-job open aggregates
+  cached on the job CLONE (clone identity is the validity token: a
+  session that mutates a clone discards it from the snapshot pool, so a
+  reused clone is bit-unchanged).  drf reuse is exact by construction
+  (the cached Resource is cloned); proportion reuse is gated on every
+  contributing task value being an exact binary integer, so collapsing
+  the per-task adds into one per-job add cannot reassociate floats.
+
+Everything gates behind ``KUBE_BATCH_TPU_INCREMENTAL=0`` — the
+sequential control arm whose placements/events/binds the CI churn sweep
+(`make bench-churn`) pins bit-identical at every churn level.
+
+Thread model: all state here is touched only by the scheduling thread
+(session open/execute/close); no locks needed.  The chaos site
+``incremental.stale_generation`` forces a mid-cycle generation mismatch
+so the fallback-to-full-rebuild path stays exercised (doc/CHAOS.md).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..chaos import plan as chaos_plan
+from ..metrics import metrics
+from ..trace import spans as trace
+
+# =0 restores the sequential control: full tensorize scans, uncached
+# plugin opens, a fresh solve every cycle, fixed-period scheduling.
+INCREMENTAL_ENV = "KUBE_BATCH_TPU_INCREMENTAL"
+# Periodic full-session floor (scheduler.py): every K cycles the loop
+# requests a full rebuild so incremental drift cannot accumulate
+# silently.  0 disables the floor.
+FULL_EVERY_ENV = "KUBE_BATCH_TPU_FULL_EVERY"
+DEFAULT_FULL_EVERY = 16
+
+# Above this dirty fraction the micro patch moves more rows than a full
+# rebuild saves — mirror of the delta shipper's _DELTA_MAX_FRACTION.
+_DIRTY_MAX_FRACTION = 0.5
+
+# Exactness bound for the proportion aggregate cache: integer-valued f64
+# below this stays exactly representable through every partial sum a
+# realistic cluster can accumulate (cluster totals stay far under 2^53).
+_EXACT_LIMIT = float(2 ** 50)
+
+
+def incremental_enabled() -> bool:
+    return os.environ.get(INCREMENTAL_ENV, "1") != "0"
+
+
+def full_session_every() -> int:
+    raw = os.environ.get(FULL_EVERY_ENV)
+    if raw:
+        try:
+            return max(0, int(raw))
+        except ValueError:
+            pass
+    return DEFAULT_FULL_EVERY
+
+
+def resource_exact(res) -> bool:
+    """True when every dimension of ``res`` is an exact binary integer
+    small enough that float addition of such values cannot round — the
+    condition under which per-job partial sums equal the per-task add
+    sequence bit for bit (see ProportionPlugin.on_session_open)."""
+    mc = float(res.milli_cpu)
+    mem = float(res.memory)
+    if not (mc.is_integer() and mem.is_integer()):
+        return False
+    if abs(mc) > _EXACT_LIMIT or abs(mem) > _EXACT_LIMIT:
+        return False
+    if res.scalar_resources:
+        for v in res.scalar_resources.values():
+            fv = float(v)
+            if not fv.is_integer() or abs(fv) > _EXACT_LIMIT:
+                return False
+    return True
+
+
+class IncrementalState:
+    """Cross-session incremental bookkeeping, attached to an
+    epoch-stamped SchedulerCache (mirror of tensor_snapshot's
+    TensorCache persistence gate).  Scheduling-thread only."""
+
+    def __init__(self):
+        # Monotonic build counter: bumps once per COMPLETED tensorize
+        # (micro or full).  Observability + test hooks; the solve cache
+        # below keys on the shipper's byte-generation instead.
+        self.generation: int = 0
+        # Last completed build's layout facts (micro-plan validation).
+        self.axis: Optional[Tuple[str, ...]] = None
+        self.struct: Optional[dict] = None
+        self.node_count: int = 0
+        self.job_count: int = 0
+        # Persistent static predicate mask: [S, n_pad] + the sig tuples
+        # and node list it was built for.  Dirty node columns are
+        # re-evaluated in place (micro path); anything else rebuilds.
+        self.sig_tuples: Optional[tuple] = None
+        self.sig_mask = None            # np.ndarray [S, n_pad] bool
+        self.sig_bonus = None           # np.ndarray [S, n_pad] int64
+        self.sig_examples: Dict[tuple, tuple] = {}
+        # Generation-keyed solve-result cache (actions/tpu_allocate.py):
+        # valid while the shipper's resident bytes are unchanged.
+        self.solve_gen: int = -1
+        self.solve_cfg = None
+        self.solve_result: Optional[tuple] = None
+        # One-shot full-rebuild request (the scheduler's periodic floor,
+        # and the chaos stale-generation recovery path).
+        self.force_full: bool = False
+        # True between begin_tensorize and finish_tensorize.  Still True
+        # at the NEXT begin means the previous build aborted mid-way
+        # (tensorizer fallback_reason early-return, or an exception)
+        # AFTER the pack refresh may have advanced node epochs but
+        # BEFORE the mask was patched/stored — the persisted mask and
+        # solve cache can then be stale for nodes that now look clean,
+        # so both are dropped before planning (the pack itself is safe:
+        # its refreshed rows were staged from live truth).
+        self.build_open: bool = False
+        # Accumulated churn footprint of the last closed session
+        # (framework/session.py close_session) — observability.
+        self.last_mutated_jobs: int = 0
+        self.last_mutated_nodes: int = 0
+        self.last_kind: str = ""
+        self.last_reason: str = ""
+        self.stats = {"micro": 0, "full": 0, "fallback": 0}
+
+    def invalidate_solve(self) -> None:
+        self.solve_gen = -1
+        self.solve_result = None
+        self.solve_cfg = None
+
+
+def state_for(cache, create: bool = True) -> Optional[IncrementalState]:
+    """The cache's persistent IncrementalState, or None for cache objects
+    without epoch stamping (same gate as tensor_snapshot._tensor_cache:
+    reuse without invalidation keys would serve stale tensors)."""
+    st = getattr(cache, "_inc_state", None)
+    if st is not None or not create:
+        return st
+    if hasattr(cache, "epoch") and isinstance(getattr(cache, "jobs", None),
+                                              dict):
+        st = IncrementalState()
+        try:
+            cache._inc_state = st
+        except AttributeError:
+            return None
+        return st
+    return None
+
+
+def request_full(cache) -> None:
+    """Force the next tensorize to run a full rebuild (the scheduler's
+    periodic full-session floor; doc/INCREMENTAL.md 'micro vs full')."""
+    st = state_for(cache)
+    if st is not None:
+        st.force_full = True
+
+
+def note_session_mutations(cache, mutated_jobs: int,
+                           mutated_nodes: int) -> None:
+    """Record the closed session's mutation footprint (close_session):
+    the accumulated churn the next cycle's plan reports alongside its
+    own dirty counts."""
+    st = state_for(cache, create=False)
+    if st is not None:
+        st.last_mutated_jobs = int(mutated_jobs)
+        st.last_mutated_nodes = int(mutated_nodes)
+
+
+def plugin_cache_enabled(cache) -> bool:
+    """Whether the plugin-open aggregate caches may be consulted.  Pure
+    env gate: clone identity alone keys validity, so non-pooled caches
+    simply never hit (fresh clones every cycle)."""
+    return incremental_enabled()
+
+
+class SessionPlan:
+    """One session's incremental decision, computed before any heavy
+    tensorize work.  ``kind``:
+
+    * ``micro``    — axis + persistent mask reused; only dirty rows
+                      re-enter the staging (``axis`` is set).
+    * ``full``     — no previous state, or the periodic floor forced a
+                      rebuild (``axis`` None: full scans run).
+    * ``fallback`` — a micro attempt was invalidated (layout/cfg change,
+                      >50% dirty, injected stale generation); full
+                      scans run and the reason is recorded.
+    """
+
+    __slots__ = ("state", "kind", "reason", "axis", "node_dirty",
+                 "dirty_jobs", "dirty_nodes", "mask_reusable")
+
+    def __init__(self, state: IncrementalState, kind: str, reason: str,
+                 axis=None, node_dirty=None, dirty_jobs: int = 0,
+                 dirty_nodes: int = 0, mask_reusable: bool = False):
+        self.state = state
+        self.kind = kind
+        self.reason = reason
+        self.axis = axis
+        self.node_dirty = node_dirty    # [(ix, epoch|None)] reusable rows
+        self.dirty_jobs = dirty_jobs
+        self.dirty_nodes = dirty_nodes
+        self.mask_reusable = mask_reusable
+
+
+def _dirty_node_rows(node_names, node_objs, mutated_nodes,
+                     pack) -> List[tuple]:
+    """The node rows whose snapshot epoch moved past the pack's stamp
+    (plus session-mutated ones) — the exact walk the tensorizer's pack
+    refresh performs, extracted so plan and refresh share one pass."""
+    dirty = []
+    for ix, name in enumerate(node_names):
+        if name in mutated_nodes:
+            dirty.append((ix, None))
+            continue
+        ep = getattr(node_objs[ix], "snap_epoch", None)
+        if ep is not None and pack.epochs[ix] == ep:
+            continue
+        dirty.append((ix, ep))
+    return dirty
+
+
+def _job_is_dirty(tc, uid, job, mutated_jobs) -> bool:
+    if uid in mutated_jobs:
+        return True
+    snap_epoch = getattr(job, "snap_epoch", None)
+    if snap_epoch is None:
+        return True
+    block = tc.jobs.get(uid)
+    return block is None or block.epoch != snap_epoch
+
+
+def _scalars_in_job(job) -> bool:
+    for t in job.tasks.values():
+        if t.resreq.scalar_resources or t.init_resreq.scalar_resources:
+            return True
+    return False
+
+
+def _struct_key(struct: dict) -> tuple:
+    """Hashable form of plugin_structure's output: the conf-derived
+    facts the persisted mask/bonus (and the whole micro plan) are only
+    valid under.  A session opened with different tiers on the same
+    cache must rebuild."""
+    return (tuple(struct["job_order"]), tuple(struct["queue_order"]),
+            struct["has_gang"], struct["has_proportion"],
+            struct["has_predicates"], struct["weights"],
+            struct["w_podaff"], struct["w_nodeaff"])
+
+
+def begin_tensorize(ssn, tc, node_names, node_objs,
+                    mutated_jobs, mutated_nodes,
+                    struct) -> Optional[SessionPlan]:
+    """Plan this session's tensorize.  Returns None when incremental
+    sessions are disabled or the cache cannot persist state — the
+    tensorizer then runs exactly the pre-incremental path."""
+    if not incremental_enabled():
+        return None
+    st = state_for(ssn.cache)
+    if st is None or not getattr(tc, "persistent", False):
+        return None
+
+    if st.build_open:
+        # The previous build never reached finish_tensorize (see the
+        # field's docstring): drop everything that could be stale
+        # relative to the advanced pack epochs.
+        st.sig_tuples = None
+        st.sig_mask = None
+        st.sig_bonus = None
+        st.invalidate_solve()
+    st.build_open = True
+
+    struct_key = _struct_key(struct)
+    if st.force_full:
+        st.force_full = False
+        st.struct = struct_key
+        return SessionPlan(st, "full", "periodic full-session floor")
+    if st.axis is None:
+        st.struct = struct_key
+        return SessionPlan(st, "full", "first session")
+    if st.struct != struct_key:
+        # Conf change on a live cache: every persisted tensor (mask
+        # bonus weights, predicate enablement) — and the example cache
+        # the mask patcher probes the predicate chain with — is keyed
+        # to the old tiers.
+        st.struct = struct_key
+        st.sig_examples.clear()
+        st.invalidate_solve()
+        return SessionPlan(st, "fallback", "plugin/tier structure changed")
+
+    def fallback(reason: str, dirty_jobs=0, dirty_nodes=0) -> SessionPlan:
+        return SessionPlan(st, "fallback", reason, dirty_jobs=dirty_jobs,
+                           dirty_nodes=dirty_nodes)
+
+    # Chaos site: forces a generation mismatch mid-cycle so the
+    # degraded path (full rebuild + solve-cache invalidation) stays
+    # exercised under the soak harness (doc/CHAOS.md).
+    plan = chaos_plan.PLAN
+    if plan is not None and plan.fire("incremental.stale_generation"):
+        st.invalidate_solve()
+        trace.note_degraded(
+            "incremental generation stale (injected): full rebuild")
+        return fallback("chaos: stale generation (injected)")
+
+    # Layout/config-key validation (mirror of the shipper's full-reship
+    # triggers): any mismatch means the persisted rows describe a
+    # different tensor layout.
+    if tc.axis != st.axis:
+        return fallback("tensor-cache axis flushed")
+    if (len(tc.sig_list) + len(tc.port_list) + len(tc.sel_list)
+            > 4096):  # _MAX_GLOBAL_IDS: the tensorizer will flush tables
+        return fallback("global id tables at flush threshold")
+    pack = tc.pack
+    if pack is None or pack.names != node_names:
+        return fallback("node membership changed",
+                        dirty_nodes=len(node_names))
+    if set(ssn.task_order_fns) - {"priority"}:
+        return fallback("non-stock task order")
+
+    node_dirty = _dirty_node_rows(node_names, node_objs, mutated_nodes,
+                                  pack)
+    n_real = len(node_names)
+
+    dirty_jobs = 0
+    dirty_job_objs = []
+    for uid, job in ssn.jobs.items():
+        if _job_is_dirty(tc, uid, job, mutated_jobs):
+            dirty_jobs += 1
+            dirty_job_objs.append(job)
+    j_total = max(len(ssn.jobs), 1)
+
+    if (len(node_dirty) > _DIRTY_MAX_FRACTION * max(n_real, 1)
+            or dirty_jobs > _DIRTY_MAX_FRACTION * j_total):
+        return fallback(
+            f"dirty fraction above {_DIRTY_MAX_FRACTION:.0%} "
+            f"({len(node_dirty)}/{n_real} nodes, "
+            f"{dirty_jobs}/{j_total} jobs)",
+            dirty_jobs=dirty_jobs, dirty_nodes=len(node_dirty))
+
+    # Axis revalidation by dirty-only scan: the last completed build
+    # proved no scalar resource existed anywhere; clean objects are
+    # bit-unchanged since, so only dirty ones can introduce one.  A
+    # scalar appearing (or a previous axis that already had scalars —
+    # removal could shrink it) means the axis must be re-derived from
+    # the full scan.
+    if st.axis != ("cpu", "memory"):
+        return fallback("scalar resources present: axis not provable "
+                        "from the dirty set",
+                        dirty_jobs=dirty_jobs,
+                        dirty_nodes=len(node_dirty))
+    for ix, _ep in node_dirty:
+        if node_objs[ix].allocatable.scalar_resources:
+            return fallback("dirty node introduces a scalar resource",
+                            dirty_jobs=dirty_jobs,
+                            dirty_nodes=len(node_dirty))
+    for job in dirty_job_objs:
+        if _scalars_in_job(job):
+            return fallback("dirty job introduces a scalar resource",
+                            dirty_jobs=dirty_jobs,
+                            dirty_nodes=len(node_dirty))
+
+    return SessionPlan(st, "micro", "", axis=st.axis,
+                       node_dirty=node_dirty, dirty_jobs=dirty_jobs,
+                       dirty_nodes=len(node_dirty), mask_reusable=True)
+
+
+def patch_sig_mask(plan: SessionPlan, ssn, sig_tuples, node_objs,
+                   n_pad: int, w_nodeaff: int):
+    """Serve the persistent [S, n_pad] sig_mask/sig_bonus with dirty
+    node columns re-evaluated in place, or None when a full rebuild is
+    required (sig set changed, shape moved, plan not micro).
+
+    Bit parity: the per-(signature, node) evaluation below is the same
+    pure function the profile build memoizes (tensor_snapshot's
+    prof_mask/prof_bonus loop), so a patched column equals a rebuilt
+    one exactly; clean columns cannot have drifted because every input
+    of the function (node labels/taints/conditions/unschedulable,
+    allocatable cap, resident count) moves the node's epoch or lands in
+    Session.mutated_nodes — both enter ``node_dirty``."""
+    import numpy as np
+
+    st = plan.state
+    key = tuple(sig_tuples)
+    if (not plan.mask_reusable or st.sig_mask is None
+            or st.sig_tuples != key
+            or st.sig_mask.shape != (len(sig_tuples), n_pad)):
+        return None
+    if len(plan.node_dirty) * len(sig_tuples) > 4096:
+        # The patch path evaluates the predicate chain per (signature,
+        # dirty node) with no static-profile dedup; past this budget the
+        # profile build (O(S x distinct profiles) evaluations plus one
+        # vector scatter) is cheaper than the patch it would replace —
+        # mirror of the pack refresh's own full-rebuild cutover.
+        return None
+    from ..plugins.nodeorder import node_affinity_score
+    from .tensor_snapshot import _sig_example, _static_example
+
+    sig_mask = st.sig_mask
+    sig_bonus = st.sig_bonus
+    examples = st.sig_examples
+    for si, sig in enumerate(sig_tuples):
+        cached = examples.get(sig)
+        if cached is None:
+            example = _sig_example(sig)
+            stripped = _static_example(example)
+            cached = (example, stripped)
+            examples[sig] = cached
+        example, stripped = cached
+        # has_pref derives from the CURRENT conf's w_nodeaff, never the
+        # cached tuple: a weight change must not serve zero bonuses for
+        # dirty columns after the struct fallback rebuilt the mask.
+        affinity = example.pod.spec.affinity
+        has_pref = (w_nodeaff and affinity is not None
+                    and affinity.preferred_node_terms)
+        for ix, _ep in plan.node_dirty:
+            node = node_objs[ix]
+            bonus = 0
+            if has_pref:
+                bonus = w_nodeaff * node_affinity_score(example, node)
+            sig_bonus[si, ix] = bonus
+            ok = True
+            try:
+                ssn.predicate_fn(stripped, node)
+            except Exception:  # lint: allow-swallow(predicate veto: any raise means infeasible, exactly like the profile build treats it)
+                ok = False
+            sig_mask[si, ix] = ok
+    return sig_mask, sig_bonus
+
+
+def store_sig_mask(plan: Optional[SessionPlan], sig_tuples, sig_mask,
+                   sig_bonus) -> None:
+    """Persist a freshly built mask for the next session's patch path.
+    Only non-empty signature sets persist (the featureless all-True row
+    is cheaper to rebuild than to key); an empty set drops any older
+    persisted mask so it cannot be served after the signatures return."""
+    if plan is None:
+        return
+    st = plan.state
+    if not sig_tuples:
+        st.sig_tuples = None
+        st.sig_mask = None
+        st.sig_bonus = None
+        st.sig_examples.clear()
+        return
+    st.sig_tuples = tuple(sig_tuples)
+    st.sig_mask = sig_mask
+    st.sig_bonus = sig_bonus
+    # Drop example cache entries for signatures that left the session.
+    live = set(st.sig_tuples)
+    for sig in [s for s in st.sig_examples if s not in live]:
+        del st.sig_examples[sig]
+
+
+def finish_tensorize(plan: Optional[SessionPlan], ssn, axis,
+                     node_count: int, job_count: int) -> None:
+    """Close out a COMPLETED build: update the layout facts the next
+    plan validates against, bump the generation, and publish the
+    kind/dirty counts to metrics and the flight recorder (the
+    /debug/sessions ``incremental`` surface)."""
+    if plan is None:
+        return
+    st = plan.state
+    st.build_open = False
+    st.axis = tuple(axis)
+    st.node_count = node_count
+    st.job_count = job_count
+    st.generation += 1
+    st.last_kind = plan.kind
+    st.last_reason = plan.reason
+    st.stats[plan.kind] = st.stats.get(plan.kind, 0) + 1
+    metrics.set_incremental_dirty(plan.dirty_nodes, plan.dirty_jobs)
+    # One count per SESSION (the scanner and the allocate action may
+    # both tensorize within one cycle; the first build classifies it).
+    if not getattr(ssn, "_inc_counted", False):
+        try:
+            ssn._inc_counted = True
+        except AttributeError:
+            pass
+        metrics.note_incremental_session(plan.kind)
+    trace.set_meta(incremental=plan.kind,
+                   dirty_nodes=plan.dirty_nodes,
+                   dirty_jobs=plan.dirty_jobs,
+                   **({"incremental_reason": plan.reason}
+                      if plan.reason else {}))
+    trace.annotate(incremental=plan.kind, dirty_nodes=plan.dirty_nodes,
+                   dirty_jobs=plan.dirty_jobs)

@@ -166,14 +166,25 @@ class DeviceResidentShipper:
     def __init__(self):
         self._state: _ShipState | None = None
         self.last_mode: str = ""  # "full" | "delta" | "clean" (tests/obs)
+        # Byte-generation of the resident image: moves whenever the
+        # shipped bytes change (full or delta ship, or an invalidation)
+        # and stays put on a clean ship.  The generation keys the
+        # incremental solve-result cache (models/incremental.py): a
+        # clean ship at an unchanged generation proves the solver inputs
+        # are byte-identical to the previous dispatch, so the
+        # deterministic solve result may be reused without a device
+        # round-trip (doc/INCREMENTAL.md).
+        self.generation: int = 0
 
     def invalidate(self) -> None:
         """Drop the resident image so the next ship is a full one.  The
         degradation paths call this after any device-pipeline failure: a
         ship that died midway (or a device left in an unknown state by an
         injected fault) must not serve as the delta baseline, or the
-        bit-parity guarantee silently breaks (doc/CHAOS.md)."""
+        bit-parity guarantee silently breaks (doc/CHAOS.md).  Bumps the
+        generation: nothing keyed to the dropped image may be reused."""
         self._state = None
+        self.generation += 1
 
     def ship(self, inp: SolverInputs, cfg=None,
              float_dtype=None) -> SolverInputs:
@@ -184,6 +195,7 @@ class DeviceResidentShipper:
             float_dtype = _default_float_dtype()
         if os.environ.get(DELTA_SHIP_ENV, "1") == "0":
             self._state = None  # clean A/B: no stale image survives
+            self.generation += 1
             spec, flat, treedef = _pack_host(inp, float_dtype)
             out = jax.tree.unflatten(
                 treedef, _unpack(spec, float_dtype, jnp.asarray(flat)))
@@ -232,6 +244,7 @@ class DeviceResidentShipper:
         st.inputs = jax.tree.unflatten(  # frozen-after: ship
             treedef, _unpack_blocks(spec, float_dtype, st.device_flat))
         self._state = st
+        self.generation += 1
         self.last_mode = "full"
         metrics.note_ship("full", flat.nbytes)
         trace.note_ship("full", flat.nbytes)
@@ -263,6 +276,7 @@ class DeviceResidentShipper:
         st.inputs = jax.tree.unflatten(
             st.treedef,
             _unpack_blocks(st.spec, st.float_dtype, st.device_flat))
+        self.generation += 1
         self.last_mode = "delta"
         metrics.note_ship("delta", upd.nbytes + idx_p.nbytes)
         trace.note_ship("delta", upd.nbytes + idx_p.nbytes)

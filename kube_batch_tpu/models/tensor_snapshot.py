@@ -803,12 +803,28 @@ def tensorize_session(ssn) -> TensorSnapshot:
     w_podaff = struct["w_podaff"]
     w_nodeaff = struct["w_nodeaff"]
 
-    axis = _resource_axis(ssn)
+    # Cross-session tensor cache + the incremental session plan: the
+    # plan (models/incremental.py) classifies this build micro / full /
+    # fallback from the dirty sets BEFORE any O(cluster) scan runs.  A
+    # micro plan revalidates the resource axis from dirty objects only
+    # and precomputes the dirty node rows the pack refresh consumes;
+    # KUBE_BATCH_TPU_INCREMENTAL=0 keeps this exactly the pre-plan path.
+    tc = _tensor_cache(ssn.cache)
+    mutated_jobs = getattr(ssn, "mutated_jobs", set())
+    mutated_nodes = getattr(ssn, "mutated_nodes", set())
+    node_names = sorted(ssn.nodes)  # must match utils.get_node_list order
+    node_objs = [ssn.nodes[name] for name in node_names]
+    from . import incremental as _inc
+    plan = _inc.begin_tensorize(ssn, tc, node_names, node_objs,
+                                mutated_jobs, mutated_nodes, struct)
+    if plan is not None and plan.axis is not None:
+        axis = list(plan.axis)
+    else:
+        axis = _resource_axis(ssn)
     snap.resource_names = axis
     r = len(axis)
 
-    # Cross-session tensor cache: axis change flushes shape-dependent state.
-    tc = _tensor_cache(ssn.cache)
+    # Axis change flushes the tensor cache's shape-dependent state.
     if tc.axis != tuple(axis):
         tc.axis = tuple(axis)
         tc.jobs.clear()
@@ -826,15 +842,11 @@ def tensorize_session(ssn) -> TensorSnapshot:
         tc.sel_gid.clear()
         tc.sel_list.clear()
         tc.jobs.clear()
-    mutated_jobs = getattr(ssn, "mutated_jobs", set())
-    mutated_nodes = getattr(ssn, "mutated_nodes", set())
 
     # ---- nodes (packed quanta rows, refreshed from deltas) ----------------
-    node_names = sorted(ssn.nodes)  # must match utils.get_node_list order
     snap.node_names = node_names
     n_real = len(node_names)
     n_pad = bucket(max(n_real, 1))
-    node_objs = [ssn.nodes[name] for name in node_names]
 
     def _node_epoch(ix: int, name: str):
         """The snapshot-time epoch this clone reflects (stamped under the
@@ -859,13 +871,15 @@ def tensorize_session(ssn) -> TensorSnapshot:
         # Same membership: refresh only rows whose snapshot epoch moved
         # (or whose session clone was already mutated this cycle).  When a
         # large fraction is dirty (e.g. the informer echo of a mass bind),
-        # the vectorized full build beats per-row numpy calls.
-        dirty = []
-        for ix, name in enumerate(node_names):
-            ep = _node_epoch(ix, name)
-            if ep is not None and pack.epochs[ix] == ep:
-                continue
-            dirty.append((ix, ep))
+        # the vectorized full build beats per-row numpy calls.  A micro
+        # plan already ran this exact walk (incremental._dirty_node_rows
+        # — the shared helper) and hands the rows over, so the epoch
+        # pass happens once per session.
+        if plan is not None and plan.node_dirty is not None:
+            dirty = plan.node_dirty
+        else:
+            dirty = _inc._dirty_node_rows(node_names, node_objs,
+                                          mutated_nodes, pack)
         if len(dirty) > max(64, n_real // 5):
             epochs = pack.epochs  # keep clean rows' stamps
             pack = _build_node_pack(node_objs, node_names, axis)
@@ -1195,7 +1209,15 @@ def tensorize_session(ssn) -> TensorSnapshot:
     # cliff a heterogeneous 64-signature x 10k-node session would hit,
     # while unique per-node labels (kubernetes.io/hostname) drop out
     # unless a signature actually selects on them.
-    if sig_tuples:
+    patched = (_inc.patch_sig_mask(plan, ssn, sig_tuples, node_objs,
+                                   n_pad, w_nodeaff)
+               if plan is not None and sig_tuples else None)
+    if patched is not None:
+        # Micro path: the persistent mask with only dirty node columns
+        # re-evaluated — bit-identical to the profile build below
+        # (models/incremental.patch_sig_mask documents why).
+        sig_mask, sig_bonus = patched
+    elif sig_tuples:
         from ..plugins.nodeorder import node_affinity_score
         label_keys = set()
         for sel, _tol, aff, pref in sig_tuples:
@@ -1259,8 +1281,12 @@ def tensorize_session(ssn) -> TensorSnapshot:
         if n_real:
             sig_mask[:, :n_real] = prof_mask[:, profile_of]
             sig_bonus[:, :n_real] = prof_bonus[:, profile_of]
+        if plan is not None:
+            _inc.store_sig_mask(plan, sig_tuples, sig_mask, sig_bonus)
     else:
         sig_mask[:, :n_real] = True
+        if plan is not None:
+            _inc.store_sig_mask(plan, (), None, None)
     if sig_bonus.any():
         # Combined-score headroom: bonus + fraction scores (+ a possible
         # pod-affinity term, hence the halved budget) must stay in int32.
@@ -1391,4 +1417,5 @@ def tensorize_session(ssn) -> TensorSnapshot:
         has_pod_affinity=bool(aff_rows or anti_rows) and has_predicates,
         has_pod_affinity_score=bool(paff_rows or panti_rows),
         weights=weights)
+    _inc.finish_tensorize(plan, ssn, snap.resource_names, n_real, j_real)
     return snap

@@ -51,15 +51,34 @@ class DrfPlugin(Plugin):
         attr.share = self._calculate_share(attr.allocated)
 
     def on_session_open(self, ssn) -> None:
+        from ..models.incremental import plugin_cache_enabled
+        reuse = plugin_cache_enabled(ssn.cache)
+
         for node in ssn.nodes.values():
             self.total_resource.add(node.allocatable)
 
+        # Incremental open (doc/INCREMENTAL.md): the per-job allocated
+        # aggregate is cached on the job CLONE, so the O(all allocated
+        # tasks) walk runs only for clones the informers (or a session)
+        # touched — clone identity is the validity token (a mutated
+        # clone is discarded from the snapshot pool and never served
+        # again).  Exact by construction: the cached Resource was built
+        # by this very walk and is cloned back out, so shares equal the
+        # uncached path bit for bit.  KUBE_BATCH_TPU_INCREMENTAL=0
+        # restores the unconditional walk (the parity control).
         for job in ssn.jobs.values():
             attr = _DrfAttr()
-            for status, tasks in job.task_status_index.items():
-                if allocated_status(status):
-                    for t in tasks.values():
-                        attr.allocated.add(t.resreq)
+            cached = getattr(job, "_drf_open_alloc", None) if reuse \
+                else None
+            if cached is not None:
+                attr.allocated = cached.clone()
+            else:
+                for status, tasks in job.task_status_index.items():
+                    if allocated_status(status):
+                        for t in tasks.values():
+                            attr.allocated.add(t.resreq)
+                if reuse:
+                    job._drf_open_alloc = attr.allocated.clone()
             self._update_share(attr)
             self.job_attrs[job.uid] = attr
 

@@ -28,6 +28,12 @@ from .trace import spans as trace
 MAX_CYCLE_BACKOFF_ENV = "KUBE_BATCH_TPU_MAX_CYCLE_BACKOFF_S"
 _DEF_MAX_CYCLE_BACKOFF_S = 30.0
 
+# Event-driven micro-sessions (doc/INCREMENTAL.md): cache churn wakes the
+# loop early; a woken loop sleeps this coalescing window first so one
+# informer burst becomes one micro-session instead of N.  Milliseconds.
+COALESCE_MS_ENV = "KUBE_BATCH_TPU_COALESCE_MS"
+_DEF_COALESCE_MS = 10.0
+
 # The shipped default pipeline puts the flagship device action first:
 # tpu-allocate solves the allocate loop on TPU and falls back to the host
 # allocate path transparently whenever the session can't be tensorized
@@ -119,16 +125,31 @@ class Scheduler:
         self.actions, self.tiers = load_scheduler_conf(
             scheduler_conf or DEFAULT_SCHEDULER_CONF)
         self._stop = threading.Event()
+        # Churn wakeup (event-driven micro-sessions, doc/INCREMENTAL.md):
+        # the cache's external ingestion paths set this; the loop then
+        # runs its next cycle immediately instead of sleeping out the
+        # remaining schedule_period.  stop() also sets it so shutdown
+        # never waits out a sleeping loop.
+        self._wake = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self._seen_errors: set = set()
         # Crash-loop backoff state (loop thread only): consecutive failed
         # run_once calls; resets to 0 on the first healthy cycle.
         self._consecutive_failures = 0
+        # Periodic full-session floor: every K cycles the loop forces a
+        # full (non-incremental) rebuild so micro-session drift cannot
+        # accumulate unrevalidated (models/incremental.py).
+        self._cycles_since_full = 0
         try:
             self._max_backoff = float(os.environ.get(
                 MAX_CYCLE_BACKOFF_ENV, _DEF_MAX_CYCLE_BACKOFF_S))
         except ValueError:
             self._max_backoff = _DEF_MAX_CYCLE_BACKOFF_S
+        try:
+            self._coalesce_s = float(os.environ.get(
+                COALESCE_MS_ENV, _DEF_COALESCE_MS)) / 1e3
+        except ValueError:
+            self._coalesce_s = _DEF_COALESCE_MS / 1e3
         # Log<->trace correlation: every loop record carries [s=<id>]
         # while a traced session is active (doc/OBSERVABILITY.md).
         trace.install_log_correlation()
@@ -193,14 +214,21 @@ class Scheduler:
                 gc.enable()
         metrics.observe_e2e_latency(time.time() - start)
 
-    def cycle(self) -> bool:
+    def cycle(self, force_full: bool = False) -> bool:
         """One protected loop iteration: run_once + the repair workers,
         never raising — the loop-survival contract (scheduler.go:63-86),
         driven directly by the loop thread and by tools/chaos_soak.py.
         Returns False when the scheduling cycle itself failed; consecutive
-        failures drive the crash-loop backoff (_cycle_delay)."""
+        failures drive the crash-loop backoff (_cycle_delay).
+
+        ``force_full``: request a full (non-incremental) tensorize for
+        this cycle — the loop's periodic full-session floor; micro
+        cycles run the incremental path, full cycles revalidate it."""
         ok = True
         try:
+            if force_full:
+                from .models import incremental
+                incremental.request_full(self.cache)
             self.run_once()
         except Exception:  # loop must survive a bad cycle
             ok = False
@@ -247,22 +275,56 @@ class Scheduler:
 
     def run(self) -> None:
         """Start the wait.Until-style loop in a background thread
-        (scheduler.go:63-86)."""
+        (scheduler.go:63-86).  The loop is event-driven: cache churn
+        (informer ingestion) wakes it early for a micro-session instead
+        of waiting out schedule_period; a short coalescing window turns
+        an informer burst into one cycle; and every
+        ``KUBE_BATCH_TPU_FULL_EVERY`` cycles a full session revalidates
+        the incremental state (doc/INCREMENTAL.md)."""
         self.cache.run()
         self.cache.wait_for_cache_sync()
+        # Install the churn wakeup on caches that support it (the
+        # SchedulerCache's external ingestion paths set it; foreign cache
+        # implementations without the attribute keep the fixed period).
+        try:
+            self.cache.churn_event = self._wake
+        except AttributeError:  # lint: allow-swallow(read-only cache object: the loop degrades to the fixed schedule_period, which is the pre-incremental behavior)
+            pass
         # Move the synced long-lived cache out of the collector's scan set
         # (see run_once's GC note).
         import gc
         gc.collect()
         gc.freeze()
 
+        from .models.incremental import full_session_every
+        full_every = full_session_every()
+
         def loop():
             while not self._stop.is_set():
                 cycle_start = time.time()
-                self.cycle()
+                # Clear BEFORE the cycle: churn arriving while it runs
+                # re-sets the event and the next wait returns at once,
+                # so no delta is ever silently absorbed into a sleep.
+                self._wake.clear()
+                force_full = bool(full_every) and \
+                    self._cycles_since_full + 1 >= full_every
+                self.cycle(force_full=force_full)
+                self._cycles_since_full = \
+                    0 if force_full else self._cycles_since_full + 1
                 delay = self._cycle_delay(time.time() - cycle_start)
-                if delay > 0:
+                if delay <= 0:
+                    continue
+                if self._consecutive_failures:
+                    # Crash-loop backoff must not be bypassed by churn:
+                    # a dead apiserver plus a watch storm would
+                    # otherwise hot-loop the failing cycle.
                     self._stop.wait(delay)
+                elif self._wake.wait(delay) and not self._stop.is_set():
+                    # Churn wakeup: coalesce the burst, then run the
+                    # micro-session.  schedule_period expiry (False)
+                    # falls through to the periodic revalidation cycle.
+                    if self._coalesce_s > 0:
+                        self._stop.wait(self._coalesce_s)
 
         # Start BEFORE publishing: run() may execute on an elector
         # callback thread while stop() runs on the main thread (HA
@@ -275,6 +337,10 @@ class Scheduler:
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
+        # Wake a sleeping loop immediately: without this, stop() blocks
+        # until the remaining schedule_period (or the full crash-loop
+        # backoff delay) elapses before the loop re-checks _stop.
+        self._wake.set()
         thread = self._thread
         if thread is not None:
             thread.join(timeout=timeout)

@@ -1,0 +1,702 @@
+"""O(churn) incremental sessions (models/incremental.py, doc/INCREMENTAL.md).
+
+The invariant the whole subsystem stands on: an incremental (micro)
+tensorize is BIT-IDENTICAL to a from-scratch ``tensorize_session`` after
+every Session/cache mutation path — bind+echo, evict, pipeline, job
+add/update/delete, node allocatable change, node add/delete.  On top of
+that: the plugin-open aggregate caches are exact, a byte-clean ship
+reuses the previous solve, the scheduler loop wakes on cache churn (and
+``stop()`` wakes a sleeping loop immediately), the periodic floor forces
+full sessions, and the chaos ``incremental.stale_generation`` site
+degrades cleanly to a full rebuild.
+"""
+
+import dataclasses as dc
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu.actions.factory import register_default_actions
+from kube_batch_tpu.actions.tpu_allocate import TpuAllocateAction
+from kube_batch_tpu.api import (Container, Node, NodeSpec, NodeStatus,
+                                ObjectMeta, Pod, PodSpec, PodStatus,
+                                pod_key)
+from kube_batch_tpu.apis.scheduling import v1alpha1
+from kube_batch_tpu.apis.scheduling.v1alpha1 import GroupNameAnnotationKey
+from kube_batch_tpu.chaos import plan as chaos_plan
+from kube_batch_tpu.chaos.plan import FaultPlan
+from kube_batch_tpu.framework import close_session, open_session
+from kube_batch_tpu.metrics import metrics
+from kube_batch_tpu.models import incremental
+from kube_batch_tpu.models.synthetic import make_synthetic_cache
+from kube_batch_tpu.models.tensor_snapshot import tensorize_session
+from kube_batch_tpu.plugins.factory import register_default_plugins
+from kube_batch_tpu.scheduler import (DEFAULT_SCHEDULER_CONF, Scheduler,
+                                      load_scheduler_conf)
+
+register_default_actions()
+register_default_plugins()
+
+
+def _tiers():
+    return load_scheduler_conf(DEFAULT_SCHEDULER_CONF)[1]
+
+
+def _open(cache):
+    return open_session(cache, _tiers())
+
+
+def _echo(cache, binder):
+    """Informer echo of binds + PodGroup status writes (the steady-state
+    feedback loop the incremental paths are keyed to)."""
+    podmap = {}
+    for job in cache.jobs.values():
+        for t in job.tasks.values():
+            podmap[pod_key(t.pod)] = t.pod
+    for key, node in sorted(binder.binds.items()):
+        old = podmap.get(key)
+        if old is None:
+            continue
+        new = dc.replace(old, spec=dc.replace(old.spec, node_name=node),
+                         status=PodStatus(phase="Running"))
+        cache.update_pod(old, new)
+    binder.binds.clear()
+    updater = cache.status_updater
+    for pg in updater.pod_groups:
+        cache.add_pod_group(pg)
+    updater.pod_groups.clear()
+
+
+def _cycle(cache, binder, echo=True):
+    ssn = _open(cache)
+    try:
+        TpuAllocateAction().execute(ssn)
+    finally:
+        close_session(ssn)
+    if echo:
+        _echo(cache, binder)
+
+
+def _oracle_snapshot(ssn):
+    """From-scratch tensorize of the SAME session: detach every
+    persistent cache and run the KUBE_BATCH_TPU_INCREMENTAL=0 path."""
+    cache = ssn.cache
+    saved = {}
+    for attr in ("_tensor_cache", "_inc_state", "_ship_cache"):
+        if hasattr(cache, attr):
+            saved[attr] = getattr(cache, attr)
+            delattr(cache, attr)
+    prev = os.environ.get(incremental.INCREMENTAL_ENV)
+    os.environ[incremental.INCREMENTAL_ENV] = "0"
+    try:
+        return tensorize_session(ssn)
+    finally:
+        if prev is None:
+            os.environ.pop(incremental.INCREMENTAL_ENV, None)
+        else:
+            os.environ[incremental.INCREMENTAL_ENV] = prev
+        for attr in ("_tensor_cache", "_inc_state", "_ship_cache"):
+            if hasattr(cache, attr):
+                delattr(cache, attr)
+        for attr, value in saved.items():
+            setattr(cache, attr, value)
+
+
+def _assert_snapshots_identical(a, b, ctx=""):
+    assert a.needs_fallback == b.needs_fallback, ctx
+    if a.needs_fallback:
+        return
+    assert a.node_names == b.node_names, ctx
+    assert a.job_uids == b.job_uids, ctx
+    assert a.queue_ids == b.queue_ids, ctx
+    assert a.resource_names == b.resource_names, ctx
+    assert a.config == b.config, ctx
+    assert [t.uid for t in a.tasks] == [t.uid for t in b.tasks], ctx
+    assert [t.uid for t in a.tasks_extra] == \
+        [t.uid for t in b.tasks_extra], ctx
+    assert np.array_equal(a.task_job, b.task_job), ctx
+    assert np.array_equal(a.task_res_f64, b.task_res_f64), ctx
+    for field in a.inputs._fields:
+        x = np.asarray(getattr(a.inputs, field))
+        y = np.asarray(getattr(b.inputs, field))
+        assert x.dtype == y.dtype, (ctx, field, x.dtype, y.dtype)
+        assert np.array_equal(x, y), (ctx, field)
+
+
+def _running_task(cache):
+    for uid in sorted(cache.jobs):
+        for tuid in sorted(cache.jobs[uid].tasks):
+            t = cache.jobs[uid].tasks[tuid]
+            if t.node_name:
+                return t
+    raise AssertionError("no running task")
+
+
+def _add_churn_job(cache, tag, n_pods=3, cpu="500m", mem="1Gi"):
+    pg = f"churn-{tag}"
+    cache.add_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name=pg, namespace="bench"),
+        spec=v1alpha1.PodGroupSpec(min_member=1, queue="q0")))
+    pods = []
+    for i in range(n_pods):
+        pod = Pod(
+            metadata=ObjectMeta(
+                name=f"{pg}-{i}", namespace="bench", uid=f"{pg}-{i}",
+                annotations={GroupNameAnnotationKey: pg},
+                creation_timestamp=1e6 + i),
+            spec=PodSpec(containers=[Container(
+                requests={"cpu": cpu, "memory": mem})]),
+            status=PodStatus(phase="Pending"))
+        cache.add_pod(pod)
+        pods.append(pod)
+    return pg, pods
+
+
+MUTATIONS = ["none", "bind_echo", "evict", "pipeline", "job_add",
+             "job_update", "job_delete", "node_update", "node_add",
+             "node_delete"]
+
+
+@pytest.mark.parametrize("mutation", MUTATIONS)
+@pytest.mark.parametrize("signatures", [1, 4])
+def test_incremental_tensors_bit_identical(mutation, signatures):
+    """After every mutation path, the incremental session's tensors are
+    bit-identical to a from-scratch tensorize — the dirty-set
+    completeness invariant the tentpole stands on."""
+    cache, binder = make_synthetic_cache(60, 16, 10, 2,
+                                         n_signatures=signatures)
+    # Three settled cycles: placements echo Running, the PodGroup status
+    # writes echo one cycle later, and the state reaches the micro path.
+    _cycle(cache, binder)
+    _cycle(cache, binder)
+    _cycle(cache, binder)
+
+    if mutation == "bind_echo":
+        _add_churn_job(cache, "be")
+        _cycle(cache, binder)  # places + echoes the churn job
+    elif mutation == "evict":
+        cache.evict(_running_task(cache), "preempted")
+    elif mutation == "pipeline":
+        # In-session evict + pipeline onto the releasing node: the evict
+        # mutates truth, the pipeline mutates ONLY the session clones —
+        # the clone pool must not serve the mutated ones back.
+        _add_churn_job(cache, "pipe", n_pods=1, cpu="100m", mem="256Mi")
+        ssn = _open(cache)
+        victim = next(
+            t for u in sorted(ssn.jobs) if "churn-pipe" not in u
+            for t in ssn.jobs[u].tasks.values() if t.node_name)
+        ssn.evict(victim, "preempted")
+        job_uid = next(u for u in ssn.jobs if "churn-pipe" in u)
+        task = next(iter(ssn.jobs[job_uid].tasks.values()))
+        ssn.pipeline(task, victim.node_name)
+        close_session(ssn)
+    elif mutation == "job_add":
+        _add_churn_job(cache, "add")
+    elif mutation == "job_update":
+        t = _running_task(cache)
+        new = dc.replace(t.pod, spec=dc.replace(
+            t.pod.spec,
+            containers=[Container(requests={"cpu": "250m",
+                                            "memory": "512Mi"})]))
+        cache.update_pod(t.pod, new)
+    elif mutation == "job_delete":
+        uid = sorted(cache.jobs)[0]
+        for t in list(cache.jobs[uid].tasks.values()):
+            cache.delete_pod(t.pod)
+    elif mutation == "node_update":
+        name = sorted(cache.nodes)[0]
+        node = cache.nodes[name].node
+        alloc = {"cpu": "32", "memory": "128Gi", "pods": 200}
+        cache.update_node(node, dc.replace(
+            node, status=NodeStatus(allocatable=dict(alloc),
+                                    capacity=dict(alloc))))
+    elif mutation == "node_add":
+        alloc = {"cpu": "16", "memory": "64Gi", "pods": 110}
+        cache.add_node(Node(
+            metadata=ObjectMeta(name="nzz-new", uid="nzz-new"),
+            spec=NodeSpec(),
+            status=NodeStatus(allocatable=dict(alloc),
+                              capacity=dict(alloc))))
+    elif mutation == "node_delete":
+        cache.delete_node(cache.nodes[sorted(cache.nodes)[-1]].node)
+
+    for round_ in range(2):
+        ssn = _open(cache)
+        snap_inc = tensorize_session(ssn)
+        snap_oracle = _oracle_snapshot(ssn)
+        _assert_snapshots_identical(
+            snap_inc, snap_oracle,
+            ctx=f"mutation={mutation} sigs={signatures} round={round_}")
+        close_session(ssn)
+
+
+def test_micro_path_actually_engages():
+    """The steady state classifies micro (with reuse of the persistent
+    mask), and the dirty gauges move."""
+    cache, binder = make_synthetic_cache(60, 16, 10, 2, n_signatures=4)
+    _cycle(cache, binder)
+    _cycle(cache, binder)
+    _cycle(cache, binder)
+    ssn = _open(cache)
+    tensorize_session(ssn)
+    close_session(ssn)
+    st = incremental.state_for(cache)
+    assert st.last_kind == "micro", (st.last_kind, st.last_reason)
+    assert st.stats["micro"] >= 1
+    assert st.generation >= 3
+
+
+def test_periodic_full_floor_and_request_full():
+    cache, binder = make_synthetic_cache(60, 16, 10, 2)
+    _cycle(cache, binder)
+    _cycle(cache, binder)
+    incremental.request_full(cache)
+    ssn = _open(cache)
+    tensorize_session(ssn)
+    close_session(ssn)
+    st = incremental.state_for(cache)
+    assert st.last_kind == "full"
+    assert st.last_reason == "periodic full-session floor"
+    # The floor is one-shot: the next session is micro again.
+    ssn = _open(cache)
+    tensorize_session(ssn)
+    close_session(ssn)
+    assert st.last_kind == "micro"
+
+
+def test_chaos_stale_generation_degrades_to_full_rebuild():
+    """The incremental.stale_generation injection site forces a
+    generation mismatch mid-cycle: the session falls back to a full
+    rebuild (identical tensors), the solve cache is invalidated, and
+    the next cycle recovers to micro."""
+    cache, binder = make_synthetic_cache(60, 16, 10, 2)
+    _cycle(cache, binder)
+    _cycle(cache, binder)
+    st = incremental.state_for(cache)
+    st.solve_gen = 123  # pretend a cached solve exists
+    plan = FaultPlan(seed=1, rate=1.0,
+                     sites=("incremental.stale_generation",), budget=1)
+    chaos_plan.install(plan)
+    try:
+        ssn = _open(cache)
+        snap_inc = tensorize_session(ssn)
+        snap_oracle = _oracle_snapshot(ssn)
+        _assert_snapshots_identical(snap_inc, snap_oracle, ctx="chaos")
+        close_session(ssn)
+    finally:
+        chaos_plan.disable()
+    assert plan.total_injected() == 1
+    assert st.last_kind == "fallback"
+    assert "stale generation" in st.last_reason
+    assert st.solve_gen == -1  # nothing keyed to the old generation survives
+    ssn = _open(cache)
+    tensorize_session(ssn)
+    close_session(ssn)
+    assert st.last_kind == "micro"
+
+
+def test_aborted_build_drops_persisted_mask():
+    """A tensorize that early-returns with a fallback_reason AFTER the
+    plan (and the pack refresh) must not leave the persisted mask
+    serveable: the pack epochs advanced, so a later micro session would
+    treat the refreshed nodes as clean and skip their mask columns."""
+    from kube_batch_tpu.api import ContainerPort
+
+    cache, binder = make_synthetic_cache(60, 16, 10, 2, n_signatures=4)
+    # A standing pending FEATURED hog keeps the signature set non-empty
+    # across cycles (a fully-placed cluster has no candidate tasks and
+    # therefore, correctly, no persisted mask to go stale).
+    pg = "churn-hog"
+    cache.add_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name=pg, namespace="bench"),
+        spec=v1alpha1.PodGroupSpec(min_member=1, queue="q0")))
+    cache.add_pod(Pod(
+        metadata=ObjectMeta(name=f"{pg}-0", namespace="bench",
+                            uid=f"{pg}-0",
+                            annotations={GroupNameAnnotationKey: pg},
+                            creation_timestamp=3e6),
+        spec=PodSpec(containers=[Container(
+            requests={"cpu": "4000", "memory": "1Ti"})],
+            node_selector={"pool": "pool0"}),
+        status=PodStatus(phase="Pending")))
+    _cycle(cache, binder)
+    _cycle(cache, binder)
+    _cycle(cache, binder)
+    st = incremental.state_for(cache)
+    assert st.sig_mask is not None  # persisted hetero mask armed
+
+    # 65 distinct host-port keys: tensorize returns fallback_reason
+    # ("distinct host-port keys") after the plan created and the pack
+    # refreshed — a genuine aborted build.
+    pg = "churn-ports"
+    cache.add_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name=pg, namespace="bench"),
+        spec=v1alpha1.PodGroupSpec(min_member=1, queue="q0")))
+    port_pods = []
+    for i in range(65):
+        pod = Pod(
+            metadata=ObjectMeta(
+                name=f"{pg}-{i}", namespace="bench", uid=f"{pg}-{i}",
+                annotations={GroupNameAnnotationKey: pg},
+                creation_timestamp=2e6 + i),
+            spec=PodSpec(containers=[Container(
+                requests={"cpu": "100m", "memory": "128Mi"},
+                ports=[ContainerPort(host_port=20000 + i)])]),
+            status=PodStatus(phase="Pending"))
+        cache.add_pod(pod)
+        port_pods.append(pod)
+    ssn = _open(cache)
+    snap = tensorize_session(ssn)
+    close_session(ssn)
+    assert snap.needs_fallback and "host-port keys" in snap.fallback_reason
+    assert st.build_open  # finish never ran
+
+    # Ports leave; the next session must rebuild (not serve) the mask
+    # and stay bit-identical to the from-scratch oracle.
+    for pod in port_pods:
+        cache.delete_pod(pod)
+    ssn = _open(cache)
+    snap_inc = tensorize_session(ssn)
+    snap_oracle = _oracle_snapshot(ssn)
+    _assert_snapshots_identical(snap_inc, snap_oracle, ctx="post-abort")
+    close_session(ssn)
+    assert not st.build_open
+
+
+def test_own_status_write_echo_does_not_spin_the_loop():
+    """A persistently invalid gang gets a fresh Unschedulable condition
+    written every session; its watch echo must NOT count as churn, or
+    the event-driven loop would wake itself at the coalesce cadence
+    forever (the review's self-wake finding)."""
+    from kube_batch_tpu.cache import Cluster, new_scheduler_cache
+
+    sys_path_has_tests = "tests" in __name__  # noqa: F841 (clarity only)
+    from kube_batch_tpu.api import (Container as C, ObjectMeta as OM,
+                                    Pod as P, PodSpec as PS,
+                                    PodStatus as PSt)
+    cluster = Cluster()
+    from kube_batch_tpu.api import Node, NodeSpec, NodeStatus
+    alloc = {"cpu": "8", "memory": "16Gi", "pods": 110}
+    cluster.create_node(Node(metadata=OM(name="n0", uid="n0"),
+                             spec=NodeSpec(),
+                             status=NodeStatus(allocatable=dict(alloc),
+                                               capacity=dict(alloc))))
+    cluster.create_queue(v1alpha1.Queue(metadata=OM(name="default"),
+                                        spec=v1alpha1.QueueSpec(weight=1)))
+    # Gang needs 3, only 1 pod exists: job_valid writes Unschedulable
+    # with a new transition_id every single session.
+    cluster.create_pod_group(v1alpha1.PodGroup(
+        metadata=OM(name="pg1", namespace="ns1"),
+        spec=v1alpha1.PodGroupSpec(min_member=3, queue="default")))
+    cluster.create_pod(P(
+        metadata=OM(name="p0", namespace="ns1", uid="p0",
+                    annotations={GroupNameAnnotationKey: "pg1"},
+                    creation_timestamp=1.0),
+        spec=PS(containers=[C(requests={"cpu": "1", "memory": "1Gi"})]),
+        status=PSt(phase="Pending")))
+    cache = new_scheduler_cache(cluster)
+    sched = Scheduler(cache, schedule_period=30.0)
+    counted = []
+    real_run_once = sched.run_once
+    sched.run_once = lambda: (counted.append(time.monotonic()),
+                              real_run_once())
+    sched.run()
+    try:
+        deadline = time.monotonic() + 5
+        while not counted and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert counted, "no cycle ran"
+        time.sleep(1.0)  # absorb the creation churn + its follow-ups
+        baseline = len(counted)
+        time.sleep(1.5)  # idle window: nothing external changes
+        extra = len(counted) - baseline
+        # Without self-echo suppression this is ~50-100 cycles (one per
+        # coalesce window); with it, at most a stray follow-up.
+        assert extra <= 2, (
+            f"loop self-woke {extra} times in 1.5s of idle cluster "
+            "(own status-write echo counted as churn)")
+    finally:
+        sched.stop()
+
+
+def test_conf_change_on_live_cache_falls_back():
+    """A session opened with different tiers on the same cache must not
+    be served tensors persisted under the old conf."""
+    cache, binder = make_synthetic_cache(60, 16, 10, 2, n_signatures=4)
+    _cycle(cache, binder)
+    _cycle(cache, binder)
+    _cycle(cache, binder)
+    st = incremental.state_for(cache)
+    assert incremental.state_for(cache).last_kind in ("micro", "full",
+                                                      "fallback")
+    other_conf = DEFAULT_SCHEDULER_CONF.replace("  - name: nodeorder\n",
+                                                "")
+    assert other_conf != DEFAULT_SCHEDULER_CONF
+    other_tiers = load_scheduler_conf(other_conf)[1]
+    ssn = open_session(cache, other_tiers)
+    snap = tensorize_session(ssn)
+    snap_oracle = _oracle_snapshot(ssn)
+    _assert_snapshots_identical(snap, snap_oracle, ctx="conf change")
+    close_session(ssn)
+    assert st.last_kind == "fallback"
+    assert st.last_reason == "plugin/tier structure changed"
+    # Steady again under the new conf: micro resumes.
+    ssn = open_session(cache, other_tiers)
+    tensorize_session(ssn)
+    close_session(ssn)
+    assert st.last_kind == "micro", (st.last_kind, st.last_reason)
+
+
+def test_plugin_open_caches_are_exact():
+    """drf/proportion opens with the aggregate caches produce exactly
+    the same shares/deserved as the uncached control on twin caches."""
+    def open_attrs(flag):
+        prev = os.environ.get(incremental.INCREMENTAL_ENV)
+        os.environ[incremental.INCREMENTAL_ENV] = flag
+        try:
+            cache, binder = make_synthetic_cache(80, 16, 12, 3)
+            _cycle(cache, binder)   # place + echo: allocated state exists
+            _cycle(cache, binder)   # first cached open fills the caches
+            ssn = _open(cache)      # second open consumes them
+            drf = ssn.plugins["drf"]
+            prop = ssn.plugins["proportion"]
+            drf_shares = {uid: (a.share, a.allocated.milli_cpu,
+                                a.allocated.memory)
+                          for uid, a in drf.job_attrs.items()}
+            prop_attrs = {qid: (a.share, a.deserved.milli_cpu,
+                                a.deserved.memory, a.allocated.milli_cpu,
+                                a.allocated.memory, a.request.milli_cpu,
+                                a.request.memory)
+                          for qid, a in prop.queue_attrs.items()}
+            close_session(ssn)
+            return drf_shares, prop_attrs
+        finally:
+            if prev is None:
+                os.environ.pop(incremental.INCREMENTAL_ENV, None)
+            else:
+                os.environ[incremental.INCREMENTAL_ENV] = prev
+
+    cached = open_attrs("1")
+    control = open_attrs("0")
+    assert cached == control
+
+
+def test_fractional_queue_accumulator_blocks_collapsed_adds():
+    """A fractional job EARLIER in the walk than a cached integer job
+    poisons the queue accumulator: acc + (t1+..+tn) reassociates against
+    ((acc+t1)+..)+tn once acc is fractional (e.g. 843.653 + [41640,
+    11614, 36095] differs in the last ulp).  The per-queue rolling
+    exactness gate must block the collapsed add, keeping the cached arm
+    bit-identical to the control."""
+    def build():
+        from kube_batch_tpu.cache import (FakeBinder, FakeEvictor,
+                                          FakeStatusUpdater,
+                                          FakeVolumeBinder, SchedulerCache)
+        from kube_batch_tpu.api.queue_info import Queue
+        cache = SchedulerCache(binder=FakeBinder(), evictor=FakeEvictor(),
+                               status_updater=FakeStatusUpdater(),
+                               volume_binder=FakeVolumeBinder())
+        cache.add_queue(Queue(metadata=ObjectMeta(
+            name="q0", creation_timestamp=0.0), weight=1))
+        alloc = {"cpu": "64", "memory": "256Gi", "pods": 110}
+        cache.add_node(Node(metadata=ObjectMeta(name="n0", uid="n0"),
+                            spec=NodeSpec(),
+                            status=NodeStatus(allocatable=dict(alloc),
+                                              capacity=dict(alloc))))
+        # Insertion order IS walk order: fractional job first, then the
+        # integer job whose subtotal would be cached and collapsed.
+        for name, cpus in (("frac", ["843.653m"]),
+                           ("intjob", ["41640m", "11614m", "36095m"])):
+            cache.add_pod_group(v1alpha1.PodGroup(
+                metadata=ObjectMeta(name=name, namespace="ns"),
+                spec=v1alpha1.PodGroupSpec(min_member=1, queue="q0")))
+            for i, cpu in enumerate(cpus):
+                cache.add_pod(Pod(
+                    metadata=ObjectMeta(
+                        name=f"{name}-{i}", namespace="ns",
+                        uid=f"{name}-{i}",
+                        annotations={GroupNameAnnotationKey: name},
+                        creation_timestamp=float(i)),
+                    spec=PodSpec(containers=[Container(
+                        requests={"cpu": cpu, "memory": "1Gi"})]),
+                    status=PodStatus(phase="Pending")))
+        return cache
+
+    def arm(flag):
+        prev = os.environ.get(incremental.INCREMENTAL_ENV)
+        os.environ[incremental.INCREMENTAL_ENV] = flag
+        try:
+            cache = build()
+            # Session 1 fills the caches; session 2 would consume them.
+            for _ in range(2):
+                ssn = _open(cache)
+                prop = ssn.plugins["proportion"]
+                attrs = {qid: (a.request.milli_cpu, a.request.memory,
+                               a.allocated.milli_cpu)
+                         for qid, a in prop.queue_attrs.items()}
+                close_session(ssn)
+            return attrs
+        finally:
+            if prev is None:
+                os.environ.pop(incremental.INCREMENTAL_ENV, None)
+            else:
+                os.environ[incremental.INCREMENTAL_ENV] = prev
+
+    assert arm("1") == arm("0")
+
+
+def test_fractional_resources_never_enter_the_proportion_cache():
+    assert incremental.resource_exact(
+        type("R", (), {"milli_cpu": 500.0, "memory": 1024.0,
+                       "scalar_resources": {}})())
+    assert not incremental.resource_exact(
+        type("R", (), {"milli_cpu": 100.5, "memory": 1024.0,
+                       "scalar_resources": {}})())
+    assert not incremental.resource_exact(
+        type("R", (), {"milli_cpu": 500.0, "memory": float(2 ** 53),
+                       "scalar_resources": {}})())
+
+
+def test_solve_result_reused_on_clean_generation():
+    """An unschedulable-but-valid pending job keeps the inputs
+    byte-identical across cycles: the second session's ship is clean and
+    the solve is served from the generation-keyed cache."""
+    cache, binder = make_synthetic_cache(20, 8, 4, 2)
+    # A pending hog no node can fit: stays Pending, tensorized each
+    # cycle, never placed — the steady no-progress state.
+    _add_churn_job(cache, "hog", n_pods=1, cpu="4000")
+    _cycle(cache, binder)     # places the feasible jobs + echoes
+    _cycle(cache, binder)     # settles status writes
+    before = metrics.generation_reuse_counts()
+    _cycle(cache, binder, echo=False)
+    mid = metrics.generation_reuse_counts()
+    _cycle(cache, binder, echo=False)
+    after = metrics.generation_reuse_counts()
+    assert not binder.binds
+    # The first no-progress cycle re-solved (bytes moved since last
+    # session); the second found a clean ship and reused its result.
+    assert after.get("hit", 0) - before.get("hit", 0) >= 1, (before, mid,
+                                                            after)
+
+
+def test_scheduler_wakes_on_cache_churn():
+    cache, _binder = make_synthetic_cache(10, 4, 2, 2)
+    sched = Scheduler(cache, schedule_period=30.0)
+    cycles = []
+    ran = threading.Event()
+
+    def fake_run_once():
+        cycles.append(time.monotonic())
+        ran.set()
+
+    sched.run_once = fake_run_once
+    sched.run()
+    try:
+        assert ran.wait(5.0), "first cycle never ran"
+        ran.clear()
+        time.sleep(0.1)  # the loop is now asleep in its 30 s wait
+        pod = Pod(metadata=ObjectMeta(name="wake", namespace="bench",
+                                      uid="wake", creation_timestamp=2e6),
+                  spec=PodSpec(containers=[Container(
+                      requests={"cpu": "100m", "memory": "64Mi"})]),
+                  status=PodStatus(phase="Pending"))
+        t0 = time.monotonic()
+        cache.add_pod(pod)  # external churn: must wake the loop
+        assert ran.wait(5.0), "churn did not wake the sleeping loop"
+        assert time.monotonic() - t0 < 5.0
+    finally:
+        sched.stop()
+
+
+def test_stop_wakes_a_sleeping_loop_immediately():
+    cache, _binder = make_synthetic_cache(10, 4, 2, 2)
+    sched = Scheduler(cache, schedule_period=30.0)
+    ran = threading.Event()
+    sched.run_once = lambda: ran.set()
+    sched.run()
+    assert ran.wait(5.0)
+    time.sleep(0.1)  # ensure the loop is inside its 30 s wait
+    t0 = time.monotonic()
+    sched.stop(timeout=10.0)
+    elapsed = time.monotonic() - t0
+    assert elapsed < 5.0, f"stop() blocked {elapsed:.1f}s on a sleeping loop"
+    assert not sched._thread.is_alive()
+
+
+def test_scheduler_periodic_floor_forces_full_sessions(monkeypatch):
+    monkeypatch.setenv(incremental.FULL_EVERY_ENV, "2")
+    cache, binder = make_synthetic_cache(40, 8, 6, 2)
+    sched = Scheduler(cache, schedule_period=30.0)
+    # Drive cycles directly (the loop thread's protocol) with the floor
+    # cadence the loop computes.
+    kinds = []
+    for i in range(4):
+        force_full = (i + 1) % 2 == 0
+        sched.cycle(force_full=force_full)
+        st = incremental.state_for(cache)
+        kinds.append(st.last_kind)
+        _echo(cache, binder)
+    assert "full" in kinds[1::2], kinds
+
+
+def test_incremental_meta_lands_in_flight_recorder():
+    from kube_batch_tpu.trace import flight_recorder
+    from kube_batch_tpu.trace import spans as tspans
+    cache, binder = make_synthetic_cache(40, 8, 6, 2)
+    _cycle(cache, binder)
+    sid = tspans.begin_session(test="incremental")
+    ssn = _open(cache)
+    try:
+        TpuAllocateAction().execute(ssn)
+    finally:
+        close_session(ssn)
+        tspans.end_session()
+    tr = flight_recorder.get(sid)
+    assert tr is not None
+    assert tr.meta.get("incremental") in ("micro", "full", "fallback")
+    assert "dirty_nodes" in tr.meta and "dirty_jobs" in tr.meta
+    # /debug/sessions serves the same meta through summaries().
+    summary = next(s for s in flight_recorder.summaries()
+                   if s["session"] == sid)
+    assert summary["meta"].get("incremental") == tr.meta["incremental"]
+
+
+def test_e2e_churn_parity_incremental_vs_control():
+    """Multi-round churn: binds and events bit-identical between the
+    incremental engine and the =0 control on twin caches."""
+    def run_arm(flag):
+        prev = os.environ.get(incremental.INCREMENTAL_ENV)
+        os.environ[incremental.INCREMENTAL_ENV] = flag
+        try:
+            cache, binder = make_synthetic_cache(80, 16, 12, 3)
+            fingerprints = []
+            mark = len(cache.events)
+            for rnd in range(5):
+                _add_churn_job(cache, f"r{rnd}", n_pods=4)
+                if rnd >= 2:
+                    for t in list(cache.jobs.get(
+                            f"bench/churn-r{rnd - 2}",
+                            type("J", (), {"tasks": {}})).tasks.values()):
+                        cache.delete_pod(t.pod)
+                ssn = _open(cache)
+                try:
+                    TpuAllocateAction().execute(ssn)
+                finally:
+                    close_session(ssn)
+                fingerprints.append(tuple(sorted(binder.binds.items())))
+                _echo(cache, binder)
+            return fingerprints, list(cache.events)[mark:]
+        finally:
+            if prev is None:
+                os.environ.pop(incremental.INCREMENTAL_ENV, None)
+            else:
+                os.environ[incremental.INCREMENTAL_ENV] = prev
+
+    inc_fp, inc_events = run_arm("1")
+    ctl_fp, ctl_events = run_arm("0")
+    assert inc_fp == ctl_fp
+    assert inc_events == ctl_events
+    assert any(binds for binds in inc_fp), "no round bound anything"

@@ -67,7 +67,11 @@ tiers:
 
 # Sites that must fire at least once across the seed sweep for the soak
 # to count as exercising "every injection site" (watch.* only exists on
-# the --edge wire).
+# the --edge wire).  ``incremental.stale_generation`` is deliberately
+# NOT required: it only activates on cycles the incremental micro path
+# would have served (a storm mostly falls back to full rebuilds on its
+# own), so the soak exercises it opportunistically while the dedicated
+# degradation test lives in tests/test_incremental_sessions.py.
 FAKE_SITES = ("session.snapshot", "session.tensorize", "solve.device_error",
               "solve.slow", "solve.poison", "evict_solve.device_error",
               "bind.timeout", "bind.http5xx", "bind.ambiguous",
@@ -414,7 +418,10 @@ def run_soak(seeds, *, nodes: int = 8, cycles: int = 10,
     site_rates = (("session.*", min(rate, 0.5) * 0.4),
                   ("solve.slow", min(1.0, rate * 1.6)),
                   ("solve.poison", min(1.0, rate * 1.4)),
-                  ("evict_solve.*", min(1.0, rate * 1.6)))
+                  ("evict_solve.*", min(1.0, rate * 1.6)),
+                  # Fires only on micro-eligible cycles (see FAKE_SITES
+                  # note): boost it so those cycles do get hit.
+                  ("incremental.stale_generation", min(1.0, rate * 1.6)))
     seed_results = []
     sites_union = set()
     for seed in seeds:

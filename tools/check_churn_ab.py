@@ -1,0 +1,75 @@
+"""CI gate for `make bench-churn`: read the churn-sweep artifact line
+from stdin, assert the incremental session engine's bit-parity verdict
+at EVERY churn level, and print both arms' timings.
+
+bench.py deliberately always exits 0 (the artifact-always-emits
+contract), so the smoke's pass/fail lives here — a parity break, a
+missing sweep, or a bench error exits nonzero and fails the CI job.
+The sweep also sanity-checks that the incremental arm actually ran
+micro sessions (an arm that silently fell back every cycle would make
+the parity gate vacuous).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> int:
+    line = ""
+    for raw in sys.stdin:
+        raw = raw.strip()
+        if raw.startswith("{"):
+            line = raw  # last JSON-looking line wins (the artifact)
+    if not line:
+        print("check_churn_ab: no artifact line on stdin", file=sys.stderr)
+        return 1
+    out = json.loads(line)
+    if out.get("error"):
+        print(f"check_churn_ab: bench reported error: {out['error']}",
+              file=sys.stderr)
+        return 1
+    sweep = out.get("churn_sweep") or {}
+    if not sweep:
+        print("check_churn_ab: artifact carries no churn_sweep",
+              file=sys.stderr)
+        return 1
+    if out.get("churn_parity") is not True:
+        print("check_churn_ab: PARITY FAILURE — the incremental session "
+              "engine diverged from the KUBE_BATCH_TPU_INCREMENTAL=0 "
+              f"control (churn_parity={out.get('churn_parity')!r})",
+              file=sys.stderr)
+        return 1
+    micro_total = 0
+    print("incremental churn sweep: parity OK at every level")
+    for label, rec in sweep.items():
+        kinds = rec.get("kinds") or {}
+        micro_total += kinds.get("micro", 0)
+        print(f"  churn {label:>5s}  incremental {rec['incremental_ms']:8.1f} ms"
+              f"   control {rec['control_ms']:8.1f} ms"
+              f"   ({rec.get('speedup')}x, "
+              f"{rec.get('sessions_per_sec')} sessions/s vs "
+              f"{rec.get('control_sessions_per_sec')}; kinds {kinds}, "
+              f"reuse {rec.get('generation_reuse')})")
+        if rec.get("parity") is not True:
+            print(f"check_churn_ab: level {label} lost parity",
+                  file=sys.stderr)
+            return 1
+        if rec.get("events_verified") is False:
+            # No silent caps: the event ring overflowed, so only binds
+            # were compared at this level — say so loudly.
+            print(f"  WARNING: level {label} event parity NOT verified "
+                  "(event ring overflowed; binds-only comparison — "
+                  "raise the ring or lower BENCH_CHURN_ROUNDS)",
+                  file=sys.stderr)
+    if micro_total == 0:
+        print("check_churn_ab: the incremental arm never ran a micro "
+              "session — the A/B compared two control arms",
+              file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -3,7 +3,8 @@ PYTHON ?= python
 COMPILE_CACHE ?= $(CURDIR)/.compile-cache
 
 .PHONY: lint lint-inventory test bench bench-cached bench-steady \
-	bench-evict bench-churn chaos chaos-smoke trace-demo clean-cache
+	bench-evict bench-churn bench-shard chaos chaos-smoke trace-demo \
+	clean-cache
 
 # graftlint: the repo's contract-enforcing static analysis (doc/LINT.md)
 # — lock discipline, donation safety, tracer hygiene, ship/no-mutate
@@ -67,6 +68,21 @@ bench-churn:
 	env JAX_PLATFORMS=cpu BENCH_CHURN_SWEEP=1 BENCH_TASKS=2000 \
 		BENCH_NODES=256 BENCH_JOBS=80 BENCH_QUEUES=4 \
 		$(PYTHON) bench.py | $(PYTHON) tools/check_churn_ab.py
+
+# Sharded-vs-single-chip A/B smoke on the virtual 8-device CPU mesh
+# (doc/SHARDING.md): runs the 4-action storm with
+# KUBE_BATCH_TPU_FORCE_SHARD on and off, asserts bit-identical victims/
+# binds/events, requires the eviction engine to actually route >=1
+# sharded solve, and proves the per-shard O(dirty-blocks) byte contract
+# with a dirty-shard probe.  The checker exits nonzero on any violation
+# (bench.py itself always exits 0), so CI fails loudly.
+bench-shard:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		BENCH_SHARD_AB=1 BENCH_TASKS=2000 BENCH_NODES=256 \
+		BENCH_JOBS=80 BENCH_QUEUES=4 \
+		KUBE_BATCH_TPU_SCAN_MIN_NODES=0 $(PYTHON) bench.py \
+		| $(PYTHON) tools/check_shard_ab.py
 
 # Chaos soak (doc/CHAOS.md): seeded fault storms at every injection site
 # vs the fault-free convergence oracle — the loop must survive 100% of

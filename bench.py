@@ -263,7 +263,8 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
         return len(binds)
 
     from kube_batch_tpu.metrics.metrics import (overlap_split_totals,
-                                                ship_counts)
+                                                route_counts, ship_counts,
+                                                ship_shard_counts)
 
     with _gc_posture():
         cold = session_ms()
@@ -277,12 +278,16 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
         host_overlap = []
         device_wait = []
         ship0 = ship_counts()
+        shard0 = ship_shard_counts()
+        routes0 = route_counts()
         for rnd in range(rounds + 1):
             if rnd == 1:
                 # Round 0 re-absorbs the cold session's mass echo (usually
                 # a full reship); the counters must cover the same [1:]
                 # steady window every other stat reports.
                 ship0 = ship_counts()
+                shard0 = ship_shard_counts()
+                routes0 = route_counts()
             round_start = time.perf_counter()
             new_keys, pgs = [], []
             remaining = k
@@ -361,6 +366,8 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
                 traces, names=("tensorize", "ship", "dispatch",
                                "host_overlap", "device_wait", "solve",
                                "apply", "fit_deltas"))
+    shard1 = ship_shard_counts()
+    routes1 = route_counts()
     stats = {
         # Whole-round pace: injection + session + echo back-to-back —
         # the sustained cycle rate, not just 1e3/session_ms.
@@ -371,6 +378,13 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
         "ship": {mode: [ship1[mode][0] - ship0[mode][0],
                         ship1[mode][1] - ship0[mode][1]]
                  for mode in ship1},
+        # Per-device node-shard bytes + routing choices over the steady
+        # window (doc/SHARDING.md): empty/None off the mesh route.
+        "ship_shards": ({k: shard1.get(k, 0) - shard0.get(k, 0)
+                         for k in shard1} or None),
+        "routes": ({k: v for k, v in
+                    ((k, routes1.get(k, 0) - routes0.get(k, 0))
+                     for k in routes1) if v} or None),
         "phase_ms": phase_ms,
     }
     return round(cold, 1), steady[1:], stats
@@ -555,6 +569,130 @@ def measure_action_pipeline(n_tasks, n_nodes, n_jobs, n_queues,
         "evictions_by_action": split,
         "parity": parity,
     }
+
+
+def measure_shard_ab(n_tasks, n_nodes, n_jobs, n_queues, cycles: int = 2):
+    """Same-box counterbalanced sharded-vs-single-chip A/B on the
+    virtual device mesh (doc/SHARDING.md; the ``make bench-shard`` CI
+    gate via tools/check_shard_ab.py).
+
+    Per pair of ``cycles``, one full 4-action storm cycle (the shipped
+    conf on a fresh deterministic make_churn_cache) runs with
+    ``KUBE_BATCH_TPU_FORCE_SHARD=1`` (knobs re-pinned through the
+    deliberate refresh hook — the production loop never flips them) and
+    one without, in single/sharded/sharded/single order.  Parity is the
+    hard gate: ordered victim sequence, binds AND the cache event stream
+    must be bit-identical across arms.  The sharded arms' route-counter
+    deltas ride along (the checker requires >=1 sharded allocate AND
+    >=1 sharded evict solve — the engine must actually take the mesh).
+
+    A deterministic dirty-shard probe then proves the steady-state bytes
+    contract: full-ship a synthetic snapshot, dirty ONE node row owned
+    by shard 0, delta-ship — the owning shard receives one bucketed
+    update and every other shard receives ZERO bytes, so per-cycle delta
+    traffic is O(dirty blocks) and does not scale with mesh size."""
+    from kube_batch_tpu.framework import close_session, open_session
+    from kube_batch_tpu.metrics.metrics import route_counts
+    from kube_batch_tpu.models.synthetic import make_churn_cache
+    from kube_batch_tpu.ops.solver import (FORCE_SHARD_ENV,
+                                           refresh_shard_knobs)
+    from kube_batch_tpu.scheduler import load_scheduler_conf
+
+    _register()
+    conf_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "config", "kube-batch-conf.yaml")
+    with open(conf_path) as fh:
+        conf = fh.read().replace('"reclaim, allocate, backfill, preempt"',
+                                 '"reclaim, tpu-allocate, backfill, '
+                                 'preempt"')
+    actions, tiers = load_scheduler_conf(conf)
+
+    def set_arm(sharded: bool):
+        if sharded:
+            os.environ[FORCE_SHARD_ENV] = "1"
+        else:
+            os.environ.pop(FORCE_SHARD_ENV, None)
+        refresh_shard_knobs()
+
+    def one_cycle():
+        cache, binder = make_churn_cache(n_tasks, n_nodes, n_jobs, n_queues)
+        with _gc_posture():
+            ssn = open_session(cache, tiers)
+            cycle_ms = {}
+            for a in actions:
+                t0 = time.perf_counter()
+                a.execute(ssn)
+                cycle_ms[a.name()] = (time.perf_counter() - t0) * 1e3
+            close_session(ssn)
+        return (cycle_ms, list(cache.evictor.evicts), dict(binder.binds),
+                list(cache.events))
+
+    prior = os.environ.get(FORCE_SHARD_ENV)
+    per_arm: dict = {True: {}, False: {}}
+    footprint: dict = {}
+    routes: dict = {}
+    evictions = 0
+    try:
+        for arm in (False, True):  # absorb both arms' jit compiles
+            set_arm(arm)
+            one_cycle()
+        arms = [False, True, True, False] * ((cycles + 1) // 2)
+        for arm in arms[:2 * cycles]:
+            set_arm(arm)
+            r0 = route_counts() if arm else None
+            cycle_ms, evicts, binds, events = one_cycle()
+            if arm and not routes:
+                r1 = route_counts()
+                routes = {kk: r1.get(kk, 0) - (r0 or {}).get(kk, 0)
+                          for kk in r1}
+                routes = {kk: v for kk, v in routes.items() if v}
+            for name, ms in cycle_ms.items():
+                per_arm[arm].setdefault(name, []).append(ms)
+            evictions = len(evicts)
+            footprint.setdefault(arm, (evicts, binds, events))
+        parity = footprint.get(True) == footprint.get(False)
+
+        # -- dirty-shard probe (per-shard O(dirty-blocks) contract) ------
+        set_arm(True)
+        from kube_batch_tpu.models.shipping import dirty_shard_probe
+        from kube_batch_tpu.models.synthetic import make_synthetic_inputs
+        inputs, config = make_synthetic_inputs(
+            n_tasks=min(n_tasks, 512), n_nodes=n_nodes,
+            n_jobs=min(n_jobs, 32), n_queues=n_queues, seed=0)
+        probe = dirty_shard_probe(inputs, config)
+    finally:
+        if prior is None:
+            os.environ.pop(FORCE_SHARD_ENV, None)
+        else:
+            os.environ[FORCE_SHARD_ENV] = prior
+        refresh_shard_knobs()
+    assert evictions > 0, "shard A/B storm evicted nothing"
+    return {
+        "actions_sharded": {name: _stats(runs)
+                            for name, runs in per_arm[True].items()},
+        "actions_single": {name: _stats(runs)
+                           for name, runs in per_arm[False].items()},
+        "evictions": evictions,
+        "routes": routes,
+        "parity": parity,
+        "probe": probe,
+    }
+
+
+def _fill_shard_ab(out, n_tasks, n_nodes, n_jobs, n_queues,
+                   cycles: int = 2) -> None:
+    ab = measure_shard_ab(n_tasks, n_nodes, n_jobs, n_queues,
+                          cycles=cycles)
+    out["shard_ab"] = {
+        "actions_sharded_ms": {name: med for name, (med, _p90)
+                               in ab["actions_sharded"].items()},
+        "actions_single_ms": {name: med for name, (med, _p90)
+                              in ab["actions_single"].items()},
+        "evictions": ab["evictions"],
+    }
+    out["shard_parity"] = ab["parity"]
+    out["shard_routes"] = ab["routes"]
+    out["shard_ship_probe"] = ab["probe"]
 
 
 def measure_churn_sweep(n_tasks, n_nodes, n_jobs, n_queues,
@@ -919,7 +1057,17 @@ def _fill_action_ab(out, n_tasks, n_nodes, n_jobs, n_queues,
 
 def _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline,
          steady_only=False, steady_rounds_n=5, evict_only=False,
-         churn_only=False):
+         churn_only=False, shard_only=False):
+    if shard_only:
+        # BENCH_SHARD_AB=1 (`make bench-shard`): ONLY the sharded-vs-
+        # single-chip A/B on the virtual mesh — storm parity (victims/
+        # binds/events), route counters, and the dirty-shard byte probe
+        # tools/check_shard_ab.py gates CI on (doc/SHARDING.md).
+        import jax as _jax
+        out["platform"] = _jax.default_backend()
+        out["mesh_devices"] = len(_jax.devices())
+        _fill_shard_ab(out, n_tasks, n_nodes, n_jobs, n_queues)
+        return
     if evict_only:
         # BENCH_EVICT_AB=1 (`make bench-evict`): ONLY the batched-vs-
         # sequential eviction A/B at the configured (small) shape — the
@@ -1039,6 +1187,8 @@ def _run_full(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n,
         out["device_wait_ms"], out["device_wait_p90"] = _stats(
             steady_stats["device_wait_ms"])
     out["ship"] = steady_stats["ship"]
+    out["ship_shards"] = steady_stats.get("ship_shards")
+    out["routes"] = steady_stats.get("routes")
     # Flight-recorder span summaries: p50/p95 per phase over the steady
     # window — WHERE the steady milliseconds went, not just the total
     # (null when KUBE_BATCH_TPU_TRACE=0).
@@ -1112,6 +1262,17 @@ def main():
         # bit-parity verdict vs the KUBE_BATCH_TPU_INCREMENTAL=0 arm.
         "churn_sweep": None,
         "churn_parity": None,
+        # Sharded steady state (doc/SHARDING.md): per-device node-shard
+        # delta bytes and chokepoint routing counters over the steady
+        # window, plus the BENCH_SHARD_AB=1 (`make bench-shard`) A/B —
+        # storm parity vs the single-chip control, route deltas, and the
+        # dirty-shard byte probe.
+        "ship_shards": None,
+        "routes": None,
+        "shard_ab": None,
+        "shard_parity": None,
+        "shard_routes": None,
+        "shard_ship_probe": None,
     }
 
     import threading
@@ -1149,12 +1310,14 @@ def main():
         steady_only = os.environ.get("BENCH_STEADY_ONLY") == "1"
         evict_only = os.environ.get("BENCH_EVICT_AB") == "1"
         churn_only = os.environ.get("BENCH_CHURN_SWEEP") == "1"
+        shard_only = os.environ.get("BENCH_SHARD_AB") == "1"
         steady_rounds_n = int(os.environ.get("BENCH_STEADY_ROUNDS", 5))
         out["metric"] = (f"sched-session solve latency @ {n_tasks} tasks "
                          f"x {n_nodes} nodes (gang+DRF+proportion)"
                          + (" [steady-only]" if steady_only else "")
                          + (" [evict-ab]" if evict_only else "")
-                         + (" [churn-sweep]" if churn_only else ""))
+                         + (" [churn-sweep]" if churn_only else "")
+                         + (" [shard-ab]" if shard_only else ""))
 
         # Wall-clock backstop for hangs the signal guard cannot reach
         # (a device call blocked in an extension never returns to the
@@ -1191,7 +1354,8 @@ def main():
             out["platform"] = platform
         _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline,
              steady_only=steady_only, steady_rounds_n=steady_rounds_n,
-             evict_only=evict_only, churn_only=churn_only)
+             evict_only=evict_only, churn_only=churn_only,
+             shard_only=shard_only)
         # Last statement INSIDE the try: a signal landing here is still
         # caught below — no handlerless gap before the emit.
         _ignore_signals()

@@ -174,6 +174,16 @@ class TpuAllocateAction(Action):
                 inputs = shipper.ship(snap.inputs, snap.config)
             metrics.observe_tpu_transfer_latency(time.time() - ship_start)
 
+            # Routing observability (doc/SHARDING.md): which engine this
+            # session's solve takes and over how many devices — on the
+            # session meta for /debug/sessions; best_solve_allocate
+            # annotates the dispatch span and counts
+            # kube_batch_solver_route_total at the chokepoint itself.
+            from ..ops.solver import choose_solver_mesh
+            route, mesh = choose_solver_mesh(snap.inputs)
+            trace.set_meta(solver_route=route,
+                           mesh_devices=mesh.size if mesh else 1)
+
             from ..models.tensor_snapshot import (build_apply_aggregates,
                                                   prepare_apply_scaffold)
             # Generation-keyed solve reuse (models/incremental.py,
@@ -198,7 +208,8 @@ class TpuAllocateAction(Action):
             with _maybe_profile():
                 if cached_solve is not None:
                     with trace.span("solve.reuse",
-                                    generation=shipper.generation):
+                                    generation=shipper.generation,
+                                    route=inc_state.solve_route):
                         assignment, kind, order, ordered = cached_solve
                         scaffold = prepare_apply_scaffold(snap)
                     metrics.note_generation_reuse(True)
@@ -242,6 +253,7 @@ class TpuAllocateAction(Action):
             inc_state.solve_gen = shipper.generation
             inc_state.solve_cfg = snap.config
             inc_state.solve_result = (assignment, kind, order, ordered)
+            inc_state.solve_route = route
             metrics.note_generation_reuse(False)
 
         deadline = solve_deadline_s()

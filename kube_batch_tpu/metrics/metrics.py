@@ -254,6 +254,19 @@ ship_bytes = registry.register(Counter(
     f"{SUBSYSTEM}_tpu_ship_bytes_total",
     "Bytes moved host->device by SolverInputs shipments, by mode",
     ("mode",)))
+# Sharded steady state (doc/SHARDING.md): per-device delta traffic of the
+# mesh-sharded resident buffer (which shards' node rows went dirty and
+# how many bytes each received — clean shards stay at ~0), and the route
+# every solver-family dispatch took at the choose_solver_mesh /
+# eviction-scan chokepoints.
+ship_shard_bytes = registry.register(Counter(
+    f"{SUBSYSTEM}_tpu_ship_shard_bytes_total",
+    "Delta bytes shipped to each mesh device's node-shard region",
+    ("shard",)))
+solver_route = registry.register(Counter(
+    f"{SUBSYSTEM}_solver_route_total",
+    "Solver-family dispatches by routing family and chosen engine",
+    ("family", "choice")))
 # Scheduler loop health (scheduler.py): a persistently failing cycle or
 # repair worker is visible on /metrics instead of vanishing into a bare
 # ``except Exception``.
@@ -463,6 +476,33 @@ def ship_counts() -> dict:
         out[mode] = (int(ship_total.value(mode)),
                      int(ship_bytes.value(mode)))
     return out
+
+
+def note_ship_shard(shard: int, nbytes: int) -> None:
+    """Count node-shard-region bytes shipped to mesh device ``shard``
+    (the per-device ledger the O(dirty-blocks) steady-state contract is
+    proven against — doc/SHARDING.md)."""
+    ship_shard_bytes.inc(float(nbytes), str(shard))
+
+
+def ship_shard_counts() -> Dict[str, int]:
+    """{shard: bytes} so far — bench artifact + check_shard_ab."""
+    return {labels[0]: int(v)
+            for labels, v in ship_shard_bytes.values().items() if labels}
+
+
+def note_route(family: str, choice: str) -> None:
+    """Count one solver-family dispatch routed at the
+    choose_solver_mesh / eviction-scan chokepoints (family is
+    allocate | evict | scan; choice is sharded | pallas | xla)."""
+    solver_route.inc(1.0, family, choice)
+
+
+def route_counts() -> Dict[str, int]:
+    """{"family/choice": count} so far — bench artifact + /debug meta."""
+    return {f"{labels[0]}/{labels[1]}": int(v)
+            for labels, v in solver_route.values().items()
+            if len(labels) == 2}
 
 
 def inc_scheduler_loop_error(stage: str) -> None:

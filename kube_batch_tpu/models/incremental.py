@@ -128,10 +128,20 @@ class IncrementalState:
         self.sig_bonus = None           # np.ndarray [S, n_pad] int64
         self.sig_examples: Dict[tuple, tuple] = {}
         # Generation-keyed solve-result cache (actions/tpu_allocate.py):
-        # valid while the shipper's resident bytes are unchanged.
+        # valid while the shipper's resident bytes are unchanged.  The
+        # byte-generation contract is layout-blind on purpose: the
+        # per-shard mesh layout (doc/SHARDING.md) moves the generation
+        # through the same full/delta/clean discipline, so a clean ship
+        # on the mesh proves byte-identical inputs exactly as on one
+        # chip and the cached result stays reusable.  ``solve_route``
+        # records which engine produced the cached result (sharded |
+        # pallas | xla) purely for observability — the parity suite
+        # makes every route placement-identical, so a route flip never
+        # invalidates the cache.
         self.solve_gen: int = -1
         self.solve_cfg = None
         self.solve_result: Optional[tuple] = None
+        self.solve_route: str = ""
         # One-shot full-rebuild request (the scheduler's periodic floor,
         # and the chaos stale-generation recovery path).
         self.force_full: bool = False

@@ -67,11 +67,21 @@ def batch_evict_enabled() -> bool:
     return os.environ.get(BATCH_EVICT_ENV, "1") != "0"
 
 
-def _shipper_wanted() -> bool:
+def _shipper_wanted(route: str = "xla") -> bool:
     import os
     forced = os.environ.get(EVICT_SHIP_ENV)
     if forced is not None:
         return forced == "1"
+    from .shipping import DELTA_SHIP_ENV
+    if route == "sharded" and os.environ.get(DELTA_SHIP_ENV, "1") != "0":
+        # The mesh-routed eviction engine reads the shipper's resident
+        # sharded node leaves in place (doc/SHARDING.md): without the
+        # shipper the batched dispatch would fall back to single-chip
+        # and every action would silently bypass the mesh.  When
+        # DELTA_SHIP=0 has disabled residency entirely, the ship could
+        # never produce a mesh-resident buffer — fall through rather
+        # than pay a throwaway full pack per attach.
+        return True
     import jax
     return jax.default_backend() != "cpu"
 
@@ -108,13 +118,20 @@ def _build_scanner(ssn, use_shipper: bool = False
         return None
     if snap.needs_fallback or not (snap.tasks or snap.tasks_extra):
         return None
+    # The shipper's own routing gate decides the resident layout; probe
+    # it here so the engine attaches the shipper whenever the layout
+    # will be mesh-sharded (choose_evict_route then follows the leaves).
+    from ..ops.solver import choose_solver_mesh
+    route = choose_solver_mesh(snap.inputs)[0]
     device_inputs = None
-    if use_shipper and _shipper_wanted():
+    if use_shipper and _shipper_wanted(route):
         # Ship the snapshot through the DeviceResidentShipper (a delta
         # against the previous cycle's image on steady clusters): the
         # batched dispatch's statics then read the already-resident
-        # SolverInputs buffer, and tpu-allocate's own ship later this
-        # cycle delta-ships against this staging — no extra full ship.
+        # SolverInputs buffer — mesh-sharded over the node axis when the
+        # shard gate fires, so the sharded evict solve reads each leaf
+        # in place — and tpu-allocate's own ship later this cycle
+        # delta-ships against this staging: no extra full ship.
         from .shipping import resident_shipper
         device_inputs = resident_shipper(ssn.cache).ship(snap.inputs,
                                                          snap.config)
@@ -177,9 +194,12 @@ class DeviceNodeScanner:
         self.cfg = snap.config
         # ``device_inputs``: the session's SolverInputs as shipped by the
         # DeviceResidentShipper (batched eviction engine) — the statics
-        # below are then views of the already-device-resident buffer, so
-        # building the scanner moves no static bytes.  Without it (the
+        # below are then views of the already-device-resident buffer
+        # (mesh-sharded under the shard route), so building the scanner
+        # moves no static bytes, and batch_seed's sharded dispatch reads
+        # the dynamic node leaves in place too.  Without it (the
         # sequential control) each leaf transfers here as before.
+        self._resident = device_inputs
         src = device_inputs if device_inputs is not None else inp
         self.statics = ScanStatics(
             sig_mask=jnp.asarray(src.sig_mask),
@@ -329,18 +349,25 @@ class DeviceNodeScanner:
         rank_p = np.full((mb,), mb, np.int32)
         node_p[:m] = vic_node
         rank_p[:m] = vic_rank
+        route, _mesh = evict_solver.choose_evict_route(self._resident)
         solve_key = evict_solver.evict_solve_key(
             self.cfg, self.r, self.np_pad, self.ns_pad,
-            self.dyn.shape[0], kb, mb, int(self.statics.sig_mask.shape[0]))
+            self.dyn.shape[0], kb, mb, int(self.statics.sig_mask.shape[0]),
+            route=route)
         from ..chaos.breaker import device_breaker
         with trace.span("evict.batch_solve", profiles=len(keys),
                         victims=m, nodes=len(self.snap.node_names)):
             try:
+                # Sharded route: the dispatch reads the resident sharded
+                # node leaves in place — staging dyn here would ship the
+                # exact O(nodes) bytes the mesh route exists to kill.
+                dyn_dev = (None if route == "sharded"
+                           else jnp.asarray(self.dyn))
                 scores, perm = evict_solver.dispatch_evict_batch_solve(
                     self.cfg, self.r, self.np_pad, self.ns_pad,
-                    self.statics, jnp.asarray(self.dyn),
+                    self.statics, dyn_dev,
                     jnp.asarray(trows), jnp.asarray(node_p),
-                    jnp.asarray(rank_p))
+                    jnp.asarray(rank_p), resident=self._resident)
                 mat = np.asarray(scores).astype(np.int64)
                 perm = np.asarray(perm)
             except Exception as exc:

@@ -19,6 +19,24 @@ dtype, leaf spec) or a solver-config key change falls back to a full
 ship.  Delta-shipped inputs are bit-identical to a fresh full ship by
 construction: dirty blocks are detected by comparing against the exact
 bytes previously shipped (tests/test_pipeline.py pins this).
+
+When ``ops.solver.choose_solver_mesh`` routes the solve to the node-
+sharded mesh engine, the shipper switches to the SHARDED resident layout
+(doc/SHARDING.md): node-major leaves are regrouped per mesh device —
+each device's buffer row holds ITS contiguous node rows of every
+node-major leaf, leaf-padded to 512-byte block boundaries, with the
+[S, N] signature leaves stored transposed (node-major) so one dirty
+node touches O(1) blocks — and placed with a ``NamedSharding`` over the
+mesh's node axis; the replicated remainder (task/job/queue/cluster
+leaves) broadcasts once.  Dirty-block detection and the donated scatter
+then run PER SHARD: a churn cycle ships bytes only to the devices whose
+node rows changed (clean shards receive nothing and their resident
+buffers stay put), the unpacked leaves come back carrying exactly the
+shardings ``parallel.sharded_solver`` declares (no implicit reshard
+between consecutive sharded solves), and the clean⇒byte-identical
+``generation`` contract is unchanged, so the incremental engine's
+solve-result reuse works on the mesh as-is
+(tests/test_shard_ship.py pins delta ≡ full bit-parity per leaf).
 """
 
 from __future__ import annotations
@@ -90,6 +108,155 @@ def _scatter_blocks(flat2d, idx, blocks):
     return flat2d.at[idx].set(blocks)
 
 
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scatter_shard(shard_blk, idx, blocks):
+    """Overwrite the dirty blocks of ONE mesh device's [1, B, _BLOCK]
+    resident node-shard in place (donated; same padding contract as
+    _scatter_blocks).  Runs per dirty device only — clean shards are
+    never touched, which is the per-shard O(dirty-blocks) steady-state
+    contract (doc/SHARDING.md)."""
+    return shard_blk.at[0, idx].set(blocks)
+
+
+# ---------------------------------------------------------------------------
+# Sharded resident layout (doc/SHARDING.md): node-major leaves regrouped
+# per mesh device, replicated remainder packed exactly like _pack_host.
+# ---------------------------------------------------------------------------
+
+# SolverInputs leaves with a LEADING node axis: each device's buffer row
+# carries its contiguous node rows of these.
+_NODE_FIELDS = frozenset({
+    "node_idle", "node_releasing", "node_used", "node_alloc",
+    "node_count", "node_max_tasks", "node_exists", "node_ports",
+    "node_selcnt"})
+# [S, N] leaves (TRAILING node axis): stored transposed per shard
+# (node-major, [n_local, S]) so a dirty node row touches O(S bytes), not
+# one block per signature row; the device unpack transposes back.
+_SIG_FIELDS = frozenset({"sig_mask", "sig_bonus"})
+
+
+def _pack_host_sharded(inp, float_dtype, n_dev: int):
+    """Stage ``inp`` for the mesh-sharded resident layout.
+
+    Returns (spec_rep, spec_shard, rep_pos, node_pos, rep_flat,
+    shard_flat, treedef): ``rep_flat`` is the replicated region's bytes
+    (same packing discipline as _pack_host, block-padded); ``shard_flat``
+    is [n_dev, shard_bytes] — row *s* holds device *s*'s node rows of
+    every node-major leaf, each leaf zero-padded to a _BLOCK boundary so
+    leaf offsets are shard-uniform.  ``spec_shard`` rows are
+    (kind, local_byte_off, local_size, packed_local_shape, is_sig);
+    ``rep_pos``/``node_pos`` map each region's leaves back to their
+    SolverInputs flatten positions."""
+    from ..ops.solver import SolverInputs as _SI
+
+    fwidth = np.dtype(float_dtype).itemsize
+    leaves, treedef = jax.tree.flatten(inp)
+    fields = _SI._fields  # NamedTuple flatten order == field order
+    n_total = int(np.asarray(inp.node_idle).shape[0])
+    n_local = n_total // n_dev
+
+    rep_spec, rep_bufs, rep_off = [], [], 0
+    shard_spec = []
+    shard_parts = [[] for _ in range(n_dev)]
+    local_off = 0
+    rep_pos, node_pos = [], []
+    for i, (name, leaf) in enumerate(zip(fields, leaves)):
+        arr = np.asarray(leaf)
+        kind = _kind_of(arr.dtype)
+        if kind == "f":
+            arr = arr.astype(float_dtype, copy=False)
+            width = fwidth
+        elif kind == "i":
+            arr = arr.astype(np.int32, copy=False)
+            width = 4
+        else:
+            arr = arr.astype(np.uint8, copy=False)
+            width = 1
+        if name in _NODE_FIELDS or name in _SIG_FIELDS:
+            node_pos.append(i)
+            sig = name in _SIG_FIELDS
+            if sig:
+                lshape = (n_local, arr.shape[0])  # packed node-major
+            else:
+                lshape = (n_local,) + arr.shape[1:]
+            lsize = 1
+            for d in lshape:
+                lsize *= int(d)
+            shard_spec.append((kind, local_off, lsize, tuple(lshape), sig))
+            seg = lsize * width
+            pad = (-seg) % _BLOCK
+            for s in range(n_dev):
+                sl = slice(s * n_local, (s + 1) * n_local)
+                piece = arr[:, sl].T if sig else arr[sl]
+                flat = np.ascontiguousarray(piece).reshape(-1)
+                flat = flat.view(np.uint8)
+                if pad:
+                    flat = np.concatenate(
+                        [flat, np.zeros(pad, np.uint8)])
+                shard_parts[s].append(flat)
+            local_off += seg + pad
+        else:
+            rep_pos.append(i)
+            flat = np.ravel(arr)
+            rep_spec.append((kind, rep_off, flat.size, arr.shape))
+            rep_bufs.append(flat.view(np.uint8))
+            rep_off += flat.size * width
+    if rep_off % _BLOCK:
+        rep_bufs.append(np.zeros(_BLOCK - rep_off % _BLOCK, np.uint8))
+    rep_flat = np.concatenate(rep_bufs)
+    shard_flat = np.stack([np.concatenate(parts) for parts in shard_parts])
+    return (tuple(rep_spec), tuple(shard_spec), tuple(rep_pos),
+            tuple(node_pos), rep_flat, shard_flat, treedef)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 2, 3))
+def _unpack_sharded(spec_rep, spec_shard, float_dtype, mesh, rep2d,
+                    shard3d):
+    """Reconstruct SolverInputs leaves from the two resident buffers
+    WITHOUT moving node bytes off their owning devices: the replicated
+    region unpacks as before (every device holds the same bytes), and
+    the node region unpacks under shard_map — each device slices and
+    bitcasts only its own [1, B, _BLOCK] shard, and the outputs come
+    back carrying exactly the shardings parallel.sharded_solver's
+    in_specs declare (node-major split, sig leaves P(None, nodes)), so
+    the sharded solve never reshards its inputs."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import NODE_AXIS, shard_map_kwargs
+
+    rep_leaves = _unpack_body(spec_rep, float_dtype, rep2d.reshape(-1))
+
+    def local(blk):
+        flat = blk.reshape(-1)
+        outs = []
+        for kind, off, size, lshape, sig in spec_shard:
+            if kind == "b":
+                seg = jax.lax.dynamic_slice(flat, (off,), (size,))
+                a = (seg != 0).reshape(lshape)
+            else:
+                width = 4 if kind == "i" else np.dtype(float_dtype).itemsize
+                seg = jax.lax.dynamic_slice(flat, (off,), (size * width,))
+                a = jax.lax.bitcast_convert_type(
+                    seg.reshape(size, width),
+                    jnp.int32 if kind == "i" else float_dtype)
+                a = a.reshape(lshape)
+            outs.append(a.T if sig else a)
+        return tuple(outs)
+
+    out_specs = tuple(
+        P(None, NODE_AXIS) if sig
+        else (P(NODE_AXIS, None) if len(lshape) == 2 else P(NODE_AXIS))
+        for _kind, _off, _size, lshape, sig in spec_shard)
+    fn = shard_map(local, mesh=mesh,
+                   in_specs=(P(NODE_AXIS, None, None),),
+                   out_specs=out_specs, **shard_map_kwargs())
+    return rep_leaves, list(fn(shard3d))
+
+
 def _pack_host(inp, float_dtype, pad_to: int = 1):
     """Flatten every leaf into one host byte buffer with final device
     dtypes applied; returns (spec, flat_u8, treedef).  ``pad_to`` zero-pads
@@ -148,6 +315,16 @@ class _ShipState:
                  "host_flat", "device_flat", "inputs")
 
 
+class _ShardShipState:
+    """The mesh-sharded resident image: per-device node-shard buffers
+    (single-device arrays, scattered into individually so clean shards
+    are never touched), the replicated-region buffer (one NamedSharding
+    broadcast), and the exact host bytes last shipped per region."""
+    __slots__ = ("layout", "spec_rep", "spec_shard", "rep_pos", "node_pos",
+                 "treedef", "float_dtype", "mesh", "host_rep", "host_shard",
+                 "rep_flat", "shard_arrays", "inputs")
+
+
 class DeviceResidentShipper:
     """Delta shipping against a device-resident SolverInputs buffer.
 
@@ -204,10 +381,19 @@ class DeviceResidentShipper:
             trace.note_ship("full", flat.nbytes)
             return out
 
+        # One routing chokepoint (ops/solver.py): when the solve will run
+        # node-sharded over the mesh, the resident buffer must live there
+        # too — same gates, so the bytes always land pre-sharded exactly
+        # where the dispatch reads them.
+        from ..ops.solver import choose_solver_mesh
+        route, mesh = choose_solver_mesh(inp)
+        if route == "sharded":
+            return self._ship_sharded(inp, cfg, float_dtype, mesh)
+
         spec, flat, treedef = _pack_host(inp, float_dtype, pad_to=_BLOCK)
         layout = (spec, np.dtype(float_dtype).str, cfg)
         st = self._state
-        if st is not None and st.layout == layout:
+        if isinstance(st, _ShipState) and st.layout == layout:
             idx = self._dirty_blocks(st.host_flat, flat)
             if idx.size == 0:
                 self.last_mode = "clean"
@@ -281,6 +467,209 @@ class DeviceResidentShipper:
         metrics.note_ship("delta", upd.nbytes + idx_p.nbytes)
         trace.note_ship("delta", upd.nbytes + idx_p.nbytes)
         return st.inputs
+
+    # -- mesh-sharded resident layout (doc/SHARDING.md) ---------------------
+
+    def _ship_sharded(self, inp, cfg, float_dtype, mesh) -> SolverInputs:
+        from ..metrics import metrics
+        from ..trace import spans as trace
+
+        (spec_rep, spec_shard, rep_pos, node_pos, rep_flat, shard_flat,
+         treedef) = _pack_host_sharded(inp, float_dtype, mesh.size)
+        layout = ("sharded", spec_rep, spec_shard,
+                  np.dtype(float_dtype).str, cfg, mesh)
+        st = self._state
+        if isinstance(st, _ShardShipState) and st.layout == layout:
+            rep_idx = self._dirty_blocks(st.host_rep, rep_flat)
+            shard_idx = self._dirty_shard_blocks(st.host_shard, shard_flat)
+            dirty = int(rep_idx.size) + sum(int(ix.size) for ix in shard_idx)
+            if dirty == 0:
+                self.last_mode = "clean"
+                metrics.note_ship("clean", 0)
+                trace.note_ship("clean", 0)
+                return st.inputs
+            total = rep_flat.nbytes + shard_flat.nbytes
+            if dirty * _BLOCK <= _DELTA_MAX_FRACTION * total:
+                return self._ship_sharded_delta(st, rep_flat, shard_flat,
+                                                rep_idx, shard_idx)
+        return self._ship_sharded_full(
+            layout, spec_rep, spec_shard, rep_pos, node_pos, treedef,
+            float_dtype, mesh, rep_flat, shard_flat)
+
+    @staticmethod
+    def _dirty_shard_blocks(old: np.ndarray, new: np.ndarray):
+        """Per-shard dirty block indices ([n_dev, shard_bytes] mirrors)."""
+        diff = (old.view(np.int64) != new.view(np.int64)).reshape(
+            old.shape[0], -1, _BLOCK // 8).any(axis=2)
+        return [np.nonzero(diff[s])[0] for s in range(old.shape[0])]
+
+    @staticmethod
+    def _pad_update(new2d: np.ndarray, idx: np.ndarray):
+        """Bucket one region's dirty-block update (repeat-last padding:
+        same index, same bytes — a no-op on device) so the scatter
+        compiles per bucket, not per distinct dirty count."""
+        k = idx.size
+        kb = bucket(k)
+        idx_p = np.full((kb,), idx[-1], np.int32)
+        idx_p[:k] = idx
+        upd = np.empty((kb, _BLOCK), np.uint8)
+        upd[:k] = new2d[idx]
+        upd[k:] = new2d[idx[-1]]
+        return idx_p, upd
+
+    def _assemble_sharded(self, st: "_ShardShipState") -> SolverInputs:
+        """Merge the two resident regions back into SolverInputs leaves.
+        The per-device shard buffers are stitched into one global array
+        (``make_array_from_single_device_arrays`` — metadata only, no
+        bytes move) and unpacked under shard_map, so every node leaf
+        comes back sharded over the mesh's node axis in place."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.mesh import NODE_AXIS
+
+        n_dev = st.mesh.size
+        b = st.host_shard.shape[1] // _BLOCK
+        shard3d = jax.make_array_from_single_device_arrays(
+            (n_dev, b, _BLOCK),
+            NamedSharding(st.mesh, P(NODE_AXIS, None, None)),
+            st.shard_arrays)
+        rep_leaves, node_leaves = _unpack_sharded(
+            st.spec_rep, st.spec_shard, st.float_dtype, st.mesh,
+            st.rep_flat, shard3d)
+        leaves = [None] * (len(st.rep_pos) + len(st.node_pos))
+        for i, pos in enumerate(st.rep_pos):
+            leaves[pos] = rep_leaves[i]
+        for i, pos in enumerate(st.node_pos):
+            leaves[pos] = node_leaves[i]
+        return jax.tree.unflatten(st.treedef, leaves)
+
+    def _ship_sharded_full(self, layout, spec_rep, spec_shard, rep_pos,
+                           node_pos, treedef, float_dtype, mesh,
+                           rep_flat: np.ndarray,
+                           shard_flat: np.ndarray) -> SolverInputs:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..metrics import metrics
+        from ..trace import spans as trace
+
+        st = _ShardShipState()
+        st.layout = layout
+        st.spec_rep = spec_rep
+        st.spec_shard = spec_shard
+        st.rep_pos = rep_pos
+        st.node_pos = node_pos
+        st.treedef = treedef
+        st.float_dtype = float_dtype
+        st.mesh = mesh
+        # Exact shipped bytes per region: the delta baseline, same
+        # no-mutate contract as the single-chip image.
+        st.host_rep = rep_flat      # frozen-after: ship
+        st.host_shard = shard_flat  # frozen-after: ship
+        st.rep_flat = jax.device_put(
+            rep_flat.reshape(-1, _BLOCK), NamedSharding(mesh, P()))
+        n_dev = mesh.size
+        blk3 = shard_flat.reshape(n_dev, -1, _BLOCK)
+        devices = list(mesh.devices.flat)
+        st.shard_arrays = [jax.device_put(blk3[s:s + 1], devices[s])
+                           for s in range(n_dev)]
+        for s in range(n_dev):
+            metrics.note_ship_shard(s, blk3.shape[1] * _BLOCK)
+        st.inputs = self._assemble_sharded(st)  # frozen-after: ship
+        self._state = st
+        self.generation += 1
+        self.last_mode = "full"
+        nbytes = rep_flat.nbytes + shard_flat.nbytes
+        metrics.note_ship("full", nbytes)
+        trace.note_ship("full", nbytes)
+        return st.inputs
+
+    def _ship_sharded_delta(self, st: "_ShardShipState",
+                            rep_flat: np.ndarray, shard_flat: np.ndarray,
+                            rep_idx: np.ndarray,
+                            shard_idx) -> SolverInputs:
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..metrics import metrics
+        from ..trace import spans as trace
+
+        mesh = st.mesh
+        nbytes = 0
+        with warnings.catch_warnings():
+            # CPU backends that cannot honor donation warn per call; the
+            # fallback (copy) is correct, just not free.
+            warnings.simplefilter("ignore")
+            if rep_idx.size:
+                # Replicated region: every device patches its replica in
+                # place — the small bucketed update broadcasts, the
+                # resident buffer itself never moves.
+                idx_p, upd = self._pad_update(
+                    rep_flat.reshape(-1, _BLOCK), rep_idx)
+                rep_sh = NamedSharding(mesh, P())
+                st.rep_flat = _scatter_blocks(
+                    st.rep_flat, jax.device_put(idx_p, rep_sh),
+                    jax.device_put(upd, rep_sh))
+                nbytes += upd.nbytes + idx_p.nbytes
+            n_dev = mesh.size
+            devices = list(mesh.devices.flat)
+            new3d = shard_flat.reshape(n_dev, -1, _BLOCK)
+            for s in range(n_dev):
+                idx = shard_idx[s]
+                if idx.size == 0:
+                    continue  # clean shard: untouched, zero bytes shipped
+                idx_p, upd = self._pad_update(new3d[s], idx)
+                buf = st.shard_arrays[s]
+                buf = _scatter_shard(buf,
+                                     jax.device_put(idx_p, devices[s]),
+                                     jax.device_put(upd, devices[s]))
+                st.shard_arrays[s] = buf
+                shard_bytes = upd.nbytes + idx_p.nbytes
+                metrics.note_ship_shard(s, shard_bytes)
+                nbytes += shard_bytes
+        st.host_rep = rep_flat
+        st.host_shard = shard_flat
+        st.inputs = self._assemble_sharded(st)
+        self.generation += 1
+        self.last_mode = "delta"
+        metrics.note_ship("delta", nbytes)
+        trace.note_ship("delta", nbytes)
+        return st.inputs
+
+
+def dirty_shard_probe(inp: SolverInputs, cfg=None) -> dict:
+    """The deterministic per-shard O(dirty-blocks) proof shared by the
+    ``make bench-shard`` CI gate and tools/shard_bench.py's multichip
+    artifact tail: full-ship ``inp`` through a throwaway resident
+    shipper, dirty ONE node row (row 0, owned by shard 0), delta-ship,
+    and report which devices the bytes actually reached.  Under the
+    sharded route the owning shard receives one bucketed update and
+    every clean shard receives ZERO bytes (doc/SHARDING.md)."""
+    from ..metrics.metrics import ship_shard_counts
+    from ..ops.solver import choose_solver_mesh
+
+    staged = jax.tree.map(np.asarray, inp)
+    route, mesh = choose_solver_mesh(staged)
+    probe = {"route": route, "mesh_devices": mesh.size if mesh else 1}
+    if route != "sharded":
+        return probe
+    if os.environ.get(DELTA_SHIP_ENV, "1") == "0":
+        # Residency disabled (the A/B escape hatch): there is no resident
+        # image to delta against — report the misconfiguration instead
+        # of crashing on the stateless ship.
+        probe["mode"] = "disabled"
+        return probe
+    shipper = DeviceResidentShipper()
+    shipper.ship(staged, cfg)
+    probe["full_bytes"] = int(shipper._state.host_rep.nbytes
+                              + shipper._state.host_shard.nbytes)
+    dirty = staged._replace(node_used=staged.node_used.copy())
+    dirty.node_used[0, 0] += 1  # one row, owned by shard 0
+    before = ship_shard_counts()
+    shipper.ship(dirty, cfg)
+    after = ship_shard_counts()
+    probe["mode"] = shipper.last_mode
+    probe["per_shard_delta_bytes"] = {
+        k: after.get(k, 0) - before.get(k, 0) for k in after}
+    return probe
 
 
 def resident_shipper(cache) -> DeviceResidentShipper:

@@ -404,6 +404,25 @@ def warm_bucket(spec: BucketSpec, cfg=None, family: Sequence[str] = ("auto",),
     names = _resolve_family(family, inp_np)
     records: List[WarmupRecord] = []
     inp = ship_inputs(inp_np)
+    resident = None
+    if "sharded" in names:
+        # Live sessions reach the sharded solve through the shipper's
+        # MESH-RESIDENT layout, and input shardings are part of the jit
+        # cache key — warming on single-device leaves would compile an
+        # executable the live path never hits.  Ship through a throwaway
+        # resident shipper (compiling the sharded pack/unpack programs
+        # too), then delta-ship one dirtied row so the per-shard donated
+        # scatter is compiled ahead as well (doc/SHARDING.md).
+        from ..models.shipping import DeviceResidentShipper
+        try:
+            warm_shipper = DeviceResidentShipper()
+            warm_shipper.ship(inp_np, cfg)
+            dirty = inp_np._replace(node_count=inp_np.node_count.copy())
+            dirty.node_count[0] += 1
+            warm_shipper.ship(dirty, cfg)
+            resident = warm_shipper.ship(inp_np, cfg)
+        except Exception:  # lint: allow-swallow(warmup must never take down boot; the sharded member below records its own failure)
+            resident = None
     for name in names:
         key = solve_key(name, inp_np, cfg)
         start = time.perf_counter()
@@ -418,7 +437,9 @@ def warm_bucket(spec: BucketSpec, cfg=None, family: Sequence[str] = ("auto",),
             elif name == "sharded":
                 from ..parallel.mesh import default_mesh
                 from ..parallel.sharded_solver import solve_allocate_sharded
-                result = solve_allocate_sharded(inp, cfg, default_mesh())
+                result = solve_allocate_sharded(
+                    inp if resident is None else resident, cfg,
+                    default_mesh())
             else:  # pragma: no cover - _resolve_family guards
                 raise ValueError(name)
             fetch_result(result)  # forces completion + warms the pack jit
@@ -432,20 +453,27 @@ def warm_bucket(spec: BucketSpec, cfg=None, family: Sequence[str] = ("auto",),
         records.append(WarmupRecord(
             spec, name, key,
             round((time.perf_counter() - start) * 1e3, 1)))
-    records.append(_warm_evict_batch(spec, cfg, inp_np, inp))
+    records.append(_warm_evict_batch(spec, cfg, inp_np, inp,
+                                     resident=resident))
     return records
 
 
-def _warm_evict_batch(spec: BucketSpec, cfg, inp_np, inp) -> WarmupRecord:
+def _warm_evict_batch(spec: BucketSpec, cfg, inp_np, inp,
+                      resident=None) -> WarmupRecord:
     """Warm the batched eviction kernel (ops/evict_solver.py) at this
     bucket: the storm path's single dispatch should never pay its XLA
     compile inside a live session either.  Warmed at the smallest
     profile bucket (storms interleave a handful of preemptor profiles)
-    and the node/victim buckets this spec implies."""
+    and the node/victim buckets this spec implies.  When ``resident``
+    (the warm shipper's mesh-sharded SolverInputs) is present, the
+    MESH-ROUTED engine is warmed through the same dispatch chokepoint
+    the live scanner uses, so the first sharded evict solve is never a
+    live compile (doc/SHARDING.md)."""
     import numpy as np
     import jax.numpy as jnp
 
-    from .evict_solver import evict_batch_solve, evict_solve_key
+    from .evict_solver import (choose_evict_route, evict_batch_solve,
+                               evict_solve_key)
     from .scan import ScanStatics
 
     r = inp_np.task_req.shape[1]
@@ -454,27 +482,47 @@ def _warm_evict_batch(spec: BucketSpec, cfg, inp_np, inp) -> WarmupRecord:
     n_pad = inp_np.node_idle.shape[0]
     kb = bucket(1)
     mb = bucket(max(spec.tasks, 1))
+    route, _mesh = choose_evict_route(resident)
     key = evict_solve_key(cfg, r, np_pad, ns_pad, n_pad, kb, mb,
-                          int(inp_np.sig_mask.shape[0]))
+                          int(inp_np.sig_mask.shape[0]), route=route)
     start = time.perf_counter()
     try:
+        src = resident if resident is not None else inp
         statics = ScanStatics(
-            sig_mask=jnp.asarray(inp.sig_mask),
-            sig_bonus=jnp.asarray(inp.sig_bonus),
-            node_alloc=jnp.asarray(inp.node_alloc),
-            node_max_tasks=jnp.asarray(inp.node_max_tasks),
-            node_exists=jnp.asarray(inp.node_exists),
-            score_shift=jnp.asarray(inp.score_shift))
-        dyn = np.concatenate(
-            [np.asarray(inp_np.node_used),
-             np.asarray(inp_np.node_count)[:, None],
-             np.asarray(inp_np.node_ports).astype(np.int32),
-             np.asarray(inp_np.node_selcnt)], axis=1).astype(np.int32)
+            sig_mask=jnp.asarray(src.sig_mask),
+            sig_bonus=jnp.asarray(src.sig_bonus),
+            node_alloc=jnp.asarray(src.node_alloc),
+            node_max_tasks=jnp.asarray(src.node_max_tasks),
+            node_exists=jnp.asarray(src.node_exists),
+            score_shift=jnp.asarray(src.score_shift))
         trows = np.zeros((kb, 1 + r + np_pad + 4 * ns_pad), np.int32)
-        scores, perm = evict_batch_solve(
-            cfg, r, np_pad, ns_pad, statics, jnp.asarray(dyn),
-            jnp.asarray(trows), jnp.asarray(np.full((mb,), n_pad, np.int32)),
-            jnp.asarray(np.full((mb,), mb, np.int32)))
+        vic_node = np.full((mb,), n_pad, np.int32)
+        vic_rank = np.full((mb,), mb, np.int32)
+        if route == "sharded":
+            # Direct call (not the dispatch chokepoint): warmup is
+            # setup, not traffic — it must not count routes, feed the
+            # breaker, or hit a chaos site.
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..parallel.sharded_scan import evict_batch_solve_sharded
+            rep = NamedSharding(_mesh, P())
+            scores, perm = evict_batch_solve_sharded(
+                cfg, r, np_pad, ns_pad, statics, resident.node_used,
+                resident.node_count, resident.node_ports,
+                resident.node_selcnt, jax.device_put(trows, rep),
+                jax.device_put(vic_node, rep),
+                jax.device_put(vic_rank, rep), _mesh)
+        else:
+            dyn = np.concatenate(
+                [np.asarray(inp_np.node_used),
+                 np.asarray(inp_np.node_count)[:, None],
+                 np.asarray(inp_np.node_ports).astype(np.int32),
+                 np.asarray(inp_np.node_selcnt)], axis=1).astype(np.int32)
+            scores, perm = evict_batch_solve(
+                cfg, r, np_pad, ns_pad, statics, jnp.asarray(dyn),
+                jnp.asarray(trows), jnp.asarray(vic_node),
+                jnp.asarray(vic_rank))
         np.asarray(scores)
         np.asarray(perm)
     except Exception as exc:  # lint: allow-swallow(warmup must never take down boot; failure is recorded in WarmupRecord.error)

@@ -89,31 +89,79 @@ def evict_batch_solve(cfg, r: int, np_pad: int, ns_pad: int,
     return scores, perm
 
 
+def choose_evict_route(resident=None):
+    """('sharded'|'xla', mesh): the eviction engine's mesh gate.
+
+    Derived from the RESIDENT BUFFER'S OWN SHARDING, not re-gated: the
+    shipper already routed its layout through ``choose_solver_mesh``
+    (models/shipping.py), and the sharded dispatch reads those leaves in
+    place — so following the leaves is self-consistent by construction
+    (a bytes-gate-only shard, which the node-count scan gate alone would
+    miss, still routes the eviction solve to the mesh).  Without a
+    resident buffer there is nothing sharded to read: single-chip."""
+    if resident is None:
+        return "xla", None
+    sharding = getattr(resident.node_used, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    mesh = getattr(sharding, "mesh", None)
+    if (mesh is not None and getattr(mesh, "size", 1) > 1
+            and spec is not None and len(spec) > 0
+            and spec[0] is not None):
+        return "sharded", mesh
+    return "xla", None
+
+
 def dispatch_evict_batch_solve(cfg, r: int, np_pad: int, ns_pad: int,
                                statics: ScanStatics, dyn: jnp.ndarray,
                                trows: jnp.ndarray, vic_node: jnp.ndarray,
-                               vic_rank: jnp.ndarray):
+                               vic_rank: jnp.ndarray, resident=None):
     """Host-side dispatch chokepoint for the jitted batched eviction
     solve — the seam the chaos engine injects device faults into
     (doc/CHAOS.md site ``evict_solve.device_error``; the branch cannot
-    live inside the jitted program).  A no-op single branch when the
-    chaos engine is off.  The scanner degrades a failure here to
-    per-profile host scoring and feeds the device breaker
-    (models/scanner.py batch_seed)."""
+    live inside the jitted program), and the eviction engine's mesh
+    routing point (doc/SHARDING.md): when the node bucket crosses the
+    shared shard gate AND ``resident`` (the shipper's device-resident
+    SolverInputs) is attached, the solve runs node-sharded over the mesh
+    reading the resident leaves in place — ``dyn`` then ships nothing.
+    A no-op single branch when the chaos engine is off.  The scanner
+    degrades a failure here to per-profile host scoring and feeds the
+    device breaker (models/scanner.py batch_seed)."""
     from ..chaos import plan as chaos_plan
+    from ..metrics import metrics
     plan = chaos_plan.PLAN
     if plan is not None and plan.fire("evict_solve.device_error"):
         raise RuntimeError(
             "chaos: batched eviction solve failed (injected)")
+    choice, mesh = choose_evict_route(resident)
+    metrics.note_route("evict", choice)
+    from ..trace import spans as trace
+    trace.annotate(route=choice, mesh_devices=mesh.size if mesh else 1)
+    if choice == "sharded":
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from ..parallel.sharded_scan import evict_batch_solve_sharded
+        # Profile rows and victim metadata are O(preemptors)/O(residents)
+        # small and replicated; committing them to the mesh up front keeps
+        # the dispatch free of mixed-device inputs.
+        rep = NamedSharding(mesh, P())
+        return evict_batch_solve_sharded(
+            cfg, r, np_pad, ns_pad, statics, resident.node_used,
+            resident.node_count, resident.node_ports,
+            resident.node_selcnt, jax.device_put(trows, rep),
+            jax.device_put(vic_node, rep), jax.device_put(vic_rank, rep),
+            mesh)
     return evict_batch_solve(cfg, r, np_pad, ns_pad, statics, dyn, trows,
                              vic_node, vic_rank)
 
 
 def evict_solve_key(cfg, r: int, np_pad: int, ns_pad: int, n_pad: int,
-                    k_pad: int, m_pad: int, s_real: int) -> tuple:
+                    k_pad: int, m_pad: int, s_real: int,
+                    route: str = "xla") -> tuple:
     """Compile-cache identity of one batched eviction executable — the
-    jit-relevant degrees of freedom (static args + every traced shape),
-    in the same spirit as compile_cache.solve_key for the allocate
-    family."""
-    return (EVICT_SOLVE_CHOICE, r, np_pad, ns_pad, n_pad, k_pad, m_pad,
-            s_real, cfg)
+    jit-relevant degrees of freedom (static args + every traced shape,
+    plus the routing choice: the sharded and single-chip engines are
+    distinct executables), in the same spirit as compile_cache.solve_key
+    for the allocate family."""
+    return (EVICT_SOLVE_CHOICE, route, r, np_pad, ns_pad, n_pad, k_pad,
+            m_pad, s_real, cfg)

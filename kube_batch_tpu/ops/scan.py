@@ -50,7 +50,21 @@ def _scan_body(cfg, r: int, np_pad: int, ns_pad: int, statics: ScanStatics,
     count = dyn[:, r]
     ports = dyn[:, r + 1:r + 1 + np_pad]
     selcnt = dyn[:, r + 1 + np_pad:r + 1 + np_pad + ns_pad]
+    return _scan_body_cols(cfg, statics, used, count, ports, selcnt, trow,
+                           r=r, np_pad=np_pad, ns_pad=ns_pad)
 
+
+def _scan_body_cols(cfg, statics: ScanStatics, used, count, ports, selcnt,
+                    trow: jnp.ndarray, *, r: int, np_pad: int,
+                    ns_pad: int) -> jnp.ndarray:
+    """The scan math over UNPACKED node columns.  The packed-``dyn`` form
+    above is the host scanner's wire shape; this form lets the
+    mesh-routed eviction engine feed the shipper's already-resident
+    SolverInputs leaves (node_used/count/ports/selcnt) directly — zero
+    node-state bytes move at dispatch, and each device scans only its
+    shard (parallel/sharded_scan.evict_batch_solve_sharded).  Bool
+    occupancy leaves compare identically to their int32 dyn packing
+    (every predicate below tests ``> 0``)."""
     sig = trow[0]
     res = trow[1:1 + r]
     off = 1 + r
@@ -100,23 +114,29 @@ def scan_nodes(cfg, r: int, np_pad: int, ns_pad: int, statics: ScanStatics,
     return _scan_body(cfg, r, np_pad, ns_pad, statics, dyn, trow)
 
 
+def choose_scan_mesh(n_nodes: int):
+    """('sharded'|'xla', mesh): the eviction-scan routing gate — the
+    allocate solver's node-count gate and startup-pinned knobs
+    (solver.shard_knobs; the bytes-limit branch needs full SolverInputs
+    and is allocate-only), so preempt/reclaim shard when allocate does."""
+    from ..parallel.mesh import default_mesh
+    from .solver import shard_knobs
+    mesh = default_mesh()
+    if mesh is not None and n_nodes % mesh.size == 0:
+        knobs = shard_knobs()
+        if knobs.force or n_nodes >= knobs.nodes:
+            return "sharded", mesh
+    return "xla", None
+
+
 def best_scan_nodes(cfg, r: int, np_pad: int, ns_pad: int,
                     statics: ScanStatics, dyn, trow) -> jnp.ndarray:
     """Route one preemptor's node walk to the node-sharded scan when the
-    mesh gate says the node bucket outgrew one chip — the allocate
-    solver's node-count gate and envs (solver.choose_solver_mesh minus
-    its bytes-limit branch, which needs full SolverInputs), so
-    preempt/reclaim shard when allocate does."""
-    import os
-
-    from .solver import (DEFAULT_SHARD_NODES, FORCE_SHARD_ENV,
-                         SHARD_NODES_ENV, _env_int)
-    from ..parallel.mesh import default_mesh
-    mesh = default_mesh()
-    n = statics.node_exists.shape[0]
-    if mesh is not None and n % mesh.size == 0 and (
-            os.environ.get(FORCE_SHARD_ENV) == "1"
-            or n >= _env_int(SHARD_NODES_ENV, DEFAULT_SHARD_NODES)):
+    mesh gate says the node bucket outgrew one chip."""
+    from ..metrics import metrics
+    choice, mesh = choose_scan_mesh(statics.node_exists.shape[0])
+    metrics.note_route("scan", choice)
+    if choice == "sharded":
         from ..parallel.sharded_scan import scan_nodes_sharded
         return scan_nodes_sharded(cfg, r, np_pad, ns_pad, statics, dyn,
                                   trow, mesh)

@@ -514,36 +514,83 @@ def _node_state_bytes(inp: SolverInputs) -> int:
     return n * per_node
 
 
-def _env_int(name: str, default: int) -> int:
-    """Tuning-knob parse that cannot take down the routing chokepoint:
-    a malformed value falls back to the default instead of raising in
-    every solve."""
+class ShardKnobs(NamedTuple):
+    """The routing gates, resolved from the environment ONCE (like the
+    trace kill switch): ``choose_solver_mesh`` sits on every solve AND
+    every shipper call, and the eviction scan gate re-reads the same
+    knobs — per-call ``os.environ`` probes plus a silent-int parse meant
+    a malformed value was swallowed invisibly on every session forever.
+    A bad value now warns loudly exactly once and pins the default."""
+    nodes: int = DEFAULT_SHARD_NODES
+    bytes: int = DEFAULT_SHARD_BYTES
+    force: bool = False
+
+
+_SHARD_KNOBS = None  # resolved lazily once; refresh_shard_knobs re-reads
+
+
+def _resolve_shard_knobs() -> ShardKnobs:
+    import logging
     import os
 
-    raw = os.environ.get(name)
-    if not raw:
-        return default
-    try:
-        return int(raw)
-    except ValueError:
-        return default
+    log = logging.getLogger(__name__)
+
+    def _int_knob(name: str, default: int) -> int:
+        raw = os.environ.get(name)
+        if not raw:
+            return default
+        try:
+            return int(raw)
+        except ValueError:
+            log.warning(
+                "%s=%r is not an integer; pinning the default %d for the "
+                "life of this process (fix the env and restart, or call "
+                "ops.solver.refresh_shard_knobs())", name, raw, default)
+            return default
+
+    raw_force = os.environ.get(FORCE_SHARD_ENV)
+    if raw_force not in (None, "", "0", "1"):
+        log.warning(
+            "%s=%r is neither 0 nor 1; pinning off for the life of this "
+            "process", FORCE_SHARD_ENV, raw_force)
+    return ShardKnobs(
+        nodes=_int_knob(SHARD_NODES_ENV, DEFAULT_SHARD_NODES),
+        bytes=_int_knob(SHARD_BYTES_ENV, DEFAULT_SHARD_BYTES),
+        force=(raw_force == "1"))
+
+
+def shard_knobs() -> ShardKnobs:
+    """The pinned routing knobs (resolved at first use, startup-stable)."""
+    global _SHARD_KNOBS
+    if _SHARD_KNOBS is None:
+        _SHARD_KNOBS = _resolve_shard_knobs()
+    return _SHARD_KNOBS
+
+
+def refresh_shard_knobs() -> ShardKnobs:
+    """Re-resolve the knobs from the current environment — the deliberate
+    ops/test hook (bench A/B arms toggle FORCE_SHARD in-process).  The
+    production loop never calls this: routing stays startup-pinned."""
+    global _SHARD_KNOBS
+    _SHARD_KNOBS = None
+    return shard_knobs()
 
 
 def choose_solver_mesh(inp: SolverInputs):
     """('sharded'|'pallas'|'xla', mesh) — one production chokepoint, chosen
-    by shape and environment (SURVEY.md §7 stage 7: pjit-shard [P, N] when
-    it outgrows one chip).  The returned mesh is the one the precondition
-    validated (non-None, node bucket divisible)."""
-    import os
-
+    by shape and the startup-pinned knobs (SURVEY.md §7 stage 7:
+    pjit-shard [P, N] when it outgrows one chip).  The returned mesh is
+    the one the precondition validated (non-None, node bucket divisible).
+    The DeviceResidentShipper routes its resident-buffer layout through
+    this same chokepoint, so the bytes land pre-sharded exactly where the
+    solve will read them (doc/SHARDING.md)."""
     from ..parallel.mesh import default_mesh
     mesh = default_mesh()
     if mesh is not None and inp.node_idle.shape[0] % mesh.size == 0:
-        node_gate = _env_int(SHARD_NODES_ENV, DEFAULT_SHARD_NODES)
-        limit = _env_int(SHARD_BYTES_ENV, DEFAULT_SHARD_BYTES)
-        if os.environ.get(FORCE_SHARD_ENV) == "1" \
-                or inp.node_idle.shape[0] >= node_gate \
-                or _node_state_bytes(inp) > limit:
+        knobs = shard_knobs()
+        if knobs.force \
+                or inp.node_idle.shape[0] >= knobs.nodes \
+                or _node_state_bytes(inp) > knobs.bytes:
             return "sharded", mesh
     if jax.default_backend() == "tpu":
         return "pallas", None
@@ -566,6 +613,10 @@ def best_solve_allocate(inp: SolverInputs, cfg: SolverConfig) -> SolveResult:
     plan = chaos_plan.PLAN
     if plan is not None and plan.fire("solve.device_error"):
         raise RuntimeError("chaos: device solve dispatch failed (injected)")
+    from ..metrics import metrics
+    metrics.note_route("allocate", choice)
+    from ..trace import spans as trace
+    trace.annotate(route=choice, mesh_devices=mesh.size if mesh else 1)
     from .compile_cache import note_solve
     note_solve(choice, inp, cfg)  # compile-cache hit/miss observability
     if choice == "sharded":

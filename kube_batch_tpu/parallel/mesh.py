@@ -11,12 +11,30 @@ annotate shardings, let XLA do the rest).
 
 from __future__ import annotations
 
+import inspect
 from typing import Optional
 
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 NODE_AXIS = "nodes"
+
+
+def shard_map_kwargs() -> dict:
+    """The replication-check kwarg this JAX spells ``check_vma`` (>=0.8)
+    or ``check_rep`` — shared by every shard_map call site (the solver,
+    the scan, the shipper's sharded unpack) so the version probe exists
+    once."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    params = inspect.signature(shard_map).parameters
+    if "check_vma" in params:
+        return {"check_vma": False}
+    if "check_rep" in params:
+        return {"check_rep": False}
+    return {}
 
 
 def make_mesh(n_devices: Optional[int] = None) -> Mesh:

@@ -17,17 +17,17 @@ and the driver's dryrun_multichip preempt-parity line.
 from __future__ import annotations
 
 import functools
-import inspect
 
 import jax
+import jax.numpy as jnp
 try:
     from jax import shard_map
 except ImportError:  # older jax
     from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ..ops.scan import ScanStatics, _scan_body
-from .mesh import NODE_AXIS
+from ..ops.scan import ScanStatics, _scan_body, _scan_body_cols
+from .mesh import NODE_AXIS, shard_map_kwargs
 
 
 def scan_statics_specs() -> ScanStatics:
@@ -50,14 +50,47 @@ def scan_nodes_sharded(cfg, r: int, np_pad: int, ns_pad: int,
     def shard(statics, dyn, trow):
         return _scan_body(cfg, r, np_pad, ns_pad, statics, dyn, trow)
 
-    kw = {}
-    params = inspect.signature(shard_map).parameters
-    if "check_vma" in params:      # jax >= 0.8 replication-check kwarg
-        kw["check_vma"] = False
-    elif "check_rep" in params:
-        kw["check_rep"] = False
     fn = shard_map(shard, mesh=mesh,
                    in_specs=(scan_statics_specs(), P(NODE_AXIS, None),
                              P(None)),
-                   out_specs=P(NODE_AXIS), **kw)
+                   out_specs=P(NODE_AXIS), **shard_map_kwargs())
     return fn(statics, dyn, trow)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "r", "np_pad", "ns_pad", "mesh"))
+def evict_batch_solve_sharded(cfg, r: int, np_pad: int, ns_pad: int,
+                              statics: ScanStatics, used, count, ports,
+                              selcnt, trows, vic_node, vic_rank,
+                              mesh: Mesh):
+    """The batched eviction pre-solve (ops/evict_solver.evict_batch_solve)
+    with the node axis sharded across ``mesh`` — the eviction engine's
+    steady-state mesh route (doc/SHARDING.md).
+
+    The node state arrives as the shipper's already-resident SolverInputs
+    leaves (node_used / node_count / node_ports / node_selcnt), each
+    sharded over the node axis, so the dispatch moves ZERO node-state
+    bytes: every device vmaps the exact per-row scan body over its own
+    shard (``_scan_body_cols`` — the same math the single-chip kernel and
+    the host numpy mirror compute, so a sharded row is bit-identical),
+    and the [K, N] score tensor materializes sharded with no cross-device
+    traffic.  The victim metadata ([M] node rows + exact int32 victim-
+    order ranks) is replicated — it is O(residents), not O(nodes) — so
+    the victim-candidate lexsort reduces across shards degenerately:
+    every device computes the identical permutation in the same fused
+    program, and the readback takes any replica.
+    """
+    def shard(statics, used, count, ports, selcnt, trows):
+        return jax.vmap(
+            lambda trow: _scan_body_cols(cfg, statics, used, count, ports,
+                                         selcnt, trow, r=r, np_pad=np_pad,
+                                         ns_pad=ns_pad))(trows)
+
+    fn = shard_map(shard, mesh=mesh,
+                   in_specs=(scan_statics_specs(), P(NODE_AXIS, None),
+                             P(NODE_AXIS), P(NODE_AXIS, None),
+                             P(NODE_AXIS, None), P(None, None)),
+                   out_specs=P(None, NODE_AXIS), **shard_map_kwargs())
+    scores = fn(statics, used, count, ports, selcnt, trows)
+    perm = jnp.lexsort((vic_rank, vic_node))
+    return scores, perm

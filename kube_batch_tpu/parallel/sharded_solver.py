@@ -290,15 +290,9 @@ def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
 
     in_specs = _node_specs()
     out_specs = (P(None), P(None), P(None), P())
-    import inspect
-    kw = {}
-    params = inspect.signature(shard_map).parameters
-    if "check_vma" in params:      # jax >= 0.8 replication-check kwarg
-        kw["check_vma"] = False
-    elif "check_rep" in params:
-        kw["check_rep"] = False
+    from .mesh import shard_map_kwargs
     fn = shard_map(shard_body, mesh=mesh, in_specs=(in_specs,),
-                   out_specs=out_specs, **kw)
+                   out_specs=out_specs, **shard_map_kwargs())
     assignment, kind, order, step = fn(inp)
     return SolveResult(assignment=assignment, kind=kind, order=order,
                        step=step)

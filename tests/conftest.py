@@ -26,3 +26,20 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _unpin_shard_knobs():
+    """The sharding knobs are resolved from the environment ONCE and
+    pinned (ops/solver.shard_knobs — the startup-stable contract).
+    Tests that monkeypatch KUBE_BATCH_TPU_SHARD_*/FORCE_SHARD call
+    refresh_shard_knobs() themselves; this teardown drops the pin so the
+    NEXT test re-resolves from its own (restored) environment instead of
+    inheriting a stale pin."""
+    yield
+    import sys
+    mod = sys.modules.get("kube_batch_tpu.ops.solver")
+    if mod is not None:
+        mod._SHARD_KNOBS = None

@@ -221,6 +221,57 @@ class TestCircuitBreaker:
         h.cycle()
         assert br.state() == "closed"
 
+    def test_mesh_route_device_error_trips_breaker_and_invalidates_shards(
+            self, monkeypatch):
+        """Chaos coverage for the MESH path (doc/SHARDING.md): with the
+        sharded route forced, an injected solve.device_error must feed
+        the shared breaker, degrade the cycle to the host oracle (which
+        still schedules), and invalidate the PER-SHARD resident image —
+        a half-shipped mesh buffer must never serve as the next delta
+        baseline."""
+        from kube_batch_tpu.models import shipping
+        from kube_batch_tpu.ops.solver import refresh_shard_knobs
+
+        monkeypatch.setenv("KUBE_BATCH_TPU_FORCE_SHARD", "1")
+        refresh_shard_knobs()
+        clk = [0.0]
+        br = CircuitBreaker("device_solve", threshold=2, cooldown=30.0,
+                            clock=lambda: clk[0])
+        monkeypatch.setattr(breaker_mod, "_device_breaker", br)
+        plan = chaos_plan.install(chaos_plan.FaultPlan(
+            seed=11, rate=1.0, sites=("solve.device_error",)))
+
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2, cpu="4")
+        h.create_job("fit", 2, 2)
+        h.create_job("hog", 1, 1, cpu="64")  # keeps a pending candidate
+        shipper = shipping.resident_shipper(h.cache)
+        h.cycle()
+        # The fault fired on the SHARDED route, the host oracle still
+        # bound the gang, and the mesh-resident image was dropped (the
+        # next ship must be a full reship, not a delta against a buffer
+        # the failed pipeline may have left half-written).
+        assert plan.injected().get("solve.device_error", 0) >= 1
+        assert len(h.bound("fit")) == 2
+        assert shipper._state is None
+        gen = shipper.generation
+        assert br.state() == "closed"
+        h.cycle()
+        assert br.state() == "open"  # threshold consecutive mesh failures
+        assert shipper.generation > gen  # every failure re-invalidated
+        tr = flight_recorder.latest()
+        assert tr.meta.get("solver_route") == "sharded"
+        assert any("host allocate fallback" in note
+                   for note in tr.meta.get("degraded", []))
+        # Device heals: the half-open probe runs the sharded route again
+        # and the full reship + sharded solve recover bit-cleanly.
+        chaos_plan.disable()
+        clk[0] = 31.0
+        h.cycle()
+        assert br.state() == "closed"
+        assert shipper.last_mode == "full"
+        assert shipper._state is not None
+
     def test_solve_deadline_counts_as_breaker_failure(self, monkeypatch):
         clk = [0.0]
         br = CircuitBreaker("device_solve", threshold=1, cooldown=30.0,

@@ -1,0 +1,334 @@
+"""Per-shard resident delta shipping + mesh-routed eviction engine
+(doc/SHARDING.md).
+
+Pins the sharded steady-state contracts on the virtual 8-device CPU
+mesh:
+
+* delta ship ≡ full ship BIT FOR BIT per leaf, across churn, with the
+  unpacked leaves carrying exactly the node-axis shardings the sharded
+  solve declares (no implicit reshard between sessions);
+* dirty-shard isolation — a churn cycle ships bytes ONLY to the devices
+  owning dirty node rows (clean shards receive zero and their resident
+  buffers are object-identical across the ship);
+* the fallback ladder (layout change, >50% dirty, route flip) and the
+  clean⇒generation-stable contract the incremental engine's solve-result
+  reuse keys on — including reuse-on-clean through the real action under
+  KUBE_BATCH_TPU_FORCE_SHARD=1;
+* the mesh-routed batched eviction solve equals the single-chip engine
+  exactly, and the per-shard donated scatter stays registered with
+  graftlint's donation-safety rule.
+"""
+
+import os
+import pathlib
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+
+from kube_batch_tpu.models.shipping import (DeviceResidentShipper,
+                                            ship_inputs)
+from kube_batch_tpu.models.synthetic import make_synthetic_inputs
+from kube_batch_tpu.parallel.mesh import NODE_AXIS
+
+ROOT = pathlib.Path(__file__).resolve().parents[1]
+if str(ROOT) not in sys.path:
+    sys.path.insert(0, str(ROOT))
+
+
+@pytest.fixture
+def forced_shard(monkeypatch):
+    from kube_batch_tpu.ops.solver import refresh_shard_knobs
+    monkeypatch.setenv("KUBE_BATCH_TPU_FORCE_SHARD", "1")
+    monkeypatch.delenv("KUBE_BATCH_TPU_DELTA_SHIP", raising=False)
+    refresh_shard_knobs()
+    yield
+    monkeypatch.delenv("KUBE_BATCH_TPU_FORCE_SHARD", raising=False)
+    refresh_shard_knobs()
+
+
+def _staged(seed=0, n_tasks=200, n_nodes=64, n_jobs=20, n_queues=3):
+    inputs, config = make_synthetic_inputs(
+        n_tasks=n_tasks, n_nodes=n_nodes, n_jobs=n_jobs,
+        n_queues=n_queues, seed=seed)
+    return jax.tree.map(np.asarray, inputs), config
+
+
+def _assert_leaves_equal(got, want):
+    for name, a, b in zip(got._fields, got, want):
+        assert np.array_equal(np.asarray(a), np.asarray(b)), \
+            f"leaf {name} diverged from the stateless full ship"
+
+
+def _shard_byte_deltas(before, after):
+    return {int(k): after.get(k, 0) - before.get(k, 0) for k in after}
+
+
+class TestShardedShipParity:
+    def test_full_ship_parity_and_shardings(self, forced_shard):
+        from jax.sharding import NamedSharding
+
+        inp, cfg = _staged()
+        sh = DeviceResidentShipper()
+        out = sh.ship(inp, cfg)
+        assert sh.last_mode == "full"
+        _assert_leaves_equal(out, ship_inputs(inp))
+        # Node leaves come back split over the node axis, sig leaves over
+        # their trailing axis, replicated leaves broadcast — exactly the
+        # specs parallel.sharded_solver declares, so the sharded solve
+        # never reshards its inputs.
+        for leaf, axis in ((out.node_idle, 0), (out.node_count, 0),
+                          (out.sig_mask, 1), (out.sig_bonus, 1)):
+            sharding = leaf.sharding
+            assert isinstance(sharding, NamedSharding)
+            assert sharding.spec[axis] == NODE_AXIS
+        assert isinstance(out.task_req.sharding, NamedSharding)
+        assert not any(out.task_req.sharding.spec)
+
+    def test_delta_ship_parity_across_churn(self, forced_shard):
+        inp, cfg = _staged(seed=1)
+        sh = DeviceResidentShipper()
+        sh.ship(inp, cfg)
+        rng = np.random.RandomState(7)
+        cur = inp
+        for cycle in range(4):
+            nxt = jax.tree.map(np.copy, cur)
+            # Node-region churn in a couple of shards + replicated-region
+            # churn (task rows, fairness vectors) — the steady shape.
+            for _ in range(3):
+                row = int(rng.randint(0, 64))
+                nxt.node_used[row, 0] += 100
+                nxt.node_count[row] += 1
+            nxt.task_res[int(rng.randint(0, 200))] += 1
+            nxt.queue_init_alloc[0, 0] += 1
+            out = sh.ship(nxt, cfg)
+            assert sh.last_mode == "delta", f"cycle {cycle}"
+            _assert_leaves_equal(out, ship_inputs(nxt))
+            cur = nxt
+
+    def test_clean_ship_keeps_generation_and_buffer(self, forced_shard):
+        inp, cfg = _staged(seed=2)
+        sh = DeviceResidentShipper()
+        out1 = sh.ship(inp, cfg)
+        gen = sh.generation
+        out2 = sh.ship(jax.tree.map(np.copy, inp), cfg)
+        assert sh.last_mode == "clean"
+        assert sh.generation == gen  # clean ⇒ byte-identical ⇒ reusable
+        assert out2 is out1          # the resident leaves, not a copy
+
+    def test_dirty_shard_isolation(self, forced_shard):
+        """One dirty node row ships bytes ONLY to its owning device;
+        every clean shard's resident buffer is the same object after the
+        delta (never scattered, never copied)."""
+        from kube_batch_tpu.metrics.metrics import ship_shard_counts
+
+        inp, cfg = _staged(seed=3)
+        sh = DeviceResidentShipper()
+        sh.ship(inp, cfg)
+        shards_before = list(sh._state.shard_arrays)
+        n_local = 64 // 8
+        target = 5  # shard owning rows 40..47
+        nxt = jax.tree.map(np.copy, inp)
+        nxt.node_used[target * n_local + 2, 1] += 64
+        before = ship_shard_counts()
+        out = sh.ship(nxt, cfg)
+        after = ship_shard_counts()
+        assert sh.last_mode == "delta"
+        deltas = _shard_byte_deltas(before, after)
+        assert deltas[target] > 0
+        assert all(v == 0 for s, v in deltas.items() if s != target), deltas
+        for s, buf in enumerate(sh._state.shard_arrays):
+            if s != target:
+                assert buf is shards_before[s], \
+                    f"clean shard {s} was touched"
+        _assert_leaves_equal(out, ship_inputs(nxt))
+
+    def test_layout_change_falls_back_to_full(self, forced_shard):
+        inp, cfg = _staged(seed=4, n_nodes=64)
+        sh = DeviceResidentShipper()
+        sh.ship(inp, cfg)
+        bigger, _ = _staged(seed=4, n_nodes=128)  # new node bucket
+        out = sh.ship(bigger, cfg)
+        assert sh.last_mode == "full"
+        _assert_leaves_equal(out, ship_inputs(bigger))
+
+    def test_over_half_dirty_falls_back_to_full(self, forced_shard):
+        inp, cfg = _staged(seed=5)
+        sh = DeviceResidentShipper()
+        sh.ship(inp, cfg)
+        nxt = jax.tree.map(
+            lambda a: (a + 1 if np.issubdtype(a.dtype, np.integer)
+                       else a), jax.tree.map(np.copy, inp))
+        out = sh.ship(nxt, cfg)
+        assert sh.last_mode == "full"
+        _assert_leaves_equal(out, ship_inputs(nxt))
+
+    def test_route_flip_falls_back_to_single_chip_layout(self, monkeypatch):
+        from kube_batch_tpu.ops.solver import refresh_shard_knobs
+
+        inp, cfg = _staged(seed=6)
+        monkeypatch.setenv("KUBE_BATCH_TPU_FORCE_SHARD", "1")
+        refresh_shard_knobs()
+        sh = DeviceResidentShipper()
+        sh.ship(inp, cfg)
+        monkeypatch.delenv("KUBE_BATCH_TPU_FORCE_SHARD")
+        refresh_shard_knobs()
+        out = sh.ship(inp, cfg)  # same bytes, different layout
+        assert sh.last_mode == "full"
+        _assert_leaves_equal(out, ship_inputs(inp))
+
+    def test_invalidate_drops_sharded_image(self, forced_shard):
+        inp, cfg = _staged(seed=7)
+        sh = DeviceResidentShipper()
+        sh.ship(inp, cfg)
+        gen = sh.generation
+        sh.invalidate()
+        assert sh.generation == gen + 1
+        sh.ship(inp, cfg)
+        assert sh.last_mode == "full"  # no stale delta baseline
+
+
+class TestGenerationReuseOnMesh:
+    def test_solve_reuse_on_clean_ship_through_the_action(
+            self, monkeypatch):
+        """PR 7's generation-keyed solve reuse, unchanged on the mesh: a
+        no-progress cycle under FORCE_SHARD ships clean at an unchanged
+        generation and reuses the previous SHARDED solve without a
+        device round-trip (the test_incremental_sessions fixture shape,
+        re-run on the mesh route)."""
+        from kube_batch_tpu.metrics.metrics import (generation_reuse_counts,
+                                                    route_counts)
+        from kube_batch_tpu.models.synthetic import make_synthetic_cache
+        from kube_batch_tpu.ops.solver import refresh_shard_knobs
+        from tests.test_incremental_sessions import _add_churn_job, _cycle
+
+        monkeypatch.setenv("KUBE_BATCH_TPU_FORCE_SHARD", "1")
+        refresh_shard_knobs()
+        routes_before = route_counts()
+        cache, binder = make_synthetic_cache(20, 8, 4, 2)
+        # A pending hog no node fits keeps inputs byte-identical across
+        # no-progress cycles.
+        _add_churn_job(cache, "hog", n_pods=1, cpu="4000")
+        _cycle(cache, binder)
+        _cycle(cache, binder)
+        before = generation_reuse_counts()
+        _cycle(cache, binder, echo=False)
+        _cycle(cache, binder, echo=False)
+        after = generation_reuse_counts()
+        assert after.get("hit", 0) - before.get("hit", 0) >= 1
+        routes_after = route_counts()
+        assert routes_after.get("allocate/sharded", 0) > \
+            routes_before.get("allocate/sharded", 0)
+
+
+class TestMeshEvictSolve:
+    def test_sharded_evict_solve_matches_single_chip(self, forced_shard):
+        import jax.numpy as jnp
+
+        from kube_batch_tpu.ops import evict_solver
+        from kube_batch_tpu.ops.scan import ScanStatics
+
+        inp, cfg = _staged(seed=8, n_tasks=96, n_nodes=64, n_jobs=12)
+        resident = DeviceResidentShipper().ship(inp, cfg)
+        r = inp.task_req.shape[1]
+        np_pad = inp.task_ports.shape[1]
+        ns_pad = inp.task_aff_req.shape[1]
+        statics = ScanStatics(
+            sig_mask=jnp.asarray(resident.sig_mask),
+            sig_bonus=jnp.asarray(resident.sig_bonus),
+            node_alloc=jnp.asarray(resident.node_alloc),
+            node_max_tasks=jnp.asarray(resident.node_max_tasks),
+            node_exists=jnp.asarray(resident.node_exists),
+            score_shift=jnp.asarray(resident.score_shift))
+        route, mesh = evict_solver.choose_evict_route(resident)
+        assert route == "sharded" and mesh is not None
+        k = 8
+        trows = np.zeros((k, 1 + r + np_pad + 4 * ns_pad), np.int32)
+        for i in range(k):
+            trows[i, 0] = int(inp.task_sig[i])
+            trows[i, 1:1 + r] = inp.task_res[i]
+        m = 16
+        rng = np.random.RandomState(0)
+        vic_node = rng.randint(0, 64, m).astype(np.int32)
+        vic_rank = rng.permutation(m).astype(np.int32)
+        scores_sh, perm_sh = evict_solver.dispatch_evict_batch_solve(
+            cfg, r, np_pad, ns_pad, statics, None, jnp.asarray(trows),
+            jnp.asarray(vic_node), jnp.asarray(vic_rank),
+            resident=resident)
+        statics1 = ScanStatics(
+            sig_mask=jnp.asarray(inp.sig_mask),
+            sig_bonus=jnp.asarray(inp.sig_bonus),
+            node_alloc=jnp.asarray(inp.node_alloc),
+            node_max_tasks=jnp.asarray(inp.node_max_tasks),
+            node_exists=jnp.asarray(inp.node_exists),
+            score_shift=jnp.asarray(inp.score_shift))
+        dyn = np.concatenate(
+            [inp.node_used, inp.node_count[:, None],
+             inp.node_ports.astype(np.int32), inp.node_selcnt],
+            axis=1).astype(np.int32)
+        scores_1, perm_1 = evict_solver.evict_batch_solve(
+            cfg, r, np_pad, ns_pad, statics1, jnp.asarray(dyn),
+            jnp.asarray(trows), jnp.asarray(vic_node),
+            jnp.asarray(vic_rank))
+        assert np.array_equal(np.asarray(scores_sh), np.asarray(scores_1))
+        assert np.array_equal(np.asarray(perm_sh), np.asarray(perm_1))
+
+    def test_choose_evict_route_without_resident_is_single_chip(
+            self, forced_shard):
+        from kube_batch_tpu.ops.evict_solver import choose_evict_route
+        assert choose_evict_route(None) == ("xla", None)
+
+
+class TestShardKnobs:
+    def test_knobs_pinned_until_refresh(self, monkeypatch):
+        from kube_batch_tpu.ops import solver
+
+        monkeypatch.delenv("KUBE_BATCH_TPU_FORCE_SHARD", raising=False)
+        solver.refresh_shard_knobs()
+        assert solver.shard_knobs().force is False
+        monkeypatch.setenv("KUBE_BATCH_TPU_FORCE_SHARD", "1")
+        # Pinned: the env change alone must NOT move routing mid-process.
+        assert solver.shard_knobs().force is False
+        assert solver.refresh_shard_knobs().force is True
+
+    def test_malformed_knob_warns_loudly_once_and_pins_default(
+            self, monkeypatch, caplog):
+        import logging
+
+        from kube_batch_tpu.ops import solver
+
+        monkeypatch.setenv(solver.SHARD_NODES_ENV, "not-a-number")
+        with caplog.at_level(logging.WARNING,
+                             logger="kube_batch_tpu.ops.solver"):
+            knobs = solver.refresh_shard_knobs()
+        assert knobs.nodes == solver.DEFAULT_SHARD_NODES
+        warnings = [r for r in caplog.records
+                    if "not-a-number" in r.getMessage()]
+        assert len(warnings) == 1
+        caplog.clear()
+        with caplog.at_level(logging.WARNING,
+                             logger="kube_batch_tpu.ops.solver"):
+            solver.shard_knobs()  # pinned: no re-parse, no re-warn
+        assert not caplog.records
+
+
+class TestDonationSafetyPin:
+    def test_per_shard_scatter_registered_with_graftlint(self):
+        """The per-shard donated scatter must stay visible to the
+        donation-safety rule: losing the registration silently disables
+        use-after-donate checking for the sharded resident buffers."""
+        from tools.graftlint import tracer
+        from tools.graftlint.core import Context, load_files
+
+        files = load_files(
+            [str(ROOT / "kube_batch_tpu" / "models" / "shipping.py")])
+        ctx = Context()
+        for sf in files:
+            tracer.collect(sf, ctx)
+        for fn in ("_scatter_shard", "_scatter_blocks"):
+            infos = ctx.jitted.get(fn)
+            assert infos, f"{fn} no longer registered as jitted"
+            assert any(0 in info.donate_pos for info in infos), \
+                f"{fn} lost its donate_argnums registration"

@@ -38,10 +38,13 @@ class TestProductionRouting:
         import numpy as np
         from kube_batch_tpu.ops.solver import (FORCE_SHARD_ENV,
                                                best_solve_allocate,
-                                               choose_solver, solve_allocate)
+                                               choose_solver,
+                                               refresh_shard_knobs,
+                                               solve_allocate)
         inputs, config = make_synthetic_inputs(
             n_tasks=128, n_nodes=64, n_jobs=16, n_queues=4, seed=3)
         monkeypatch.setenv(FORCE_SHARD_ENV, "1")
+        refresh_shard_knobs()  # knobs are startup-pinned; re-read the env
         assert choose_solver(inputs) == "sharded"
         sharded = best_solve_allocate(inputs, config)
         single = solve_allocate(inputs, config)
@@ -51,16 +54,19 @@ class TestProductionRouting:
     def test_size_gate_threshold(self, monkeypatch):
         from kube_batch_tpu.ops.solver import (SHARD_BYTES_ENV,
                                                _node_state_bytes,
-                                               choose_solver)
+                                               choose_solver,
+                                               refresh_shard_knobs)
         inputs, _ = make_synthetic_inputs(
             n_tasks=64, n_nodes=64, n_jobs=8, n_queues=2, seed=0)
         monkeypatch.delenv("KUBE_BATCH_TPU_FORCE_SHARD", raising=False)
         # Tiny bucket on a big threshold: stays single-chip.
         monkeypatch.setenv(SHARD_BYTES_ENV, str(1 << 40))
+        refresh_shard_knobs()
         assert choose_solver(inputs) in ("pallas", "xla")
         # Threshold below the bucket's footprint: shards.
         monkeypatch.setenv(SHARD_BYTES_ENV,
                            str(_node_state_bytes(inputs) - 1))
+        refresh_shard_knobs()
         assert choose_solver(inputs) == "sharded"
 
     def test_action_path_with_forced_shard(self, monkeypatch):
@@ -70,9 +76,11 @@ class TestProductionRouting:
         from kube_batch_tpu.actions.factory import register_default_actions
         from kube_batch_tpu.ops.solver import choose_solver
         from kube_batch_tpu.plugins.factory import register_default_plugins
+        from kube_batch_tpu.ops.solver import refresh_shard_knobs
         register_default_actions()
         register_default_plugins()
         monkeypatch.setenv("KUBE_BATCH_TPU_FORCE_SHARD", "1")
+        refresh_shard_knobs()
         # The routing must actually take the sharded branch for this shape,
         # or the parity assert below silently re-tests the XLA path.
         probe, _ = make_synthetic_inputs(n_tasks=16, n_nodes=8, n_jobs=4,
@@ -96,9 +104,11 @@ def test_gate_routes_sharded_unforced(monkeypatch):
     from kube_batch_tpu.ops.solver import (DEFAULT_SHARD_NODES,
                                            FORCE_SHARD_ENV,
                                            SHARD_BYTES_ENV,
-                                           SHARD_NODES_ENV, choose_solver)
+                                           SHARD_NODES_ENV, choose_solver,
+                                           refresh_shard_knobs)
     for var in (FORCE_SHARD_ENV, SHARD_NODES_ENV, SHARD_BYTES_ENV):
         monkeypatch.delenv(var, raising=False)
+    refresh_shard_knobs()
     small, _ = make_synthetic_inputs(n_tasks=64, n_nodes=512, n_jobs=8,
                                      n_queues=2, seed=0)
     assert choose_solver(small) != "sharded"
@@ -176,7 +186,8 @@ class TestShardedScan:
         """The production chokepoint (best_scan_nodes) reaches the mesh
         path under the allocate solver's own FORCE_SHARD env."""
         from kube_batch_tpu.ops.scan import best_scan_nodes, scan_nodes
-        from kube_batch_tpu.ops.solver import FORCE_SHARD_ENV
+        from kube_batch_tpu.ops.solver import (FORCE_SHARD_ENV,
+                                               refresh_shard_knobs)
         from kube_batch_tpu.parallel import mesh as mesh_mod
         inputs, config = make_synthetic_inputs(
             n_tasks=64, n_nodes=64, n_jobs=8, n_queues=2, seed=1)
@@ -191,6 +202,7 @@ class TestShardedScan:
              np.asarray(inputs.task_paff_w)[0],
              np.asarray(inputs.task_panti_w)[0]]).astype(np.int32)
         monkeypatch.setenv(FORCE_SHARD_ENV, "1")
+        refresh_shard_knobs()
         monkeypatch.setattr(mesh_mod, "_default_mesh", make_mesh(8))
         routed = np.asarray(best_scan_nodes(
             config, r, np_pad, ns_pad, statics, dyn, trow))

@@ -1,0 +1,96 @@
+"""CI gate for `make bench-shard`: read the bench artifact line from
+stdin and assert the sharded steady state's three contracts
+(doc/SHARDING.md):
+
+1. **Bit parity** — the FORCE_SHARD storm arm's ordered victim
+   sequence, binds and cache events are identical to the single-chip
+   control (`shard_parity`);
+2. **The mesh is actually taken** — the sharded arms recorded at least
+   one sharded allocate solve AND at least one sharded eviction solve
+   (`shard_routes`; without this the parity gate could silently compare
+   two single-chip arms);
+3. **Per-shard O(dirty-blocks) bytes** — the dirty-shard probe's delta
+   ship moved bytes ONLY to the shard owning the dirtied node row:
+   every clean shard received zero, so steady delta traffic cannot
+   scale with mesh size.
+
+bench.py deliberately always exits 0 (the artifact-always-emits
+contract), so the smoke's pass/fail lives here: any violation exits
+nonzero and fails the CI job.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> int:
+    line = ""
+    for raw in sys.stdin:
+        raw = raw.strip()
+        if raw.startswith("{"):
+            line = raw  # last JSON-looking line wins (the artifact)
+    if not line:
+        print("check_shard_ab: no artifact line on stdin", file=sys.stderr)
+        return 1
+    out = json.loads(line)
+    if out.get("error"):
+        print(f"check_shard_ab: bench reported error: {out['error']}",
+              file=sys.stderr)
+        return 1
+    if out.get("shard_parity") is not True:
+        print("check_shard_ab: PARITY FAILURE — the sharded arm diverged "
+              "from the single-chip control on victims, binds or events "
+              f"(shard_parity={out.get('shard_parity')!r})",
+              file=sys.stderr)
+        return 1
+    routes = out.get("shard_routes") or {}
+    if routes.get("allocate/sharded", 0) < 1:
+        print("check_shard_ab: the sharded arm never routed an allocate "
+              f"solve to the mesh (routes={routes})", file=sys.stderr)
+        return 1
+    if routes.get("evict/sharded", 0) < 1:
+        print("check_shard_ab: the eviction engine never routed a batched "
+              f"solve to the mesh (routes={routes})", file=sys.stderr)
+        return 1
+    probe = out.get("shard_ship_probe") or {}
+    if probe.get("route") != "sharded":
+        print(f"check_shard_ab: dirty-shard probe did not shard ({probe})",
+              file=sys.stderr)
+        return 1
+    if probe.get("mode") != "delta":
+        print("check_shard_ab: dirty-shard probe fell back to a "
+              f"{probe.get('mode')!r} ship", file=sys.stderr)
+        return 1
+    deltas = {int(k): v for k, v in
+              (probe.get("per_shard_delta_bytes") or {}).items()}
+    dirty_bytes = deltas.get(0, 0)
+    clean = {s: v for s, v in deltas.items() if s != 0}
+    if dirty_bytes <= 0:
+        print("check_shard_ab: the dirtied shard received no bytes "
+              f"({deltas})", file=sys.stderr)
+        return 1
+    if any(v != 0 for v in clean.values()):
+        print("check_shard_ab: CLEAN SHARDS RECEIVED BYTES — per-shard "
+              f"delta isolation broken ({deltas})", file=sys.stderr)
+        return 1
+    full = probe.get("full_bytes") or 0
+    ab = out.get("shard_ab") or {}
+    print("sharded steady-state A/B: parity OK "
+          f"({ab.get('evictions')} evictions; routes {routes}; "
+          f"dirty-shard probe shipped {dirty_bytes} B to 1/"
+          f"{probe.get('mesh_devices')} devices vs {full} B full, "
+          "clean shards 0 B)")
+    single = ab.get("actions_single_ms") or {}
+    for action, ms in (ab.get("actions_sharded_ms") or {}).items():
+        base = single.get(action)
+        ratio = f"   ({round(base / ms, 2)}x)" if base and ms else ""
+        print(f"  {action:12s} sharded {ms:8.1f} ms   "
+              f"single-chip {base if base is not None else float('nan'):8.1f}"
+              f" ms{ratio}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -71,6 +71,16 @@ def main():
         np.asarray(result.assignment)
         runs.append((time.perf_counter() - start) * 1e3)
 
+    # Per-shard resident delta tail (doc/SHARDING.md): force the shard
+    # route and run the shared dirty-shard probe — the same contract
+    # `make bench-shard` CI-gates, surfaced in the multichip artifact.
+    os.environ["KUBE_BATCH_TPU_FORCE_SHARD"] = "1"
+    from kube_batch_tpu.metrics.metrics import route_counts
+    from kube_batch_tpu.models.shipping import dirty_shard_probe
+    from kube_batch_tpu.ops.solver import refresh_shard_knobs
+    refresh_shard_knobs()
+    ship_tail = dirty_shard_probe(inputs, config)
+
     print(json.dumps({
         "metric": (f"node-sharded solve @ {n_tasks} tasks x {n_nodes} nodes "
                    f"on {n_devices}-device cpu mesh"),
@@ -81,6 +91,9 @@ def main():
         # index/fit-flags pmin).
         "hlo_all_reduce_ops": all_reduces,
         "collectives_per_placement": 2,
+        # Per-device resident-buffer delta traffic + chokepoint routes.
+        "resident_ship": ship_tail,
+        "routes": route_counts() or None,
     }))
 
 

@@ -59,13 +59,19 @@ bench-evict:
 		| $(PYTHON) tools/check_evict_ab.py
 
 # Incremental-vs-control churn sweep at a small CPU shape
-# (doc/INCREMENTAL.md): runs 0.1% / 1% / 10% churn with
+# (doc/INCREMENTAL.md): runs 0.1% / 1% / 10% churn — plus one
+# KUBE_BATCH_TPU_FORCE_SHARD leg on the virtual 8-device mesh — with
 # KUBE_BATCH_TPU_INCREMENTAL on and off over identical deterministic
 # churn schedules, asserts bit-identical binds and events at every
-# level, and prints both arms' timings.  The checker exits nonzero on a
-# parity break (bench.py itself always exits 0), so CI fails loudly.
+# level, that the candidate-row solve prefilter actually fired (single
+# chip AND mesh), and that the snapshot/close/occupancy O(N)-work
+# counters scale with dirty objects on micro cycles.  The checker exits
+# nonzero on any violation (bench.py itself always exits 0), so CI
+# fails loudly.
 bench-churn:
-	env JAX_PLATFORMS=cpu BENCH_CHURN_SWEEP=1 BENCH_TASKS=2000 \
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		BENCH_CHURN_SWEEP=1 BENCH_TASKS=2000 \
 		BENCH_NODES=256 BENCH_JOBS=80 BENCH_QUEUES=4 \
 		$(PYTHON) bench.py | $(PYTHON) tools/check_churn_ab.py
 

@@ -720,8 +720,11 @@ def measure_churn_sweep(n_tasks, n_nodes, n_jobs, n_queues,
     from kube_batch_tpu.apis.scheduling.v1alpha1 import \
         GroupNameAnnotationKey
     from kube_batch_tpu.framework import close_session, open_session
-    from kube_batch_tpu.metrics.metrics import (generation_reuse_counts,
-                                                incremental_session_counts)
+    from kube_batch_tpu.metrics.metrics import (candidate_solve_counts,
+                                                cycle_floor_values,
+                                                generation_reuse_counts,
+                                                incremental_session_counts,
+                                                onwork_values)
     from kube_batch_tpu.models.incremental import INCREMENTAL_ENV
     from kube_batch_tpu.models.synthetic import make_synthetic_cache
 
@@ -732,6 +735,13 @@ def measure_churn_sweep(n_tasks, n_nodes, n_jobs, n_queues,
         os.environ[INCREMENTAL_ENV] = "1" if incremental else "0"
         cache, binder = make_synthetic_cache(n_tasks, n_nodes, n_jobs,
                                              n_queues)
+        # Event parity must hold at EVERY shape: the default 10k ring
+        # overflows under a 50k mass placement, silently narrowing the
+        # A/B to binds-only (events_verified=false) — size the ring to
+        # the arm's worst case instead.
+        from kube_batch_tpu.cache.cache import _EventDeque
+        cache.events = _EventDeque(
+            maxlen=max(200000, 4 * n_tasks + 20000))
         action = TpuAllocateAction()
         podmap = {}
         for job in cache.jobs.values():
@@ -777,8 +787,10 @@ def measure_churn_sweep(n_tasks, n_nodes, n_jobs, n_queues,
             next_uid = n_tasks
             retire = []
             times, walls = [], []
+            rounds_meta = []  # per-round kind + floors + O(N)-work
             counts0 = incremental_session_counts()
             reuse0 = generation_reuse_counts()
+            cand0 = candidate_solve_counts()
             events_mark = len(cache.events)
             for rnd in range(rounds):
                 round_start = time.perf_counter()
@@ -824,13 +836,21 @@ def measure_churn_sweep(n_tasks, n_nodes, n_jobs, n_queues,
                             metadata=ObjectMeta(name=pg_name,
                                                 namespace="bench"),
                             spec=v1alpha1.PodGroupSpec(min_member=1)))
+                kmark = incremental_session_counts()
                 times.append(session_ms())
+                kafter = incremental_session_counts()
+                kind = next((kk for kk in ("micro", "full", "fallback")
+                             if kafter.get(kk, 0) > kmark.get(kk, 0)), None)
+                rounds_meta.append({"kind": kind,
+                                    "floors": cycle_floor_values(),
+                                    "onwork": onwork_values()})
                 fingerprints.append(tuple(sorted(binder.binds.items())))
                 echo()
                 retire.append((pgs, new_keys))
                 walls.append(time.perf_counter() - round_start)
             counts1 = incremental_session_counts()
             reuse1 = generation_reuse_counts()
+            cand1 = candidate_solve_counts()
         # A deque at capacity may have evicted the mark: skip the event
         # comparison rather than compare misaligned slices — and FLAG
         # it, so the CI gate can say the event half of parity was not
@@ -849,38 +869,86 @@ def measure_churn_sweep(n_tasks, n_nodes, n_jobs, n_queues,
                       for kk in ("micro", "full", "fallback")},
             "reuse": {kk: reuse1.get(kk, 0) - reuse0.get(kk, 0)
                       for kk in ("hit", "miss")},
+            "candidate": {kk: cand1.get(kk, 0) - cand0.get(kk, 0)
+                          for kk in ("fired", "full")},
+            "rounds_meta": rounds_meta,
         }
+
+    def run_level(label, churn):
+        arms = [run_arm(inc, churn)
+                for inc in (False, True, True, False)]
+        control = arms[0]["times"][1:] + arms[3]["times"][1:]
+        incr = arms[1]["times"][1:] + arms[2]["times"][1:]
+        parity = all(
+            arm["fingerprints"] == arms[0]["fingerprints"]
+            and (arm["events"] is None or arms[0]["events"] is None
+                 or arm["events"] == arms[0]["events"])
+            for arm in arms[1:])
+        med_i, p90_i = _stats(incr)
+        med_c, p90_c = _stats(control)
+        # Residual-floor attribution + the O(N)-work regression guard
+        # (tools/check_churn_ab.py): per-floor medians over the
+        # incremental arms' steady rounds, and the worst per-round
+        # object walks seen on MICRO rounds — a silent full-walk
+        # regression shows up here as walked ~= objects.
+        inc_meta = arms[1]["rounds_meta"] + arms[2]["rounds_meta"]
+        floors = {}
+        for f in ("solve_wait", "snapshot", "close", "occupancy"):
+            vals = sorted(m["floors"].get(f, 0.0) for m in inc_meta)
+            floors[f] = round(vals[len(vals) // 2], 3) if vals else None
+        micro = [m for m in inc_meta if m["kind"] == "micro"]
+        onwork = {"objects_total": n_nodes + n_jobs,
+                  "nodes_total": n_nodes, "jobs_total": n_jobs}
+        for key in ("snapshot_walked", "close_walked",
+                    "occupancy_rebuilt", "candidate_rows"):
+            onwork[f"micro_{key}_max"] = (
+                max(int(m["onwork"].get(key, 0)) for m in micro)
+                if micro else None)
+        sweep[label] = {
+            "events_verified": not any(a["events_truncated"]
+                                       for a in arms),
+            "incremental_ms": med_i, "incremental_p90": p90_i,
+            "control_ms": med_c, "control_p90": p90_c,
+            "speedup": (round(med_c / med_i, 2) if med_i else None),
+            "sessions_per_sec": arms[1]["sessions_per_sec"],
+            "control_sessions_per_sec": arms[0]["sessions_per_sec"],
+            "kinds": arms[1]["kinds"],
+            "generation_reuse": arms[1]["reuse"],
+            "candidate": {
+                kk: arms[1]["candidate"][kk] + arms[2]["candidate"][kk]
+                for kk in ("fired", "full")},
+            "floors_ms": floors,
+            "onwork": onwork,
+            "parity": parity,
+        }
+        return parity
 
     prior = os.environ.get(INCREMENTAL_ENV)
     sweep = {}
     parity_all = True
     try:
         for churn in churns:
-            arms = [run_arm(inc, churn)
-                    for inc in (False, True, True, False)]
-            control = arms[0]["times"][1:] + arms[3]["times"][1:]
-            incr = arms[1]["times"][1:] + arms[2]["times"][1:]
-            parity = all(
-                arm["fingerprints"] == arms[0]["fingerprints"]
-                and (arm["events"] is None or arms[0]["events"] is None
-                     or arm["events"] == arms[0]["events"])
-                for arm in arms[1:])
-            parity_all = parity_all and parity
-            med_i, p90_i = _stats(incr)
-            med_c, p90_c = _stats(control)
-            label = f"{churn * 100:g}%"
-            sweep[label] = {
-                "events_verified": not any(a["events_truncated"]
-                                           for a in arms),
-                "incremental_ms": med_i, "incremental_p90": p90_i,
-                "control_ms": med_c, "control_p90": p90_c,
-                "speedup": (round(med_c / med_i, 2) if med_i else None),
-                "sessions_per_sec": arms[1]["sessions_per_sec"],
-                "control_sessions_per_sec": arms[0]["sessions_per_sec"],
-                "kinds": arms[1]["kinds"],
-                "generation_reuse": arms[1]["reuse"],
-                "parity": parity,
-            }
+            parity_all = run_level(f"{churn * 100:g}%", churn) and parity_all
+        # One leg under the forced mesh route (doc/SHARDING.md): the
+        # candidate-row prefilter's per-shard gather must hold the same
+        # bit parity on the 8-device mesh — CI-gated, not just
+        # unit-tested.  Skipped (and flagged) on a single-device host.
+        import jax
+        from kube_batch_tpu.ops.solver import refresh_shard_knobs
+        n_dev = len(jax.devices())
+        if n_dev > 1:
+            prior_force = os.environ.get("KUBE_BATCH_TPU_FORCE_SHARD")
+            os.environ["KUBE_BATCH_TPU_FORCE_SHARD"] = "1"
+            refresh_shard_knobs()
+            try:
+                parity_all = run_level(
+                    f"{churns[0] * 100:g}%@shard", churns[0]) and parity_all
+            finally:
+                if prior_force is None:
+                    os.environ.pop("KUBE_BATCH_TPU_FORCE_SHARD", None)
+                else:
+                    os.environ["KUBE_BATCH_TPU_FORCE_SHARD"] = prior_force
+                refresh_shard_knobs()
     finally:
         if prior is None:
             os.environ.pop(INCREMENTAL_ENV, None)

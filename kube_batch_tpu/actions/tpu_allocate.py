@@ -204,6 +204,23 @@ class TpuAllocateAction(Action):
                     and inc_state.solve_result is not None):
                 cached_solve = inc_state.solve_result
             pipelined = os.environ.get(PIPELINE_ENV, "1") != "0"
+            # Candidate-row solve prefilter (ops/prefilter.py,
+            # doc/INCREMENTAL.md "floors"): on a micro build the host
+            # derives the provably-sufficient candidate node set from
+            # the staged start tensors, and the dispatch gathers only
+            # those rows out of the resident buffer — the per-placement
+            # device scan drops from O(N) to O(C).  Full sessions (and
+            # the INCREMENTAL=0 / CANDIDATE_SOLVE=0 controls) keep the
+            # whole node bucket.
+            candidates = None
+            if (pipelined and cached_solve is None
+                    and inc_state is not None
+                    and inc_state.last_kind == "micro"):
+                from ..ops.prefilter import derive_candidates
+                with trace.span("prefilter"):
+                    candidates = derive_candidates(snap, route, mesh)
+                if candidates is not None:
+                    trace.set_meta(candidate_rows=candidates.count)
             solve_start = time.time()
             with _maybe_profile():
                 if cached_solve is not None:
@@ -213,6 +230,7 @@ class TpuAllocateAction(Action):
                         assignment, kind, order, ordered = cached_solve
                         scaffold = prepare_apply_scaffold(snap)
                     metrics.note_generation_reuse(True)
+                    metrics.set_cycle_floor("solve_wait", 0.0)
                 elif pipelined:
                     # Dispatch, overlap the result-independent apply
                     # preparation with the executing device program, then
@@ -220,7 +238,11 @@ class TpuAllocateAction(Action):
                     # packed readback also forces completion
                     # (block_until_ready is unreliable on the axon tunnel).
                     with trace.span("dispatch"):
-                        pending = dispatch_solve(inputs, snap.config)
+                        pending = dispatch_solve(inputs, snap.config,
+                                                 candidates=candidates)
+                    metrics.note_candidate_solve(
+                        candidates is not None,
+                        candidates.count if candidates is not None else 0)
                     overlap_start = time.perf_counter()
                     with trace.span("host_overlap"):
                         scaffold = prepare_apply_scaffold(snap)
@@ -230,12 +252,16 @@ class TpuAllocateAction(Action):
                     with trace.span("device_wait"):
                         assignment, kind, order, ordered = \
                             fetch_solve(pending)
-                    metrics.observe_device_wait_latency(
-                        time.perf_counter() - wait_start)
+                    wait_elapsed = time.perf_counter() - wait_start
+                    metrics.observe_device_wait_latency(wait_elapsed)
+                    metrics.set_cycle_floor("solve_wait", wait_elapsed)
                 else:
                     with trace.span("solve"):
                         result = best_solve_allocate(inputs, snap.config)
                         assignment, kind, order = fetch_result(result)
+                    metrics.note_candidate_solve(False, 0)
+                    metrics.set_cycle_floor("solve_wait",
+                                            time.time() - solve_start)
                     placed = np.nonzero(kind > 0)[0]
                     ordered = placed[np.argsort(order[placed],
                                                 kind="stable")]

@@ -104,6 +104,71 @@ class _EventDeque(_deque):
             self.append(item)
 
 
+class _SnapState:
+    """The generation-keyed snapshot map (doc/INCREMENTAL.md "floors"):
+    the previous cycle's ClusterInfo entries, kept in TRUTH-DICT ORDER so
+    an incremental refresh walks only epoch-dirty objects while handing
+    the session a dict whose iteration order is bit-identical to the full
+    walk's (plugin-open float accumulation is order-dependent; a reordered
+    jobs dict would break the INCREMENTAL=0 parity gate).
+
+    Order discipline: every (re)insertion into the truth dicts stamps a
+    monotone ``_ins_seq``, so truth iteration order == ascending seq
+    order.  The map mirrors that: in-place value replacement keeps a
+    key's position; an insertion whose seq tops the high-water mark
+    appends; anything else (a node flipping back to Ready, a no-spec job
+    regaining its PodGroup) forces one seq-sort rebuild of the map — rare
+    by construction, O(dirty) otherwise.
+
+    All fields are guarded by the owning cache's mutex (informer threads
+    feed the dirty sets, the scheduling thread consumes them)."""
+
+    __slots__ = ("jobs", "nodes", "jobs_seq", "nodes_seq", "job_hw",
+                 "node_hw", "dirty_jobs", "dirty_nodes", "no_spec",
+                 "valid", "full", "close_active", "recloned_jobs",
+                 "close_walk_all", "agg_valid", "agg_total", "grid_cap",
+                 "grid_used", "grid_max")
+
+    def __init__(self):
+        self.jobs: Dict[str, JobInfo] = {}
+        self.nodes: Dict[str, NodeInfo] = {}
+        self.jobs_seq: Dict[str, int] = {}
+        self.nodes_seq: Dict[str, int] = {}
+        self.job_hw = -1          # high-water _ins_seq present in jobs
+        self.node_hw = -1
+        self.dirty_jobs: set = set()
+        self.dirty_nodes: set = set()
+        # Spec-less jobs (no PodGroup/PDB): the full walk emits one
+        # FailedScheduling event per walk for each — replayed in seq
+        # order on incremental walks so the event stream stays
+        # bit-identical to the control.
+        self.no_spec: Dict[str, int] = {}
+        self.valid = False        # a full walk has populated the map
+        self.full = False         # next snapshot must run the full walk
+        # close_session bookkeeping: uids whose last close was NOT
+        # provably silent (they must be re-processed every cycle), and
+        # the uids the latest snapshot re-cloned (fresh clones carry no
+        # quiet verdict yet).
+        self.close_active: set = set()
+        self.recloned_jobs: set = set()
+        self.close_walk_all = True
+        # Node-open aggregates (doc/INCREMENTAL.md "floors"): the
+        # cluster total-allocatable sum and the per-node quantized
+        # (cap, used) grid entries the drf/proportion/nodeorder opens
+        # otherwise rebuild O(nodes) every session — maintained from the
+        # same entry changes the map itself sees.  agg_total is None
+        # whenever ANY node's allocatable has a non-integer dimension
+        # (float re-association would break bit parity; the plugins then
+        # keep their own walk — the exactness gate of
+        # models/incremental.resource_exact).  grid_max is None when a
+        # component maximum may have shrunk (lazy recompute at read).
+        self.agg_valid = False
+        self.agg_total = None   # {"cpu","mem","sc"} exact-int floats
+        self.grid_cap: Dict[str, tuple] = {}
+        self.grid_used: Dict[str, tuple] = {}
+        self.grid_max = None
+
+
 class SchedulerCache(Cache):
     """In-memory cluster mirror (cache.go:73-105)."""
 
@@ -159,6 +224,12 @@ class SchedulerCache(Cache):
         # uid -> (epoch, clone) / name -> (epoch, clone)
         self._pooled_jobs: Dict[str, tuple] = {}   # guarded-by: mutex
         self._pooled_nodes: Dict[str, tuple] = {}  # guarded-by: mutex
+        # Incremental snapshot (doc/INCREMENTAL.md "floors"): dict-order
+        # seq counter + the generation-keyed snapshot map; None while the
+        # control arm (KUBE_BATCH_TPU_INCREMENTAL=0) runs, so the full
+        # walk stays the unmodified oracle.
+        self._obj_seq: int = 0                     # guarded-by: mutex
+        self._snap_state = None                    # guarded-by: mutex
 
         # Leadership write fence.  The reference fences by exiting the
         # process on lost lease (server.go:135-137); here an in-flight
@@ -183,11 +254,50 @@ class SchedulerCache(Cache):
     # ------------------------------------------------------------------
     # epoch stamping + clone pool
 
-    def _touch_job(self, job: JobInfo) -> None:
+    def _touch_job(self, job: JobInfo) -> None:  # holds-lock: mutex
         job.mod_epoch = self.epoch
+        st = self._snap_state
+        if st is not None:
+            st.dirty_jobs.add(job.uid)
 
-    def _touch_node(self, node: NodeInfo) -> None:
+    def _touch_node(self, node: NodeInfo) -> None:  # holds-lock: mutex
         node.mod_epoch = self.epoch
+        st = self._snap_state
+        if st is not None:
+            st.dirty_nodes.add(node.name)
+
+    def _stamp_seq(self, obj) -> int:  # holds-lock: mutex
+        """Stamp a monotone dict-insertion sequence number onto a truth
+        object the moment it enters self.jobs/self.nodes: truth dicts
+        iterate in insertion order, so ascending ``_ins_seq`` IS the
+        truth order — the invariant the incremental snapshot map's
+        ordering discipline stands on (_SnapState)."""
+        self._obj_seq += 1
+        obj._ins_seq = self._obj_seq
+        return self._obj_seq
+
+    def _obj_seq_of(self, obj) -> int:  # holds-lock: mutex
+        seq = getattr(obj, "_ins_seq", None)
+        if seq is None:
+            # Pre-existing object (state enabled after ingestion began):
+            # lazy stamps during an ordered walk assign ascending seqs
+            # consistent with the current dict order.
+            seq = self._stamp_seq(obj)
+        return seq
+
+    def _snap_full_invalidate(self) -> None:  # holds-lock: mutex
+        """Queue/PriorityClass-level changes alter job filtering or
+        priorities without bumping any job epoch: the next snapshot must
+        run the full walk."""
+        st = self._snap_state
+        if st is not None:
+            st.full = True
+
+    def request_full_snapshot(self) -> None:
+        """The scheduler's periodic full-session floor also revalidates
+        the snapshot map (models/incremental.request_full)."""
+        with self.mutex:
+            self._snap_full_invalidate()
 
     def discard_pooled_job(self, uid: str) -> None:
         """Called by a Session the moment it mutates a job clone: the clone
@@ -198,10 +308,16 @@ class SchedulerCache(Cache):
         graftlint's guarded-by check)."""
         with self.mutex:
             self._pooled_jobs.pop(uid, None)
+            st = self._snap_state
+            if st is not None:
+                st.dirty_jobs.add(uid)
 
     def discard_pooled_node(self, name: str) -> None:
         with self.mutex:
             self._pooled_nodes.pop(name, None)
+            st = self._snap_state
+            if st is not None:
+                st.dirty_nodes.add(name)
 
     def _note_churn(self) -> None:
         """Wake the scheduler loop: external cluster state changed."""
@@ -254,9 +370,11 @@ class SchedulerCache(Cache):
                 job.set_pod_group(create_shadow_pod_group(ti.pod))
                 job.queue = self.default_queue
                 self.jobs[key] = job
+                self._stamp_seq(job)
             return self.jobs[key]
         if ti.job not in self.jobs:
             self.jobs[ti.job] = JobInfo(ti.job)
+            self._stamp_seq(self.jobs[ti.job])
         return self.jobs[ti.job]
 
     def _add_task(self, ti: _TaskInfo) -> None:  # holds-lock: mutex
@@ -281,6 +399,7 @@ class SchedulerCache(Cache):
             if ti.node_name not in self.nodes:
                 self.nodes[ti.node_name] = NodeInfo(None)
                 self.nodes[ti.node_name].name = ti.node_name
+                self._stamp_seq(self.nodes[ti.node_name])
             self._touch_node(self.nodes[ti.node_name])
             try:
                 self.nodes[ti.node_name].add_task(ti)
@@ -369,6 +488,7 @@ class SchedulerCache(Cache):
                 self.nodes[node.name].set_node(node)
             else:
                 self.nodes[node.name] = NodeInfo(node)
+                self._stamp_seq(self.nodes[node.name])
             self._touch_node(self.nodes[node.name])
         self._note_churn()
 
@@ -379,6 +499,7 @@ class SchedulerCache(Cache):
                 self.nodes[new_node.name].set_node(new_node)
             else:
                 self.nodes[new_node.name] = NodeInfo(new_node)
+                self._stamp_seq(self.nodes[new_node.name])
             self._touch_node(self.nodes[new_node.name])
         self._note_churn()
 
@@ -387,6 +508,9 @@ class SchedulerCache(Cache):
             self.epoch += 1
             self.nodes.pop(node.name, None)
             self._pooled_nodes.pop(node.name, None)
+            st = self._snap_state
+            if st is not None:
+                st.dirty_nodes.add(node.name)
         self._note_churn()
 
     # ------------------------------------------------------------------
@@ -401,6 +525,7 @@ class SchedulerCache(Cache):
             self.epoch += 1
             if key not in self.jobs:
                 self.jobs[key] = JobInfo(key)
+                self._stamp_seq(self.jobs[key])
             job = self.jobs[key]
             # Self-echo detection: the watch echo of OUR OWN PodGroup
             # status write (update_job_status records the pushed
@@ -446,6 +571,7 @@ class SchedulerCache(Cache):
         q = queue if isinstance(queue, Queue) else queue_from_versioned(queue)
         with self.mutex:
             self.queues[q.metadata.name] = q
+            self._snap_full_invalidate()
         self._note_churn()
 
     def update_queue(self, old_queue, new_queue) -> None:
@@ -455,6 +581,7 @@ class SchedulerCache(Cache):
         name = queue.metadata.name if hasattr(queue, "metadata") else str(queue)
         with self.mutex:
             self.queues.pop(name, None)
+            self._snap_full_invalidate()
         self._note_churn()
 
     def add_pdb(self, pdb) -> None:
@@ -465,6 +592,7 @@ class SchedulerCache(Cache):
             self.epoch += 1
             if key not in self.jobs:
                 self.jobs[key] = JobInfo(key)
+                self._stamp_seq(self.jobs[key])
             job = self.jobs[key]
             job.set_pdb(pdb)
             job.queue = self.default_queue
@@ -497,6 +625,7 @@ class SchedulerCache(Cache):
             self.priority_classes[pc.metadata.name] = pc
             if pc.global_default:
                 self.default_priority_class = pc
+            self._snap_full_invalidate()
         # PriorityClass changes alter job priorities without bumping any
         # job epoch (snapshot() re-resolves priority every cycle), so
         # the wake is the only thing making the loop react before the
@@ -510,6 +639,7 @@ class SchedulerCache(Cache):
                     and self.default_priority_class.metadata.name
                     == pc.metadata.name):
                 self.default_priority_class = None
+            self._snap_full_invalidate()
         self._note_churn()
 
     # ------------------------------------------------------------------
@@ -518,62 +648,368 @@ class SchedulerCache(Cache):
     def snapshot(self) -> ClusterInfo:
         """Clone the cluster state for one session (cache.go:627-683).
 
-        Incremental: clones from the previous cycle are pooled and reused
-        when (a) the informers have not touched the object since it was
-        cloned (``mod_epoch`` match) and (b) the previous session did not
-        mutate the clone (sessions call discard_pooled_* the moment they
-        touch one).  At 1% churn this turns the O(cluster) clone walk into
-        an O(delta) one."""
+        Incremental, twice over: clones from the previous cycle are
+        pooled and reused when (a) the informers have not touched the
+        object since it was cloned (``mod_epoch`` match) and (b) the
+        previous session did not mutate the clone (sessions call
+        discard_pooled_* the moment they touch one) — and the WALK itself
+        is O(dirty): the generation-keyed snapshot map (_SnapState) keeps
+        the previous ClusterInfo entries in truth order, so a steady
+        cycle revalidates only the objects in the dirty sets instead of
+        re-checking every pooled entry.  Queue/PriorityClass changes and
+        the periodic full-session floor force the full walk, which is
+        also the KUBE_BATCH_TPU_INCREMENTAL=0 control (bit-identical
+        dicts and events either way — the churn parity gate pins it)."""
+        from ..models.incremental import incremental_enabled
+
         with self.mutex:
-            info = ClusterInfo()
-            pooled_n = self._pooled_nodes
-            for name, node in self.nodes.items():
-                if not node.ready():
-                    continue  # OutOfSync/NotReady nodes excluded (cache.go:638-643)
-                entry = pooled_n.get(name)
-                if entry is not None and entry[0] == node.mod_epoch:
-                    info.nodes[name] = entry[1]
-                else:
-                    clone = node.snapshot_clone()
-                    # Epoch captured HERE, under the mutex: tensorization
-                    # must key its caches on the truth state this clone
-                    # reflects, not on live truth a reflector thread may
-                    # have already moved past (TOCTOU).
-                    clone.snap_epoch = node.mod_epoch
-                    pooled_n[name] = (node.mod_epoch, clone)
-                    info.nodes[name] = clone
-            for name, queue in self.queues.items():
-                info.queues[name] = QueueInfo(queue)
-            pooled_j = self._pooled_jobs
-            for uid, job in self.jobs.items():
-                # Jobs without a scheduling spec (PodGroup or legacy PDB)
-                # are skipped (cache.go:650-656).
-                if job.pod_group is None and job.pdb is None:
-                    self.events.append(
-                        ("FailedScheduling", uid, "job without PodGroup"))
+            st = self._snap_state
+            if not incremental_enabled():
+                # Control arm: drop any map so a later re-enable starts
+                # from a fresh full walk instead of a stale baseline.
+                self._snap_state = None
+                return self._snapshot_full_locked(None)
+            if st is None:
+                st = self._snap_state = _SnapState()
+            if not st.valid or st.full:
+                return self._snapshot_full_locked(st)
+            return self._snapshot_incremental_locked(st)
+
+    def _clone_job_locked(self, uid: str, job: JobInfo) -> JobInfo:  # holds-lock: mutex
+        """One job's session clone: pooled when epoch-clean, else a fresh
+        snapshot_clone; priority re-resolved from PriorityClasses (the
+        incremental walk only reaches here for dirty jobs — PriorityClass
+        changes force the full walk, so clean clones' priorities hold)."""
+        pooled_j = self._pooled_jobs
+        entry = pooled_j.get(uid)
+        if entry is not None and entry[0] == job.mod_epoch:
+            clone = entry[1]
+        else:
+            clone = job.snapshot_clone()
+            # Epoch captured HERE, under the mutex: tensorization must
+            # key its caches on the truth state this clone reflects, not
+            # on live truth a reflector thread may have already moved
+            # past (TOCTOU).
+            clone.snap_epoch = job.mod_epoch
+            pooled_j[uid] = (job.mod_epoch, clone)
+        if clone.pod_group is not None:
+            pc_name = clone.pod_group.spec.priority_class_name
+            if self.default_priority_class is not None:
+                clone.priority = self.default_priority_class.value
+            pc = self.priority_classes.get(pc_name)
+            if pc is not None:
+                clone.priority = pc.value
+        return clone
+
+    def _snapshot_full_locked(self, st) -> ClusterInfo:  # holds-lock: mutex
+        """The reference full walk (the INCREMENTAL=0 control), doubling
+        as the map (re)build when ``st`` is given."""
+        info = ClusterInfo()
+        pooled_n = self._pooled_nodes
+        if st is not None:
+            st.no_spec.clear()
+        for name, node in self.nodes.items():
+            if not node.ready():
+                continue  # OutOfSync/NotReady nodes excluded (cache.go:638-643)
+            entry = pooled_n.get(name)
+            if entry is not None and entry[0] == node.mod_epoch:
+                info.nodes[name] = entry[1]
+            else:
+                clone = node.snapshot_clone()
+                clone.snap_epoch = node.mod_epoch  # see _clone_job_locked
+                pooled_n[name] = (node.mod_epoch, clone)
+                info.nodes[name] = clone
+        for name, queue in self.queues.items():
+            info.queues[name] = QueueInfo(queue)
+        for uid, job in self.jobs.items():
+            # Jobs without a scheduling spec (PodGroup or legacy PDB)
+            # are skipped (cache.go:650-656).
+            if job.pod_group is None and job.pdb is None:
+                self.events.append(
+                    ("FailedScheduling", uid, "job without PodGroup"))
+                if st is not None:
+                    st.no_spec[uid] = self._obj_seq_of(job)
+                continue
+            # Jobs whose queue is missing are skipped (cache.go:658-662).
+            if job.queue not in info.queues:
+                continue
+            info.jobs[uid] = self._clone_job_locked(uid, job)
+        walked = len(self.nodes) + len(self.jobs)
+        metrics.set_snapshot_objects(walked, 0)
+        if st is not None:
+            st.jobs = dict(info.jobs)
+            st.nodes = dict(info.nodes)
+            st.jobs_seq = {uid: self._obj_seq_of(self.jobs[uid])
+                           for uid in info.jobs}
+            st.nodes_seq = {name: self._obj_seq_of(self.nodes[name])
+                            for name in info.nodes}
+            st.job_hw = self._obj_seq
+            st.node_hw = self._obj_seq
+            st.dirty_jobs.clear()
+            st.dirty_nodes.clear()
+            st.valid = True
+            st.full = False
+            st.recloned_jobs = set(info.jobs)
+            st.close_walk_all = True
+            self._agg_rebuild_locked(st, info.nodes)
+        return info
+
+    def _agg_rebuild_locked(self, st, nodes: Dict) -> None:  # holds-lock: mutex
+        """Node-open aggregates from scratch (the full-walk path): the
+        exact-int total-allocatable sum and the quantized grid entries —
+        vectorized like plugins/nodeorder.GridUsage (column quantization
+        is value-identical to per-value quantize_value)."""
+        import numpy as np
+
+        from ..models.incremental import resource_exact
+        from ..ops.resources import quantize_columns
+
+        total = {"cpu": 0.0, "mem": 0.0, "sc": {}}
+        exact = True
+        names = list(nodes)
+        clones = list(nodes.values())
+        for clone in clones:
+            al = clone.allocatable
+            if exact and not resource_exact(al):
+                exact = False
+            total["cpu"] += al.milli_cpu
+            total["mem"] += al.memory
+            if al.scalar_resources:
+                sc = total["sc"]
+                for k, v in al.scalar_resources.items():
+                    sc[k] = sc.get(k, 0.0) + v
+        if names:
+            arr = np.empty((len(names), 2), np.float64)
+            arr[:, 0] = [c.allocatable.milli_cpu for c in clones]
+            arr[:, 1] = [c.allocatable.memory for c in clones]
+            caps = quantize_columns(arr)
+            arr[:, 0] = [c.used.milli_cpu for c in clones]
+            arr[:, 1] = [c.used.memory for c in clones]
+            useds = quantize_columns(arr)
+            st.grid_cap = {n: (int(c), int(m)) for n, (c, m)
+                           in zip(names, caps.tolist())}
+            st.grid_used = {n: (int(c), int(m)) for n, (c, m)
+                            in zip(names, useds.tolist())}
+        else:
+            st.grid_cap = {}
+            st.grid_used = {}
+        st.grid_max = None
+        st.agg_total = total if exact else None
+        st.agg_valid = True
+
+    def _agg_apply_locked(self, st, name: str, old, new) -> None:  # holds-lock: mutex
+        """Apply one map-entry change (old clone -> new clone, either
+        side None) to the node-open aggregates.  Exact by the integer
+        gate: removing a previously-added integer value and adding the
+        replacement reassociates nothing a fresh sum would not."""
+        if not st.agg_valid or old is new:
+            return
+        from ..models.incremental import resource_exact
+        from ..ops.resources import quantize_value
+
+        t = st.agg_total
+        if t is not None:
+            for clone, sign in ((old, -1.0), (new, 1.0)):
+                if clone is None:
                     continue
-                # Jobs whose queue is missing are skipped (cache.go:658-662).
-                if job.queue not in info.queues:
-                    continue
-                entry = pooled_j.get(uid)
-                if entry is not None and entry[0] == job.mod_epoch:
-                    clone = entry[1]
-                else:
-                    clone = job.snapshot_clone()
-                    clone.snap_epoch = job.mod_epoch  # see node note above
-                    pooled_j[uid] = (job.mod_epoch, clone)
-                if clone.pod_group is not None:
-                    # Resolve priority from PriorityClass (cache.go:664-674)
-                    # every cycle, pooled or not: PriorityClass changes do
-                    # not bump job epochs.
-                    pc_name = clone.pod_group.spec.priority_class_name
-                    if self.default_priority_class is not None:
-                        clone.priority = self.default_priority_class.value
-                    pc = self.priority_classes.get(pc_name)
-                    if pc is not None:
-                        clone.priority = pc.value
-                info.jobs[uid] = clone
-            return info
+                al = clone.allocatable
+                if not resource_exact(al):
+                    st.agg_total = t = None
+                    break
+                t["cpu"] += sign * al.milli_cpu
+                t["mem"] += sign * al.memory
+                if al.scalar_resources:
+                    sc = t["sc"]
+                    for k, v in al.scalar_resources.items():
+                        sc[k] = sc.get(k, 0.0) + sign * v
+        if new is None:
+            old_cap = st.grid_cap.pop(name, None)
+            st.grid_used.pop(name, None)
+            if (old_cap is not None and st.grid_max is not None
+                    and (old_cap[0] >= st.grid_max[0]
+                         or old_cap[1] >= st.grid_max[1])):
+                st.grid_max = None  # a component max may have shrunk
+            return
+        cap = (quantize_value(new.allocatable.milli_cpu, 0),
+               quantize_value(new.allocatable.memory, 1))
+        old_cap = st.grid_cap.get(name)
+        st.grid_cap[name] = cap
+        st.grid_used[name] = (quantize_value(new.used.milli_cpu, 0),
+                              quantize_value(new.used.memory, 1))
+        if st.grid_max is not None:
+            if (old_cap is not None
+                    and (old_cap[0] >= st.grid_max[0]
+                         or old_cap[1] >= st.grid_max[1])
+                    and (cap[0] < old_cap[0] or cap[1] < old_cap[1])):
+                st.grid_max = None
+            else:
+                st.grid_max = (max(st.grid_max[0], cap[0]),
+                               max(st.grid_max[1], cap[1]))
+
+    def node_open_aggregates(self):
+        """(total_allocatable | None, grid_cap, grid_used, shift) for
+        the session the latest snapshot produced, or None when the map
+        is cold / the control arm runs.  Dicts are fresh copies (the
+        nodeorder GridUsage mutates its ``used`` live); the total is a
+        private Resource.  total is None — with the grids still served —
+        when some allocatable dimension is fractional (the exactness
+        gate; callers keep their own walk for the total then)."""
+        from ..api.resource import Resource
+        from ..models.incremental import incremental_enabled
+        from ..ops.resources import score_shift_for
+
+        if not incremental_enabled():
+            return None
+        with self.mutex:
+            st = self._snap_state
+            if st is None or not st.agg_valid:
+                return None
+            if st.grid_max is None:
+                st.grid_max = (
+                    max((c[0] for c in st.grid_cap.values()), default=0),
+                    max((c[1] for c in st.grid_cap.values()), default=0))
+            shift = (score_shift_for(st.grid_max[0]),
+                     score_shift_for(st.grid_max[1]))
+            total = None
+            if st.agg_total is not None:
+                total = Resource.__new__(Resource)
+                total.milli_cpu = st.agg_total["cpu"]
+                total.memory = st.agg_total["mem"]
+                total.scalar_resources = dict(st.agg_total["sc"])
+                total.max_task_num = 0
+            return total, dict(st.grid_cap), dict(st.grid_used), shift
+
+    @staticmethod
+    def _snap_insert(target: Dict, seqmap: Dict, hw: int,
+                     inserts: List[tuple]) -> int:
+        """Insert (seq, key, value) rows into an order-kept map: appends
+        when every new seq tops the high-water mark (the steady case —
+        fresh truth insertions), otherwise one seq-sort rebuild (re-ready
+        node / job regaining its spec)."""
+        if not inserts:
+            return hw
+        inserts.sort()
+        if inserts[0][0] > hw:
+            for seq, key, value in inserts:
+                target[key] = value
+                seqmap[key] = seq
+            return inserts[-1][0]
+        items = sorted(
+            [(seqmap[k], k, v) for k, v in target.items()]
+            + inserts)
+        target.clear()
+        seqmap.clear()
+        for seq, key, value in items:
+            target[key] = value
+            seqmap[key] = seq
+        return items[-1][0] if items else -1
+
+    def _snapshot_incremental_locked(self, st) -> ClusterInfo:  # holds-lock: mutex
+        """O(dirty) walk: revalidate exactly the objects whose epoch
+        moved (or whose clone the last session mutated), splice them into
+        the order-kept map, and replay the per-walk no-spec events."""
+        info = ClusterInfo()
+        walked = 0
+
+        inserts: List[tuple] = []
+        for name in st.dirty_nodes:
+            walked += 1
+            old = st.nodes.get(name)
+            node = self.nodes.get(name)
+            if node is None or not node.ready():
+                st.nodes.pop(name, None)
+                st.nodes_seq.pop(name, None)
+                if old is not None:
+                    self._agg_apply_locked(st, name, old, None)
+                continue
+            entry = self._pooled_nodes.get(name)
+            if entry is not None and entry[0] == node.mod_epoch:
+                clone = entry[1]
+            else:
+                clone = node.snapshot_clone()
+                clone.snap_epoch = node.mod_epoch
+                self._pooled_nodes[name] = (node.mod_epoch, clone)
+            self._agg_apply_locked(st, name, old, clone)
+            seq = self._obj_seq_of(node)
+            if st.nodes_seq.get(name) == seq:
+                st.nodes[name] = clone  # same position, new value
+            else:
+                st.nodes.pop(name, None)
+                st.nodes_seq.pop(name, None)
+                inserts.append((seq, name, clone))
+        st.node_hw = self._snap_insert(st.nodes, st.nodes_seq, st.node_hw,
+                                       inserts)
+        st.dirty_nodes.clear()
+
+        for name, queue in self.queues.items():
+            info.queues[name] = QueueInfo(queue)
+
+        st.recloned_jobs = set()
+        inserts = []
+        for uid in st.dirty_jobs:
+            walked += 1
+            job = self.jobs.get(uid)
+            if job is None:
+                st.jobs.pop(uid, None)
+                st.jobs_seq.pop(uid, None)
+                st.no_spec.pop(uid, None)
+                continue
+            if job.pod_group is None and job.pdb is None:
+                st.jobs.pop(uid, None)
+                st.jobs_seq.pop(uid, None)
+                st.no_spec[uid] = self._obj_seq_of(job)
+                continue
+            st.no_spec.pop(uid, None)
+            if job.queue not in info.queues:
+                st.jobs.pop(uid, None)
+                st.jobs_seq.pop(uid, None)
+                continue
+            clone = self._clone_job_locked(uid, job)
+            st.recloned_jobs.add(uid)
+            seq = self._obj_seq_of(job)
+            if st.jobs_seq.get(uid) == seq:
+                st.jobs[uid] = clone
+            else:
+                st.jobs.pop(uid, None)
+                st.jobs_seq.pop(uid, None)
+                inserts.append((seq, uid, clone))
+        st.job_hw = self._snap_insert(st.jobs, st.jobs_seq, st.job_hw,
+                                      inserts)
+        st.dirty_jobs.clear()
+        st.close_walk_all = False
+
+        # The control emits one FailedScheduling event per spec-less job
+        # on EVERY walk, in truth order — replay for event bit-parity.
+        if st.no_spec:
+            for uid, _seq in sorted(st.no_spec.items(),
+                                    key=lambda kv: kv[1]):
+                self.events.append(
+                    ("FailedScheduling", uid, "job without PodGroup"))
+
+        info.nodes = dict(st.nodes)
+        info.jobs = dict(st.jobs)
+        metrics.set_snapshot_objects(
+            walked, len(info.nodes) + len(info.jobs) + len(st.no_spec))
+        return info
+
+    def close_plan(self):
+        """close_session's O(touched) walk plan: (active, recloned,
+        seqmap), or None when the whole-session walk must run (first
+        session, full snapshot, control arm).  See _SnapState."""
+        with self.mutex:
+            st = self._snap_state
+            if st is None or st.close_walk_all:
+                return None
+            return (set(st.close_active), set(st.recloned_jobs),
+                    dict(st.jobs_seq))
+
+    def note_close_results(self, active: set) -> None:
+        """Record which jobs' close outcome was NOT provably silent —
+        the re-process set for the next incremental close."""
+        with self.mutex:
+            st = self._snap_state
+            if st is not None:
+                st.close_active = set(active)
 
     # ------------------------------------------------------------------
     # effectors (cache.go:425-535)
@@ -821,12 +1257,18 @@ class SchedulerCache(Cache):
             self.sync_task(task, cluster_pod)
 
     def process_cleanup_jobs(self) -> None:
-        """Drop terminated jobs queued for deletion (cache.go:576-600)."""
+        """Drop terminated jobs queued for deletion (cache.go:576-600).
+        A pop here is a truth mutation like any other: the incremental
+        snapshot map must see it (dirty mark), or it would keep serving
+        the removed job until the FULL_EVERY floor."""
         with self.mutex:
             remaining = []
+            st = self._snap_state
             for job in self.deleted_jobs:
                 if job_terminated(job):
                     self.jobs.pop(job.uid, None)
+                    if st is not None:
+                        st.dirty_jobs.add(job.uid)
                 else:
                     remaining.append(job)
             self.deleted_jobs = remaining

@@ -739,7 +739,10 @@ def open_session(cache, tiers: List[Tier],
         plan = chaos_plan.PLAN
         if plan is not None and plan.fire("session.snapshot"):
             raise RuntimeError("chaos: session snapshot failed (injected)")
+        snap_start = time.perf_counter()
         snapshot: ClusterInfo = cache.snapshot()
+        metrics.set_cycle_floor("snapshot",
+                                time.perf_counter() - snap_start)
     ssn.jobs = snapshot.jobs
     ssn.nodes = snapshot.nodes
     ssn.queues = snapshot.queues
@@ -788,6 +791,64 @@ def open_session(cache, tiers: List[Tier],
     return ssn
 
 
+def _close_one_job(ssn: Session, job: JobInfo) -> bool:
+    """One job's close-out — the exact per-job body of the reference
+    walk (session.go:119-144).  Returns True when the outcome was
+    provably SILENT: nothing was pushed, no event was appended, no pod
+    condition was written, AND (because the clone is bit-unchanged until
+    it re-enters the dirty set) re-running it next cycle would be just as
+    silent — the license for the incremental close to skip it."""
+    if job.pod_group is None:
+        ssn.cache.record_job_status_event(job)
+        return _close_is_silent(job)
+    status = job.pod_group.status
+    phase, running, failed, succeeded = _derive_job_status(ssn, job)
+    if (job.uid in ssn.mutated_jobs
+            or (status.phase, status.running, status.failed,
+                status.succeeded) != (phase, running, failed,
+                                      succeeded)):
+        # The session touched the job (placements, conditions) or the
+        # derived status moved: push it.  mutated_jobs matters for
+        # condition-only changes (e.g. gang Unschedulable), which the
+        # phase/count compare cannot see.
+        ssn._dirty_job(job.uid)
+        status.phase = phase
+        status.running = running
+        status.failed = failed
+        status.succeeded = succeeded
+        try:
+            ssn.cache.update_job_status(job)
+        except Exception:
+            # Same policy as open_session's discard path: the close
+            # must finish; the failure is counted.
+            metrics.note_swallowed("job_status_update")
+        return False  # pushed (and the echo re-dirties it anyway)
+    ssn.cache.record_job_status_event(job)
+    return _close_is_silent(job)
+
+
+def _close_is_silent(job: JobInfo) -> bool:
+    """Whether record_job_status_event(job) observably did anything:
+    mirrors its guards exactly — a non-shadow Pending/Unknown PodGroup
+    (or a PDB job with Pending tasks) appends an Unschedulable event, and
+    any Allocated/Pending task gets a pod condition + FailedScheduling
+    event.  A True verdict is stable for an unchanged clone, so the
+    incremental close may skip the job until it re-enters a dirty set."""
+    from ..cache.shadow import shadow_pod_group
+    pg = job.pod_group
+    if not shadow_pod_group(pg):
+        if pg is not None and pg.status.phase in (PodGroupUnknown,
+                                                  PodGroupPending):
+            return False
+        if job.pdb is not None and \
+                job.task_status_index.get(TaskStatus.Pending):
+            return False
+    if job.task_status_index.get(TaskStatus.Allocated) \
+            or job.task_status_index.get(TaskStatus.Pending):
+        return False
+    return True
+
+
 def close_session(ssn: Session) -> None:
     for plugin in ssn.plugins.values():
         start = time.time()
@@ -801,33 +862,51 @@ def close_session(ssn: Session) -> None:
     # the derived state by nothing, and skipping it keeps pristine job
     # clones reusable by the snapshot pool (events and pod conditions are
     # still recorded every cycle, as the reference does).
-    for job in ssn.jobs.values():
-        if job.pod_group is None:
-            ssn.cache.record_job_status_event(job)
-            continue
-        status = job.pod_group.status
-        phase, running, failed, succeeded = _derive_job_status(ssn, job)
-        if (job.uid in ssn.mutated_jobs
-                or (status.phase, status.running, status.failed,
-                    status.succeeded) != (phase, running, failed,
-                                          succeeded)):
-            # The session touched the job (placements, conditions) or the
-            # derived status moved: push it.  mutated_jobs matters for
-            # condition-only changes (e.g. gang Unschedulable), which the
-            # phase/count compare cannot see.
-            ssn._dirty_job(job.uid)
-            status.phase = phase
-            status.running = running
-            status.failed = failed
-            status.succeeded = succeeded
-            try:
-                ssn.cache.update_job_status(job)
-            except Exception:
-                # Same policy as open_session's discard path: the close
-                # must finish; the failure is counted.
-                metrics.note_swallowed("job_status_update")
-        else:
-            ssn.cache.record_job_status_event(job)
+    #
+    # Incremental close (doc/INCREMENTAL.md "floors"): after an
+    # incremental snapshot, only the session's touched jobs, the freshly
+    # re-cloned ones, and the jobs whose last close was not provably
+    # silent are walked — every skipped job is bit-unchanged since a
+    # close that observably did nothing, so the event stream, condition
+    # writes, and status pushes are identical to the full walk (the
+    # churn parity gate pins it).  Candidates run in truth (seq) order so
+    # multi-job event interleaving matches the control exactly.
+    from ..models import incremental
+    close_start = time.perf_counter()
+    plan = None
+    if incremental.incremental_enabled():
+        close_plan = getattr(ssn.cache, "close_plan", None)
+        if close_plan is not None:
+            plan = close_plan()
+    walked = 0
+    if plan is None:
+        active = set()
+        for job in ssn.jobs.values():
+            walked += 1
+            if not _close_one_job(ssn, job):
+                active.add(job.uid)
+        if incremental.incremental_enabled():
+            note = getattr(ssn.cache, "note_close_results", None)
+            if note is not None:
+                note(active)
+    else:
+        old_active, recloned, seqmap = plan
+        process = old_active | recloned | set(ssn.mutated_jobs)
+        active = set(old_active)
+        tail = float("inf")
+        for uid in sorted(process, key=lambda u: seqmap.get(u, tail)):
+            job = ssn.jobs.get(uid)
+            if job is None:
+                active.discard(uid)
+                continue
+            walked += 1
+            if _close_one_job(ssn, job):
+                active.discard(uid)
+            else:
+                active.add(uid)
+        ssn.cache.note_close_results(active)
+    metrics.set_close_objects_walked(walked)
+    metrics.set_cycle_floor("close", time.perf_counter() - close_start)
 
     # Publish the cycle's mutation footprint: the dirty-set sizes that
     # bound the next cycle's incremental staging and delta ship.  The

@@ -367,6 +367,37 @@ incremental_generation_reuse = registry.register(Counter(
     f"{SUBSYSTEM}_incremental_generation_reuse_total",
     "Device solves served from (hit) or missing (miss) the "
     "generation-keyed result cache", ("result",)))
+# Residual per-cycle floors (doc/INCREMENTAL.md "Killing the per-cycle
+# floors"): what the last cycle actually paid for each formerly-O(N)
+# stage, so a residual floor is attributable from /metrics without a
+# profiler, and the O(N)-work counters the `make bench-churn` gate
+# asserts scale with dirty objects (a regression that silently
+# re-introduces a full walk fails CI, not just a latency graph).
+cycle_floor_ms = registry.register(Gauge(
+    f"{SUBSYSTEM}_tpu_cycle_floor_ms",
+    "Last cycle's cost of each residual floor stage "
+    "(solve_wait | snapshot | close | occupancy), milliseconds",
+    ("floor",)))
+candidate_solve = registry.register(Counter(
+    f"{SUBSYSTEM}_candidate_solve_total",
+    "Allocate solves by node-axis scope (fired = candidate-row "
+    "prefiltered program; full = whole node bucket)", ("result",)))
+candidate_rows = registry.register(Gauge(
+    f"{SUBSYSTEM}_candidate_solve_rows",
+    "Candidate node rows the last prefiltered solve actually scanned"))
+snapshot_objects = registry.register(Gauge(
+    f"{SUBSYSTEM}_snapshot_objects",
+    "Objects the last cache.snapshot() individually processed (walked) "
+    "vs served from the generation-keyed snapshot map (reused)",
+    ("mode",)))
+close_objects_walked = registry.register(Gauge(
+    f"{SUBSYSTEM}_close_objects_walked",
+    "Jobs the last close_session individually processed (the remainder "
+    "was provably quiet and skipped)"))
+occupancy_rows_rebuilt = registry.register(Gauge(
+    f"{SUBSYSTEM}_occupancy_rows_rebuilt",
+    "Node occupancy (host-port/selector) rows rebuilt by the last "
+    "tensorize; -1 = feature inactive this session"))
 
 
 # Helper API (metrics.go:123-191).
@@ -614,3 +645,55 @@ def generation_reuse_counts() -> Dict[str, int]:
     return {labels[0]: int(v)
             for labels, v in incremental_generation_reuse.values().items()
             if labels}
+
+
+def set_cycle_floor(floor: str, seconds: float) -> None:
+    """Record what the current cycle paid for one residual floor stage
+    (solve_wait | snapshot | close | occupancy)."""
+    cycle_floor_ms.set(round(seconds * 1e3, 3), floor)
+
+
+def cycle_floor_values() -> Dict[str, float]:
+    """{floor: ms} of the last cycle — bench churn artifact + /debug."""
+    return {labels[0]: v for labels, v in cycle_floor_ms.values().items()
+            if labels}
+
+
+def note_candidate_solve(fired: bool, rows: int = 0) -> None:
+    candidate_solve.inc(1.0, "fired" if fired else "full")
+    # Gauge always moves (0 on full solves) so per-cycle readers never
+    # see a stale candidate count from an earlier micro cycle.
+    candidate_rows.set(float(rows))
+
+
+def candidate_solve_counts() -> Dict[str, int]:
+    """{result: count} so far — the check_churn_ab vacuous-gate guard."""
+    return {labels[0]: int(v)
+            for labels, v in candidate_solve.values().items() if labels}
+
+
+def set_snapshot_objects(walked: int, reused: int) -> None:
+    snapshot_objects.set(float(walked), "walked")
+    snapshot_objects.set(float(reused), "reused")
+
+
+def set_close_objects_walked(count: int) -> None:
+    close_objects_walked.set(float(count))
+
+
+def set_occupancy_rows_rebuilt(count: int) -> None:
+    occupancy_rows_rebuilt.set(float(count))
+
+
+def onwork_values() -> Dict[str, float]:
+    """The last cycle's O(N)-work counters in one dict — the bench churn
+    artifact embeds these per round so `make bench-churn` can assert
+    they scale with dirty objects, not cluster size."""
+    out: Dict[str, float] = {}
+    for labels, v in snapshot_objects.values().items():
+        if labels:
+            out[f"snapshot_{labels[0]}"] = v
+    out["close_walked"] = close_objects_walked.value()
+    out["occupancy_rebuilt"] = occupancy_rows_rebuilt.value()
+    out["candidate_rows"] = candidate_rows.value()
+    return out

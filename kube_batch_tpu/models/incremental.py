@@ -188,10 +188,17 @@ def state_for(cache, create: bool = True) -> Optional[IncrementalState]:
 
 def request_full(cache) -> None:
     """Force the next tensorize to run a full rebuild (the scheduler's
-    periodic full-session floor; doc/INCREMENTAL.md 'micro vs full')."""
+    periodic full-session floor; doc/INCREMENTAL.md 'micro vs full').
+    The same floor revalidates the incremental snapshot map and the
+    quiet-close bookkeeping: the next cache.snapshot() runs the full
+    walk, so close_session re-walks every job too — no skip survives
+    more than KUBE_BATCH_TPU_FULL_EVERY cycles unrevalidated."""
     st = state_for(cache)
     if st is not None:
         st.force_full = True
+    req = getattr(cache, "request_full_snapshot", None)
+    if req is not None:
+        req()
 
 
 def note_session_mutations(cache, mutated_jobs: int,
@@ -210,6 +217,33 @@ def plugin_cache_enabled(cache) -> bool:
     env gate: clone identity alone keys validity, so non-pooled caches
     simply never hit (fresh clones every cycle)."""
     return incremental_enabled()
+
+
+def node_open_aggregates(ssn):
+    """The snapshot map's node-open aggregates for this session —
+    (total_allocatable | None, grid_cap, grid_used, shift) — or None
+    when unavailable (control arm, cold map, foreign cache).  Each call
+    returns PRIVATE copies: two GridUsage consumers in one session (e.g.
+    nodeorder + tpu-score) mutate their ``used`` mirrors independently,
+    exactly like two control-path instances (doc/INCREMENTAL.md
+    "floors")."""
+    if not incremental_enabled():
+        return None
+    fn = getattr(ssn.cache, "node_open_aggregates", None)
+    if fn is None:
+        return None
+    return fn()
+
+
+def cluster_total_allocatable(ssn):
+    """Exact-integer cached sum of every session node's allocatable, or
+    None (fractional dimension somewhere / aggregates unavailable): the
+    O(nodes) open walk of drf and proportion, served from the snapshot
+    map.  Each caller gets a private clone (plugins own their total)."""
+    agg = node_open_aggregates(ssn)
+    if agg is None or agg[0] is None:
+        return None
+    return agg[0].clone()
 
 
 class SessionPlan:

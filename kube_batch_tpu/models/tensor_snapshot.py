@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import functools
 import operator
+import time
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
@@ -309,6 +310,29 @@ class TensorCache:
         self.axis: Optional[tuple] = None
         self.jobs: Dict[str, _JobBlock] = {}
         self.pack: Optional[_NodePack] = None
+        # Persistent occupancy matrices (doc/INCREMENTAL.md "floors"):
+        # the host-port / selector resident-occupancy rows, updated in
+        # place for dirty node rows instead of re-walking every resident
+        # each session.  Valid only under occ_key (the compacted
+        # port/selector id sets and pads) and the pack's unchanged node
+        # membership; sessions receive COPIES, so the persistent arrays
+        # are mutated only by the dirty-row patch on the scheduling
+        # thread (same thread model as the rest of the TensorCache).
+        self.occ_key: Optional[tuple] = None
+        # Per-row epoch baseline of the occupancy matrices — their OWN
+        # validity stamp, deliberately not the pack's current-dirty walk:
+        # a session whose feature set skips the occupancy section (or a
+        # tensorize that falls back before reaching it) advances
+        # pack.epochs without patching these rows, and the next
+        # occupancy-active session must treat exactly the rows whose
+        # stamps diverged as dirty.  -1 rows (session-mutated clones)
+        # never match and re-patch every session, like the pack's.
+        self.occ_epochs = None  # np [n_pad] int64
+        # frozen-after: occupancy — direct in-place writes anywhere would
+        # bypass the one sanctioned patch path (_occ_fill_row receives
+        # the row views); rebinding whole matrices is the full rebuild.
+        self.occ_ports = None   # frozen-after: occupancy
+        self.occ_selcnt = None  # frozen-after: occupancy
         self.persistent = False
 
     def sig_id(self, sig: tuple) -> int:
@@ -612,6 +636,25 @@ def stage_node_dyn_row(node, axis, port_index, selectors,
     return row
 
 
+def _occ_fill_row(node, row_ports: np.ndarray, row_sel: np.ndarray,
+                  port_index, matches, np_real: int, ns_real: int) -> None:
+    """One node's occupancy rows from its resident tasks — the exact
+    per-node walk of the full occupancy build, factored so the full
+    rebuild and the persistent dirty-row patch cannot drift (the same
+    contract stage_node_dyn_row documents for the eviction engine)."""
+    if np_real:
+        row_ports[:] = False
+        for rt in node.tasks.values():
+            for pk in _task_port_keys(rt):
+                pid = port_index.get(pk)
+                if pid is not None:
+                    row_ports[pid] = True
+    if ns_real:
+        row_sel[:] = 0
+        for rt in node.tasks.values():
+            row_sel[:ns_real] += matches(rt.pod.metadata.labels)
+
+
 def _fill_node_row(pack: _NodePack, ix: int, node, axis) -> None:
     from ..ops.resources import quantize_columns
     rows = np.stack(_node_row_vectors(node, axis))
@@ -858,6 +901,11 @@ def tensorize_session(ssn) -> TensorSnapshot:
         return getattr(node_objs[ix], "snap_epoch", None)
 
     pack = tc.pack
+    # Exact changed-row set of this session when node membership held
+    # (None on membership change / first build): the pack refresh, the
+    # persistent sig-mask patch, and the persistent occupancy matrices
+    # below all share this one epoch walk.
+    node_dirty_rows = None
     if pack is None or pack.names != node_names:
         # Membership changed (or first session): vectorized full build.
         pack = _build_node_pack(node_objs, node_names, axis)
@@ -880,6 +928,7 @@ def tensorize_session(ssn) -> TensorSnapshot:
         else:
             dirty = _inc._dirty_node_rows(node_names, node_objs,
                                           mutated_nodes, pack)
+        node_dirty_rows = [ix for ix, _ep in dirty]
         if len(dirty) > max(64, n_real // 5):
             epochs = pack.epochs  # keep clean rows' stamps
             pack = _build_node_pack(node_objs, node_names, axis)
@@ -1134,15 +1183,8 @@ def tensorize_session(ssn) -> TensorSnapshot:
     node_ports0 = np.zeros((n_pad, np_pad), bool)
     node_selcnt0 = np.zeros((n_pad, ns_pad), np.int32)
     port_index = {tc.port_list[g]: i for g, i in plocal.items()}
-    if np_real:
-        # Occupancy from resident tasks (only session-relevant keys matter).
-        for nix, node in enumerate(node_objs):
-            for rt in node.tasks.values():
-                for pk in _task_port_keys(rt):
-                    pid = port_index.get(pk)
-                    if pid is not None:
-                        node_ports0[nix, pid] = True
     snap.port_index = port_index
+    matches = None
     if ns_real:
         selectors = [dict(tc.sel_list[g]) for g in used_sel]
         snap.selectors = selectors
@@ -1166,10 +1208,65 @@ def tensorize_session(ssn) -> TensorSnapshot:
         for k, t in enumerate(extras):
             task_match[p_real + k, :ns_real] = matches(
                 t.pod.metadata.labels)
-        for nix, node in enumerate(node_objs):
-            for rt in node.tasks.values():
-                node_selcnt0[nix, :ns_real] += matches(
-                    rt.pod.metadata.labels)
+    if np_real or ns_real:
+        # Persistent occupancy matrices (doc/INCREMENTAL.md "floors"):
+        # the resident-task port/selector occupancy rows are a pure
+        # function of each node's residents and the session's compacted
+        # id sets — residents change only through paths that dirty the
+        # node row (informer epoch or Session.mutated_nodes), so under
+        # an unchanged occ_key only dirty rows re-walk their residents;
+        # an id-set/pad/membership change rebuilds O(residents) once.
+        # Sessions get COPIES (the SolverInputs leaves must not alias
+        # state a later session patches in place).
+        occ_start = time.perf_counter()
+        occ_key = (tuple(used_pg), tuple(used_sel), n_pad, np_pad, ns_pad)
+        persist = tc.persistent and _inc.incremental_enabled()
+        if (persist and tc.occ_key == occ_key
+                and tc.occ_ports is not None
+                and node_dirty_rows is not None
+                and tc.occ_epochs is not None
+                and tc.occ_epochs.shape == pack.epochs.shape):
+            # Rows whose epoch stamp diverged from the occupancy's OWN
+            # baseline (not just this session's pack-dirty set: sessions
+            # that skip this section advance pack.epochs without
+            # patching here).  -1 rows are always dirty.
+            occ_dirty = np.nonzero((tc.occ_epochs != pack.epochs)
+                                   | (pack.epochs < 0))[0]
+            for ix in occ_dirty:
+                if ix >= n_real:
+                    continue
+                _occ_fill_row(node_objs[ix], tc.occ_ports[ix],
+                              tc.occ_selcnt[ix], port_index, matches,
+                              np_real, ns_real)
+            tc.occ_epochs = pack.epochs.copy()
+            occ_rebuilt = int(occ_dirty.size)
+        else:
+            occ_ports = node_ports0
+            occ_selcnt = node_selcnt0
+            if persist:
+                occ_ports = np.zeros((n_pad, np_pad), bool)
+                occ_selcnt = np.zeros((n_pad, ns_pad), np.int32)
+            for nix, node in enumerate(node_objs):
+                _occ_fill_row(node, occ_ports[nix], occ_selcnt[nix],
+                              port_index, matches, np_real, ns_real)
+            occ_rebuilt = n_real
+            if persist:
+                tc.occ_key = occ_key
+                tc.occ_ports = occ_ports
+                tc.occ_selcnt = occ_selcnt
+                tc.occ_epochs = pack.epochs.copy()
+        if persist:
+            node_ports0 = tc.occ_ports.copy()
+            node_selcnt0 = tc.occ_selcnt.copy()
+        from ..metrics.metrics import (set_cycle_floor,
+                                       set_occupancy_rows_rebuilt)
+        set_occupancy_rows_rebuilt(occ_rebuilt)
+        set_cycle_floor("occupancy", time.perf_counter() - occ_start)
+    else:
+        from ..metrics.metrics import (set_cycle_floor,
+                                       set_occupancy_rows_rebuilt)
+        set_occupancy_rows_rebuilt(-1)
+        set_cycle_floor("occupancy", 0.0)
 
     if paff_rows or panti_rows:
         # int32 guard for the device score: the pod-affinity term adds

@@ -455,7 +455,56 @@ def warm_bucket(spec: BucketSpec, cfg=None, family: Sequence[str] = ("auto",),
             round((time.perf_counter() - start) * 1e3, 1)))
     records.append(_warm_evict_batch(spec, cfg, inp_np, inp,
                                      resident=resident))
+    records.append(_warm_candidate(spec, cfg, inp, resident=resident))
     return records
+
+
+def _warm_candidate(spec: BucketSpec, cfg, inp,
+                    resident=None) -> WarmupRecord:
+    """Warm the candidate-row gather+solve (ops/prefilter.py) at the
+    smallest candidate bucket — where micro churn cycles land — so the
+    first prefiltered session never pays its XLA compile live.  When the
+    warm shipper produced a mesh-resident image, the PER-SHARD gather and
+    the sharded solve at the candidate bucket are warmed through the same
+    entry points the live dispatch uses (doc/SHARDING.md)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from .solver import (_gather_candidate_inputs, fetch_result,
+                         solve_allocate)
+
+    cb = bucket(1)
+    key: tuple = ("candidate", spec, cb)
+    start = time.perf_counter()
+    try:
+        if resident is not None:
+            from ..parallel.mesh import default_mesh
+            from ..parallel.sharded_solver import (gather_candidate_sharded,
+                                                   solve_allocate_sharded)
+            mesh = default_mesh()
+            local = np.zeros((mesh.size, cb), np.int32)
+            valid = np.zeros((mesh.size, cb), bool)
+            sub = gather_candidate_sharded(resident, jnp.asarray(local),
+                                           jnp.asarray(valid), mesh)
+            key = solve_key("sharded", sub, cfg)
+            result = solve_allocate_sharded(sub, cfg, mesh)
+        else:
+            idx = np.zeros((cb,), np.int32)
+            valid = np.zeros((cb,), bool)
+            sub = _gather_candidate_inputs(inp, jnp.asarray(idx),
+                                           jnp.asarray(valid))
+            key = solve_key("xla", sub, cfg)
+            result = solve_allocate(sub, cfg)
+        fetch_result(result)
+    except Exception as exc:  # lint: allow-swallow(warmup must never take down boot; failure is recorded in WarmupRecord.error)
+        return WarmupRecord(
+            spec, "candidate", key,
+            round((time.perf_counter() - start) * 1e3, 1),
+            f"{type(exc).__name__}: {exc}")
+    note_warmed(key)
+    return WarmupRecord(
+        spec, "candidate", key,
+        round((time.perf_counter() - start) * 1e3, 1))
 
 
 def _warm_evict_batch(spec: BucketSpec, cfg, inp_np, inp,

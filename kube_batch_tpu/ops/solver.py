@@ -452,16 +452,98 @@ class PendingSolve(NamedTuple):
     (device executing asynchronously) but not fetched.  The action runs
     its host-overlappable apply preparation between ``dispatch_solve``
     and ``fetch_solve`` — the input-pipeline overlap the pipelined
-    session engine is built on (doc/PIPELINE.md)."""
+    session engine is built on (doc/PIPELINE.md).  ``remap`` is set by
+    the candidate-row dispatch (ops/prefilter.py): the packed assignment
+    column then holds candidate-LOCAL rows and fetch_solve scatters them
+    back into full-space node indices."""
     packed: jnp.ndarray  # [4, P]: assignment / kind / order / placed-perm
+    remap: object = None  # np [C_pad] int32 full node row per program row
 
 
-def dispatch_solve(inp: SolverInputs, cfg: SolverConfig) -> PendingSolve:
+@jax.jit
+def _gather_candidate_inputs(inp: SolverInputs, idx: jnp.ndarray,
+                             valid: jnp.ndarray) -> SolverInputs:
+    """Rebucket the node axis to the candidate rows (ascending full-space
+    order, so first-max tie-breaks survive the gather): node-major leaves
+    take rows out of the RESIDENT buffer on device, [S, N] leaves take
+    columns, and padding rows are masked out through node_exists (their
+    data repeats the last real candidate, so downstream math stays
+    well-defined).  Everything replicated (tasks/jobs/queues/cluster,
+    including total_res and score_shift — the DRF denominator and score
+    grid stay full-cluster) passes through untouched."""
+    def take(a):
+        return jnp.take(a, idx, axis=0)
+
+    return inp._replace(
+        node_idle=take(inp.node_idle),
+        node_releasing=take(inp.node_releasing),
+        node_used=take(inp.node_used),
+        node_alloc=take(inp.node_alloc),
+        node_count=take(inp.node_count),
+        node_max_tasks=take(inp.node_max_tasks),
+        node_exists=take(inp.node_exists) & valid,
+        node_ports=take(inp.node_ports),
+        node_selcnt=take(inp.node_selcnt),
+        sig_mask=jnp.take(inp.sig_mask, idx, axis=1),
+        sig_bonus=jnp.take(inp.sig_bonus, idx, axis=1))
+
+
+def _solve_candidates(inp: SolverInputs, cfg: SolverConfig,
+                      candidates) -> SolveResult:
+    """Dispatch the candidate-row program: gather [C] rows from the
+    resident buffer (per shard on the mesh route — the gather follows
+    ``choose_solver_mesh`` exactly like the shipper, so candidate rows
+    never leave their owning device) and run the standard solver on the
+    smaller bucket.  Placement-identical to the full program by the
+    prefilter's exactness argument (ops/prefilter.py) and pinned by the
+    oracle suite (tests/test_cycle_floors.py)."""
+    choice, mesh = choose_solver_mesh(inp)
+    # Same chaos chokepoint as best_solve_allocate: the candidate path is
+    # still a device dispatch and must feed the breaker under injection.
+    plan = chaos_plan.PLAN
+    if plan is not None and plan.fire("solve.device_error"):
+        raise RuntimeError("chaos: device solve dispatch failed (injected)")
+    from ..metrics import metrics
+    from ..trace import spans as trace
+    from .compile_cache import note_solve
+    if choice == "sharded":
+        from ..parallel.sharded_solver import (gather_candidate_sharded,
+                                               solve_allocate_sharded)
+        sub = gather_candidate_sharded(
+            inp, jnp.asarray(candidates.local_idx),
+            jnp.asarray(candidates.local_valid), mesh)
+        metrics.note_route("allocate", "sharded")
+        trace.annotate(route="sharded", mesh_devices=mesh.size,
+                       candidate_rows=candidates.count)
+        note_solve("sharded", sub, cfg)
+        return solve_allocate_sharded(sub, cfg, mesh)
+    # Single chip: the gathered program runs the two-level XLA solve on
+    # every backend (the Pallas kernel keeps the full-bucket layout; all
+    # family members are placement-identical by the parity suite).
+    sub = _gather_candidate_inputs(inp, jnp.asarray(candidates.idx),
+                                   jnp.asarray(candidates.valid))
+    metrics.note_route("allocate", "xla")
+    trace.annotate(route="xla", mesh_devices=1,
+                   candidate_rows=candidates.count)
+    note_solve("xla", sub, cfg)
+    return solve_allocate(sub, cfg)
+
+
+def dispatch_solve(inp: SolverInputs, cfg: SolverConfig,
+                   candidates=None) -> PendingSolve:
     """Route and dispatch the solve without blocking on its result.  All
     solver family members dispatch asynchronously (JAX async dispatch on
-    every backend), so this returns as soon as the programs are enqueued."""
+    every backend), so this returns as soon as the programs are enqueued.
+    ``candidates`` (ops/prefilter.CandidateSet) narrows the node axis to
+    the prefiltered rows; the fetch remaps the result to full space."""
     from ..trace import spans as trace
     with trace.span("solver.dispatch"):
+        if candidates is not None:
+            result = _solve_candidates(inp, cfg, candidates)
+            return PendingSolve(
+                _pack_result_ordered(result.assignment, result.kind,
+                                     result.order),
+                remap=candidates.remap)
         result = best_solve_allocate(inp, cfg)
         return PendingSolve(_pack_result_ordered(result.assignment,
                                                  result.kind, result.order))
@@ -472,7 +554,10 @@ def fetch_solve(pending: PendingSolve):
 
     Returns (assignment, kind, order, ordered) where ``ordered`` is the
     placed task ids in placement order — the device-computed equivalent of
-    ``placed[np.argsort(order[placed], kind="stable")]``."""
+    ``placed[np.argsort(order[placed], kind="stable")]``.  A candidate-row
+    solve's assignment column is scattered back to full-space node rows
+    here (unplaced rows keep -1), so consumers never see program-local
+    indices."""
     import numpy as np
 
     from ..trace import spans as trace
@@ -480,6 +565,10 @@ def fetch_solve(pending: PendingSolve):
         packed = np.asarray(pending.packed)
     packed, _ = _chaos_fetch(packed)
     assignment, kind, order, perm = packed
+    if pending.remap is not None:
+        remap = pending.remap
+        local = np.clip(assignment, 0, len(remap) - 1)
+        assignment = np.where(kind > 0, remap[local], assignment)
     n_placed = int(np.count_nonzero(kind > 0))
     return assignment, kind, order, perm[:n_placed]
 

@@ -63,6 +63,52 @@ def _node_specs():
         score_shift=P(None))
 
 
+@functools.partial(jax.jit, static_argnames=("mesh",))
+def gather_candidate_sharded(inp: SolverInputs, local_idx: jnp.ndarray,
+                             valid: jnp.ndarray, mesh: Mesh) -> SolverInputs:
+    """Per-shard candidate-row gather (ops/prefilter.py): each device
+    takes ITS OWN candidate rows ([n_dev, L] device-local indices) out of
+    its resident node shard — zero cross-device traffic, and the output
+    leaves carry exactly ``_node_specs``' shardings at the smaller
+    n = n_dev * L bucket, so the follow-on ``solve_allocate_sharded``
+    never reshards.  Padding rows repeat a real local row and are masked
+    out through node_exists & valid (the same discipline as the
+    single-chip gather)."""
+    def body(idx, val, n_idle, n_rel, n_used, n_alloc, n_count, n_max,
+             n_exists, n_ports, n_selcnt, s_mask, s_bonus):
+        ix = idx[0]
+
+        def take(a):
+            return jnp.take(a, ix, axis=0)
+
+        return (take(n_idle), take(n_rel), take(n_used), take(n_alloc),
+                take(n_count), take(n_max), take(n_exists) & val[0],
+                take(n_ports), take(n_selcnt),
+                jnp.take(s_mask, ix, axis=1),
+                jnp.take(s_bonus, ix, axis=1))
+
+    n1, n2 = P(NODE_AXIS), P(NODE_AXIS, None)
+    sig = P(None, NODE_AXIS)
+    from .mesh import shard_map_kwargs
+    fn = shard_map(
+        body, mesh=mesh,
+        in_specs=(n2, n2, n2, n2, n2, n2, n1, n1, n1, n2, n2, sig, sig),
+        out_specs=(n2, n2, n2, n2, n1, n1, n1, n2, n2, sig, sig),
+        **shard_map_kwargs())
+    (idle, rel, used, alloc, count, maxt, exists, ports, selcnt,
+     s_mask, s_bonus) = fn(local_idx, valid, inp.node_idle,
+                           inp.node_releasing, inp.node_used,
+                           inp.node_alloc, inp.node_count,
+                           inp.node_max_tasks, inp.node_exists,
+                           inp.node_ports, inp.node_selcnt,
+                           inp.sig_mask, inp.sig_bonus)
+    return inp._replace(
+        node_idle=idle, node_releasing=rel, node_used=used,
+        node_alloc=alloc, node_count=count, node_max_tasks=maxt,
+        node_exists=exists, node_ports=ports, node_selcnt=selcnt,
+        sig_mask=s_mask, sig_bonus=s_bonus)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "mesh"))
 def solve_allocate_sharded(inp: SolverInputs, cfg: SolverConfig,
                            mesh: Mesh) -> SolveResult:

@@ -51,11 +51,19 @@ class DrfPlugin(Plugin):
         attr.share = self._calculate_share(attr.allocated)
 
     def on_session_open(self, ssn) -> None:
-        from ..models.incremental import plugin_cache_enabled
+        from ..models.incremental import (cluster_total_allocatable,
+                                          plugin_cache_enabled)
         reuse = plugin_cache_enabled(ssn.cache)
 
-        for node in ssn.nodes.values():
-            self.total_resource.add(node.allocatable)
+        # Total allocatable from the snapshot map's exact-int running
+        # sum when available (doc/INCREMENTAL.md "floors"); the O(nodes)
+        # walk stays for the control arm and fractional clusters.
+        cached_total = cluster_total_allocatable(ssn)
+        if cached_total is not None:
+            self.total_resource = cached_total
+        else:
+            for node in ssn.nodes.values():
+                self.total_resource.add(node.allocatable)
 
         # Incremental open (doc/INCREMENTAL.md): the per-job allocated
         # aggregate is cached on the job CLONE, so the O(all allocated

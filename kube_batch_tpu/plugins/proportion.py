@@ -53,8 +53,15 @@ class ProportionPlugin(Plugin):
         attr.share = res
 
     def on_session_open(self, ssn) -> None:
-        for node in ssn.nodes.values():
-            self.total_resource.add(node.allocatable)
+        from ..models.incremental import cluster_total_allocatable
+        cached_total = cluster_total_allocatable(ssn)
+        if cached_total is not None:
+            # Snapshot-map running sum (exact-int gated): identical
+            # floats to the walk below (doc/INCREMENTAL.md "floors").
+            self.total_resource = cached_total
+        else:
+            for node in ssn.nodes.values():
+                self.total_resource.add(node.allocatable)
 
         # Aggregate allocated/request per queue (proportion.go:69-99).
         # Incremental open (doc/INCREMENTAL.md): a job clone the

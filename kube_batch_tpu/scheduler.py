@@ -208,6 +208,11 @@ class Scheduler:
             finally:
                 with trace.span("close_session"):
                     close_session(ssn)
+                # Residual-floor attribution on /debug/sessions: what
+                # this cycle paid per formerly-O(N) stage, plus the
+                # O(N)-work counters (doc/INCREMENTAL.md "floors").
+                trace.set_meta(floors=metrics.cycle_floor_values(),
+                               onwork=metrics.onwork_values())
         finally:
             trace.end_session()
             if gc_was_enabled:

@@ -133,9 +133,10 @@ class TestWarmupAndHits:
         w.join(120)
         assert w.done
         # One bucket x (one routed allocate solver + the batched
-        # eviction kernel, which warms alongside the family).
-        assert len(w.records) == 2
-        assert {r.solver for r in w.records} >= {"evict_batch"}
+        # eviction kernel + the candidate-row gather+solve, which warm
+        # alongside the family).
+        assert len(w.records) == 3
+        assert {r.solver for r in w.records} >= {"evict_batch", "candidate"}
         assert w.errors == []
         w.stop()  # after completion: no-op, returns immediately
 

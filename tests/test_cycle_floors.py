@@ -1,0 +1,687 @@
+"""Killing the per-cycle floors (doc/INCREMENTAL.md "floors").
+
+Three invariants, each with its oracle:
+
+* candidate-row solve — the prefiltered [C << N] program is
+  placement-identical to the full-bucket solve AND to the sequential
+  control, across bind/evict/job-update/node-update mutations, homo and
+  hetero signatures, on the single chip and the 8-device mesh;
+* incremental snapshot + close — the generation-keyed snapshot map hands
+  the session dicts bit-identical (content AND order) to a fresh full
+  walk, and the quiet-close skip changes no event/status behavior;
+* persistent occupancy — the in-place-patched host-port/selector
+  matrices equal freshly rebuilt ones.
+"""
+
+import dataclasses as dc
+import os
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu.actions.factory import register_default_actions
+from kube_batch_tpu.actions.tpu_allocate import TpuAllocateAction
+from kube_batch_tpu.api import (Container, ContainerPort, Node, NodeSpec,
+                                NodeStatus, ObjectMeta, Pod, PodSpec,
+                                PodStatus, pod_key)
+from kube_batch_tpu.apis.scheduling import v1alpha1
+from kube_batch_tpu.apis.scheduling.v1alpha1 import GroupNameAnnotationKey
+from kube_batch_tpu.framework import close_session, open_session
+from kube_batch_tpu.metrics import metrics
+from kube_batch_tpu.models import incremental
+from kube_batch_tpu.models.synthetic import (make_synthetic_cache,
+                                             make_synthetic_inputs)
+from kube_batch_tpu.models.tensor_snapshot import tensorize_session
+from kube_batch_tpu.ops import prefilter
+from kube_batch_tpu.ops.solver import (dispatch_solve, fetch_solve,
+                                       refresh_shard_knobs, solve_allocate,
+                                       solve_allocate_stepwise)
+from kube_batch_tpu.plugins.factory import register_default_plugins
+from kube_batch_tpu.scheduler import (DEFAULT_SCHEDULER_CONF,
+                                      load_scheduler_conf)
+
+register_default_actions()
+register_default_plugins()
+
+
+def _tiers():
+    return load_scheduler_conf(DEFAULT_SCHEDULER_CONF)[1]
+
+
+def _echo(cache, binder):
+    podmap = {}
+    for job in cache.jobs.values():
+        for t in job.tasks.values():
+            podmap[pod_key(t.pod)] = t.pod
+    for key, node in sorted(binder.binds.items()):
+        old = podmap.get(key)
+        if old is None:
+            continue
+        new = dc.replace(old, spec=dc.replace(old.spec, node_name=node),
+                         status=PodStatus(phase="Running"))
+        cache.update_pod(old, new)
+    binder.binds.clear()
+    updater = cache.status_updater
+    for pg in updater.pod_groups:
+        cache.add_pod_group(pg)
+    updater.pod_groups.clear()
+
+
+def _cycle(cache, binder, echo=True):
+    ssn = open_session(cache, _tiers())
+    try:
+        TpuAllocateAction().execute(ssn)
+    finally:
+        close_session(ssn)
+    if echo:
+        _echo(cache, binder)
+
+
+def _add_churn_job(cache, tag, n_pods=3, cpu="500m", mem="1Gi",
+                   queue="q0", ports=None, min_member=1):
+    pg = f"churn-{tag}"
+    cache.add_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name=pg, namespace="bench"),
+        spec=v1alpha1.PodGroupSpec(min_member=min_member, queue=queue)))
+    pods = []
+    for i in range(n_pods):
+        pod = Pod(
+            metadata=ObjectMeta(
+                name=f"{pg}-{i}", namespace="bench", uid=f"{pg}-{i}",
+                annotations={GroupNameAnnotationKey: pg},
+                creation_timestamp=1e6 + i),
+            spec=PodSpec(containers=[Container(
+                requests={"cpu": cpu, "memory": mem},
+                ports=list(ports or []))]),
+            status=PodStatus(phase="Pending"))
+        cache.add_pod(pod)
+        pods.append(pod)
+    return pg, pods
+
+
+def _running_task(cache):
+    for uid in sorted(cache.jobs):
+        for tuid in sorted(cache.jobs[uid].tasks):
+            t = cache.jobs[uid].tasks[tuid]
+            if t.node_name:
+                return t
+    raise AssertionError("no running task")
+
+
+# ---------------------------------------------------------------------------
+# 1. Candidate-row solve: prefiltered == full == sequential oracle
+# ---------------------------------------------------------------------------
+
+class _Snap:
+    pass
+
+
+def _snap_of(inp, cfg, p_real):
+    s = _Snap()
+    s.inputs = inp
+    s.config = cfg
+    s.tasks = [None] * p_real
+    return s
+
+
+def _result_tuple(assignment, kind, order):
+    a = np.asarray(assignment)
+    k = np.asarray(kind)
+    o = np.asarray(order)
+    return (np.where(k > 0, a, -1).tolist(), k.tolist(), o.tolist())
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2, 7])
+def test_candidate_solve_matches_full_and_stepwise(seed):
+    """Synthetic-inputs oracle: gather + candidate solve == full
+    two-level solve == the stepwise reference solver."""
+    import jax
+    inp, cfg = make_synthetic_inputs(n_tasks=40, n_nodes=300, n_jobs=6,
+                                     n_queues=2, seed=seed)
+    inp_np = jax.tree.map(np.asarray, inp)
+    p_real = int(np.asarray(inp.job_count).sum())
+    cand = prefilter.derive_candidates(_snap_of(inp_np, cfg, p_real),
+                                       "xla", None)
+    assert cand is not None and cand.count < inp_np.node_idle.shape[0]
+    full = solve_allocate(inp, cfg)
+    step = solve_allocate_stepwise(inp, cfg)
+    pend = dispatch_solve(inp, cfg, candidates=cand)
+    a, k, o, ordered = fetch_solve(pend)
+    want = _result_tuple(full.assignment, full.kind, full.order)
+    assert _result_tuple(step.assignment, step.kind, step.order) == want
+    assert _result_tuple(a, k, o) == want
+    # remapped node rows are full-space and in range
+    placed = np.asarray(k) > 0
+    if placed.any():
+        assert int(np.asarray(a)[placed].max()) \
+            < inp_np.node_idle.shape[0]
+
+
+def test_candidate_solve_matches_on_mesh(monkeypatch):
+    """Per-shard gather through the resident mesh layout: candidate
+    solve == the single-chip full solve, bit for bit."""
+    import jax
+    from kube_batch_tpu.models.shipping import DeviceResidentShipper
+    from kube_batch_tpu.ops.solver import choose_solver_mesh
+
+    monkeypatch.setenv("KUBE_BATCH_TPU_FORCE_SHARD", "1")
+    refresh_shard_knobs()
+    inp, cfg = make_synthetic_inputs(n_tasks=20, n_nodes=400, n_jobs=4,
+                                     n_queues=2, seed=3)
+    inp_np = jax.tree.map(np.asarray, inp)
+    route, mesh = choose_solver_mesh(inp_np)
+    assert route == "sharded"
+    p_real = int(np.asarray(inp.job_count).sum())
+    cand = prefilter.derive_candidates(_snap_of(inp_np, cfg, p_real),
+                                       route, mesh)
+    assert cand is not None and cand.sharded
+    shipper = DeviceResidentShipper()
+    resident = shipper.ship(inp_np, cfg)
+    pend = dispatch_solve(resident, cfg, candidates=cand)
+    a, k, o, _ordered = fetch_solve(pend)
+    full = solve_allocate(inp, cfg)
+    assert _result_tuple(a, k, o) == _result_tuple(
+        full.assignment, full.kind, full.order)
+
+
+MUTATIONS = ["bind_echo", "evict", "job_update", "node_update"]
+
+
+@pytest.mark.parametrize("mutation", MUTATIONS)
+@pytest.mark.parametrize("signatures", [1, 4])
+def test_candidate_e2e_binds_identical(mutation, signatures, monkeypatch):
+    """End-to-end: the same churn schedule run with the prefilter on
+    (incremental) and with the sequential control produces identical
+    binds and events across every mutation path."""
+    def run_arm(inc):
+        monkeypatch.setenv(incremental.INCREMENTAL_ENV,
+                           "1" if inc else "0")
+        cache, binder = make_synthetic_cache(60, 64, 10, 2,
+                                             n_signatures=signatures)
+        fingerprints = []
+        ev_mark = len(cache.events)
+
+        def session():
+            _cycle(cache, binder, echo=False)
+            fingerprints.append(tuple(sorted(binder.binds.items())))
+            _echo(cache, binder)
+
+        session()
+        session()
+        if mutation == "bind_echo":
+            _add_churn_job(cache, "be")
+        elif mutation == "evict":
+            cache.evict(_running_task(cache), "preempted")
+        elif mutation == "job_update":
+            t = _running_task(cache)
+            new = dc.replace(t.pod, spec=dc.replace(
+                t.pod.spec,
+                containers=[Container(requests={"cpu": "250m",
+                                                "memory": "512Mi"})]))
+            cache.update_pod(t.pod, new)
+        elif mutation == "node_update":
+            name = sorted(cache.nodes)[0]
+            node = cache.nodes[name].node
+            alloc = {"cpu": "32", "memory": "128Gi", "pods": 200}
+            cache.update_node(node, dc.replace(
+                node, status=NodeStatus(allocatable=dict(alloc),
+                                        capacity=dict(alloc))))
+        for _ in range(3):
+            _add_churn_job(cache, f"r{len(fingerprints)}", n_pods=2)
+            session()
+        return fingerprints, list(cache.events)[ev_mark:]
+
+    cand0 = metrics.candidate_solve_counts().get("fired", 0)
+    f_ctl, e_ctl = run_arm(False)
+    f_inc, e_inc = run_arm(True)
+    assert f_ctl == f_inc
+    assert e_ctl == e_inc
+    # the incremental arm must have exercised the prefilter at least once
+    assert metrics.candidate_solve_counts().get("fired", 0) > cand0
+
+
+def test_prefilter_host_mirrors_equal_device_math():
+    """The prefilter's host fit/score mirrors are exactness-load-bearing
+    (the candidate proof needs the TRUE device ranking): pin them
+    value-identical to ops.solver._unrolled_le and ops.scoring.grid_score
+    on adversarial inputs, so a drift in either breaks here instead of
+    silently mis-ranking candidates (they are a deliberate numpy copy of
+    the same math models/scanner._scores_numpy mirrors)."""
+    import jax.numpy as jnp
+    from kube_batch_tpu.ops.resources import EPS_QUANTA
+    from kube_batch_tpu.ops.scoring import ScoreWeights, shifted_caps, \
+        grid_score
+    from kube_batch_tpu.ops.solver import _unrolled_le
+
+    rng = np.random.default_rng(5)
+    n, r = 64, 3
+    mat = rng.integers(0, 40, size=(n, r)).astype(np.int32)
+    # adversarial epsilon band: requests straddling mat +- EPS_QUANTA
+    for req in ([0, 0, 0], [9, 10, 11], [39, 40, 41], [5, 0, EPS_QUANTA]):
+        req = np.asarray(req, np.int64)
+        host = prefilter._fit_rows(req, mat)
+        dev = np.asarray(_unrolled_le(jnp.asarray(req, jnp.int32),
+                                      jnp.asarray(mat), r))
+        assert np.array_equal(host, dev), req
+    used = rng.integers(0, 1 << 20, size=(n, 2)).astype(np.int32)
+    alloc = rng.integers(1, 1 << 21, size=(n, 2)).astype(np.int32)
+    alloc[0] = 0  # zero-cap branch
+    shift = np.asarray([3, 7], np.int32)
+    for weights in (ScoreWeights(), ScoreWeights(1, 2, 3),
+                    ScoreWeights(0, 1, 0)):
+        res = rng.integers(0, 1 << 10, size=(2,)).astype(np.int64)
+        host = prefilter._grid_score_rows(res, used, alloc, shift, weights)
+        cs, den = shifted_caps(jnp.asarray(alloc), jnp.asarray(shift))
+        dev = np.asarray(grid_score(jnp.asarray(res, jnp.int32),
+                                    jnp.asarray(used), jnp.asarray(shift),
+                                    cs, den, weights))
+        assert np.array_equal(host, dev.astype(np.int64)), weights
+
+
+def test_cleanup_pop_feeds_snapshot_map():
+    """process_cleanup_jobs removing a job from truth is a mutation the
+    incremental snapshot map must see (a stale deleted_jobs entry can
+    pop a same-key re-created job; the control stops scheduling it
+    immediately, so the map must too)."""
+    cache, binder = make_synthetic_cache(30, 8, 5, 2)
+    _cycle(cache, binder)
+    _cycle(cache, binder)
+    uid = sorted(cache.jobs)[0]
+    pg_name = uid.split("/", 1)[1]
+    pods = [t.pod for t in cache.jobs[uid].tasks.values()]
+    # PodGroup deleted while pods exist -> queued on deleted_jobs
+    cache.delete_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name=pg_name, namespace="bench"),
+        spec=v1alpha1.PodGroupSpec(min_member=1)))
+    assert cache.deleted_jobs
+    # pods go away -> inline removal; the deleted_jobs entry goes stale
+    for p in pods:
+        cache.delete_pod(p)
+    # same-key re-creation enters the map
+    cache.add_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name=pg_name, namespace="bench"),
+        spec=v1alpha1.PodGroupSpec(min_member=1, queue="q0")))
+    for p in pods:
+        cache.add_pod(dc.replace(
+            p, spec=dc.replace(p.spec, node_name=""),
+            status=PodStatus(phase="Pending")))
+    _assert_snapshot_matches_control(cache, "recreated")
+    # the stale entry pops the live re-created job (reference semantics)
+    cache.process_cleanup_jobs()
+    assert uid not in cache.jobs
+    _assert_snapshot_matches_control(cache, "after cleanup pop")
+
+
+def test_candidate_env_gate_disables(monkeypatch):
+    monkeypatch.setenv(prefilter.CANDIDATE_SOLVE_ENV, "0")
+    inp, cfg = make_synthetic_inputs(n_tasks=20, n_nodes=200, seed=0)
+    import jax
+    inp_np = jax.tree.map(np.asarray, inp)
+    assert prefilter.derive_candidates(
+        _snap_of(inp_np, cfg, 20), "xla", None) is None
+
+
+def test_candidate_stands_down_on_dynamic_predicates():
+    """Host ports / pod affinity make untouched-node scores
+    occupancy-dependent: the prefilter must not rank under them."""
+    inp, cfg = make_synthetic_inputs(n_tasks=20, n_nodes=200, seed=0)
+    import jax
+    inp_np = jax.tree.map(np.asarray, inp)
+    for flag in ("has_ports", "has_pod_affinity", "has_pod_affinity_score"):
+        assert prefilter.derive_candidates(
+            _snap_of(inp_np, cfg._replace(**{flag: True}), 20),
+            "xla", None) is None
+
+
+# ---------------------------------------------------------------------------
+# 2. Incremental snapshot: map == fresh full walk (content AND order)
+# ---------------------------------------------------------------------------
+
+def _control_snapshot(cache):
+    """A fresh full walk of the SAME cache with the map detached — the
+    INCREMENTAL=0 control."""
+    saved_state = cache._snap_state
+    cache._snap_state = None
+    prev = os.environ.get(incremental.INCREMENTAL_ENV)
+    os.environ[incremental.INCREMENTAL_ENV] = "0"
+    ev_mark = len(cache.events)
+    try:
+        info = cache.snapshot()
+    finally:
+        if prev is None:
+            os.environ.pop(incremental.INCREMENTAL_ENV, None)
+        else:
+            os.environ[incremental.INCREMENTAL_ENV] = prev
+        cache._snap_state = saved_state
+    return info, list(cache.events)[ev_mark:]
+
+
+def _assert_snapshot_matches_control(cache, ctx=""):
+    ev_mark = len(cache.events)
+    inc = cache.snapshot()
+    inc_events = list(cache.events)[ev_mark:]
+    ctl, ctl_events = _control_snapshot(cache)
+    assert list(inc.nodes) == list(ctl.nodes), ctx     # order included
+    assert list(inc.jobs) == list(ctl.jobs), ctx
+    assert list(inc.queues) == list(ctl.queues), ctx
+    for name in ctl.nodes:
+        assert inc.nodes[name] is ctl.nodes[name], (ctx, name)
+    for uid in ctl.jobs:
+        assert inc.jobs[uid] is ctl.jobs[uid], (ctx, uid)
+        assert inc.jobs[uid].priority == ctl.jobs[uid].priority
+    assert inc_events == ctl_events, ctx
+    return inc
+
+
+def test_incremental_snapshot_matches_full_walk():
+    cache, binder = make_synthetic_cache(60, 16, 10, 2)
+    _cycle(cache, binder)
+    _cycle(cache, binder)
+    _assert_snapshot_matches_control(cache, "settled")
+
+    # informer churn: new job + node update + pod delete
+    _add_churn_job(cache, "a")
+    name = sorted(cache.nodes)[0]
+    node = cache.nodes[name].node
+    alloc = {"cpu": "32", "memory": "128Gi", "pods": 200}
+    cache.update_node(node, dc.replace(
+        node, status=NodeStatus(allocatable=dict(alloc),
+                                capacity=dict(alloc))))
+    _assert_snapshot_matches_control(cache, "churned")
+
+    # delete + re-add a node: the truth dict moves it to the END; the
+    # seq discipline must reorder the map identically.
+    victim = sorted(cache.nodes)[2]
+    vnode = cache.nodes[victim].node
+    cache.delete_node(vnode)
+    _assert_snapshot_matches_control(cache, "node deleted")
+    cache.add_node(vnode)
+    _assert_snapshot_matches_control(cache, "node re-added")
+
+    # delete + re-add a job (same uid): same reorder discipline
+    uid = sorted(cache.jobs)[0]
+    pods = [t.pod for t in cache.jobs[uid].tasks.values()]
+    pg_name = uid.split("/", 1)[1]
+    for p in pods:
+        cache.delete_pod(p)
+    cache.delete_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name=pg_name, namespace="bench"),
+        spec=v1alpha1.PodGroupSpec(min_member=1)))
+    _assert_snapshot_matches_control(cache, "job deleted")
+    cache.add_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name=pg_name, namespace="bench"),
+        spec=v1alpha1.PodGroupSpec(min_member=1, queue="q0")))
+    for p in pods:
+        cache.add_pod(dc.replace(
+            p, spec=dc.replace(p.spec, node_name=""),
+            status=PodStatus(phase="Pending")))
+    _assert_snapshot_matches_control(cache, "job re-added")
+
+
+def test_incremental_snapshot_o_dirty():
+    """A micro cycle's snapshot walks the dirty objects, not the
+    cluster; the counters prove it."""
+    cache, binder = make_synthetic_cache(120, 32, 12, 2)
+    _cycle(cache, binder)
+    _cycle(cache, binder)
+    _cycle(cache, binder)
+    total = len(cache.nodes) + len(cache.jobs)
+    _add_churn_job(cache, "tiny", n_pods=1)
+    cache.snapshot()
+    vals = {k: int(v) for k, v in
+            (("walked", metrics.snapshot_objects.value("walked")),
+             ("reused", metrics.snapshot_objects.value("reused")))}
+    assert 0 < vals["walked"] < total / 4, vals
+    assert vals["reused"] > total / 2, vals
+
+
+def test_priority_class_change_forces_full_walk():
+    """PriorityClass changes bump no job epoch: the map must fall back
+    to the full walk so clean clones' priorities re-resolve."""
+    class PC:
+        def __init__(self, name, value, default=False):
+            self.metadata = ObjectMeta(name=name)
+            self.value = value
+            self.global_default = default
+
+    cache, binder = make_synthetic_cache(30, 8, 5, 2)
+    _cycle(cache, binder)
+    cache.snapshot()
+    cache.add_priority_class(PC("gold", 77, default=True))
+    info = cache.snapshot()  # must be a full walk with new priorities
+    walked = int(metrics.snapshot_objects.value("walked"))
+    assert walked == len(cache.nodes) + len(cache.jobs)
+    assert all(j.priority == 77 for j in info.jobs.values())
+    # and the map is consistent again afterwards
+    _assert_snapshot_matches_control(cache, "after pc change")
+
+
+def test_no_spec_job_events_replayed():
+    """A job without PodGroup/PDB emits one FailedScheduling event per
+    snapshot in the control; the incremental walk must replay it."""
+    cache, binder = make_synthetic_cache(30, 8, 5, 2)
+    _cycle(cache, binder)
+    # a bare pod of our scheduler with an explicit (but absent) group
+    pod = Pod(metadata=ObjectMeta(
+        name="orphan", namespace="bench", uid="orphan",
+        annotations={GroupNameAnnotationKey: "missing-pg"},
+        creation_timestamp=5e6),
+        spec=PodSpec(containers=[Container(
+            requests={"cpu": "100m", "memory": "128Mi"})]),
+        status=PodStatus(phase="Pending"))
+    cache.add_pod(pod)
+    # JobInfo exists but has no pod_group object -> no-spec path
+    _assert_snapshot_matches_control(cache, "orphan added")
+    _assert_snapshot_matches_control(cache, "orphan steady")
+    ev_mark = len(cache.events)
+    cache.snapshot()
+    replays = [e for e in list(cache.events)[ev_mark:]
+               if e[0] == "FailedScheduling" and "PodGroup" in e[2]]
+    assert replays, "no-spec event not replayed on the incremental walk"
+
+
+# ---------------------------------------------------------------------------
+# 3. Incremental close: quiet-skip == full walk
+# ---------------------------------------------------------------------------
+
+def test_close_parity_with_sticky_pending_job(monkeypatch):
+    """A PDB-free gang job that cannot place keeps emitting
+    Unschedulable events every close; the quiet-skip machinery must
+    keep re-processing it while skipping settled jobs — event streams
+    identical to the control."""
+    def run_arm(inc):
+        monkeypatch.setenv(incremental.INCREMENTAL_ENV,
+                           "1" if inc else "0")
+        cache, binder = make_synthetic_cache(40, 8, 6, 2)
+        _cycle(cache, binder)
+        _cycle(cache, binder)
+        # a gang that can never place: absurd request
+        _add_churn_job(cache, "hog", n_pods=2, cpu="4000",
+                       mem="4000Gi", min_member=2)
+        ev_mark = len(cache.events)
+        conds_mark = len(cache.status_updater.pod_conditions)
+        for _ in range(3):
+            _cycle(cache, binder)
+        return (list(cache.events)[ev_mark:],
+                cache.status_updater.pod_conditions[conds_mark:])
+
+    e_ctl, c_ctl = run_arm(False)
+    e_inc, c_inc = run_arm(True)
+    assert e_ctl == e_inc
+    assert c_ctl == c_inc
+    assert any(e[0] == "Unschedulable" for e in e_ctl)
+
+
+def test_close_walk_is_o_touched():
+    cache, binder = make_synthetic_cache(120, 16, 12, 2)
+    _cycle(cache, binder)
+    _cycle(cache, binder)
+    _cycle(cache, binder)
+    _add_churn_job(cache, "one", n_pods=1)
+    _cycle(cache, binder)
+    walked = int(metrics.close_objects_walked.value())
+    assert 0 < walked < len(cache.jobs) / 2, walked
+
+
+def test_full_floor_revalidates_snapshot_and_close():
+    """request_full (the KUBE_BATCH_TPU_FULL_EVERY floor) must force the
+    next snapshot AND close back to the full walk."""
+    cache, binder = make_synthetic_cache(40, 8, 6, 2)
+    _cycle(cache, binder)
+    _cycle(cache, binder)
+    incremental.request_full(cache)
+    _cycle(cache, binder)
+    assert int(metrics.snapshot_objects.value("walked")) \
+        == len(cache.nodes) + len(cache.jobs)
+    assert int(metrics.close_objects_walked.value()) >= len(cache.jobs)
+
+
+# ---------------------------------------------------------------------------
+# 4. Persistent occupancy matrices
+# ---------------------------------------------------------------------------
+
+def _oracle_snapshot(ssn):
+    """From-scratch tensorize of the SAME session (control path)."""
+    cache = ssn.cache
+    saved = {}
+    for attr in ("_tensor_cache", "_inc_state", "_ship_cache"):
+        if hasattr(cache, attr):
+            saved[attr] = getattr(cache, attr)
+            delattr(cache, attr)
+    prev = os.environ.get(incremental.INCREMENTAL_ENV)
+    os.environ[incremental.INCREMENTAL_ENV] = "0"
+    try:
+        return tensorize_session(ssn)
+    finally:
+        if prev is None:
+            os.environ.pop(incremental.INCREMENTAL_ENV, None)
+        else:
+            os.environ[incremental.INCREMENTAL_ENV] = prev
+        for attr in ("_tensor_cache", "_inc_state", "_ship_cache"):
+            if hasattr(cache, attr):
+                delattr(cache, attr)
+        for attr, value in saved.items():
+            setattr(cache, attr, value)
+
+
+def test_occupancy_in_place_equals_rebuilt():
+    """Across churn with host-port pods resident, the persistent
+    occupancy matrices patched in place equal a fresh O(residents)
+    rebuild, and micro cycles patch only the dirty rows."""
+    cache, binder = make_synthetic_cache(40, 8, 6, 2)
+    ports = [ContainerPort(host_port=7777, protocol="TCP")]
+    _add_churn_job(cache, "p0", n_pods=1, cpu="100m", mem="128Mi",
+                   ports=ports)
+    _cycle(cache, binder)
+    _cycle(cache, binder)
+    # keep a port-using pod PENDING forever (unplaceable request, same
+    # port key as the resident p0 pod) so has_ports stays active and the
+    # resident occupancy actually matters, and churn a plain job so a
+    # micro cycle patches rows
+    _add_churn_job(cache, "p1", n_pods=1, cpu="4000", mem="4000Gi",
+                   ports=[ContainerPort(host_port=7777, protocol="TCP")])
+    _cycle(cache, binder)
+    _add_churn_job(cache, "plain", n_pods=2)
+    ssn = open_session(cache, _tiers())
+    try:
+        snap_inc = tensorize_session(ssn)
+        rebuilt = int(metrics.occupancy_rows_rebuilt.value())
+        assert 0 <= rebuilt < len(cache.nodes), rebuilt
+        snap_ctl = _oracle_snapshot(ssn)
+        assert not snap_inc.needs_fallback
+        assert np.array_equal(np.asarray(snap_inc.inputs.node_ports),
+                              np.asarray(snap_ctl.inputs.node_ports))
+        assert np.array_equal(np.asarray(snap_inc.inputs.node_selcnt),
+                              np.asarray(snap_ctl.inputs.node_selcnt))
+        # session leaves must not alias the persistent matrices
+        tc = cache._tensor_cache
+        assert snap_inc.inputs.node_ports is not tc.occ_ports
+    finally:
+        close_session(ssn)
+
+
+def test_occupancy_gauge_inactive_without_features():
+    cache, binder = make_synthetic_cache(20, 8, 4, 2)
+    _cycle(cache, binder)
+    assert int(metrics.occupancy_rows_rebuilt.value()) == -1
+
+
+def test_node_open_aggregates_match_control(monkeypatch):
+    """The snapshot map's node-open aggregates (total allocatable +
+    GridUsage entries + shift) equal a fresh control walk after node
+    update/delete churn, bit for bit."""
+    from kube_batch_tpu.api.resource import Resource
+    from kube_batch_tpu.plugins.nodeorder import GridUsage
+
+    cache, binder = make_synthetic_cache(40, 12, 6, 2)
+    _cycle(cache, binder)
+    _cycle(cache, binder)
+    name = sorted(cache.nodes)[1]
+    node = cache.nodes[name].node
+    alloc = {"cpu": "32", "memory": "128Gi", "pods": 200}
+    cache.update_node(node, dc.replace(
+        node, status=NodeStatus(allocatable=dict(alloc),
+                                capacity=dict(alloc))))
+    cache.delete_node(cache.nodes[sorted(cache.nodes)[2]].node)
+    _add_churn_job(cache, "agg", n_pods=2)
+    _cycle(cache, binder)
+
+    ssn = open_session(cache, _tiers())
+    try:
+        agg = cache.node_open_aggregates()
+        assert agg is not None
+        total, cap, used, shift = agg
+        monkeypatch.setenv(incremental.INCREMENTAL_ENV, "0")
+        ctl = GridUsage(ssn)  # control path: the accessor is gated off
+        assert cap == ctl.cap
+        assert used == ctl.used
+        assert shift == ctl.shift
+        walk = Resource.empty()
+        for n in ssn.nodes.values():
+            walk.add(n.allocatable)
+        assert total.milli_cpu == walk.milli_cpu
+        assert total.memory == walk.memory
+        assert total.scalar_resources == walk.scalar_resources
+    finally:
+        close_session(ssn)
+
+
+def test_fractional_allocatable_disables_total_only():
+    """A node with a non-integer allocatable dimension voids the cached
+    total (float re-association risk) but keeps serving the integer
+    grid entries."""
+    from kube_batch_tpu.models.incremental import cluster_total_allocatable
+
+    cache, binder = make_synthetic_cache(20, 6, 4, 2)
+    _cycle(cache, binder)
+    cache.add_node(Node(
+        metadata=ObjectMeta(name="frac-node", uid="frac-node"),
+        spec=NodeSpec(),
+        status=NodeStatus(allocatable={"cpu": "1", "memory": "0.5",
+                                       "pods": 10},
+                          capacity={"cpu": "1", "memory": "0.5",
+                                    "pods": 10})))
+    ssn = open_session(cache, _tiers())
+    try:
+        assert cluster_total_allocatable(ssn) is None
+        agg = cache.node_open_aggregates()
+        assert agg is not None and agg[0] is None
+        assert "frac-node" in agg[1]
+    finally:
+        close_session(ssn)
+
+
+# ---------------------------------------------------------------------------
+# 5. Floors observability
+# ---------------------------------------------------------------------------
+
+def test_cycle_floor_metrics_populate():
+    cache, binder = make_synthetic_cache(30, 8, 5, 2)
+    _cycle(cache, binder)
+    floors = metrics.cycle_floor_values()
+    for key in ("solve_wait", "snapshot", "close", "occupancy"):
+        assert key in floors, floors
+    onwork = metrics.onwork_values()
+    for key in ("snapshot_walked", "snapshot_reused", "close_walked",
+                "occupancy_rebuilt", "candidate_rows"):
+        assert key in onwork, onwork

@@ -61,8 +61,18 @@ def test_contract_annotations_cover_the_known_invariants():
         "VictimIndex guarded-by coverage shrank: "
         f"{[str(m) for m in vindex_guarded]}")
     frozen = {m.detail for m in by_kind.get("frozen-after", [])}
-    assert {"ship", "scores"} <= frozen, \
+    assert {"ship", "scores", "occupancy"} <= frozen, \
         f"frozen-after coverage shrank: {sorted(frozen)}"
+    # The incremental snapshot map's cache-side state (seq counter +
+    # _SnapState handle) stays under the cache mutex: losing these
+    # annotations silently exempts the informer-thread dirty feeds from
+    # rule 1 (doc/INCREMENTAL.md "floors").
+    cache_guarded = [m for m in by_kind.get("guarded-by", [])
+                     if m.path.replace("\\", "/").endswith(
+                         "cache/cache.py")]
+    assert len(cache_guarded) >= 12, (
+        "SchedulerCache guarded-by coverage shrank: "
+        f"{[str(m) for m in cache_guarded]}")
     # The flight recorder's ring fields (trace/recorder.py) stay under
     # lock discipline: losing these annotations silently exempts the
     # recorder from rule 1 while /debug readers race end_session.

@@ -42,16 +42,23 @@ def main() -> int:
               file=sys.stderr)
         return 1
     micro_total = 0
+    fired_total = 0
+    shard_leg = None
     print("incremental churn sweep: parity OK at every level")
     for label, rec in sweep.items():
         kinds = rec.get("kinds") or {}
+        cand = rec.get("candidate") or {}
         micro_total += kinds.get("micro", 0)
-        print(f"  churn {label:>5s}  incremental {rec['incremental_ms']:8.1f} ms"
+        fired_total += cand.get("fired", 0)
+        if "@shard" in label:
+            shard_leg = rec
+        print(f"  churn {label:>11s}  incremental {rec['incremental_ms']:8.1f} ms"
               f"   control {rec['control_ms']:8.1f} ms"
               f"   ({rec.get('speedup')}x, "
               f"{rec.get('sessions_per_sec')} sessions/s vs "
               f"{rec.get('control_sessions_per_sec')}; kinds {kinds}, "
-              f"reuse {rec.get('generation_reuse')})")
+              f"reuse {rec.get('generation_reuse')}, candidate {cand}, "
+              f"floors {rec.get('floors_ms')})")
         if rec.get("parity") is not True:
             print(f"check_churn_ab: level {label} lost parity",
                   file=sys.stderr)
@@ -63,9 +70,58 @@ def main() -> int:
                   "(event ring overflowed; binds-only comparison — "
                   "raise the ring or lower BENCH_CHURN_ROUNDS)",
                   file=sys.stderr)
+        # O(N)-work regression guard (doc/INCREMENTAL.md "floors"): on
+        # micro cycles the snapshot/close walks must scale with dirty
+        # objects, not cluster size — a change that silently
+        # re-introduces a full walk fails here, not in a latency graph.
+        onwork = rec.get("onwork") or {}
+        if kinds.get("micro", 0) > 0 and onwork:
+            objects = onwork.get("objects_total") or 0
+            jobs = onwork.get("jobs_total") or 0
+            nodes = onwork.get("nodes_total") or 0
+            snap_max = onwork.get("micro_snapshot_walked_max")
+            close_max = onwork.get("micro_close_walked_max")
+            occ_max = onwork.get("micro_occupancy_rebuilt_max")
+            if snap_max is not None and objects and \
+                    snap_max > objects / 2:
+                print(f"check_churn_ab: level {label} micro snapshot "
+                      f"walked {snap_max}/{objects} objects — the "
+                      "O(dirty) snapshot walk regressed to a full walk",
+                      file=sys.stderr)
+                return 1
+            if close_max is not None and jobs and close_max > jobs / 2:
+                print(f"check_churn_ab: level {label} micro close "
+                      f"walked {close_max}/{jobs} jobs — the O(touched) "
+                      "close walk regressed to a full walk",
+                      file=sys.stderr)
+                return 1
+            if occ_max is not None and occ_max >= 0 and nodes and \
+                    occ_max > nodes / 2:
+                print(f"check_churn_ab: level {label} micro occupancy "
+                      f"rebuilt {occ_max}/{nodes} rows — the in-place "
+                      "occupancy update regressed to a full rebuild",
+                      file=sys.stderr)
+                return 1
     if micro_total == 0:
         print("check_churn_ab: the incremental arm never ran a micro "
               "session — the A/B compared two control arms",
+              file=sys.stderr)
+        return 1
+    if fired_total == 0:
+        print("check_churn_ab: no candidate-row solve fired anywhere in "
+              "the sweep — the prefilter parity gate is vacuous "
+              "(ops/prefilter.py stood down every micro cycle)",
+              file=sys.stderr)
+        return 1
+    if shard_leg is None:
+        print("check_churn_ab: the sweep carries no @shard leg — the "
+              "prefilter's mesh parity was not exercised (run with "
+              ">1 device: XLA_FLAGS=--xla_force_host_platform_device_"
+              "count=8)", file=sys.stderr)
+        return 1
+    if (shard_leg.get("candidate") or {}).get("fired", 0) == 0:
+        print("check_churn_ab: the @shard leg never fired a candidate-"
+              "row solve — the per-shard gather parity is unexercised",
               file=sys.stderr)
         return 1
     return 0

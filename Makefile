@@ -3,8 +3,15 @@ PYTHON ?= python
 COMPILE_CACHE ?= $(CURDIR)/.compile-cache
 
 .PHONY: lint lint-inventory test bench bench-cached bench-steady \
-	bench-evict bench-churn bench-shard chaos chaos-smoke trace-demo \
-	clean-cache
+	bench-evict bench-churn bench-shard bench-gate bench-gate-baseline \
+	lineage-ab chaos chaos-smoke trace-demo clean-cache
+
+# The bench-gate shape: small enough for CI, big enough that the steady
+# path, delta shipping, and the residual floors all exercise (mirrors
+# bench-steady).  One definition so the gate and its baseline can never
+# drift onto different shapes.
+GATE_ENV = env JAX_PLATFORMS=cpu BENCH_STEADY_ONLY=1 BENCH_STEADY_ROUNDS=8 \
+	BENCH_TASKS=2000 BENCH_NODES=256 BENCH_JOBS=80 BENCH_QUEUES=4
 
 # graftlint: the repo's contract-enforcing static analysis (doc/LINT.md)
 # — lock discipline, donation safety, tracer hygiene, ship/no-mutate
@@ -89,6 +96,34 @@ bench-shard:
 		BENCH_JOBS=80 BENCH_QUEUES=4 \
 		KUBE_BATCH_TPU_SCAN_MIN_NODES=0 $(PYTHON) bench.py \
 		| $(PYTHON) tools/check_shard_ab.py
+
+# Continuous perf-regression gate (doc/OBSERVABILITY.md "The bench
+# gate"): run the steady bench at the pinned gate shape, diff the
+# artifact against the committed doc/BENCH_BASELINE.json under the
+# per-key median + noise-band rules, append this run to the
+# machine-readable doc/BENCH_TRAJECTORY.jsonl, and write the comparison
+# report CI uploads as an artifact.  bench_compare exits nonzero on any
+# gated-key regression (bench.py itself always exits 0), so a floor
+# regression fails the PR instead of being discovered by a reviewer.
+bench-gate:
+	$(GATE_ENV) $(PYTHON) bench.py | $(PYTHON) tools/bench_compare.py \
+		--baseline doc/BENCH_BASELINE.json \
+		--trajectory doc/BENCH_TRAJECTORY.jsonl \
+		--report doc/bench_gate_report.json
+
+# (Re)measure the committed baseline on THIS box (run on a quiet
+# machine; commit the refreshed doc/BENCH_BASELINE.json deliberately).
+bench-gate-baseline:
+	$(GATE_ENV) $(PYTHON) bench.py | $(PYTHON) tools/bench_compare.py \
+		--baseline doc/BENCH_BASELINE.json --update-baseline
+
+# Pod-lineage overhead A/B (doc/OBSERVABILITY.md "Pod lineage"):
+# counterbalanced OFF/ON/ON/OFF steady rounds with the SLO layer
+# toggled through its kill switch — the ≤1% overhead budget check.
+lineage-ab:
+	env JAX_PLATFORMS=cpu BENCH_LINEAGE_AB=1 BENCH_STEADY_ROUNDS=8 \
+		BENCH_TASKS=2000 BENCH_NODES=256 BENCH_JOBS=80 \
+		BENCH_QUEUES=4 $(PYTHON) bench.py
 
 # Chaos soak (doc/CHAOS.md): seeded fault storms at every injection site
 # vs the fault-free convergence oracle — the loop must survive 100% of

@@ -58,7 +58,8 @@ rounds per arm, default 6).
 Env overrides: BENCH_TASKS, BENCH_NODES, BENCH_JOBS, BENCH_QUEUES;
 BENCH_PIPELINE=0 skips the 4-action scenario, BENCH_COLD_N (default 5);
 BENCH_STEADY_ONLY=1, BENCH_STEADY_ROUNDS (default 5); BENCH_EVICT_AB=1;
-BENCH_CHURN_SWEEP=1, BENCH_CHURN_ROUNDS (default 6);
+BENCH_CHURN_SWEEP=1, BENCH_CHURN_ROUNDS (default 6); BENCH_LINEAGE_AB=1
+(counterbalanced pod-lineage overhead A/B, `make lineage-ab`);
 BENCH_PROBE_TIMEOUT (s, default 150), BENCH_PROBE_BACKOFF (s, default
 2 — the probe retries once after this backoff), BENCH_DEADLINE (s,
 default 5400 — wall-clock backstop that emits whatever was measured and
@@ -262,7 +263,8 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
             updater.pod_groups.clear()
         return len(binds)
 
-    from kube_batch_tpu.metrics.metrics import (overlap_split_totals,
+    from kube_batch_tpu.metrics.metrics import (cycle_floor_values,
+                                                overlap_split_totals,
                                                 route_counts, ship_counts,
                                                 ship_shard_counts)
 
@@ -277,6 +279,7 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
         round_wall = []
         host_overlap = []
         device_wait = []
+        floors_rounds = []
         ship0 = ship_counts()
         shard0 = ship_shard_counts()
         routes0 = route_counts()
@@ -330,6 +333,7 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
             h0, w0, _ = overlap_split_totals()
             steady.append(session_ms())
             h1, w1, _ = overlap_split_totals()
+            floors_rounds.append(cycle_floor_values())
             echo()
             retire.append((pgs, new_keys))
             host_overlap.append(h1 - h0)
@@ -386,8 +390,58 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
                     ((k, routes1.get(k, 0) - routes0.get(k, 0))
                      for k in routes1) if v} or None),
         "phase_ms": phase_ms,
+        # Residual per-cycle floors over the steady window (median per
+        # floor): the trajectory key `make bench-gate` compares across
+        # PRs (doc/OBSERVABILITY.md "The bench gate").
+        "floors_ms": ({floor: round(statistics.median(
+                           [f.get(floor, 0.0) for f in floors_rounds[1:]]),
+                           3)
+                       for floor in floors_rounds[-1]}
+                      if len(floors_rounds) > 1 and floors_rounds[-1]
+                      else None),
     }
     return round(cold, 1), steady[1:], stats
+
+
+def _fill_lineage_ab(out, n_tasks, n_nodes, n_jobs, n_queues, rounds):
+    """BENCH_LINEAGE_AB=1 (`make lineage-ab`): same-box counterbalanced
+    A/B of the pod-lineage layer's steady-cycle overhead — OFF/ON/ON/OFF
+    arms of the exact sustained-throughput measurement, toggled through
+    the KUBE_BATCH_TPU_LINEAGE kill switch + refresh (the ≤1% overhead
+    budget the SLO layer ships under, doc/OBSERVABILITY.md)."""
+    from kube_batch_tpu.trace.lineage import (LINEAGE_ENV, lineage,
+                                              refresh_lineage)
+
+    prior = os.environ.get(LINEAGE_ENV)
+    arms = {"0": [], "1": []}
+    tracked = 0
+    try:
+        for setting in ("0", "1", "1", "0"):
+            os.environ[LINEAGE_ENV] = setting
+            refresh_lineage()
+            _, steady_rounds, _stats_d = measure_steady_session(
+                n_tasks, n_nodes, n_jobs, n_queues, rounds=rounds)
+            arms[setting].extend(steady_rounds)
+            if setting == "1":
+                # The ON arms must actually have tracked pods — a
+                # vacuous A/B (lineage silently off) must be visible.
+                tracked = max(tracked, lineage.summary()["tracked_pods"])
+    finally:
+        if prior is None:
+            os.environ.pop(LINEAGE_ENV, None)
+        else:
+            os.environ[LINEAGE_ENV] = prior
+        refresh_lineage()
+    off_med, off_p90 = _stats(arms["0"])
+    on_med, on_p90 = _stats(arms["1"])
+    out["lineage_ab"] = {
+        "off_ms": off_med, "off_p90": off_p90,
+        "on_ms": on_med, "on_p90": on_p90,
+        "overhead_pct": (round((on_med - off_med) / off_med * 100.0, 2)
+                         if off_med else None),
+        "rounds_per_arm": len(arms["1"]),
+        "tracked_pods": tracked,
+    }
 
 
 def run_session_stages(cache, tiers):
@@ -1125,7 +1179,16 @@ def _fill_action_ab(out, n_tasks, n_nodes, n_jobs, n_queues,
 
 def _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline,
          steady_only=False, steady_rounds_n=5, evict_only=False,
-         churn_only=False, shard_only=False):
+         churn_only=False, shard_only=False, lineage_only=False):
+    if lineage_only:
+        # BENCH_LINEAGE_AB=1 (`make lineage-ab`): ONLY the pod-lineage
+        # overhead A/B — counterbalanced steady rounds with the SLO
+        # layer on vs off (doc/OBSERVABILITY.md "overhead discipline").
+        import jax as _jax
+        out["platform"] = _jax.default_backend()
+        _fill_lineage_ab(out, n_tasks, n_nodes, n_jobs, n_queues,
+                         rounds=steady_rounds_n)
+        return
     if shard_only:
         # BENCH_SHARD_AB=1 (`make bench-shard`): ONLY the sharded-vs-
         # single-chip A/B on the virtual mesh — storm parity (victims/
@@ -1261,6 +1324,9 @@ def _run_full(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n,
     # window — WHERE the steady milliseconds went, not just the total
     # (null when KUBE_BATCH_TPU_TRACE=0).
     out["phase_ms"] = steady_stats.get("phase_ms")
+    # Residual-floor medians over the same window: the attributable keys
+    # tools/bench_compare.py gates (doc/OBSERVABILITY.md).
+    out["floors_ms"] = steady_stats.get("floors_ms")
 
     if not steady_only:
         _, steady_het_rounds, _het_stats = measure_steady_session(
@@ -1341,6 +1407,11 @@ def main():
         "shard_parity": None,
         "shard_routes": None,
         "shard_ship_probe": None,
+        # Residual-floor medians over the steady window + the
+        # pod-lineage overhead A/B (BENCH_LINEAGE_AB=1 /
+        # `make lineage-ab`) — doc/OBSERVABILITY.md.
+        "floors_ms": None,
+        "lineage_ab": None,
     }
 
     import threading
@@ -1379,13 +1450,15 @@ def main():
         evict_only = os.environ.get("BENCH_EVICT_AB") == "1"
         churn_only = os.environ.get("BENCH_CHURN_SWEEP") == "1"
         shard_only = os.environ.get("BENCH_SHARD_AB") == "1"
+        lineage_only = os.environ.get("BENCH_LINEAGE_AB") == "1"
         steady_rounds_n = int(os.environ.get("BENCH_STEADY_ROUNDS", 5))
         out["metric"] = (f"sched-session solve latency @ {n_tasks} tasks "
                          f"x {n_nodes} nodes (gang+DRF+proportion)"
                          + (" [steady-only]" if steady_only else "")
                          + (" [evict-ab]" if evict_only else "")
                          + (" [churn-sweep]" if churn_only else "")
-                         + (" [shard-ab]" if shard_only else ""))
+                         + (" [shard-ab]" if shard_only else "")
+                         + (" [lineage-ab]" if lineage_only else ""))
 
         # Wall-clock backstop for hangs the signal guard cannot reach
         # (a device call blocked in an extension never returns to the
@@ -1423,7 +1496,7 @@ def main():
         _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline,
              steady_only=steady_only, steady_rounds_n=steady_rounds_n,
              evict_only=evict_only, churn_only=churn_only,
-             shard_only=shard_only)
+             shard_only=shard_only, lineage_only=lineage_only)
         # Last statement INSIDE the try: a signal landing here is still
         # caught below — no handlerless gap before the emit.
         _ignore_signals()

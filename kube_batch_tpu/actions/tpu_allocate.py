@@ -311,9 +311,18 @@ class TpuAllocateAction(Action):
                                          scaffold=scaffold)
             kinds = kind[ordered].tolist()
             hostnames = scaffold.node_names_arr[assignment[ordered]].tolist()
-            ssn.batch_apply(
-                zip(scaffold.tasks_arr[ordered].tolist(), hostnames, kinds),
-                agg=agg)
+            # Pod lineage: batch_apply records the bulk "placed" stage;
+            # the cycle context names which engine decided it (shown on
+            # /debug/lineage as e.g. "via tpu-allocate/sharded").
+            from ..trace.lineage import lineage as pod_lineage
+            pod_lineage.cycle_context = f"via {self.name()}/{route}"
+            try:
+                ssn.batch_apply(
+                    zip(scaffold.tasks_arr[ordered].tolist(), hostnames,
+                        kinds),
+                    agg=agg)
+            finally:
+                pod_lineage.cycle_context = ""
         with trace.span("fit_deltas"):
             self._record_fit_deltas(ssn, snap, kind, assignment, order,
                                     scaffold=scaffold)

@@ -24,6 +24,7 @@ from ..api.queue_info import Queue, queue_from_versioned
 from ..api.pod_group_info import from_versioned
 from ..chaos import plan as chaos_plan
 from ..metrics import metrics
+from ..trace.lineage import lineage as pod_lineage
 from .interface import (AmbiguousOutcomeError, Binder, Cache, Evictor,
                         StatusUpdater, VolumeBinder)
 from .shadow import create_shadow_pod_group, shadow_group_key, shadow_pod_group
@@ -439,15 +440,59 @@ class SchedulerCache(Cache):
             self.events.append(("FailedParsePod", pod_key(pod), str(exc)))
             return None
 
+    def _lineage_capture(self, ti, pod):  # holds-lock: mutex
+        """Snapshot the facts the pod-lineage hook needs (key, queue,
+        bound-at-truth, edge ingest stamp) while the mutex is already
+        held; the lineage recorder itself is driven AFTER the mutex is
+        released (_lineage_emit) so lineage bookkeeping never extends
+        the informer's cache-mutex hold — the session snapshot cannot
+        be delayed by it."""
+        if not pod_lineage.cfg().enabled:
+            return None
+        if ti.node_name:
+            job = self.jobs.get(ti.job)
+            return (pod_key(pod), job.queue if job is not None else "",
+                    True, None)
+        if ti.status == TaskStatus.Pending:
+            job = self.jobs.get(ti.job)
+            return (pod_key(pod), job.queue if job is not None else "",
+                    False, getattr(pod, "_ingest_ts", None))
+        return None
+
+    @staticmethod
+    def _lineage_emit(cap, source: str) -> None:
+        """Pod-lineage hook for EXTERNAL ingestion (informer callbacks,
+        resync repair) — deliberately not wired into _add_task, so the
+        scheduler's own _assume_bound mirror never records an echo it
+        did not receive.  A Pending unbound pod starts (or keeps) its
+        timeline with the edge decode's monotonic stamp when one rode
+        in on the object; a node-carrying delivery of a tracked pod is
+        the bind landing at truth (first proof emits the SLO sample;
+        the stamp-once/first-wins contract in trace/lineage.py is what
+        keeps samples non-negative and single-counted across relists,
+        resyncs, and ambiguous binds)."""
+        if cap is None:
+            return
+        key, queue, bound, ingest_ts = cap
+        if bound:
+            pod_lineage.note_bound(key, queue, source=source)
+            pod_lineage.note_echo(key)
+        else:
+            pod_lineage.note_ingest(key, ingest_ts, queue=queue)
+
     def add_pod(self, pod: Pod) -> None:
+        lin = None
         with self.mutex:
             self.epoch += 1
             ti = self._task_info(pod)
             if ti is not None:
                 self._add_task(ti)
+                lin = self._lineage_capture(ti, pod)
+        self._lineage_emit(lin, "echo")
         self._note_churn()
 
     def update_pod(self, old_pod: Pod, new_pod: Pod) -> None:
+        lin = None
         with self.mutex:
             self.epoch += 1
             old_ti = self._task_info(old_pod)
@@ -456,6 +501,8 @@ class SchedulerCache(Cache):
             ti = self._task_info(new_pod)
             if ti is not None:
                 self._add_task(ti)
+                lin = self._lineage_capture(ti, new_pod)
+        self._lineage_emit(lin, "echo")
         self._note_churn()
 
     def delete_pod(self, pod: Pod) -> None:
@@ -464,11 +511,13 @@ class SchedulerCache(Cache):
             ti = self._task_info(pod)
             if ti is not None:
                 self._delete_task(ti)
+        pod_lineage.note_deleted(pod_key(pod))
         self._note_churn()
 
     def sync_task(self, old_task: TaskInfo, cluster_pod: Optional[Pod]) -> None:
         """Refetch ground truth for a task whose effect failed
         (event_handlers.go:101-119)."""
+        lin = None
         with self.mutex:
             self.epoch += 1
             self._delete_task(old_task)
@@ -476,6 +525,8 @@ class SchedulerCache(Cache):
                 ti = self._task_info(cluster_pod)
                 if ti is not None:
                     self._add_task(ti)
+                    lin = self._lineage_capture(ti, cluster_pod)
+        self._lineage_emit(lin, "resync")
         self._note_churn()
 
     # ------------------------------------------------------------------
@@ -1095,15 +1146,30 @@ class SchedulerCache(Cache):
             if ti is not None:
                 self._add_task(ti)
 
+    def _lineage_bound(self, tasks, source: str) -> None:
+        """Bind egress proven for ``tasks``: resolve queues under the
+        mutex in one pass, then hand the whole batch to the lineage
+        recorder (one recorder-lock acquisition, trace/lineage.py)."""
+        if not pod_lineage.cfg().enabled:
+            return
+        with self.mutex:
+            pairs = [(pod_key(t.pod),
+                      job.queue if (job := self.jobs.get(t.job)) is not None
+                      else "")
+                     for t in tasks]
+        pod_lineage.note_bound_many(pairs, source=source)
+
     def bind(self, task: TaskInfo, hostname: str) -> None:
         """Delegate to the Binder; revert task status and queue a resync on
         failure (cache.go:491-535)."""
         if self.binder is None:
             raise RuntimeError("no binder configured")
         self._check_write_fence()
+        pod_lineage.note_bind_sent((pod_key(task.pod),))
         try:
             self._bind_with_backoff(task.pod, hostname)
             self._assume_bound(task, hostname)
+            self._lineage_bound((task,), "bind")
             self.events.append(("Scheduled", pod_key(task.pod), hostname))
         except AmbiguousOutcomeError:
             # Delivered but unproven: don't guess — the resync worker
@@ -1140,6 +1206,8 @@ class SchedulerCache(Cache):
         if self.binder is None:
             raise RuntimeError("no binder configured")
         self._check_write_fence()
+        if pod_lineage.cfg().enabled:
+            pod_lineage.note_bind_sent([pod_key(t.pod) for t in tasks])
         pending = [(t.pod, t.node_name) for t in tasks]
         retries = _bind_retries()
         delay = _BIND_BACKOFF_BASE_S
@@ -1170,16 +1238,21 @@ class SchedulerCache(Cache):
         if not failed_uids:  # one bulk event write for the whole batch
             for t in tasks:
                 self._assume_bound(t, t.node_name)
+            self._lineage_bound(tasks, "bind")
             self.events.extend(("Scheduled", pod_key(t.pod), t.node_name)
                                for t in tasks)
             return
+        landed = []
         for t in tasks:
             if t.uid in failed_uids:
                 self._resync_task(t)
             else:
                 self._assume_bound(t, t.node_name)
+                landed.append(t)
                 self.events.append(("Scheduled", pod_key(t.pod),
                                     t.node_name))
+        if landed:
+            self._lineage_bound(landed, "bind")
 
     def evict(self, task: TaskInfo, reason: str) -> None:
         """Delegate to the Evictor (cache.go:425-488)."""
@@ -1209,6 +1282,7 @@ class SchedulerCache(Cache):
                 raise AmbiguousOutcomeError(
                     "chaos: connection lost after the evict DELETE was "
                     "delivered (injected)")
+            pod_lineage.note_evicted(pod_key(task.pod), reason)
             self.events.append(("Evict", pod_key(task.pod), reason))
         except Exception:
             self._resync_task(task)

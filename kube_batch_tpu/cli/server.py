@@ -6,11 +6,18 @@ the cache and scheduler, serves Prometheus metrics over HTTP, and wraps the
 scheduling loop in leader election when enabled.  The flight-recorder
 endpoints (doc/OBSERVABILITY.md) ride the same server:
 
+  /debug                     index of every debug endpoint (JSON)
   /debug/sessions            recent session summaries (JSON)
   /debug/trace?session=<id>  one session as Chrome trace-event JSON
                              (open in Perfetto / chrome://tracing)
   /debug/why?job=<name>      the gating predicate/quota/gang reason for a
                              Pending job, answered from the recorder
+  /debug/lineage?pod=<name>  one pod's end-to-end scheduling timeline
+                             (ingest -> considered -> placed -> bind ->
+                             echo), answered from the lineage ring
+  /debug/tenants             per-queue fairness table (share vs
+                             deserved, starvation age) from the last
+                             session's proportion/drf opens
 """
 
 from __future__ import annotations
@@ -48,7 +55,7 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", "2")
             self.end_headers()
             self.wfile.write(b"ok")
-        elif path.startswith("/debug/"):
+        elif path == "/debug" or path.startswith("/debug/"):
             try:
                 self._debug(path, parse_qs(parts.query))
             except Exception:  # a debug read must never kill the server
@@ -58,10 +65,51 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             self.send_response(404)
             self.end_headers()
 
+    # One-line description per endpoint: the /debug index page, so
+    # operators stop guessing URLs (doc/OBSERVABILITY.md).
+    _DEBUG_INDEX = {
+        "/debug/sessions": "recent session summaries: phases, verdicts, "
+                           "evictions, degraded reasons, floors",
+        "/debug/trace?session=<id|latest>": "one session as Chrome "
+                           "trace-event JSON (open in ui.perfetto.dev)",
+        "/debug/why?job=<[ns/]name>": "why is this job still Pending — "
+                           "the gating plugin verdict + solver tally",
+        "/debug/lineage?pod=<[ns/]name>": "one pod's end-to-end timeline:"
+                           " ingest -> considered -> placed -> bind -> "
+                           "echo, with time-to-bind",
+        "/debug/tenants": "per-queue fairness: share vs deserved, "
+                          "pending demand, starvation age",
+    }
+
     def _debug(self, path: str, query: dict) -> None:
         """The flight-recorder read endpoints.  Read-only: everything is
         answered from recorded traces, nothing re-runs."""
-        if path == "/debug/sessions":
+        from ..metrics.tenants import tenant_table
+        from ..trace import pod_lineage
+
+        if path in ("/debug", "/debug/"):
+            self._send_json({"endpoints": self._DEBUG_INDEX,
+                             "tracing_enabled": _trace_enabled(),
+                             "lineage": pod_lineage.summary()})
+        elif path == "/debug/lineage":
+            pod = (query.get("pod") or [""])[0]
+            if not pod:
+                self._send_json({"error": "pass ?pod=<[namespace/]name>"},
+                                400)
+                return
+            answer = pod_lineage.lineage(pod)
+            if answer is None:
+                self._send_json(
+                    {"pod": pod,
+                     "error": "not in the lineage ring: the pod was "
+                              "never ingested Pending, aged out of the "
+                              "ring, or lineage is disabled "
+                              "(KUBE_BATCH_TPU_LINEAGE=0)"}, 404)
+                return
+            self._send_json(answer)
+        elif path == "/debug/tenants":
+            self._send_json(tenant_table.snapshot())
+        elif path == "/debug/sessions":
             self._send_json({"sessions": flight_recorder.summaries(),
                              "capacity": flight_recorder.capacity,
                              "evictions_total":

@@ -14,10 +14,12 @@ import http.client
 import json
 import socket
 import threading
+import time
 import urllib.error
 import urllib.request
 from typing import Dict
 
+from ..api import objects as _objects
 from ..cache.cluster import Informer
 from ..cache.interface import AmbiguousOutcomeError
 from ..chaos import plan as chaos_plan
@@ -359,8 +361,19 @@ class RemoteCluster:
                 else codec.encode(obj))
 
     def _decode(self, doc):
-        return (codec_k8s.from_k8s(doc) if self.wire == "k8s"
-                else codec.decode(doc))
+        obj = (codec_k8s.from_k8s(doc) if self.wire == "k8s"
+               else codec.decode(doc))
+        # Pod-lineage ingest stamp (trace/lineage.py): the moment the
+        # object materialized off the wire, monotonic so the SLO clock
+        # survives wall-clock steps.  Stamped HERE (the client edge,
+        # both wire modes, one chokepoint) and not in the codecs — the
+        # server decodes through the same codec functions and must not
+        # mark ITS objects as scheduler-ingested.  An instance
+        # attribute: dataclass __eq__ ignores it, the codec never
+        # re-encodes it.
+        if isinstance(obj, _objects.Pod):
+            obj._ingest_ts = time.monotonic()
+        return obj
 
     def _request(self, method: str, path: str, payload=None,
                  content_type: str = "application/json"):

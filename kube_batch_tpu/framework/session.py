@@ -24,6 +24,7 @@ from ..chaos import plan as chaos_plan
 from ..metrics import metrics
 from ..native import apply_placements as native_apply
 from ..trace import spans as trace
+from ..trace.lineage import lineage as pod_lineage
 from ..utils.priority_queue import PriorityQueue, SortedDrainQueue
 from .events import AllocateBatch, Event, EventHandler
 from .interface import Plugin
@@ -587,6 +588,14 @@ class Session:
             for hostname in accs:
                 self._dirty_node(hostname)
 
+        # Pod lineage: one bulk "placed" record for the whole batch (the
+        # cycle context set by tpu-allocate names the action/route).
+        # Untracked pods are skipped inside; O(applied) key builds only
+        # while lineage is enabled.
+        if applied and pod_lineage.cfg().enabled:
+            pod_lineage.note_placed([pod_key(t.pod) for t in applied],
+                                    session=trace.current_session_id())
+
         # Remove contributions of skipped placements so the (pre)computed
         # sums describe exactly what was applied.
         for task, hostname, kind in skipped:
@@ -743,6 +752,9 @@ def open_session(cache, tiers: List[Tier],
         snapshot: ClusterInfo = cache.snapshot()
         metrics.set_cycle_floor("snapshot",
                                 time.perf_counter() - snap_start)
+    # Pod-lineage session ledger: this open is the "first consider" for
+    # every pod ingested since the previous one (trace/lineage.py).
+    pod_lineage.note_session_open()
     ssn.jobs = snapshot.jobs
     ssn.nodes = snapshot.nodes
     ssn.queues = snapshot.queues

@@ -10,11 +10,96 @@ counts, job_retry_counts.  Exposition-format text is served by
 
 from __future__ import annotations
 
+import logging
+import os
 import threading
 from collections import defaultdict
 from typing import Dict, List, Tuple
 
 SUBSYSTEM = "kube_batch"
+
+log = logging.getLogger(__name__)
+
+# ----------------------------------------------------------------------
+# Label-cardinality bound (doc/OBSERVABILITY.md "SLO metrics"): metrics
+# labeled by USER-INFLUENCED names (queue / namespace) cap their distinct
+# series; past the cap, new label values collapse into one ``other``
+# series and the rerouted observations count in
+# ``kube_batch_metric_series_dropped_total{metric}`` — a namespace storm
+# can no longer grow the Prometheus scrape without bound.  The cap env
+# is validated like ops/solver.shard_knobs: a malformed value warns
+# loudly exactly once and pins the default.
+
+SERIES_CAP_ENV = "KUBE_BATCH_TPU_METRIC_SERIES_CAP"
+DEFAULT_SERIES_CAP = 64
+
+_series_lock = threading.Lock()
+_series_seen: Dict[str, set] = {}       # guarded-by: _series_lock
+_series_cap = None                      # guarded-by: _series_lock
+OTHER_LABEL = "other"
+
+
+def _resolve_series_cap() -> int:
+    raw = os.environ.get(SERIES_CAP_ENV)
+    if not raw:
+        return DEFAULT_SERIES_CAP
+    try:
+        cap = int(raw)
+        if cap < 1:
+            raise ValueError(raw)
+        return cap
+    except ValueError:
+        log.warning(
+            "%s=%r is not a positive integer; pinning the default %d for "
+            "the life of this process (fix the env and restart, or call "
+            "metrics.refresh_series_cap())", SERIES_CAP_ENV, raw,
+            DEFAULT_SERIES_CAP)
+        return DEFAULT_SERIES_CAP
+
+
+def refresh_series_cap() -> int:
+    """Re-resolve the series cap from the current environment — the
+    deliberate test hook (mirror of ops.solver.refresh_shard_knobs).
+    Forgets which label values were already admitted."""
+    global _series_cap
+    with _series_lock:
+        _series_cap = None
+        _series_seen.clear()
+    return series_cap()
+
+
+def series_cap() -> int:
+    global _series_cap
+    with _series_lock:
+        if _series_cap is None:
+            _series_cap = _resolve_series_cap()
+        return _series_cap
+
+
+def bounded_label(metric: str, value: str) -> str:
+    """Admit ``value`` as a label for ``metric``, or reroute it to the
+    shared ``other`` bucket once the metric's distinct-series cap is
+    reached (counting the reroute).  The seen-set is itself bounded by
+    the cap, so adversarial cardinality cannot grow THIS state either."""
+    value = str(value) if value else "none"
+    global _series_cap
+    with _series_lock:
+        if _series_cap is None:
+            _series_cap = _resolve_series_cap()
+        seen = _series_seen.get(metric)
+        if seen is None:
+            seen = _series_seen[metric] = set()
+        if value in seen:
+            return value
+        if len(seen) >= _series_cap:
+            dropped = True
+        else:
+            seen.add(value)
+            dropped = False
+    if dropped:
+        series_dropped.inc(1.0, metric)
+        return OTHER_LABEL
+    return value
 
 
 def _exp_buckets(start: float, factor: float, count: int) -> List[float]:
@@ -398,6 +483,70 @@ occupancy_rows_rebuilt = registry.register(Gauge(
     f"{SUBSYSTEM}_occupancy_rows_rebuilt",
     "Node occupancy (host-port/selector) rows rebuilt by the last "
     "tensorize; -1 = feature inactive this session"))
+# Scheduling-SLO layer (trace/lineage.py, doc/OBSERVABILITY.md): the
+# quantity the scheduler actually promises users — how long a pod waits
+# from cluster arrival (edge-decode ingest stamp) to bind — plus where
+# that wait went (before the scheduler first considered it vs inside
+# scheduling/egress) and the per-tenant fairness surface computed from
+# the proportion/drf session opens.  Queue labels are user-influenced,
+# so every one passes through bounded_label above.
+_SLO_BUCKETS = [0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0, 1800.0]
+slo_time_to_bind = registry.register(Histogram(
+    f"{SUBSYSTEM}_slo_time_to_bind_seconds",
+    "Pod wall time from cluster-arrival ingest to the first successful "
+    "bind, by queue", _SLO_BUCKETS, ("queue",)))
+slo_first_consider = registry.register(Histogram(
+    f"{SUBSYSTEM}_slo_time_to_first_consider_seconds",
+    "Pod wall time from ingest to the first scheduling session opened "
+    "after it (the scheduler's first look), by queue", _SLO_BUCKETS,
+    ("queue",)))
+slo_queue_wait = registry.register(Histogram(
+    f"{SUBSYSTEM}_slo_queue_wait_seconds",
+    "Where the pod's wait went: segment pre_consider (ingest -> first "
+    "session open) vs scheduling (first session open -> bind)",
+    _SLO_BUCKETS, ("queue", "segment")))
+slo_samples_dropped = registry.register(Counter(
+    f"{SUBSYSTEM}_slo_samples_dropped_total",
+    "SLO samples not recorded, by reason (negative | ledger_evicted | "
+    "ring_evicted)", ("reason",)))
+series_dropped = registry.register(Counter(
+    f"{SUBSYSTEM}_metric_series_dropped_total",
+    "Observations rerouted to the shared 'other' series after the "
+    "per-metric label-cardinality cap (KUBE_BATCH_TPU_METRIC_SERIES_CAP)"
+    " was reached, by metric", ("metric",)))
+# Per-tenant fairness accounting (plugins/proportion.py + plugins/drf.py
+# session opens; /debug/tenants serves the same table as JSON).  Shares
+# are dominant-resource fractions so allocated vs deserved is directly
+# comparable per queue.
+tenant_share = registry.register(Gauge(
+    f"{SUBSYSTEM}_tenant_share",
+    "Dominant-resource allocated/deserved ratio per queue (>1 = the "
+    "queue holds more than its fair share)", ("queue",)))
+tenant_deserved_share = registry.register(Gauge(
+    f"{SUBSYSTEM}_tenant_deserved_share",
+    "Deserved fraction of the cluster per queue (proportion "
+    "water-filling outcome, dominant resource)", ("queue",)))
+tenant_allocated_share = registry.register(Gauge(
+    f"{SUBSYSTEM}_tenant_allocated_share",
+    "Allocated fraction of the cluster per queue (dominant resource)",
+    ("queue",)))
+tenant_pending_jobs = registry.register(Gauge(
+    f"{SUBSYSTEM}_tenant_pending_jobs",
+    "Jobs with Pending tasks per queue at the last session open",
+    ("queue",)))
+tenant_starvation = registry.register(Gauge(
+    f"{SUBSYSTEM}_tenant_starvation_seconds",
+    "Age of the oldest job still holding Pending tasks per queue "
+    "(0 = no pending work)", ("queue",)))
+tenant_starved_sessions = registry.register(Counter(
+    f"{SUBSYSTEM}_tenant_starved_sessions_total",
+    "Sessions that opened with the queue under its deserved share while "
+    "it still had pending demand", ("queue",)))
+tenant_max_job_share = registry.register(Gauge(
+    f"{SUBSYSTEM}_tenant_max_job_share",
+    "Largest drf job share inside each queue at the last session open",
+    ("queue",)))
 
 
 # Helper API (metrics.go:123-191).
@@ -683,6 +832,56 @@ def set_close_objects_walked(count: int) -> None:
 
 def set_occupancy_rows_rebuilt(count: int) -> None:
     occupancy_rows_rebuilt.set(float(count))
+
+
+def observe_time_to_bind(queue: str, seconds: float) -> None:
+    """One pod's ingest->bind SLO sample (trace/lineage.py emits exactly
+    one per pod lifetime; queue label cardinality-capped)."""
+    slo_time_to_bind.observe(seconds, bounded_label("slo", queue))
+
+
+def observe_first_consider(queue: str, seconds: float) -> None:
+    slo_first_consider.observe(seconds, bounded_label("slo", queue))
+
+
+def observe_queue_wait(queue: str, segment: str, seconds: float) -> None:
+    slo_queue_wait.observe(seconds, bounded_label("slo", queue), segment)
+
+
+def note_slo_dropped(reason: str) -> None:
+    slo_samples_dropped.inc(1.0, reason)
+
+
+def set_tenant_stats(queue: str, share: float, deserved_share: float,
+                     allocated_share: float, pending_jobs: int,
+                     starvation_s: float, starved: bool) -> None:
+    """Publish one queue's fairness row (proportion's session open).
+    The queue label is cardinality-capped under ONE shared 'tenant'
+    budget, so all tenant gauges collapse the same overflow queues."""
+    q = bounded_label("tenant", queue)
+    tenant_share.set(round(float(share), 4), q)
+    tenant_deserved_share.set(round(float(deserved_share), 4), q)
+    tenant_allocated_share.set(round(float(allocated_share), 4), q)
+    tenant_pending_jobs.set(float(pending_jobs), q)
+    tenant_starvation.set(round(float(starvation_s), 3), q)
+    if starved:
+        tenant_starved_sessions.inc(1.0, q)
+
+
+def set_tenant_max_job_share(queue: str, share: float) -> None:
+    tenant_max_job_share.set(round(float(share), 4),
+                             bounded_label("tenant", queue))
+
+
+def clear_tenant_gauges(queues) -> None:
+    """Zero the gauges of queues that left the cluster so /metrics does
+    not keep reporting a departed tenant's last shares forever."""
+    for queue in queues:
+        q = bounded_label("tenant", queue)
+        for gauge in (tenant_share, tenant_deserved_share,
+                      tenant_allocated_share, tenant_pending_jobs,
+                      tenant_starvation, tenant_max_job_share):
+            gauge.set(0.0, q)
 
 
 def onwork_values() -> Dict[str, float]:

@@ -74,6 +74,10 @@ class DrfPlugin(Plugin):
         # by this very walk and is cloned back out, so shares equal the
         # uncached path bit for bit.  KUBE_BATCH_TPU_INCREMENTAL=0
         # restores the unconditional walk (the parity control).
+        # Per-tenant accounting rider (metrics/tenants.py): the largest
+        # job share inside each queue, collected in the SAME walk (one
+        # compare per job, both churn-A/B arms identical).
+        q_max: dict = {}
         for job in ssn.jobs.values():
             attr = _DrfAttr()
             cached = getattr(job, "_drf_open_alloc", None) if reuse \
@@ -89,6 +93,11 @@ class DrfPlugin(Plugin):
                     job._drf_open_alloc = attr.allocated.clone()
             self._update_share(attr)
             self.job_attrs[job.uid] = attr
+            q_cur = q_max.get(job.queue)
+            if q_cur is None or attr.share > q_cur:
+                q_max[job.queue] = attr.share
+        from ..metrics.tenants import tenant_table
+        tenant_table.note_drf_job_shares(q_max)
 
         def preemptable_fn(preemptor: TaskInfo,
                            preemptees: List[TaskInfo]) -> List[TaskInfo]:

@@ -13,6 +13,7 @@ is the parity oracle.
 
 from __future__ import annotations
 
+import time
 from typing import Dict, List
 
 from ..api import (QueueInfo, Resource, TaskInfo, TaskStatus,
@@ -86,6 +87,11 @@ class ProportionPlugin(Plugin):
         # the first fractional contribution is integer-exact in both
         # arms, so gating consumption on the running flag is airtight.
         q_exact: Dict[str, bool] = {}
+        # Per-tenant fairness accounting (metrics/tenants.py): pending
+        # demand + the oldest still-waiting job per queue, tracked inside
+        # the SAME O(jobs) walk the open already does (two dict ops per
+        # job — no new cluster walk, identical in both churn-A/B arms).
+        q_pending: Dict[str, list] = {}  # queue -> [n_jobs, oldest_ts]
         for job in ssn.jobs.values():
             if job.queue not in self.queue_attrs:
                 queue = ssn.queues.get(job.queue)
@@ -94,6 +100,20 @@ class ProportionPlugin(Plugin):
                 self.queue_attrs[job.queue] = _QueueAttr(
                     queue.uid, queue.name, queue.weight)
             attr = self.queue_attrs[job.queue]
+            if job.task_status_index.get(TaskStatus.Pending):
+                # A zero/missing creationTimestamp is UNKNOWN, not the
+                # epoch: it must not win the oldest-waiter min, or a
+                # wire PodGroup without the field reports ~55 years of
+                # starvation.  inf never wins and yields 0.0 age when
+                # every pending job's timestamp is unknown.
+                ts = job.creation_timestamp or float("inf")
+                pend = q_pending.get(job.queue)
+                if pend is None:
+                    q_pending[job.queue] = [1, ts]
+                else:
+                    pend[0] += 1
+                    if ts < pend[1]:
+                        pend[1] = ts
             qe = q_exact.get(job.queue, True)
             cached = getattr(job, "_prop_open_agg", None) \
                 if reuse and qe else None
@@ -169,6 +189,35 @@ class ProportionPlugin(Plugin):
             remaining.sub(increased).add(decreased)
             if remaining.is_empty():
                 break
+
+        # Publish the session's fairness table (ROADMAP item 3's
+        # "fairness across tenants surfaced in /metrics and /debug"):
+        # every number below already exists in the attrs the
+        # water-filling just produced — this only formats and hands it
+        # to metrics/tenants.py.  A queue is STARVED this session when
+        # it still has pending demand while holding less than its
+        # deserved share (share < 1 means under-deserved on every
+        # dimension proportion tracks).
+        from ..metrics.tenants import dominant_share, tenant_table
+        now = time.time()
+        rows: Dict[str, dict] = {}
+        for attr in self.queue_attrs.values():
+            pend = q_pending.get(attr.name, (0, now))
+            starvation = max(0.0, now - pend[1]) if pend[0] else 0.0
+            rows[attr.name] = {
+                "weight": attr.weight,
+                "share": round(attr.share, 4),
+                "deserved_share": round(dominant_share(
+                    attr.deserved, self.total_resource), 4),
+                "allocated_share": round(dominant_share(
+                    attr.allocated, self.total_resource), 4),
+                "request_share": round(dominant_share(
+                    attr.request, self.total_resource), 4),
+                "pending_jobs": pend[0],
+                "starvation_s": round(starvation, 3),
+                "starved": bool(pend[0]) and attr.share < 1.0,
+            }
+        tenant_table.publish(rows, session_uid=ssn.uid)
 
         def queue_order_fn(l: QueueInfo, r: QueueInfo) -> int:
             ls = self.queue_attrs[l.uid].share

@@ -15,7 +15,6 @@ compute summaries outside it.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Dict, List, Optional
 
@@ -27,10 +26,11 @@ class FlightRecorder:
 
     def __init__(self, capacity: Optional[int] = None):
         if capacity is None:
-            try:
-                capacity = int(os.environ.get(_RING_ENV, _DEFAULT_RING))
-            except ValueError:
-                capacity = _DEFAULT_RING
+            # Validated like ops/solver.shard_knobs: a malformed ring
+            # size warns loudly exactly once and pins the default,
+            # instead of being silently swallowed at first use.
+            from .lineage import validated_ring_env
+            capacity = validated_ring_env(_RING_ENV, _DEFAULT_RING)
         self.capacity = max(1, capacity)
         self._lock = threading.Lock()
         self._traces: List = []            # guarded-by: _lock  (oldest first)

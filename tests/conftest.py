@@ -43,3 +43,22 @@ def _unpin_shard_knobs():
     mod = sys.modules.get("kube_batch_tpu.ops.solver")
     if mod is not None:
         mod._SHARD_KNOBS = None
+
+
+@pytest.fixture(autouse=True)
+def _unpin_lineage_cfg():
+    """Same discipline for the pod-lineage kill switch / ring size and
+    the metric series cap: tests that monkeypatch the env refresh
+    in-test; the teardown drops the pins so the NEXT test re-resolves
+    from its own restored environment.  The lineage RING is deliberately
+    left alone (refresh() clears it; tests that assert ring contents
+    clear it themselves)."""
+    yield
+    import sys
+    lineage_mod = sys.modules.get("kube_batch_tpu.trace.lineage")
+    if lineage_mod is not None:
+        lineage_mod.lineage._cfg = None
+    metrics_mod = sys.modules.get("kube_batch_tpu.metrics.metrics")
+    if metrics_mod is not None:
+        with metrics_mod._series_lock:
+            metrics_mod._series_cap = None

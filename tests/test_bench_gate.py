@@ -1,0 +1,226 @@
+"""Continuous perf-regression gate (tools/bench_compare.py,
+doc/OBSERVABILITY.md "The bench gate"): key extraction, the
+median + noise-band + abs-slack verdict rules in both directions, the
+synthetic-regression failure the acceptance pins, baseline round-trip,
+trajectory append, and the CLI wiring `make bench-gate` drives."""
+
+import json
+
+import pytest
+
+from tools import bench_compare as bc
+
+
+def _artifact(**over):
+    art = {
+        "metric": "steady-only test artifact",
+        "platform": "cpu",
+        "session_steady_ms": 100.0,
+        "session_steady_p90": 140.0,
+        "sessions_per_sec": 5.0,
+        "ship": {"full": [1, 1000000], "delta": [7, 80000],
+                 "clean": [0, 0]},
+        "floors_ms": {"solve_wait": 1.0, "snapshot": 2.0, "close": 0.5,
+                      "occupancy": 0.0},
+    }
+    art.update(over)
+    return art
+
+
+def _baseline(bands=None, slacks=None):
+    base = bc.make_baseline(_artifact())
+    if bands:
+        base["bands"].update(bands)
+    if slacks:
+        base["abs_slack"].update(slacks)
+    return base
+
+
+class TestExtractAndRules:
+    def test_extract_keys(self):
+        keys = bc.extract_keys(_artifact())
+        assert keys["steady_ms"] == 100.0
+        assert keys["ship_delta_bytes"] == 80000.0
+        assert keys["floors_ms.snapshot"] == 2.0
+        # Absent paths are simply absent, not zero.
+        assert "solve_ms" not in keys
+
+    def test_identical_artifact_passes(self):
+        report = bc.compare(_artifact(), _baseline())
+        assert report["pass"] and not report["regressed"]
+        assert all(r["verdict"] == "ok" for r in report["keys"].values())
+
+    def test_synthetic_20pct_steady_regression_fails_loudly(self):
+        """The acceptance pin: a 20% steady-latency regression against a
+        10%-band baseline must fail."""
+        base = _baseline(bands={"steady_ms": 0.10},
+                         slacks={"steady_ms": 0.0})
+        bad = _artifact(session_steady_ms=120.0)
+        report = bc.compare(bad, base)
+        assert not report["pass"]
+        assert "steady_ms" in report["regressed"]
+        row = report["keys"]["steady_ms"]
+        assert row["verdict"] == "regressed"
+        assert row["candidate"] > row["limit"]
+
+    def test_within_band_regression_passes(self):
+        base = _baseline(bands={"steady_ms": 0.25},
+                         slacks={"steady_ms": 0.0})
+        report = bc.compare(_artifact(session_steady_ms=120.0), base)
+        assert report["pass"]
+
+    def test_throughput_direction_is_higher_better(self):
+        base = _baseline(bands={"sessions_per_sec": 0.10})
+        # 40% throughput DROP regresses...
+        report = bc.compare(_artifact(sessions_per_sec=3.0), base)
+        assert "sessions_per_sec" in report["regressed"]
+        # ...a 40% gain is an improvement, never a failure.
+        report = bc.compare(_artifact(sessions_per_sec=7.0), base)
+        assert report["pass"]
+        assert report["keys"]["sessions_per_sec"]["verdict"] == "improved"
+
+    def test_abs_slack_floors_absorb_small_blips(self):
+        """A 0.0 ms floor must not fail on a 2 ms blip: the absolute
+        slack exists exactly for near-zero baselines where any relative
+        band is meaningless."""
+        base = _baseline()  # occupancy baseline is 0.0, abs_slack 5.0
+        art = _artifact()
+        art["floors_ms"]["occupancy"] = 2.0
+        assert bc.compare(art, base)["pass"]
+        art["floors_ms"]["occupancy"] = 50.0
+        report = bc.compare(art, base)
+        assert "floors_ms.occupancy" in report["regressed"]
+
+    def test_band_scale_tightens_everything(self):
+        base = _baseline(bands={"steady_ms": 1.0},
+                         slacks={"steady_ms": 0.0})
+        art = _artifact(session_steady_ms=150.0)
+        assert bc.compare(art, base)["pass"]
+        report = bc.compare(art, base, band_scale=0.25)
+        assert "steady_ms" in report["regressed"]
+
+    def test_missing_key_fails_gate(self):
+        """A baseline key absent from the candidate artifact FAILS: a
+        change that stops emitting a gated measurement must not silently
+        un-gate it (the vacuous-gate discipline of check_churn_ab)."""
+        art = _artifact()
+        del art["sessions_per_sec"]
+        report = bc.compare(art, _baseline())
+        assert not report["pass"]
+        assert report["missing"] == ["sessions_per_sec"]
+        assert not report["regressed"]
+        assert report["keys"]["sessions_per_sec"]["verdict"] == "missing"
+
+    def test_ship_bytes_regression_fails(self):
+        art = _artifact()
+        art["ship"]["delta"][1] = 200000  # 2.5x the shipped delta bytes
+        report = bc.compare(art, _baseline())
+        assert "ship_delta_bytes" in report["regressed"]
+
+
+class TestBaselineAndTrajectory:
+    def test_make_baseline_round_trip(self):
+        base = bc.make_baseline(_artifact())
+        assert base["keys"]["steady_ms"] == 100.0
+        assert 0 < base["bands"]["ship_delta_bytes"] <= 0.5
+        report = bc.compare(_artifact(), base)
+        assert report["pass"]
+
+    def test_trajectory_appends_jsonl(self, tmp_path):
+        path = tmp_path / "traj.jsonl"
+        report = bc.compare(_artifact(), _baseline())
+        bc.append_trajectory(str(path), _artifact(), report, label="t1")
+        bc.append_trajectory(str(path), _artifact(), None, label="t2")
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        assert [e["label"] for e in lines] == ["t1", "t2"]
+        assert lines[0]["pass"] is True and lines[1]["pass"] is None
+        assert lines[0]["keys"]["steady_ms"] == 100.0
+
+    def test_read_artifact_last_json_line_wins(self, tmp_path):
+        import io
+        stream = io.StringIO(
+            'noise\n{"metric": "a", "session_steady_ms": 1}\n'
+            'more noise\n{"metric": "b", "session_steady_ms": 2}\n')
+        art = bc.read_artifact(stream)
+        assert art["metric"] == "b"
+
+    def test_read_artifact_whole_document_wrapper(self, tmp_path):
+        """The committed BENCH_r0*.json wrappers are pretty-printed with
+        the real artifact nested under "parsed"."""
+        p = tmp_path / "wrap.json"
+        p.write_text(json.dumps({"n": 5, "parsed": _artifact()},
+                                indent=2))
+        with open(p) as f:
+            art = bc.read_artifact(f)
+        assert art["parsed"]["session_steady_ms"] == 100.0
+
+
+class TestCli:
+    def test_cli_pass_fail_and_report(self, tmp_path, capsys):
+        base_path = tmp_path / "base.json"
+        art_path = tmp_path / "art.json"
+        report_path = tmp_path / "report.json"
+        traj_path = tmp_path / "traj.jsonl"
+        base_path.write_text(json.dumps(_baseline(
+            bands={"steady_ms": 0.10}, slacks={"steady_ms": 0.0})))
+
+        art_path.write_text(json.dumps(_artifact()))
+        rc = bc.main(["--artifact", str(art_path),
+                      "--baseline", str(base_path),
+                      "--trajectory", str(traj_path),
+                      "--report", str(report_path), "--label", "ok-run"])
+        assert rc == 0
+        assert "PASS" in capsys.readouterr().out
+        assert json.loads(report_path.read_text())["pass"] is True
+
+        # The synthetic 20% regression, end to end through the CLI.
+        art_path.write_text(json.dumps(
+            _artifact(session_steady_ms=120.0)))
+        rc = bc.main(["--artifact", str(art_path),
+                      "--baseline", str(base_path),
+                      "--trajectory", str(traj_path),
+                      "--report", str(report_path)])
+        assert rc == 1
+        err = capsys.readouterr().err
+        assert "FAIL" in err and "steady_ms" in err
+        assert json.loads(report_path.read_text())["pass"] is False
+        lines = [json.loads(l) for l in
+                 traj_path.read_text().splitlines()]
+        assert [e["pass"] for e in lines] == [True, False]
+
+    def test_cli_bench_error_fails(self, tmp_path):
+        art_path = tmp_path / "art.json"
+        art_path.write_text(json.dumps(
+            {"metric": "x", "error": "backend exploded"}))
+        rc = bc.main(["--artifact", str(art_path)])
+        assert rc == 1
+
+    def test_cli_missing_baseline_instructs(self, tmp_path, capsys):
+        art_path = tmp_path / "art.json"
+        art_path.write_text(json.dumps(_artifact()))
+        rc = bc.main(["--artifact", str(art_path),
+                      "--baseline", str(tmp_path / "missing.json")])
+        assert rc == 1
+        assert "update-baseline" in capsys.readouterr().err
+
+    def test_cli_update_baseline_then_gate(self, tmp_path):
+        base_path = tmp_path / "base.json"
+        art_path = tmp_path / "art.json"
+        art_path.write_text(json.dumps(_artifact()))
+        assert bc.main(["--artifact", str(art_path),
+                        "--baseline", str(base_path),
+                        "--update-baseline"]) == 0
+        assert bc.main(["--artifact", str(art_path),
+                        "--baseline", str(base_path)]) == 0
+
+    def test_committed_baseline_is_loadable_and_gated(self):
+        """The repo's own baseline: every key it gates is a known key
+        with a band and slack — `make bench-gate` cannot silently gate
+        nothing."""
+        with open("doc/BENCH_BASELINE.json") as f:
+            base = json.load(f)
+        assert base["keys"], "committed baseline gates no keys"
+        for name in base["keys"]:
+            assert name in bc.GATED_KEYS, name
+        assert "steady_ms" in base["keys"]
+        assert "ship_delta_bytes" in base["keys"]

@@ -493,6 +493,138 @@ class TestWatchFaults:
 
 
 # ----------------------------------------------------------------------
+# pod lineage under chaos (doc/OBSERVABILITY.md): watch faults, resync,
+# and ambiguous binds must not corrupt the time-to-bind SLO
+
+
+def _slo_samples():
+    """{queue: count} + total of kube_batch_slo_time_to_bind_seconds."""
+    with metrics.slo_time_to_bind._lock:
+        per = {labels[0]: n for labels, n
+               in metrics.slo_time_to_bind._totals.items() if labels}
+    return per, sum(per.values())
+
+
+class TestLineageUnderChaos:
+    @pytest.fixture(autouse=True)
+    def _fresh_lineage(self):
+        from kube_batch_tpu.trace import pod_lineage
+        pod_lineage.refresh()
+        yield
+        pod_lineage.refresh()
+
+    def test_ambiguous_bind_single_counts_time_to_bind(self):
+        """The bind LANDS server-side but the cache only sees a dead
+        connection; the resync proves it.  Exactly ONE sample per pod —
+        not zero (the bind did land), not two (resync + echo must not
+        both count) — and never negative."""
+        from kube_batch_tpu.trace import pod_lineage
+
+        chaos_plan.install(chaos_plan.FaultPlan(
+            seed=3, rate=1.0, sites=("bind.ambiguous",)))
+        neg0 = metrics.slo_samples_dropped.value("negative")
+        _, total0 = _slo_samples()
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2)
+        h.create_job("j", 2, 2)
+        h.cycle()
+        assert len(h.bound("j")) == 2
+        assert len(h.cache.err_tasks) == 2
+        # The resync discovers the binds landed: that is the proof that
+        # emits the samples (the egress path never confirmed).
+        h.cache.process_resync_tasks(h.cache.binder.cluster)
+        chaos_plan.disable()
+        h.cycle()  # a clean follow-up cycle must not re-sample
+        _, total1 = _slo_samples()
+        assert total1 - total0 == 2
+        assert metrics.slo_samples_dropped.value("negative") == neg0
+        for name in ("j-0", "j-1"):
+            lin = pod_lineage.lineage(f"test/{name}")
+            assert lin["bound"] and lin["time_to_bind_s"] >= 0
+            bound_events = [s for s in lin["stages"]
+                            if s["stage"] == "bound"]
+            assert len(bound_events) == 1
+
+    def test_watch_disconnect_relist_keeps_samples_clean(self):
+        """A watch storm forces disconnects + full relists while pods
+        bind over the wire: the relist's redelivered ADDEDs must not
+        restart any pod's arrival clock (negative samples) and the
+        replayed bound pods must not double-count."""
+        from kube_batch_tpu.api import ObjectMeta
+        from kube_batch_tpu.apis.scheduling import v1alpha1
+        from kube_batch_tpu.cache import Cluster, new_scheduler_cache
+        from kube_batch_tpu.edge import ApiServer, RemoteCluster
+        from kube_batch_tpu.scheduler import (DEFAULT_SCHEDULER_CONF,
+                                              Scheduler)
+        from kube_batch_tpu.trace import pod_lineage
+        from tests.test_utils import (build_node, build_pod,
+                                      build_resource_list)
+
+        neg0 = metrics.slo_samples_dropped.value("negative")
+        _, total0 = _slo_samples()
+        cluster = Cluster()
+        server = ApiServer(cluster).start()
+        remote = None
+        sched = None
+        try:
+            cluster.create_node(build_node(
+                "n0", build_resource_list("16", "32Gi", pods=110)))
+            cluster.create_queue(v1alpha1.Queue(
+                metadata=ObjectMeta(name="default"),
+                spec=v1alpha1.QueueSpec(weight=1)))
+            cluster.create_pod_group(v1alpha1.PodGroup(
+                metadata=ObjectMeta(name="pg1", namespace="ns"),
+                spec=v1alpha1.PodGroupSpec(min_member=1,
+                                           queue="default")))
+            remote = RemoteCluster(server.url).start(timeout=60)
+            cache = new_scheduler_cache(remote)
+            sched = Scheduler(cache, scheduler_conf=DEFAULT_SCHEDULER_CONF
+                              .replace('"allocate, backfill"',
+                                       '"tpu-allocate, backfill"'),
+                              schedule_period=0.05)
+            # Storm the pod watch stream while scheduling runs: every
+            # disconnect replays the world as ADDED events.
+            chaos_plan.install(chaos_plan.FaultPlan(
+                seed=9, rate=0.2,
+                sites=("watch.disconnect:pods", "watch.stale:pods"),
+                budget=12))
+            sched.run()
+            n_pods = 4
+            for i in range(n_pods):
+                remote.create_pod(build_pod(
+                    "ns", f"p{i}", "", "Pending",
+                    build_resource_list("1", "1Gi"), "pg1"))
+            deadline = time.time() + 30
+            while time.time() < deadline:
+                with cluster.lock:
+                    bound = [p for p in cluster.pods.values()
+                             if p.spec.node_name]
+                if len(bound) == n_pods:
+                    break
+                time.sleep(0.1)
+            assert len(bound) == n_pods
+            # Let the relist replays drain before asserting.
+            time.sleep(1.0)
+        finally:
+            chaos_plan.disable()
+            if sched is not None:
+                sched.stop()
+            if remote is not None:
+                remote.stop()
+            server.stop()
+        _, total1 = _slo_samples()
+        # One sample per pod, no negatives, despite the storm.
+        assert total1 - total0 == n_pods
+        assert metrics.slo_samples_dropped.value("negative") == neg0
+        for i in range(n_pods):
+            lin = pod_lineage.lineage(f"ns/p{i}")
+            assert lin is not None and lin["bound"]
+            assert lin["time_to_bind_s"] >= 0
+            assert len([s for s in lin["stages"]
+                        if s["stage"] == "bound"]) == 1
+
+
+# ----------------------------------------------------------------------
 # the soak property, tier-1-gated at a small shape
 
 

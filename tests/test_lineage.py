@@ -1,0 +1,346 @@
+"""Pod lineage + scheduling-SLO layer (trace/lineage.py,
+doc/OBSERVABILITY.md): the end-to-end timeline through the fake cluster
+and over the HTTP edge, the KUBE_BATCH_TPU_LINEAGE=0 kill switch (zero
+ring writes), ring bounding + env validation (warn once, pin default),
+the per-tenant fairness surface, and the /debug endpoints."""
+
+import json
+import logging
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kube_batch_tpu.metrics import metrics
+from kube_batch_tpu.metrics.tenants import tenant_table
+from kube_batch_tpu.trace import lineage as lineage_mod
+from kube_batch_tpu.trace import pod_lineage
+from tests.test_e2e import CONF_TPU, Harness
+
+pytestmark = pytest.mark.usefixtures("_clean_lineage")
+
+
+@pytest.fixture()
+def _clean_lineage():
+    pod_lineage.refresh()
+    tenant_table.clear()
+    yield
+    pod_lineage.refresh()
+    tenant_table.clear()
+
+
+def _slo_count(queue: str) -> int:
+    with metrics.slo_time_to_bind._lock:
+        return metrics.slo_time_to_bind._totals.get((queue,), 0)
+
+
+# ----------------------------------------------------------------------
+# e2e through the fake cluster
+
+
+class TestFakeClusterLineage:
+    def test_complete_timeline_and_samples(self):
+        before = _slo_count("q1")
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2)
+        h.create_job("j", 2, 2)
+        h.cycle()
+        assert len(h.bound("j")) == 2
+
+        lin = pod_lineage.lineage("test/j-0")
+        assert lin is not None and lin["bound"]
+        stages = [s["stage"] for s in lin["stages"]]
+        # The full acceptance timeline: ingest -> (derived) considered ->
+        # placed -> bind egress -> proven bind -> watch echo.
+        assert stages == ["ingest", "considered", "placed", "bind_sent",
+                          "bound", "echo"]
+        # Stage times are monotone non-decreasing and non-negative.
+        rels = [s["t_rel_s"] for s in lin["stages"]]
+        assert rels == sorted(rels) and rels[0] == 0.0
+        assert lin["time_to_bind_s"] >= 0
+        assert lin["time_to_first_consider_s"] >= 0
+        assert lin["queue"] == "q1"
+        # The placed stage names the engine that decided it.
+        placed = [s for s in lin["stages"] if s["stage"] == "placed"][0]
+        assert "tpu-allocate" in placed["detail"]
+
+        # Exactly one histogram sample per bound pod, labeled by queue.
+        assert _slo_count("q1") - before == 2
+
+        # A second cycle (no new pods) must not re-sample.
+        h.cycle()
+        assert _slo_count("q1") - before == 2
+
+    def test_first_consider_vs_bind_attribution(self):
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2)
+        h.create_job("j", 1, 1)
+        h.cycle()
+        lin = pod_lineage.lineage("test/j-0")
+        # pre_consider + scheduling segments partition time-to-bind.
+        assert lin["time_to_first_consider_s"] <= lin["time_to_bind_s"]
+
+    def test_bare_and_qualified_lookup(self):
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(1)
+        h.create_job("j", 1, 1)
+        h.cycle()
+        assert pod_lineage.lineage("j-0")["pod"] == "test/j-0"
+        assert pod_lineage.lineage("test/j-0")["pod"] == "test/j-0"
+        assert pod_lineage.lineage("nope") is None
+
+    def test_relist_redelivery_keeps_arrival_stamp(self):
+        """A duplicate ADDED (watch relist) of a tracked Pending pod
+        must NOT reset the arrival clock."""
+        from tests.test_e2e import mk_pod
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2)
+        h.create_job("j", 1, 1)
+        with pod_lineage._lock:
+            t0 = pod_lineage._pods["test/j-0"].ingest_mono
+        # Redeliver the same pod straight into the cache (the relist
+        # upsert path informers take on reconnect).
+        h.cache.add_pod(mk_pod("j-0", "j"))
+        with pod_lineage._lock:
+            assert pod_lineage._pods["test/j-0"].ingest_mono == t0
+        h.cycle()
+        lin = pod_lineage.lineage("test/j-0")
+        assert lin["bound"] and lin["time_to_bind_s"] >= 0
+
+    def test_deleted_pod_recreated_starts_fresh(self):
+        from tests.test_e2e import mk_pod
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2)
+        h.create_job("j", 1, 1)
+        h.cycle()
+        h.cache.delete_pod(mk_pod("j-0", "j"))
+        assert pod_lineage.lineage("test/j-0")["deleted"]
+        # Same key re-created: a fresh timeline replaces the closed one.
+        h.cache.add_pod(mk_pod("j-0", "j"))
+        lin = pod_lineage.lineage("test/j-0")
+        assert not lin["deleted"] and not lin["bound"]
+        assert [s["stage"] for s in lin["stages"]][0] == "ingest"
+
+
+# ----------------------------------------------------------------------
+# kill switch + ring bounds + env validation
+
+
+class TestKillSwitchAndRing:
+    def test_kill_switch_pins_zero_ring_writes(self, monkeypatch):
+        monkeypatch.setenv(lineage_mod.LINEAGE_ENV, "0")
+        pod_lineage.refresh()
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2)
+        h.create_job("j", 2, 2)
+        before = _slo_count("q1")
+        h.cycle()
+        assert len(h.bound("j")) == 2
+        # Zero ring writes, zero session-ledger writes, zero samples.
+        assert pod_lineage.tracked() == 0
+        with pod_lineage._lock:
+            assert not pod_lineage._session_opens
+        assert _slo_count("q1") == before
+        assert pod_lineage.lineage("j-0") is None
+
+    def test_ring_is_bounded_fifo(self, monkeypatch):
+        monkeypatch.setenv(lineage_mod.LINEAGE_RING_ENV, "4")
+        pod_lineage.refresh()
+        for i in range(10):
+            pod_lineage.note_ingest(f"ns/p{i}", None, queue="q")
+        assert pod_lineage.tracked() == 4
+        assert pod_lineage.lineage("p0") is None
+        assert pod_lineage.lineage("p9") is not None
+
+    def test_malformed_ring_env_warns_once_and_pins_default(
+            self, monkeypatch, caplog):
+        monkeypatch.setenv(lineage_mod.LINEAGE_RING_ENV, "banana")
+        lineage_mod._warned_envs.discard(lineage_mod.LINEAGE_RING_ENV)
+        with caplog.at_level(logging.WARNING,
+                             logger="kube_batch_tpu.trace.lineage"):
+            cfg = pod_lineage.refresh()
+            assert cfg.capacity == lineage_mod.DEFAULT_RING
+            cfg = pod_lineage.refresh()  # second resolve: no second warn
+            assert cfg.capacity == lineage_mod.DEFAULT_RING
+        warns = [r for r in caplog.records if "banana" in r.message]
+        assert len(warns) == 1
+
+    def test_malformed_trace_ring_env_warns_once_and_pins_default(
+            self, monkeypatch, caplog):
+        """Satellite: KUBE_BATCH_TPU_TRACE_RING now validates the way
+        ops/solver.shard_knobs does, instead of silently pinning."""
+        from kube_batch_tpu.trace.recorder import (_DEFAULT_RING,
+                                                   FlightRecorder)
+        monkeypatch.setenv("KUBE_BATCH_TPU_TRACE_RING", "-3")
+        lineage_mod._warned_envs.discard("KUBE_BATCH_TPU_TRACE_RING")
+        with caplog.at_level(logging.WARNING,
+                             logger="kube_batch_tpu.trace.lineage"):
+            rec = FlightRecorder()
+            assert rec.capacity == _DEFAULT_RING
+            rec = FlightRecorder()  # warn-once across instances
+            assert rec.capacity == _DEFAULT_RING
+        warns = [r for r in caplog.records if "TRACE_RING" in r.message]
+        assert len(warns) == 1
+
+    def test_malformed_kill_switch_warns_and_stays_enabled(
+            self, monkeypatch, caplog):
+        monkeypatch.setenv(lineage_mod.LINEAGE_ENV, "maybe")
+        lineage_mod._warned_envs.discard(lineage_mod.LINEAGE_ENV)
+        with caplog.at_level(logging.WARNING,
+                             logger="kube_batch_tpu.trace.lineage"):
+            cfg = pod_lineage.refresh()
+        assert cfg.enabled  # pin the default (on), loudly
+        assert any("maybe" in r.message for r in caplog.records)
+
+
+# ----------------------------------------------------------------------
+# per-tenant fairness surface
+
+
+class TestTenants:
+    def test_table_from_proportion_open(self):
+        h = Harness(conf=CONF_TPU, queues=("q1", "q2"), weights=(3, 1))
+        h.add_nodes(2)
+        h.create_job("j", 2, 2, queue="q1")
+        h.create_job("big", 8, 8, queue="q2", cpu="4", mem="8Gi")
+        h.cycle()
+        h.cycle()
+        snap = tenant_table.snapshot()
+        assert snap["session_uid"]
+        rows = snap["queues"]
+        assert {"q1", "q2"} <= set(rows)
+        q2 = rows["q2"]
+        # q2's gang cannot fit: pending demand + starvation age.
+        assert q2["pending_jobs"] >= 1
+        assert q2["starvation_s"] >= 0
+        assert q2["starved"] is True
+        # q1 bound in cycle 1, so at cycle 2's open it holds its share.
+        q1 = rows["q1"]
+        assert q1["pending_jobs"] == 0 and q1["starved"] is False
+        assert q1["allocated_share"] > 0
+        # Weighted water-filling: both deserved shares are fractions.
+        for row in rows.values():
+            assert 0 <= row["deserved_share"] <= 1.0001
+        # drf's rider: the bound q1 job has a nonzero max job share.
+        assert q1.get("max_job_share", 0) > 0
+
+    def test_gauges_on_metrics_text(self):
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2)
+        h.create_job("j", 2, 2)
+        h.cycle()
+        text = metrics.registry.expose()
+        assert 'kube_batch_tenant_share{queue="q1"}' in text
+        assert 'kube_batch_tenant_deserved_share{queue="q1"}' in text
+        assert "kube_batch_tenant_starvation_seconds" in text
+
+
+# ----------------------------------------------------------------------
+# /debug endpoints
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read().decode())
+
+
+class TestDebugEndpoints:
+    def test_index_lineage_and_tenants(self):
+        from kube_batch_tpu.cli.server import start_metrics_server
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2)
+        h.create_job("j", 2, 2)
+        h.cycle()
+        server = start_metrics_server("127.0.0.1:0")
+        try:
+            base = f"http://127.0.0.1:{server.server_address[1]}"
+            # The index lists every endpoint with a one-line description.
+            status, index = _get(f"{base}/debug")
+            assert status == 200
+            urls = set(index["endpoints"])
+            for want in ("sessions", "trace", "why", "lineage",
+                         "tenants"):
+                assert any(want in u for u in urls), (want, urls)
+            assert all(index["endpoints"][u] for u in urls)
+            assert index["lineage"]["tracked_pods"] >= 2
+
+            status, lin = _get(f"{base}/debug/lineage?pod=j-0")
+            assert status == 200 and lin["bound"]
+            assert [s["stage"] for s in lin["stages"]][0] == "ingest"
+
+            status, tenants = _get(f"{base}/debug/tenants")
+            assert status == 200 and "q1" in tenants["queues"]
+
+            assert _get(f"{base}/debug/lineage")[0] == 400
+            assert _get(f"{base}/debug/lineage?pod=ghost")[0] == 404
+        finally:
+            server.shutdown()
+
+
+# ----------------------------------------------------------------------
+# over the HTTP edge (one --edge wire run)
+
+
+class TestEdgeWireLineage:
+    def test_wire_run_yields_edge_stamped_lineage(self):
+        from kube_batch_tpu.api import ObjectMeta
+        from kube_batch_tpu.apis.scheduling import v1alpha1
+        from kube_batch_tpu.cache import Cluster, new_scheduler_cache
+        from kube_batch_tpu.edge import ApiServer, RemoteCluster
+        from kube_batch_tpu.scheduler import (DEFAULT_SCHEDULER_CONF,
+                                              Scheduler)
+        from tests.test_utils import (build_node, build_pod,
+                                      build_resource_list)
+
+        cluster = Cluster()
+        server = ApiServer(cluster).start()
+        remote = None
+        sched = None
+        try:
+            cluster.create_node(build_node(
+                "n0", build_resource_list("8", "16Gi", pods=110)))
+            cluster.create_queue(v1alpha1.Queue(
+                metadata=ObjectMeta(name="default"),
+                spec=v1alpha1.QueueSpec(weight=1)))
+            cluster.create_pod_group(v1alpha1.PodGroup(
+                metadata=ObjectMeta(name="pg1", namespace="ns"),
+                spec=v1alpha1.PodGroupSpec(min_member=2, queue="default")))
+            remote = RemoteCluster(server.url).start()
+            cache = new_scheduler_cache(remote)
+            sched = Scheduler(cache, scheduler_conf=DEFAULT_SCHEDULER_CONF
+                              .replace('"allocate, backfill"',
+                                       '"tpu-allocate, backfill"'),
+                              schedule_period=0.05)
+            sched.run()
+            for i in range(2):
+                remote.create_pod(build_pod(
+                    "ns", f"p{i}", "", "Pending",
+                    build_resource_list("2", "4Gi"), "pg1"))
+            deadline = time.time() + 30
+            lin = None
+            while time.time() < deadline:
+                lin = pod_lineage.lineage("ns/p0")
+                if lin is not None and lin.get("bound") and any(
+                        s["stage"] == "echo" for s in lin["stages"]):
+                    break
+                time.sleep(0.1)
+        finally:
+            if sched is not None:
+                sched.stop()
+            if remote is not None:
+                remote.stop()
+            server.stop()
+        assert lin is not None and lin["bound"], lin
+        stages = {s["stage"]: s for s in lin["stages"]}
+        # The wire run's ingest carries the EDGE decode stamp.
+        assert stages["ingest"].get("detail") == "edge"
+        for want in ("ingest", "considered", "placed", "bind_sent",
+                     "bound", "echo"):
+            assert want in stages, (want, sorted(stages))
+        assert lin["time_to_bind_s"] >= 0
+        # Ingest precedes everything else on the shared monotonic clock.
+        assert all(s["t_rel_s"] >= 0 for s in lin["stages"])

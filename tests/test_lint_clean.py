@@ -82,5 +82,14 @@ def test_contract_annotations_cover_the_known_invariants():
     assert len(recorder_guarded) >= 2, (
         "flight-recorder guarded-by coverage shrank: "
         f"{[str(m) for m in recorder_guarded]}")
+    # The pod-lineage recorder's ring + session ledger (trace/lineage.py)
+    # stay under lock discipline: reflector threads, the scheduling
+    # thread, and /debug readers all touch them.
+    lineage_guarded = [m for m in by_kind.get("guarded-by", [])
+                       if m.path.replace("\\", "/").endswith(
+                           "trace/lineage.py")]
+    assert len(lineage_guarded) >= 4, (
+        "pod-lineage guarded-by coverage shrank: "
+        f"{[str(m) for m in lineage_guarded]}")
     # The except-audit markers stay greppable.
     assert len(by_kind.get("allow-swallow", [])) >= 10

@@ -219,3 +219,94 @@ def test_empty_counter_exposes_zero_sample():
     parsed = parse_exposition(reg.expose())
     (_n, _labels, value), = parsed["t_zero"]["samples"]
     assert value == 0.0
+
+
+# ----------------------------------------------------------------------
+# label-cardinality bound (doc/OBSERVABILITY.md "SLO metrics"): a
+# namespace/queue storm must not blow up the scrape
+
+
+def test_namespace_storm_is_cardinality_bounded(monkeypatch):
+    from kube_batch_tpu.metrics import metrics
+
+    monkeypatch.setenv(metrics.SERIES_CAP_ENV, "8")
+    metrics.refresh_series_cap()
+    try:
+        dropped0 = metrics.series_dropped.value("slo")
+        storm = 1000
+        for i in range(storm):
+            metrics.observe_time_to_bind(f"storm-q{i}", 0.25)
+        with metrics.slo_time_to_bind._lock:
+            queues = {labels[0] for labels
+                      in metrics.slo_time_to_bind._counts
+                      if labels and labels[0].startswith("storm-q")
+                      or labels == (metrics.OTHER_LABEL,)}
+        # At most the cap's worth of storm queues became real series...
+        storm_series = [q for q in queues if q.startswith("storm-q")]
+        assert len(storm_series) <= 8
+        # ...the overflow collapsed into ONE shared 'other' series...
+        with metrics.slo_time_to_bind._lock:
+            other = metrics.slo_time_to_bind._totals.get(
+                (metrics.OTHER_LABEL,), 0)
+        assert other >= storm - 8
+        # ...and every rerouted observation was counted.
+        assert (metrics.series_dropped.value("slo") - dropped0
+                >= storm - 8)
+        # The exposition still parses strictly and stays bounded.
+        parsed = parse_exposition(registry.expose())
+        fam = parsed["kube_batch_slo_time_to_bind_seconds"]
+        series = {labels["queue"] for _n, labels, _v in fam["samples"]}
+        assert len([q for q in series if q.startswith("storm-q")]) <= 8
+        assert metrics.OTHER_LABEL in series
+    finally:
+        metrics.refresh_series_cap()  # drop the storm's seen-set
+
+
+def test_tenant_gauges_share_one_cardinality_budget(monkeypatch):
+    from kube_batch_tpu.metrics import metrics
+
+    monkeypatch.setenv(metrics.SERIES_CAP_ENV, "4")
+    metrics.refresh_series_cap()
+    try:
+        for i in range(50):
+            metrics.set_tenant_stats(f"storm-t{i}", 1.0, 0.5, 0.5, 1,
+                                     2.0, False)
+        with metrics.tenant_share._lock:
+            tenant_series = [l for l in metrics.tenant_share._values
+                             if l and l[0].startswith("storm-t")]
+        assert len(tenant_series) <= 4
+        assert metrics.series_dropped.value("tenant") >= 46
+        parse_exposition(registry.expose())  # still strictly valid
+    finally:
+        metrics.refresh_series_cap()
+
+
+def test_adversarial_queue_name_via_slo_path(monkeypatch):
+    """An adversarial queue name flows decode -> lineage -> histogram:
+    the scrape must survive AND round-trip it."""
+    from kube_batch_tpu.metrics import metrics
+
+    metrics.refresh_series_cap()
+    try:
+        metrics.observe_time_to_bind(ADVERSARIAL, 0.5)
+        parsed = parse_exposition(registry.expose())
+        fam = parsed["kube_batch_slo_time_to_bind_seconds"]
+        assert any(labels["queue"] == ADVERSARIAL
+                   for _n, labels, _v in fam["samples"])
+    finally:
+        metrics.refresh_series_cap()
+
+
+def test_malformed_series_cap_env_warns_and_pins_default(
+        monkeypatch, caplog):
+    import logging
+
+    from kube_batch_tpu.metrics import metrics
+
+    monkeypatch.setenv(metrics.SERIES_CAP_ENV, "lots")
+    with caplog.at_level(logging.WARNING,
+                         logger="kube_batch_tpu.metrics.metrics"):
+        cap = metrics.refresh_series_cap()
+    assert cap == metrics.DEFAULT_SERIES_CAP
+    assert any("lots" in r.message for r in caplog.records)
+    metrics.refresh_series_cap()

@@ -1,0 +1,358 @@
+"""Continuous perf-regression gate: diff a bench artifact against the
+committed baseline (`make bench-gate`).
+
+bench.py deliberately always exits 0 (the artifact-always-emits
+contract); pass/fail lives HERE, exactly like tools/check_churn_ab.py —
+a regression, a bench error, or a missing artifact exits nonzero and
+fails CI instead of waiting for a reviewer to eyeball five uncompared
+BENCH_r0*.json files.
+
+Rules (doc/OBSERVABILITY.md "The bench gate"): every gated key carries a
+baseline MEDIAN, a direction (lower-better ms/bytes vs higher-better
+throughput), a relative NOISE BAND, and an absolute slack floor (so a
+0.1 ms floor cannot fail on a 0.2 ms blip).  A candidate is a regression
+when it lands outside ``base * (1 ± band) ± abs_slack`` on the bad side.
+Bands live in the baseline file per key: deterministic keys (ship bytes)
+run tight, wall-clock keys run wide enough to absorb cross-box variance
+(CI runners are not the box the baseline was measured on) — same-box
+runs can tighten everything with BENCH_GATE_BAND_SCALE < 1.
+
+Every invocation appends one line to ``doc/BENCH_TRAJECTORY.jsonl`` (the
+machine-readable latency trajectory the ROADMAP reasons about) and can
+write a JSON comparison report for the CI artifact upload.
+
+Usage:
+  python bench.py | python tools/bench_compare.py \
+      --baseline doc/BENCH_BASELINE.json \
+      --trajectory doc/BENCH_TRAJECTORY.jsonl \
+      --report doc/bench_gate_report.json [--label <tag>]
+  ... --update-baseline     # (re)write the baseline from this artifact
+  ... --no-gate             # extract + append trajectory only, exit 0
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Dict, Optional, Tuple
+
+BAND_SCALE_ENV = "BENCH_GATE_BAND_SCALE"
+
+# The gated keys: artifact path, direction, default relative band,
+# absolute slack.  Wall-clock keys carry wide default bands on purpose —
+# the committed baseline is measured on ONE box and CI runs on another;
+# the band must not turn box variance into a red PR.  Deterministic keys
+# (bytes shipped) run tight.  Per-key overrides in the baseline file
+# win over these defaults.
+GATED_KEYS = {
+    "steady_ms": {
+        "path": ("session_steady_ms",), "direction": "down",
+        "band": 1.0, "abs_slack": 2.0},
+    "steady_p90_ms": {
+        "path": ("session_steady_p90",), "direction": "down",
+        "band": 1.25, "abs_slack": 3.0},
+    "sessions_per_sec": {
+        "path": ("sessions_per_sec",), "direction": "up",
+        "band": 0.6, "abs_slack": 0.0},
+    "ship_delta_bytes": {
+        "path": ("ship", "delta", 1), "direction": "down",
+        "band": 0.25, "abs_slack": 4096.0},
+    "floors_ms.solve_wait": {
+        "path": ("floors_ms", "solve_wait"), "direction": "down",
+        "band": 3.0, "abs_slack": 5.0},
+    "floors_ms.snapshot": {
+        "path": ("floors_ms", "snapshot"), "direction": "down",
+        "band": 3.0, "abs_slack": 5.0},
+    "floors_ms.close": {
+        "path": ("floors_ms", "close"), "direction": "down",
+        "band": 3.0, "abs_slack": 5.0},
+    "floors_ms.occupancy": {
+        "path": ("floors_ms", "occupancy"), "direction": "down",
+        "band": 3.0, "abs_slack": 5.0},
+    # Full-bench keys: absent from steady-only artifacts (so they never
+    # enter the bench-gate baseline) but extracted into the trajectory
+    # when a full 50k-shape run is appended — the cross-PR history the
+    # five BENCH_r0*.json artifacts seed.
+    "solve_ms": {
+        "path": ("value",), "direction": "down",
+        "band": 1.0, "abs_slack": 5.0},
+    "session_ms": {
+        "path": ("session_ms",), "direction": "down",
+        "band": 1.0, "abs_slack": 5.0},
+    "session_cold_ms": {
+        "path": ("session_cold_ms",), "direction": "down",
+        "band": 1.0, "abs_slack": 5.0},
+    "preempt_ms": {
+        "path": ("actions_ms", "preempt"), "direction": "down",
+        "band": 1.0, "abs_slack": 5.0},
+}
+
+
+def extract_keys(artifact: dict) -> Dict[str, float]:
+    """Pull every gated key present in the artifact (missing paths are
+    simply absent — a steady-only artifact has no churn keys and vice
+    versa)."""
+    out: Dict[str, float] = {}
+    for name, spec in GATED_KEYS.items():
+        node = artifact
+        ok = True
+        for step in spec["path"]:
+            try:
+                node = node[step]
+            except (KeyError, IndexError, TypeError):
+                ok = False
+                break
+        if ok and isinstance(node, (int, float)) and node is not True \
+                and node is not False:
+            out[name] = float(node)
+    return out
+
+
+def _band_scale() -> float:
+    raw = os.environ.get(BAND_SCALE_ENV)
+    if not raw:
+        return 1.0
+    try:
+        scale = float(raw)
+        if scale <= 0:
+            raise ValueError(raw)
+        return scale
+    except ValueError:
+        print(f"bench_compare: {BAND_SCALE_ENV}={raw!r} is not a positive "
+              "number; using 1.0", file=sys.stderr)
+        return 1.0
+
+
+def judge_key(name: str, candidate: float, base: float,
+              band: float, abs_slack: float,
+              direction: str) -> Tuple[str, float]:
+    """('ok'|'regressed'|'improved', limit): median + noise-band rule.
+    ``limit`` is the worst acceptable candidate value."""
+    if direction == "up":
+        limit = base * (1.0 - band) - abs_slack
+        if candidate < limit:
+            return "regressed", limit
+        if candidate > base * (1.0 + band) + abs_slack:
+            return "improved", limit
+    else:
+        limit = base * (1.0 + band) + abs_slack
+        if candidate > limit:
+            return "regressed", limit
+        if candidate < base * (1.0 - band) - abs_slack:
+            return "improved", limit
+    return "ok", limit
+
+
+def compare(artifact: dict, baseline: dict,
+            band_scale: float = 1.0) -> dict:
+    """The full comparison report.  ``baseline["keys"]`` carries the
+    medians; optional ``baseline["bands"]`` / ``baseline["abs_slack"]``
+    override the per-key defaults."""
+    candidate = extract_keys(artifact)
+    base_keys: Dict[str, float] = baseline.get("keys") or {}
+    bands: Dict[str, float] = baseline.get("bands") or {}
+    slacks: Dict[str, float] = baseline.get("abs_slack") or {}
+    rows = {}
+    regressed = []
+    missing = []
+    for name, base in base_keys.items():
+        spec = GATED_KEYS.get(name, {})
+        band = float(bands.get(name, spec.get("band", 0.5))) * band_scale
+        abs_slack = float(slacks.get(name, spec.get("abs_slack", 0.0)))
+        direction = spec.get("direction", "down")
+        cand = candidate.get(name)
+        if cand is None:
+            # A change that stops EMITTING a gated measurement must not
+            # silently un-gate it (the vacuous-gate failure mode
+            # tools/check_churn_ab.py was hardened against): a key in
+            # the committed baseline that is absent from the candidate
+            # artifact fails the gate.
+            rows[name] = {"baseline": base, "candidate": None,
+                          "verdict": "missing"}
+            missing.append(name)
+            continue
+        verdict, limit = judge_key(name, cand, base, band, abs_slack,
+                                   direction)
+        rows[name] = {"baseline": base, "candidate": cand,
+                      "band": round(band, 4), "abs_slack": abs_slack,
+                      "direction": direction, "limit": round(limit, 4),
+                      "ratio": (round(cand / base, 4) if base else None),
+                      "verdict": verdict}
+        if verdict == "regressed":
+            regressed.append(name)
+    extras = {k: v for k, v in candidate.items() if k not in base_keys}
+    return {
+        "pass": not regressed and not missing,
+        "regressed": regressed,
+        "missing": missing,
+        "keys": rows,
+        "ungated_keys": extras,
+        "band_scale": band_scale,
+        "baseline_shape": baseline.get("shape"),
+        "artifact_metric": artifact.get("metric"),
+        "artifact_platform": artifact.get("platform"),
+    }
+
+
+def make_baseline(artifact: dict, shape: Optional[dict] = None) -> dict:
+    keys = extract_keys(artifact)
+    return {
+        "comment": "Committed bench-gate baseline (make bench-gate). "
+                   "Regenerate with: make bench-gate-baseline.  Bands "
+                   "are per-key relative noise tolerances; wall-clock "
+                   "keys run wide to absorb cross-box variance, "
+                   "deterministic keys (bytes) run tight "
+                   "(doc/OBSERVABILITY.md 'The bench gate').",
+        "shape": shape or {
+            "metric": artifact.get("metric"),
+            "platform": artifact.get("platform"),
+        },
+        "keys": keys,
+        "bands": {name: GATED_KEYS[name]["band"]
+                  for name in keys if name in GATED_KEYS},
+        "abs_slack": {name: GATED_KEYS[name]["abs_slack"]
+                      for name in keys if name in GATED_KEYS},
+    }
+
+
+def append_trajectory(path: str, artifact: dict, report: Optional[dict],
+                      label: str = "") -> dict:
+    entry = {
+        "when": time.strftime("%Y-%m-%dT%H:%M:%S%z"),
+        "label": label or None,
+        "metric": artifact.get("metric"),
+        "platform": artifact.get("platform"),
+        "keys": extract_keys(artifact),
+        "pass": report["pass"] if report is not None else None,
+        "regressed": report["regressed"] if report is not None else None,
+    }
+    with open(path, "a") as f:
+        f.write(json.dumps(entry) + "\n")
+    return entry
+
+
+def read_artifact(source) -> Optional[dict]:
+    """Last JSON-looking line wins (the bench artifact contract; stderr
+    noise and progress lines are ignored).  A pretty-printed FILE (the
+    committed BENCH_r0*.json wrappers) parses as one whole document."""
+    text = source.read()
+    try:
+        doc = json.loads(text)
+        if isinstance(doc, dict):
+            return doc
+    except ValueError:
+        pass
+    line = ""
+    for raw in text.splitlines():
+        raw = raw.strip()
+        if raw.startswith("{"):
+            line = raw
+    return json.loads(line) if line else None
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--artifact", help="artifact JSON file (default: the "
+                    "last JSON line on stdin)")
+    ap.add_argument("--baseline", default="doc/BENCH_BASELINE.json")
+    ap.add_argument("--trajectory", default=None,
+                    help="JSONL file to append this run's keys to")
+    ap.add_argument("--report", default=None,
+                    help="write the full comparison report JSON here")
+    ap.add_argument("--label", default="",
+                    help="trajectory entry label (e.g. a PR/round tag)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="(re)write the baseline from this artifact "
+                    "instead of gating against it")
+    ap.add_argument("--no-gate", action="store_true",
+                    help="extract keys + append trajectory only; never "
+                    "fails (used to seed the trajectory from historical "
+                    "artifacts)")
+    args = ap.parse_args(argv)
+
+    if args.artifact:
+        with open(args.artifact) as f:
+            artifact = read_artifact(f)
+    else:
+        artifact = read_artifact(sys.stdin)
+    if artifact is None:
+        print("bench_compare: no artifact JSON found", file=sys.stderr)
+        return 1
+    # The BENCH_r0*.json wrappers nest the real artifact under "parsed".
+    if "parsed" in artifact and isinstance(artifact["parsed"], dict):
+        artifact = artifact["parsed"]
+    if artifact.get("error"):
+        print(f"bench_compare: bench reported error: {artifact['error']}",
+              file=sys.stderr)
+        if not args.no_gate:
+            return 1
+
+    if args.update_baseline:
+        baseline = make_baseline(artifact)
+        with open(args.baseline, "w") as f:
+            json.dump(baseline, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"bench_compare: baseline written to {args.baseline} "
+              f"({len(baseline['keys'])} keys)")
+        if args.trajectory:
+            append_trajectory(args.trajectory, artifact, None,
+                              label=args.label or "baseline")
+        return 0
+
+    report = None
+    if not args.no_gate:
+        try:
+            with open(args.baseline) as f:
+                baseline = json.load(f)
+        except FileNotFoundError:
+            print(f"bench_compare: no baseline at {args.baseline}; run "
+                  "with --update-baseline first (make "
+                  "bench-gate-baseline)", file=sys.stderr)
+            return 1
+        report = compare(artifact, baseline, band_scale=_band_scale())
+
+    if args.trajectory:
+        append_trajectory(args.trajectory, artifact, report,
+                          label=args.label)
+    if args.report and report is not None:
+        with open(args.report, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    if report is None:
+        print(f"bench_compare: extracted "
+              f"{len(extract_keys(artifact))} keys (no gate)")
+        return 0
+
+    for name, row in sorted(report["keys"].items()):
+        cand = row.get("candidate")
+        print(f"  {name:>24s}  base {row['baseline']:>12.3f}  "
+              + (f"cand {cand:>12.3f}  x{row.get('ratio')}  "
+                 f"[{row['verdict']}]" if cand is not None
+                 else "cand      MISSING  [missing]"))
+    if report["pass"]:
+        print("bench-gate: PASS — no gated key regressed beyond its "
+              "noise band")
+        return 0
+    if report["missing"]:
+        print("bench-gate: FAIL — baseline keys missing from the "
+              "candidate artifact (a gated measurement stopped "
+              "emitting): " + ", ".join(report["missing"]),
+              file=sys.stderr)
+    if report["regressed"]:
+        print("bench-gate: FAIL — regressed keys: "
+              + ", ".join(report["regressed"]), file=sys.stderr)
+    for name in report["regressed"]:
+        row = report["keys"][name]
+        print(f"  {name}: candidate {row['candidate']} vs baseline "
+              f"{row['baseline']} (worst acceptable {row['limit']}, "
+              f"direction {row['direction']}, band {row['band']})",
+          file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

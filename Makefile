@@ -3,8 +3,9 @@ PYTHON ?= python
 COMPILE_CACHE ?= $(CURDIR)/.compile-cache
 
 .PHONY: lint lint-inventory test bench bench-cached bench-steady \
-	bench-evict bench-churn bench-shard bench-gate bench-gate-baseline \
-	lineage-ab chaos chaos-smoke trace-demo clean-cache
+	bench-evict bench-churn bench-shard bench-topo bench-gate \
+	bench-gate-baseline lineage-ab chaos chaos-smoke scenarios \
+	trace-demo clean-cache
 
 # The bench-gate shape: small enough for CI, big enough that the steady
 # path, delta shipping, and the residual floors all exercise (mirrors
@@ -96,6 +97,29 @@ bench-shard:
 		BENCH_JOBS=80 BENCH_QUEUES=4 \
 		KUBE_BATCH_TPU_SCAN_MIN_NODES=0 $(PYTHON) bench.py \
 		| $(PYTHON) tools/check_shard_ab.py
+
+# Topology A/B smoke (doc/TOPOLOGY.md): defrag-aware vs capacity-only
+# eviction on a fragmentation-pressure torus, plus batched-vs-
+# sequential and FORCE_SHARD-mesh placement parity.  The checker exits
+# nonzero on any bind/victim divergence, a defrag arm that fails to
+# produce a strictly larger contiguous free block, or a vacuous run
+# with zero slice placements (bench.py itself always exits 0), so CI
+# fails loudly.
+bench-topo:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		BENCH_TOPO_AB=1 $(PYTHON) bench.py \
+		| $(PYTHON) tools/check_topo_ab.py
+
+# Adversarial scenario sweep (doc/TOPOLOGY.md "Scenario harness"):
+# seeded generated workloads (gang deadlocks, priority inversions,
+# churn storms, hetero pools, fragmentation pressure) run against the
+# sequential parity oracle — bit-identical binds, no double-bind, no
+# lost eviction, no node overcommit — plus one lineage-ring replay
+# round-trip.  Exits nonzero on any divergence.
+scenarios:
+	env JAX_PLATFORMS=cpu $(PYTHON) tools/scenario_gen.py --seeds 20 \
+		--cycles 4 --replay
 
 # Continuous perf-regression gate (doc/OBSERVABILITY.md "The bench
 # gate"): run the steady bench at the pinned gate shape, diff the

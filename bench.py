@@ -263,7 +263,8 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
             updater.pod_groups.clear()
         return len(binds)
 
-    from kube_batch_tpu.metrics.metrics import (cycle_floor_values,
+    from kube_batch_tpu.metrics.metrics import (compile_cache_counts,
+                                                cycle_floor_values,
                                                 overlap_split_totals,
                                                 route_counts, ship_counts,
                                                 ship_shard_counts)
@@ -280,6 +281,7 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
         host_overlap = []
         device_wait = []
         floors_rounds = []
+        recompiled = []
         ship0 = ship_counts()
         shard0 = ship_shard_counts()
         routes0 = route_counts()
@@ -331,8 +333,16 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
                         metadata=ObjectMeta(name=pg_name, namespace="bench"),
                         spec=v1alpha1.PodGroupSpec(min_member=1)))
             h0, w0, _ = overlap_split_totals()
+            _hits0, miss0 = compile_cache_counts()
             steady.append(session_ms())
             h1, w1, _ = overlap_split_totals()
+            _hits1, miss1 = compile_cache_counts()
+            # A fresh in-process compile inside this round (churn
+            # crossing a bucket boundary) makes its wall clock a
+            # compile measurement, not a steady one: mark it so the
+            # steady median/p90 window can drop it
+            # (doc/OBSERVABILITY.md "The bench gate").
+            recompiled.append(miss1 > miss0)
             floors_rounds.append(cycle_floor_values())
             echo()
             retire.append((pgs, new_keys))
@@ -399,6 +409,13 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
                        for floor in floors_rounds[-1]}
                       if len(floors_rounds) > 1 and floors_rounds[-1]
                       else None),
+        # Rounds of the [1:] steady window that contained a fresh XLA
+        # compile: their wall clock measures the recompile, not the
+        # steady state, so the median/p90 summary drops them (falling
+        # back to the full window only if EVERY round recompiled).
+        "recompiled_rounds": int(sum(recompiled[1:])),
+        "steady_clean": ([ms for ms, rec in zip(steady[1:], recompiled[1:])
+                          if not rec] or steady[1:]),
     }
     return round(cold, 1), steady[1:], stats
 
@@ -442,6 +459,132 @@ def _fill_lineage_ab(out, n_tasks, n_nodes, n_jobs, n_queues, rounds):
         "rounds_per_arm": len(arms["1"]),
         "tracked_pods": tracked,
     }
+
+
+TOPO_CONF = """
+actions: "topo-allocate, tpu-allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: topology
+"""
+
+
+def _run_topo_arm(defrag: bool, batch: bool, force_shard: bool = False):
+    """One topo A/B arm: a two-cycle fragmentation-pressure run on the
+    checkerboard torus (models/synthetic.make_topo_cache) —
+
+      cycle 1: the slice job finds no free box; the defrag arm evicts a
+               contiguous box (and pipelines the slice onto it), the
+               capacity arm evicts by count only (here: nothing — free
+               capacity already exceeds the slice, which is exactly the
+               reasoning gap the A/B exposes);
+      echo:    evicted victims terminate (deleted at truth) — the
+               kubelet's side of a preemption;
+      frag:    largest contiguous free block measured at truth, BEFORE
+               any placement consumes it (the defrag-vs-capacity
+               comparison key tools/check_topo_ab.py gates);
+      cycle 2: the defrag arm's cleared box is now a FREE box — the
+               slice places and binds; the capacity arm stays pending.
+
+    Returns (binds, evict_sequence, frag_after, slice_binds)."""
+    import numpy as np
+
+    from kube_batch_tpu.framework import close_session, open_session
+    from kube_batch_tpu.models.synthetic import make_topo_cache
+    from kube_batch_tpu.models.topology import (TOPO_BATCH_ENV,
+                                                TOPO_DEFRAG_ENV, build_view)
+    from kube_batch_tpu.ops.solver import FORCE_SHARD_ENV, \
+        refresh_shard_knobs
+    from kube_batch_tpu.scheduler import load_scheduler_conf
+
+    prior = {k: os.environ.get(k) for k in (TOPO_BATCH_ENV,
+                                            TOPO_DEFRAG_ENV,
+                                            FORCE_SHARD_ENV)}
+    os.environ[TOPO_BATCH_ENV] = "1" if batch else "0"
+    os.environ[TOPO_DEFRAG_ENV] = "1" if defrag else "0"
+    if force_shard:
+        os.environ[FORCE_SHARD_ENV] = "1"
+    refresh_shard_knobs()
+    try:
+        _register()
+        cache, binder = make_topo_cache()
+        actions, tiers = load_scheduler_conf(TOPO_CONF)
+        podmap = {}
+        for job in cache.jobs.values():
+            for t in job.tasks.values():
+                from kube_batch_tpu.api import pod_key
+                podmap[pod_key(t.pod)] = t.pod
+
+        def cycle():
+            ssn = open_session(cache, tiers)
+            try:
+                for a in actions:
+                    a.execute(ssn)
+            finally:
+                close_session(ssn)
+
+        cycle()
+        # Evict echo: the victims terminate.
+        evicts = list(cache.evictor.evicts)
+        for key in evicts:
+            pod = podmap.pop(key, None)
+            if pod is not None:
+                cache.delete_pod(pod)
+        # Pre-placement fragmentation at truth (free = empty node).
+        snap_nodes = {name: cache.nodes[name] for name in cache.nodes}
+        view = build_view(snap_nodes)
+        free = np.asarray([not snap_nodes[n].tasks
+                           for n in view.node_names], bool) & view.valid
+        frag_after = view.frag_stats(free)
+        cycle()
+        binds = dict(binder.binds)
+        slice_binds = {k: v for k, v in binds.items() if "slice0" in k}
+        return binds, evicts, frag_after, slice_binds
+    finally:
+        for k, v in prior.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        refresh_shard_knobs()
+
+
+def _fill_topo_ab(out):
+    """BENCH_TOPO_AB=1 (`make bench-topo`): the topology subsystem's A/B
+    smoke (doc/TOPOLOGY.md) — defrag-vs-capacity eviction contrast on a
+    fragmentation-pressure scenario, plus the batched-vs-sequential and
+    FORCE_SHARD-mesh parity legs tools/check_topo_ab.py gates CI on."""
+    b_bat, e_bat, frag_d, slices_d = _run_topo_arm(defrag=True, batch=True)
+    b_seq, e_seq, _f, _s = _run_topo_arm(defrag=True, batch=False)
+    out["topo_parity"] = (b_bat == b_seq and e_bat == e_seq)
+    b_sh, e_sh, _f2, _s2 = _run_topo_arm(defrag=True, batch=True,
+                                         force_shard=True)
+    out["topo_shard_parity"] = (b_bat == b_sh and e_bat == e_sh)
+    _bc, e_cap, frag_c, slices_c = _run_topo_arm(defrag=False, batch=True)
+    out["topo_ab"] = {
+        "defrag": {
+            "largest_free_block": max(
+                (r["largest_block"] for r in frag_d.values()), default=0),
+            "frag": frag_d, "evictions": len(e_bat),
+            "slice_binds": len(slices_d),
+        },
+        "capacity": {
+            "largest_free_block": max(
+                (r["largest_block"] for r in frag_c.values()), default=0),
+            "frag": frag_c, "evictions": len(e_cap),
+            "slice_binds": len(slices_c),
+        },
+    }
+    from kube_batch_tpu.metrics.metrics import topo_slice_counts
+    out["topo_slices"] = topo_slice_counts()
 
 
 def run_session_stages(cache, tiers):
@@ -1179,7 +1322,18 @@ def _fill_action_ab(out, n_tasks, n_nodes, n_jobs, n_queues,
 
 def _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline,
          steady_only=False, steady_rounds_n=5, evict_only=False,
-         churn_only=False, shard_only=False, lineage_only=False):
+         churn_only=False, shard_only=False, lineage_only=False,
+         topo_only=False):
+    if topo_only:
+        # BENCH_TOPO_AB=1 (`make bench-topo`): ONLY the topology A/B —
+        # defrag-vs-capacity eviction on the fragmentation-pressure
+        # torus plus the batched/sequential/mesh parity legs
+        # tools/check_topo_ab.py gates CI on (doc/TOPOLOGY.md).
+        import jax as _jax
+        out["platform"] = _jax.default_backend()
+        out["mesh_devices"] = len(_jax.devices())
+        _fill_topo_ab(out)
+        return
     if lineage_only:
         # BENCH_LINEAGE_AB=1 (`make lineage-ab`): ONLY the pod-lineage
         # overhead A/B — counterbalanced steady rounds with the SLO
@@ -1309,8 +1463,14 @@ def _run_full(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n,
     # and the delta-ship counters.
     steady_cold, steady_rounds, steady_stats = measure_steady_session(
         n_tasks, n_nodes, n_jobs, n_queues, rounds=steady_rounds_n)
+    # The steady summary window excludes rounds that paid a fresh XLA
+    # compile (bucket drift): steady_p90 previously captured the
+    # recompile round, carrying a documented asterisk through every
+    # bench-gate comparison.  The count is reported so a sweep where
+    # recompiles dominate is visible, not hidden.
     out["session_steady_ms"], out["session_steady_p90"] = _stats(
-        steady_rounds)
+        steady_stats.get("steady_clean") or steady_rounds)
+    out["steady_recompiled_rounds"] = steady_stats.get("recompiled_rounds")
     out["sessions_per_sec"] = steady_stats["sessions_per_sec"]
     if steady_stats["host_overlap_ms"]:
         out["host_overlap_ms"], out["host_overlap_p90"] = _stats(
@@ -1412,6 +1572,18 @@ def main():
         # `make lineage-ab`) — doc/OBSERVABILITY.md.
         "floors_ms": None,
         "lineage_ab": None,
+        # Topology A/B (BENCH_TOPO_AB=1 / `make bench-topo`): defrag vs
+        # capacity eviction contrast + batched/sequential/mesh parity
+        # (doc/TOPOLOGY.md; gated by tools/check_topo_ab.py).
+        "topo_ab": None,
+        "topo_parity": None,
+        "topo_shard_parity": None,
+        "topo_slices": None,
+        # Steady rounds whose window contained a fresh XLA compile
+        # (bucket drift): excluded from the steady median/p90 so the
+        # gate measures steady state, not the recompile
+        # (doc/OBSERVABILITY.md "The bench gate").
+        "steady_recompiled_rounds": None,
     }
 
     import threading
@@ -1451,6 +1623,7 @@ def main():
         churn_only = os.environ.get("BENCH_CHURN_SWEEP") == "1"
         shard_only = os.environ.get("BENCH_SHARD_AB") == "1"
         lineage_only = os.environ.get("BENCH_LINEAGE_AB") == "1"
+        topo_only = os.environ.get("BENCH_TOPO_AB") == "1"
         steady_rounds_n = int(os.environ.get("BENCH_STEADY_ROUNDS", 5))
         out["metric"] = (f"sched-session solve latency @ {n_tasks} tasks "
                          f"x {n_nodes} nodes (gang+DRF+proportion)"
@@ -1458,7 +1631,8 @@ def main():
                          + (" [evict-ab]" if evict_only else "")
                          + (" [churn-sweep]" if churn_only else "")
                          + (" [shard-ab]" if shard_only else "")
-                         + (" [lineage-ab]" if lineage_only else ""))
+                         + (" [lineage-ab]" if lineage_only else "")
+                         + (" [topo-ab]" if topo_only else ""))
 
         # Wall-clock backstop for hangs the signal guard cannot reach
         # (a device call blocked in an extension never returns to the
@@ -1496,7 +1670,8 @@ def main():
         _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline,
              steady_only=steady_only, steady_rounds_n=steady_rounds_n,
              evict_only=evict_only, churn_only=churn_only,
-             shard_only=shard_only, lineage_only=lineage_only)
+             shard_only=shard_only, lineage_only=lineage_only,
+             topo_only=topo_only)
         # Last statement INSIDE the try: a signal landing here is still
         # caught below — no handlerless gap before the emit.
         _ignore_signals()

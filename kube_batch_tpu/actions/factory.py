@@ -12,3 +12,7 @@ def register_default_actions() -> None:
     # The TPU-batched allocate action (imports jax lazily).
     from . import tpu_allocate
     register_action(tpu_allocate.new())
+    # Topology-aware slice placement (imports jax lazily via the
+    # batched box scan; doc/TOPOLOGY.md).
+    from . import topo_allocate
+    register_action(topo_allocate.new())

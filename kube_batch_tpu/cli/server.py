@@ -18,6 +18,9 @@ endpoints (doc/OBSERVABILITY.md) ride the same server:
   /debug/tenants             per-queue fairness table (share vs
                              deserved, starvation age) from the last
                              session's proportion/drf opens
+  /debug/topology            per-pool fragmentation (free nodes,
+                             largest contiguous free block, frag
+                             ratio) + slice placement outcomes
 """
 
 from __future__ import annotations
@@ -79,6 +82,9 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                            "echo, with time-to-bind",
         "/debug/tenants": "per-queue fairness: share vs deserved, "
                           "pending demand, starvation age",
+        "/debug/topology": "per-pool fragmentation: free nodes, largest "
+                           "contiguous free block, frag ratio, slice "
+                           "placement outcomes",
     }
 
     def _debug(self, path: str, query: dict) -> None:
@@ -109,6 +115,11 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             self._send_json(answer)
         elif path == "/debug/tenants":
             self._send_json(tenant_table.snapshot())
+        elif path == "/debug/topology":
+            from ..models.topology import topo_table
+            doc = topo_table.snapshot()
+            doc["slices"] = metrics.topo_slice_counts()
+            self._send_json(doc)
         elif path == "/debug/sessions":
             self._send_json({"sessions": flight_recorder.summaries(),
                              "capacity": flight_recorder.capacity,

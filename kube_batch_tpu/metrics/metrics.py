@@ -547,6 +547,26 @@ tenant_max_job_share = registry.register(Gauge(
     f"{SUBSYSTEM}_tenant_max_job_share",
     "Largest drf job share inside each queue at the last session open",
     ("queue",)))
+# Topology / fragmentation SLO (models/topology.py, doc/TOPOLOGY.md):
+# per-pool fragmentation computed in the topo action's occupancy walk
+# and surfaced on /debug/topology + the bench-topo artifact.
+topo_frag_ratio = registry.register(Gauge(
+    f"{SUBSYSTEM}_topo_frag_ratio",
+    "Fragmentation of each pool's free nodes: 1 - largest contiguous "
+    "free block / free nodes (0 = one solid block or no free nodes)",
+    ("pool",)))
+topo_largest_free_block = registry.register(Gauge(
+    f"{SUBSYSTEM}_topo_largest_free_block",
+    "Largest contiguous free block (torus-connected nodes) per pool",
+    ("pool",)))
+topo_slices = registry.register(Counter(
+    f"{SUBSYSTEM}_topo_slices_total",
+    "Slice placement outcomes (placed | defrag_placed | pending | "
+    "too_few_tasks | bad_shape | degraded)", ("outcome",)))
+topo_bad_coords = registry.register(Counter(
+    f"{SUBSYSTEM}_topo_bad_coords_total",
+    "Nodes degraded to flat-list placement by malformed/missing/"
+    "duplicate coordinate labels (incl. chaos topology.bad_coords)"))
 
 
 # Helper API (metrics.go:123-191).
@@ -896,3 +916,41 @@ def onwork_values() -> Dict[str, float]:
     out["occupancy_rebuilt"] = occupancy_rows_rebuilt.value()
     out["candidate_rows"] = candidate_rows.value()
     return out
+
+
+_topo_pools_seen: set = set()  # single writer: the scheduling thread's topo action
+
+
+def set_topo_frag(pool: str, frag_ratio: float, largest_block: int) -> None:
+    """Publish one pool's fragmentation row (the topo action's
+    occupancy walk; same shared cardinality budget as tenants)."""
+    p = bounded_label("topo_pool", pool)
+    topo_frag_ratio.set(round(float(frag_ratio), 4), p)
+    topo_largest_free_block.set(float(largest_block), p)
+
+
+def publish_topo_frag(pools: "Dict[str, dict]") -> None:
+    """Replace the fragmentation table wholesale: pools that left the
+    view (decommissioned / mislabeled nodes) have their gauges zeroed
+    so /metrics does not report a departed pool's last fragmentation
+    forever — the tenants-table staleness discipline."""
+    global _topo_pools_seen
+    for pool, row in pools.items():
+        set_topo_frag(pool, row["frag_ratio"], row["largest_block"])
+    for gone in _topo_pools_seen - set(pools):
+        set_topo_frag(gone, 0.0, 0)
+    _topo_pools_seen = set(pools)
+
+
+def note_topo_slice(outcome: str) -> None:
+    topo_slices.inc(1.0, outcome)
+
+
+def topo_slice_counts() -> Dict[str, int]:
+    """{outcome: count} so far — bench-topo artifact + tests."""
+    return {labels[0]: int(v)
+            for labels, v in topo_slices.values().items() if labels}
+
+
+def note_topo_bad_coords() -> None:
+    topo_bad_coords.inc()

@@ -128,7 +128,7 @@ def _scatter_shard(shard_blk, idx, blocks):
 _NODE_FIELDS = frozenset({
     "node_idle", "node_releasing", "node_used", "node_alloc",
     "node_count", "node_max_tasks", "node_exists", "node_ports",
-    "node_selcnt"})
+    "node_selcnt", "node_coords"})
 # [S, N] leaves (TRAILING node axis): stored transposed per shard
 # (node-major, [n_local, S]) so a dirty node row touches O(S bytes), not
 # one block per signature row; the device unpack transposes back.

@@ -287,9 +287,17 @@ class _JobBlock:
 
 class _NodePack:
     """Packed per-node quanta rows (int64 pre-guard), row-updated from
-    informer deltas instead of rebuilt O(cluster) per session."""
+    informer deltas instead of rebuilt O(cluster) per session.
+
+    ``coords_raw`` carries each node's parsed topology
+    (``((pod, rack, x, y, z), declared_dims)`` tuple or None —
+    models/topology.py), refreshed
+    by the same full-build/dirty-row discipline as the quanta rows, so
+    the ``node_coords`` leaf assembly below is O(labeled nodes) per
+    session and O(0) for clusters that never carried a coordinate label
+    (``coords_any`` short-circuits the walk)."""
     __slots__ = ("names", "epochs", "idle", "rel", "used", "alloc",
-                 "count", "maxt", "hi_rows")
+                 "count", "maxt", "hi_rows", "coords_raw", "coords_any")
 
 
 class TensorCache:
@@ -655,6 +663,22 @@ def _occ_fill_row(node, row_ports: np.ndarray, row_sel: np.ndarray,
             row_sel[:ns_real] += matches(rt.pod.metadata.labels)
 
 
+def _node_coords_raw(node):
+    """The node's parsed topology (coords, declared dims) for the pack
+    (pure label parse, no chaos: the injection site lives in the
+    action's build_view — the leaf must stage identical bytes in the
+    chaos and control arms so delta-ship parity holds under
+    injection).  None when the node carries no/malformed coordinates."""
+    from .topology import parse_coord_labels, parse_dim_labels
+    nd = node.node
+    if nd is None:
+        return None
+    coords = parse_coord_labels(nd.metadata.labels)
+    if coords is None:
+        return None
+    return (coords, parse_dim_labels(nd.metadata.labels))
+
+
 def _fill_node_row(pack: _NodePack, ix: int, node, axis) -> None:
     from ..ops.resources import quantize_columns
     rows = np.stack(_node_row_vectors(node, axis))
@@ -666,6 +690,10 @@ def _fill_node_row(pack: _NodePack, ix: int, node, axis) -> None:
     pack.count[ix] = len(node.tasks)
     pack.maxt[ix] = node.allocatable.max_task_num
     pack.hi_rows[ix] = int(np.abs(q).max())
+    coords = _node_coords_raw(node)
+    pack.coords_raw[ix] = coords
+    if coords is not None:
+        pack.coords_any = True
 
 
 def _build_node_pack(node_objs, node_names, axis) -> _NodePack:
@@ -696,6 +724,13 @@ def _build_node_pack(node_objs, node_names, axis) -> _NodePack:
                             for nd in node_objs], np.int64).reshape(n)
     pack.hi_rows = (np.abs(np.stack(mats)).max(axis=(0, 2))
                     if n else np.zeros((0,), np.int64))
+    pack.coords_raw = np.empty((max(n, 1),), dtype=object)
+    pack.coords_any = False
+    for ix, nd in enumerate(node_objs):
+        coords = _node_coords_raw(nd)
+        pack.coords_raw[ix] = coords
+        if coords is not None:
+            pack.coords_any = True
     return pack
 
 
@@ -727,7 +762,7 @@ def _static_example(task):
 
 
 _SUPPORTED_PLUGINS = {"priority", "gang", "drf", "proportion", "predicates",
-                      "nodeorder", "conformance", "tpu-score"}
+                      "nodeorder", "conformance", "tpu-score", "topology"}
 _JOB_ORDER_PLUGINS = ("priority", "gang", "drf")
 _QUEUE_ORDER_PLUGINS = ("proportion",)
 
@@ -752,10 +787,18 @@ def plugin_structure(tiers):
     # means their weights add.  No scoring plugin -> all-zero scores and the
     # first feasible node wins on both paths.
     w_least = w_most = w_balanced = w_podaff = w_nodeaff = 0.0
+    w_frag = 0.0
     for tier in tiers:
         for option in tier.plugins:
             if option.name not in _SUPPORTED_PLUGINS:
                 return None, f"unsupported plugin {option.name}"
+            if option.name == "topology" and option.enabled_node_order:
+                # Fragmentation-aware scoring (plugins/topology.py): the
+                # plugin computes the at-open bonus ONCE per session and
+                # tensorize folds the identical integers into sig_bonus,
+                # so host and device scores cannot drift.
+                w_frag += option.arguments.get_float(
+                    "topology.frag.weight", 1.0)
             if option.name in _JOB_ORDER_PLUGINS and option.enabled_job_order:
                 enabled_job_order.append(option.name)
             if (option.name in _QUEUE_ORDER_PLUGINS
@@ -776,7 +819,7 @@ def plugin_structure(tiers):
                 w_podaff += w["podaffinity"]
                 w_nodeaff += w["nodeaffinity"]
     if any(w != int(w) for w in (w_least, w_most, w_balanced, w_podaff,
-                                 w_nodeaff)):
+                                 w_nodeaff, w_frag)):
         # Grid scoring combines integer weights exactly; fractional weights
         # would need float score sums with platform-dependent rounding.
         return None, "fractional nodeorder weights"
@@ -791,7 +834,8 @@ def plugin_structure(tiers):
               "queue_order": enabled_queue_order,
               "has_gang": has_gang, "has_proportion": has_proportion,
               "has_predicates": has_predicates, "weights": weights,
-              "w_podaff": w_podaff, "w_nodeaff": w_nodeaff}
+              "w_podaff": w_podaff, "w_nodeaff": w_nodeaff,
+              "w_frag": w_frag}
     return struct, ""
 
 
@@ -1384,6 +1428,22 @@ def tensorize_session(ssn) -> TensorSnapshot:
         sig_mask[:, :n_real] = True
         if plan is not None:
             _inc.store_sig_mask(plan, (), None, None)
+    # Fragmentation-aware topology bonus (doc/TOPOLOGY.md): the topology
+    # plugin computed the at-open bonus ONCE in on_session_open and
+    # stashed the exact integers on the session — folding the same array
+    # here makes the device score bit-identical to the host prioritizer
+    # by construction.  Task-independent, so it adds to EVERY signature
+    # row; recomputed fresh each session, so the persistent sig-mask
+    # patch path (models/incremental.py) keeps storing the base
+    # (affinity-only) bonus and stays exact.
+    frag_bonus = ssn.prescan.get("topo_frag_bonus") \
+        if hasattr(ssn, "prescan") else None
+    if frag_bonus is not None and n_real \
+            and len(frag_bonus) >= n_real:
+        frag_pad = np.zeros((n_pad,), np.int64)
+        frag_pad[:n_real] = np.asarray(frag_bonus[:n_real], np.int64)
+        sig_bonus = sig_bonus + frag_pad[None, :]
+
     if sig_bonus.any():
         # Combined-score headroom: bonus + fraction scores (+ a possible
         # pod-affinity term, hence the halved budget) must stay in int32.
@@ -1455,6 +1515,28 @@ def tensorize_session(ssn) -> TensorSnapshot:
     total_res_q = pack.alloc.sum(axis=0, dtype=np.int64) \
         if n_real else np.zeros((r,), np.int64)
 
+    # Topology coordinate leaf (models/topology.py, doc/TOPOLOGY.md):
+    # [n_pad, 8] i32 pod/rack/x/y/z + per-pod torus dims, -1 = flat.
+    # Assembled from the pack's parsed rows through the SAME interning
+    # core the session view uses (view_from_parsed: identical duplicate
+    # degradation and declared-dims rules, so leaf and view cannot
+    # drift) — O(labeled nodes), and an unlabeled cluster (coords_any
+    # False) skips the walk entirely, so the flat steady path pays
+    # nothing.  count_bad=False: the view already counted this
+    # session's bad coords; the leaf re-derives the same rows.
+    from .topology import topology_enabled as _topo_on
+    if n_real and getattr(pack, "coords_any", False) and _topo_on():
+        from .topology import coords_leaf, view_from_parsed
+        raw = [pack.coords_raw[ix] for ix in range(n_real)]
+        leaf_view = view_from_parsed(
+            pack.names[:n_real],
+            [t[0] if t else None for t in raw],
+            [t[1] if t else None for t in raw],
+            count_bad=False)
+        node_coords_leaf = coords_leaf(leaf_view, n_pad)
+    else:
+        node_coords_leaf = np.full((n_pad, 8), -1, np.int32)
+
     # deserved, exactly scaled to quanta but NOT rounded (see SolverInputs
     # docstring): the water-fill's fractional values must not round in the
     # share denominator.  The numerator (queue alloc) is still integer
@@ -1505,7 +1587,8 @@ def tensorize_session(ssn) -> TensorSnapshot:
         scalar_dims=np.asarray([False, False] + [True] * (r - 2)),
         score_shift=np.asarray(
             [score_shift_for(int(node_alloc_q[:, d].max()) if n_real else 0)
-             for d in range(2)], dtype=np.int32))
+             for d in range(2)], dtype=np.int32),
+        node_coords=node_coords_leaf)
     snap.config = SolverConfig(
         job_key_order=tuple(enabled_job_order),
         queue_key_order=tuple(enabled_queue_order),

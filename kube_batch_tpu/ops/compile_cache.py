@@ -342,7 +342,8 @@ def make_bucket_inputs(spec: BucketSpec, r: int = 2, np_pad: int = 8,
         total_res=f(r),
         eps=np.full((r,), EPS_QUANTA, dtype=np.int32),
         scalar_dims=np.asarray([False, False] + [True] * (r - 2)),
-        score_shift=i(2))
+        score_shift=i(2),
+        node_coords=np.full((n, 8), -1, np.int32))
 
 
 class WarmupRecord(NamedTuple):
@@ -456,7 +457,49 @@ def warm_bucket(spec: BucketSpec, cfg=None, family: Sequence[str] = ("auto",),
     records.append(_warm_evict_batch(spec, cfg, inp_np, inp,
                                      resident=resident))
     records.append(_warm_candidate(spec, cfg, inp, resident=resident))
+    from ..models.topology import topology_enabled
+    if topology_enabled():
+        records.append(_warm_topo(spec))
     return records
+
+
+def _warm_topo(spec: BucketSpec) -> WarmupRecord:
+    """Warm the batched slice box scan (ops/topo_solver.py) at this
+    node bucket for the documented default slice shape, through the
+    same dispatch chokepoint the live topo-allocate action uses — so
+    the first slice session never pays its XLA compile live.  Other
+    shapes compile on first use (the scan is small).  Skipped entirely
+    when KUBE_BATCH_TPU_TOPOLOGY=0 (warm_bucket gates the append):
+    flat deployments pay nothing for a kernel they can never
+    dispatch."""
+    import numpy as np
+
+    from ..ops import topo_solver as ts
+
+    # The default slice shape every in-repo gate exercises (bench-topo,
+    # the frag_pressure scenario, tests/test_topology.py).
+    shape = (2, 2, 2)
+    n_pad = bucket(max(spec.nodes, 1))
+    route, _mesh = ts.choose_topo_route(n_pad)
+    key = ts.topo_solve_key(route, n_pad, shape)
+    start = time.perf_counter()
+    try:
+        inp = ts.BoxInputs(
+            coords=np.full((n_pad, 8), -1, np.int32),
+            free=np.zeros((n_pad,), bool),
+            evictable=np.zeros((n_pad,), bool),
+            vic_cnt=np.zeros((n_pad,), np.int32),
+            vic_cost=np.zeros((n_pad,), np.int32))
+        ts.dispatch_box_scan(inp, shape)
+    except Exception as exc:  # lint: allow-swallow(warmup must never take down boot; failure is recorded in WarmupRecord.error)
+        return WarmupRecord(
+            spec, "topo_box", key,
+            round((time.perf_counter() - start) * 1e3, 1),
+            f"{type(exc).__name__}: {exc}")
+    note_warmed(key)
+    return WarmupRecord(
+        spec, "topo_box", key,
+        round((time.perf_counter() - start) * 1e3, 1))
 
 
 def _warm_candidate(spec: BucketSpec, cfg, inp,

@@ -107,6 +107,16 @@ class SolverInputs(NamedTuple):
     eps: jnp.ndarray            # [R] epsilon vector
     scalar_dims: jnp.ndarray    # [R] bool
     score_shift: jnp.ndarray    # [2] i32 grid shifts for cpu/mem scoring
+    # topology (models/topology.py): [N, 8] i32 pod/rack/x/y/z + the
+    # owning pod's torus dims; -1 rows = no coordinates (flat node).
+    # Inert to the allocate solve (no program reads it), and the box
+    # scan (ops/topo_solver.py) currently stages its own origin-sharded
+    # copy per dispatch — the leaf exists so the RESIDENT layout never
+    # flips when the topology subsystem engages (layout stability is
+    # the delta-ship/generation contract) and mesh-resident topology
+    # consumers can bind to it without a reshape.  All-(-1) on flat
+    # clusters: one full ship, then zero delta bytes.
+    node_coords: jnp.ndarray    # [N, 8] i32
 
 
 class SolverConfig(NamedTuple):

@@ -1,0 +1,237 @@
+"""Batched slice-shape feasibility: every candidate origin's contiguous-
+block question answered in ONE device dispatch.
+
+A PodGroup requesting a slice shape ``(sx, sy, sz)`` needs an
+axis-aligned sub-box of the torus — ``prod(shape)`` nodes at coordinates
+``origin + [0..sx) x [0..sy) x [0..sz)`` (mod the pod's torus dims) —
+that are all placeable.  The host formulation walks N origins x vol box
+offsets; this module vectorizes the whole question as a pairwise
+membership scan over the int32 coordinate rows (models/topology.py's
+``node_coords`` leaf layout): one jitted program returns, per origin,
+
+  * ``complete``       — the box has all prod(shape) member nodes
+                         (wrapped self-overlap can never fake this: a
+                         torus axis shorter than the request covers
+                         fewer distinct positions, so the count falls
+                         short — doc/TOPOLOGY.md),
+  * ``free_cnt``       — members currently free,
+  * ``blocked``        — members neither free nor evictable (a box with
+                         blocked > 0 can never become this slice),
+  * ``vic_cnt`` / ``vic_cost`` — the defrag evictor's cost row: how many
+                         victims (and their priority sum) clearing the
+                         box would evict,
+  * ``boundary_free``  — free nodes OUTSIDE the box torus-adjacent to
+                         it: the fragmentation-aware placement key
+                         (fewer free neighbors = tighter packing =
+                         larger contiguous blocks preserved elsewhere).
+
+``box_scan_seq`` is the pure-numpy per-origin sequential oracle — a
+structurally different implementation computing the same exact integers
+(pinned by tests/test_topology.py); ``KUBE_BATCH_TPU_TOPO_BATCH=0``
+routes every live scan through it.  ``dispatch_box_scan`` is the routing
+chokepoint: compile-cache keyed (``topo_solve_key`` + ``note_solve_key``,
+warmed by compile_cache.warm_bucket), counted in
+``kube_batch_solver_route_total{family="topo"}``, and sharded over the
+origin axis of the device mesh under the same startup-pinned gates the
+allocate/evict engines use (ops/solver.shard_knobs).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+TOPO_SOLVE_CHOICE = "topo_box"
+
+# Stats column layout (shared by the batched kernel and the oracle).
+COL_COMPLETE = 0
+COL_FREE = 1
+COL_BLOCKED = 2
+COL_VCNT = 3
+COL_VCOST = 4
+COL_BOUNDARY = 5
+N_COLS = 6
+
+
+class BoxInputs(NamedTuple):
+    """One scan's staged arrays ([N] over the padded node bucket)."""
+    coords: jnp.ndarray     # [N, 8] i32 (models/topology.COORD_WIDTH)
+    free: jnp.ndarray       # [N] bool: placeable now (empty + fits + preds)
+    evictable: jnp.ndarray  # [N] bool: clearable for this preemptor
+    vic_cnt: jnp.ndarray    # [N] i32 victims resident on the node
+    vic_cost: jnp.ndarray   # [N] i32 victim priority sum on the node
+
+
+def _box_body(coords, free, evictable, vic_cnt, vic_cost, origins,
+              sx: int, sy: int, sz: int):
+    """The box scan over an ``origins`` row block ([L, 8] — the whole
+    bucket single-chip, one shard's rows on the mesh).  All int32
+    elementwise/matmul math; every term is exact."""
+    valid = coords[:, 0] >= 0
+    o_valid = origins[:, 0] >= 0
+    pod = coords[:, 0]
+    xyz = coords[:, 2:5]
+    dims = jnp.maximum(coords[:, 5:8], 1)
+
+    o_pod = origins[:, 0]
+    o_xyz = origins[:, 2:5]
+    o_dims = jnp.maximum(origins[:, 5:8], 1)
+
+    # Pairwise torus offsets of every node j relative to every origin o,
+    # modulo the ORIGIN's pod dims (same pod => same dims).
+    d = jnp.mod(xyz[None, :, :] - o_xyz[:, None, :], o_dims[:, None, :])
+    member = (o_valid[:, None] & valid[None, :]
+              & (pod[None, :] == o_pod[:, None])
+              & (d[:, :, 0] < sx) & (d[:, :, 1] < sy) & (d[:, :, 2] < sz))
+    m32 = member.astype(jnp.int32)
+
+    vol = sx * sy * sz
+    cnt = m32.sum(axis=1)
+    complete = (o_valid & (cnt == vol)).astype(jnp.int32)
+    free32 = free.astype(jnp.int32)
+    free_cnt = (m32 * free32[None, :]).sum(axis=1)
+    blocked = (m32 * (~free & ~evictable & valid)[None, :]
+               .astype(jnp.int32)).sum(axis=1)
+    vcnt = (m32 * vic_cnt[None, :]).sum(axis=1)
+    vcost = (m32 * vic_cost[None, :]).sum(axis=1)
+
+    # Torus adjacency of every (j, k) node pair: same pod, exactly one
+    # axis one step apart (mod dims), the rest equal.
+    dd = jnp.mod(xyz[None, :, :] - xyz[:, None, :], dims[:, None, :])
+    step = ((dd == 1) | (dd == (dims[:, None, :] - 1))) \
+        & (dims[:, None, :] > 1)
+    same = dd == 0
+    one_step = ((step[:, :, 0] & same[:, :, 1] & same[:, :, 2])
+                | (same[:, :, 0] & step[:, :, 1] & same[:, :, 2])
+                | (same[:, :, 0] & same[:, :, 1] & step[:, :, 2]))
+    adj = (valid[:, None] & valid[None, :]
+           & (pod[:, None] == pod[None, :]) & one_step
+           & ~(same[:, :, 0] & same[:, :, 1] & same[:, :, 2]))
+    touch = (m32 @ adj.astype(jnp.int32)) > 0
+    boundary_free = (touch & ~member & free[None, :]) \
+        .astype(jnp.int32).sum(axis=1)
+
+    return jnp.stack([complete, free_cnt, blocked, vcnt, vcost,
+                      boundary_free], axis=1)
+
+
+@functools.partial(jax.jit, static_argnames=("sx", "sy", "sz"))
+def box_scan(inp: BoxInputs, sx: int, sy: int, sz: int) -> jnp.ndarray:
+    """[N, 6] i32 per-origin stats; every node row is a candidate
+    origin."""
+    return _box_body(inp.coords, inp.free, inp.evictable, inp.vic_cnt,
+                     inp.vic_cost, inp.coords, sx, sy, sz)
+
+
+@functools.partial(jax.jit, static_argnames=("sx", "sy", "sz", "mesh"))
+def box_scan_sharded(inp: BoxInputs, sx: int, sy: int, sz: int,
+                     mesh) -> jnp.ndarray:
+    """Origin-axis sharded scan: each device answers its own origin rows
+    against the replicated coordinate table — no cross-device traffic,
+    rows identical to the single-chip program."""
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import NODE_AXIS, shard_map_kwargs
+
+    def local(origins, coords, free, evictable, vic_cnt, vic_cost):
+        return _box_body(coords, free, evictable, vic_cnt, vic_cost,
+                         origins, sx, sy, sz)
+
+    fn = shard_map(
+        local, mesh=mesh,
+        in_specs=(P(NODE_AXIS, None), P(None, None), P(None), P(None),
+                  P(None), P(None)),
+        out_specs=P(NODE_AXIS, None), **shard_map_kwargs())
+    return fn(inp.coords, inp.coords, inp.free, inp.evictable,
+              inp.vic_cnt, inp.vic_cost)
+
+
+def box_scan_seq(view, free, evictable, vic_cnt, vic_cost,
+                 shape) -> np.ndarray:
+    """The sequential oracle: per-origin Python walk over box offsets
+    through the view's coordinate index — the reference formulation the
+    batched kernel must match bit-for-bit.  [N, 6] i32 over the view's
+    (unpadded) node rows."""
+    sx, sy, sz = shape
+    vol = sx * sy * sz
+    n = len(view.node_names)
+    out = np.zeros((n, N_COLS), np.int32)
+    nbrs = view.neighbors()
+    for o in range(n):
+        if not view.valid[o]:
+            continue
+        pod, _r, x, y, z, dx, dy, dz = (int(v) for v in view.coords[o])
+        members = []
+        for ox in range(sx):
+            for oy in range(sy):
+                for oz in range(sz):
+                    j = view._index.get(
+                        (pod, (x + ox) % dx, (y + oy) % dy, (z + oz) % dz))
+                    if j is not None:
+                        members.append(j)
+        members = set(members)
+        cnt = len(members)
+        out[o, COL_COMPLETE] = 1 if cnt == vol else 0
+        boundary = set()
+        for j in members:
+            if free[j]:
+                out[o, COL_FREE] += 1
+            elif not evictable[j]:
+                out[o, COL_BLOCKED] += 1
+            out[o, COL_VCNT] += int(vic_cnt[j])
+            out[o, COL_VCOST] += int(vic_cost[j])
+            for k in nbrs[j]:
+                if k not in members and free[k]:
+                    boundary.add(k)
+        out[o, COL_BOUNDARY] = len(boundary)
+    return out
+
+
+def choose_topo_route(n_pad: int):
+    """('sharded'|'xla', mesh): the topo scan's mesh gate — the
+    allocate/evict engines' node-count gate and startup-pinned knobs
+    (ops/solver.shard_knobs), so slice scans shard when the solvers
+    do."""
+    from ..parallel.mesh import default_mesh
+    from .solver import shard_knobs
+    mesh = default_mesh()
+    if mesh is not None and n_pad % mesh.size == 0:
+        knobs = shard_knobs()
+        if knobs.force or n_pad >= knobs.nodes:
+            return "sharded", mesh
+    return "xla", None
+
+
+def topo_solve_key(route: str, n_pad: int, shape) -> tuple:
+    """Compile-cache identity of one box-scan executable (the
+    evict_solve_key discipline): route + padded node bucket + the static
+    slice shape."""
+    return (TOPO_SOLVE_CHOICE, route, n_pad, tuple(shape))
+
+
+def dispatch_box_scan(inp: BoxInputs, shape) -> np.ndarray:
+    """Route and run one batched box scan, returning host [N, 6] i32.
+    The one production chokepoint: route counters, compile-cache
+    hit/miss accounting, and the mesh gate all live here."""
+    from ..metrics import metrics
+    from ..trace import spans as trace
+    from .compile_cache import note_solve_key
+
+    sx, sy, sz = (int(v) for v in shape)
+    n_pad = int(np.asarray(inp.coords).shape[0])
+    route, mesh = choose_topo_route(n_pad)
+    metrics.note_route("topo", route)
+    trace.annotate(route=route, mesh_devices=mesh.size if mesh else 1)
+    note_solve_key(topo_solve_key(route, n_pad, (sx, sy, sz)))
+    staged = BoxInputs(*(jnp.asarray(a) for a in inp))
+    if route == "sharded":
+        return np.asarray(box_scan_sharded(staged, sx, sy, sz, mesh))
+    return np.asarray(box_scan(staged, sx, sy, sz))

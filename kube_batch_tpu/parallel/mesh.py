@@ -81,7 +81,8 @@ def solver_input_shardings(mesh: Mesh):
         node_alloc=node_2d, node_count=node_1d, node_max_tasks=node_1d,
         node_exists=node_1d, node_ports=node_2d, node_selcnt=node_2d,
         sig_mask=sig, sig_bonus=sig,
-        total_res=rep, eps=rep, scalar_dims=rep, score_shift=rep)
+        total_res=rep, eps=rep, scalar_dims=rep, score_shift=rep,
+        node_coords=node_2d)
 
 
 def shard_solver_inputs(inputs, mesh: Mesh):

@@ -60,7 +60,7 @@ def _node_specs():
         node_count=n1, node_max_tasks=n1, node_exists=n1,
         node_ports=n2, node_selcnt=n2, sig_mask=sig, sig_bonus=sig,
         total_res=P(None), eps=P(None), scalar_dims=P(None),
-        score_shift=P(None))
+        score_shift=P(None), node_coords=n2)
 
 
 @functools.partial(jax.jit, static_argnames=("mesh",))

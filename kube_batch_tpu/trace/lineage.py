@@ -164,6 +164,11 @@ class LineageRecorder:
         self._session_seqs: List[int] = []     # guarded-by: _lock
         self._session_opens: List[float] = []  # guarded-by: _lock
         self._sessions_dropped = 0             # guarded-by: _lock
+        # Pods aged out of the bounded ring (ANY pod, bound or not):
+        # nonzero means the ring is no longer a complete record of the
+        # workload, which the replay harness's capture must refuse
+        # (tools/replay.py) rather than silently mis-schedule.
+        self._pods_dropped = 0                 # guarded-by: _lock
         self._next_session = 1                 # guarded-by: _lock
         # Cycle context (action/route of the in-flight placement pass):
         # written only by the scheduling thread between set/clear, read
@@ -194,6 +199,7 @@ class LineageRecorder:
             self._session_seqs.clear()
             self._session_opens.clear()
             self._sessions_dropped = 0
+            self._pods_dropped = 0
             self._next_session = 1
         self.cycle_context = ""
         return self.cfg()
@@ -204,6 +210,7 @@ class LineageRecorder:
             self._session_seqs.clear()
             self._session_opens.clear()
             self._sessions_dropped = 0
+            self._pods_dropped = 0
 
     # ------------------------------------------------------------------
     # recording hooks (every one no-ops on the kill switch)
@@ -250,6 +257,7 @@ class LineageRecorder:
             evicted_unbound = 0
             while len(self._pods) > cfg.capacity:
                 _, old = self._pods.popitem(last=False)
+                self._pods_dropped += 1
                 if not old.bound and not old.closed:
                     evicted_unbound += 1
         # A still-pending pod aged out of the ring loses its eventual
@@ -487,6 +495,41 @@ class LineageRecorder:
             return {"enabled": cfg.enabled, "capacity": cfg.capacity,
                     "tracked_pods": len(self._pods),
                     "sessions_seen": self._next_session - 1}
+
+    def dump(self) -> dict:
+        """Serialize the whole ring for the replay harness
+        (tools/replay.py): every tracked pod in ingest order with its
+        first-visible session (the ledger seq of the first session
+        opened after the pod's ingest stamp — how replay regroups
+        arrivals into the recorded cycle cadence) and its raw stage
+        timeline, plus the session ledger itself.  Read-only, answered
+        from the ring like :meth:`lineage`."""
+        cfg = self.cfg()
+        with self._lock:
+            opens = list(self._session_opens)
+            seqs = list(self._session_seqs)
+            pods = []
+            for key, rec in self._pods.items():
+                ix = bisect.bisect_right(opens, rec.ingest_mono)
+                pods.append({
+                    "pod": key,
+                    "queue": rec.queue,
+                    "first_session": seqs[ix] if ix < len(seqs) else None,
+                    "bound": rec.bound,
+                    "deleted": rec.closed,
+                    "evicted": any(s == "evicted" for s, _t, _d
+                                   in rec.events),
+                    "stages": [{"stage": s, "t": round(t, 6),
+                                **({"detail": d} if d else {})}
+                               for s, t, d in rec.events],
+                })
+            return {"enabled": cfg.enabled,
+                    "sessions": self._next_session - 1,
+                    "sessions_dropped": self._sessions_dropped,
+                    "pods_dropped": self._pods_dropped,
+                    "ledger": [[seq, round(ts, 6)]
+                               for seq, ts in zip(seqs, opens)],
+                    "pods": pods}
 
 
 lineage = LineageRecorder()

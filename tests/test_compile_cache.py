@@ -133,10 +133,11 @@ class TestWarmupAndHits:
         w.join(120)
         assert w.done
         # One bucket x (one routed allocate solver + the batched
-        # eviction kernel + the candidate-row gather+solve, which warm
-        # alongside the family).
-        assert len(w.records) == 3
-        assert {r.solver for r in w.records} >= {"evict_batch", "candidate"}
+        # eviction kernel + the candidate-row gather+solve + the topo
+        # box scan, which warm alongside the family).
+        assert len(w.records) == 4
+        assert {r.solver for r in w.records} >= {"evict_batch", "candidate",
+                                                 "topo_box"}
         assert w.errors == []
         w.stop()  # after completion: no-op, returns immediately
 
@@ -237,6 +238,10 @@ def _repad(inp, spec):
               "node_count", "node_max_tasks", "node_exists", "node_ports",
               "node_selcnt"):
         out[f] = grow(a[f], 0, n2)
+    # Coordinate padding rows are -1 (invalid), not zero.
+    out["node_coords"] = np.concatenate(
+        [a["node_coords"],
+         np.full((n2 - n, a["node_coords"].shape[1]), -1, np.int32)])
     for f in ("sig_mask", "sig_bonus"):
         out[f] = grow(a[f], 1, n2)
     return SolverInputs(**out)

@@ -1,0 +1,547 @@
+"""Topology subsystem tests (doc/TOPOLOGY.md).
+
+Pins the subsystem's contracts end to end:
+
+* coordinate-label / slice-shape grammar and the degrade-to-flat rules
+  (malformed, missing, duplicate coordinates);
+* fragmentation accounting (frag_stats / frag_bonus exact integers);
+* batched box-scan parity — the jitted kernel and the FORCE_SHARD mesh
+  leg are bit-identical to the pure-numpy sequential oracle
+  (ops/topo_solver.box_scan_seq);
+* e2e slice placement — batched arm ≡ sequential-oracle arm on the
+  fragmentation-pressure scenario, ``KUBE_BATCH_TPU_TOPOLOGY=0``
+  bit-parity with a conf that never listed the subsystem, and the
+  capacity-only control (``TOPO_DEFRAG=0``) leaving the slice pending;
+* scenario-generator determinism (same seed => byte-identical spec) and
+  the lineage-ring replay round trip (tools/replay.py) reproducing the
+  recorded binds bit-identically;
+* chaos site ``topology.bad_coords`` degrading nodes to flat-list
+  placement instead of failing the cycle (doc/CHAOS.md).
+"""
+
+import json
+import types
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu.chaos import plan as chaos_plan
+from kube_batch_tpu.chaos.breaker import device_breaker
+from kube_batch_tpu.metrics import metrics
+from kube_batch_tpu.models import topology as topo
+from kube_batch_tpu.ops import topo_solver as ts
+from kube_batch_tpu.ops.compile_cache import bucket
+from tools import replay as replay_mod
+from tools import scenario_gen as sg
+
+
+@pytest.fixture(autouse=True)
+def _clean_chaos():
+    chaos_plan.disable()
+    device_breaker().reset()
+    yield
+    chaos_plan.disable()
+    device_breaker().reset()
+
+
+def _ninfo(name, labels):
+    return types.SimpleNamespace(node=replay_mod.build_node(
+        {"name": name, "labels": labels,
+         "allocatable": {"cpu": "8", "memory": "16Gi", "pods": "110"}}))
+
+
+def _torus(dx, dy, dz, pod="pod-a"):
+    """{name: node-info} for a fully coordinate-labeled dx*dy*dz torus."""
+    nodes = {}
+    for x in range(dx):
+        for y in range(dy):
+            for z in range(dz):
+                labels = {topo.POD_LABEL: pod,
+                          topo.RACK_LABEL: str(x // 2),
+                          topo.AXIS_LABELS[0]: str(x),
+                          topo.AXIS_LABELS[1]: str(y),
+                          topo.AXIS_LABELS[2]: str(z)}
+                nodes[f"t-{x}-{y}-{z}"] = _ninfo(f"t-{x}-{y}-{z}", labels)
+    return nodes
+
+
+# ----------------------------------------------------------------------
+# grammar
+
+
+class TestGrammar:
+    def test_coord_labels_good_and_rack_default(self):
+        labels = {topo.POD_LABEL: "p", topo.AXIS_LABELS[0]: "1",
+                  topo.AXIS_LABELS[1]: "2", topo.AXIS_LABELS[2]: "0"}
+        assert topo.parse_coord_labels(labels) == ("p", "0", 1, 2, 0)
+        labels[topo.RACK_LABEL] = "r7"
+        assert topo.parse_coord_labels(labels) == ("p", "r7", 1, 2, 0)
+
+    @pytest.mark.parametrize("mutate", [
+        lambda d: d.pop(topo.POD_LABEL),
+        lambda d: d.pop(topo.AXIS_LABELS[2]),
+        lambda d: d.update({topo.AXIS_LABELS[0]: "one"}),
+        lambda d: d.update({topo.AXIS_LABELS[1]: "-1"}),
+        lambda d: d.update({topo.POD_LABEL: ""}),
+    ])
+    def test_coord_labels_malformed_is_none(self, mutate):
+        labels = {topo.POD_LABEL: "p", topo.AXIS_LABELS[0]: "1",
+                  topo.AXIS_LABELS[1]: "2", topo.AXIS_LABELS[2]: "0"}
+        mutate(labels)
+        assert topo.parse_coord_labels(labels) is None
+
+    def test_slice_shape_grammar(self):
+        assert topo.parse_slice_shape("2x2x4") == (2, 2, 4)
+        assert topo.parse_slice_shape("4") == (4, 1, 1)
+        assert topo.parse_slice_shape("2x3") == (2, 3, 1)
+        assert topo.parse_slice_shape("2X2") == (2, 2, 1)  # case-blind
+        for bad in (None, "", "0x2", "axb", "1x2x3x4", "2x-1", "2.5"):
+            assert topo.parse_slice_shape(bad) is None
+
+
+# ----------------------------------------------------------------------
+# view build + fragmentation accounting
+
+
+class TestViewBuild:
+    def test_coords_dims_and_pools(self):
+        view = topo.build_view(_torus(4, 2, 2))
+        assert view.n_valid == 16
+        assert view.pools == ["pod-a"]
+        row = view.node_names.index("t-3-1-0")
+        assert list(view.coords[row]) == [0, 1, 3, 1, 0, 4, 2, 2]
+
+    def test_malformed_and_unlabeled_degrade_single_node(self):
+        nodes = _torus(2, 2, 1)
+        nodes["t-0-0-0"].node.metadata.labels[topo.AXIS_LABELS[0]] = "oops"
+        nodes["flat-1"] = _ninfo("flat-1", {})
+        view = topo.build_view(nodes)
+        assert view.n_valid == 3
+        assert not view.valid[view.node_names.index("t-0-0-0")]
+        assert not view.valid[view.node_names.index("flat-1")]
+
+    def test_duplicate_coordinate_degrades_both(self):
+        nodes = _torus(2, 2, 1)
+        dup = _ninfo("t-dup", dict(
+            nodes["t-1-1-0"].node.metadata.labels))
+        nodes["t-dup"] = dup
+        before = metrics.topo_bad_coords.value()
+        view = topo.build_view(nodes)
+        assert view.n_valid == 3
+        assert not view.valid[view.node_names.index("t-1-1-0")]
+        assert not view.valid[view.node_names.index("t-dup")]
+        assert metrics.topo_bad_coords.value() == before + 1
+
+    def test_third_duplicate_claimant_stays_degraded(self):
+        """A position declared ambiguous never re-enters the torus: the
+        third (and any later) claimant of a duplicated coordinate is
+        degraded too, not silently accepted."""
+        nodes = _torus(2, 2, 1)
+        labels = dict(nodes["t-1-1-0"].node.metadata.labels)
+        nodes["t-dup-a"] = _ninfo("t-dup-a", dict(labels))
+        nodes["t-dup-b"] = _ninfo("t-dup-b", dict(labels))
+        before = metrics.topo_bad_coords.value()
+        view = topo.build_view(nodes)
+        assert view.n_valid == 3
+        for name in ("t-1-1-0", "t-dup-a", "t-dup-b"):
+            assert not view.valid[view.node_names.index(name)]
+        assert metrics.topo_bad_coords.value() == before + 2
+
+    def test_declared_dims_prevent_partial_axis_wrap(self):
+        """An axis registered only partially (nodes x=0..2 of a
+        declared 8-wide torus) must not fabricate wraparound adjacency;
+        without the declaration the inferred extent (3) wraps."""
+        def mk(declare):
+            nodes = {}
+            for x in range(3):
+                labels = {topo.POD_LABEL: "p",
+                          topo.AXIS_LABELS[0]: str(x),
+                          topo.AXIS_LABELS[1]: "0",
+                          topo.AXIS_LABELS[2]: "0"}
+                if declare:
+                    labels[topo.DIM_LABELS[0]] = "8"
+                nodes[f"t-{x}-0-0"] = _ninfo(f"t-{x}-0-0", labels)
+            return topo.build_view(nodes)
+
+        inferred = mk(declare=False)
+        assert set(inferred.neighbors()[
+            inferred.node_names.index("t-0-0-0")]) == {
+                inferred.node_names.index("t-1-0-0"),
+                inferred.node_names.index("t-2-0-0")}  # false wrap
+        declared = mk(declare=True)
+        assert int(declared.coords[0, 5]) == 8
+        assert set(declared.neighbors()[
+            declared.node_names.index("t-0-0-0")]) == {
+                declared.node_names.index("t-1-0-0")}
+
+    def test_dim_label_malformed_falls_back_to_inferred(self):
+        assert topo.parse_dim_labels({topo.DIM_LABELS[0]: "oops"}) is None
+        assert topo.parse_dim_labels({topo.DIM_LABELS[0]: "0"}) is None
+        assert topo.parse_dim_labels({topo.DIM_LABELS[1]: "4"}) == (0, 4, 0)
+
+    def test_coords_leaf_matches_session_view(self):
+        """The shipped node_coords leaf and the session's TopologyView
+        derive from the SAME interning core (view_from_parsed): same
+        duplicate degradation, same declared-dims rules — asserted by
+        rebuilding the leaf exactly as tensor_snapshot does."""
+        nodes = _torus(2, 2, 2)
+        nodes["t-dup"] = _ninfo(
+            "t-dup", dict(nodes["t-0-0-0"].node.metadata.labels))
+        nodes["t-1-1-1"].node.metadata.labels[topo.DIM_LABELS[2]] = "4"
+        names = sorted(nodes)
+        view = topo.build_view(nodes)
+        parsed = [topo.parse_coord_labels(nodes[n].node.metadata.labels)
+                  for n in names]
+        declared = [topo.parse_dim_labels(nodes[n].node.metadata.labels)
+                    if parsed[i] is not None else None
+                    for i, n in enumerate(names)]
+        leaf_view = topo.view_from_parsed(names, parsed, declared,
+                                          count_bad=False)
+        leaf = topo.coords_leaf(leaf_view, 16)
+        np.testing.assert_array_equal(leaf[:len(names)],
+                                      view.coords[:len(names)])
+        assert leaf[len(names):].min() == -1 == leaf[len(names):].max()
+
+    def test_frag_stats_checkerboard(self):
+        view = topo.build_view(_torus(4, 2, 2))
+        free = np.ones((16,), bool)
+        stats = view.frag_stats(free)["pod-a"]
+        assert stats == {"free": 16, "largest_block": 16,
+                         "frag_ratio": 0.0}
+        # Checkerboard free: even-parity dims make every free cell's
+        # torus neighbors occupied — maximal fragmentation.
+        for i, name in enumerate(view.node_names):
+            x, y, z = (int(v) for v in name.split("-")[1:])
+            free[i] = (x + y + z) % 2 == 0
+        stats = view.frag_stats(free)["pod-a"]
+        assert stats == {"free": 8, "largest_block": 1,
+                         "frag_ratio": 0.875}
+
+    def test_frag_stats_full_pool_is_not_fragmented(self):
+        view = topo.build_view(_torus(2, 2, 1))
+        stats = view.frag_stats(np.zeros((4,), bool))["pod-a"]
+        assert stats == {"free": 0, "largest_block": 0, "frag_ratio": 0.0}
+
+    def test_frag_bonus_exact_grid_integers(self):
+        from kube_batch_tpu.ops.resources import SCORE_GRID_K
+        view = topo.build_view(_torus(4, 2, 2))
+        occupied = np.zeros((16,), bool)
+        occupied[view.node_names.index("t-1-0-0")] = True
+        bonus = view.frag_bonus(occupied, 2)
+        assert bonus.dtype == np.int32
+        assert (bonus % (2 * SCORE_GRID_K) == 0).all()
+        # t-0-0-0's x+ neighbor is occupied: 1 occupied + 0 absent.
+        assert bonus[view.node_names.index("t-0-0-0")] == 2 * SCORE_GRID_K
+        assert (view.frag_bonus(occupied, 0) == 0).all()
+
+    def test_frag_bonus_counts_missing_neighbors_as_occupied(self):
+        nodes = _torus(4, 2, 2)
+        del nodes["t-1-0-0"]  # coordinate hole next to t-0-0-0
+        view = topo.build_view(nodes)
+        from kube_batch_tpu.ops.resources import SCORE_GRID_K
+        bonus = view.frag_bonus(np.zeros((15,), bool), 1)
+        assert bonus[view.node_names.index("t-0-0-0")] == SCORE_GRID_K
+
+
+# ----------------------------------------------------------------------
+# batched box scan ≡ sequential oracle
+
+
+def _random_masks(rng, n):
+    free = rng.random(n) < 0.4
+    evictable = ~free & (rng.random(n) < 0.5)
+    vic_cnt = np.where(evictable, rng.integers(1, 4, n), 0).astype(np.int32)
+    vic_cost = (vic_cnt * rng.integers(1, 100, n)).astype(np.int32)
+    return free, evictable, vic_cnt, vic_cost
+
+
+class TestBoxScanParity:
+    @pytest.mark.parametrize("shape", [(2, 2, 2), (1, 2, 4), (4, 1, 1),
+                                       (3, 2, 1)])
+    def test_batched_equals_oracle(self, shape):
+        rng = np.random.default_rng(hash(shape) % 2**32)
+        nodes = _torus(4, 4, 2)
+        # Degrade a couple of nodes so invalid rows are in play.
+        nodes["t-0-1-0"].node.metadata.labels.pop(topo.POD_LABEL)
+        nodes["flat-x"] = _ninfo("flat-x", {})
+        view = topo.build_view(nodes)
+        n = len(view.node_names)
+        free, evictable, vic_cnt, vic_cost = _random_masks(rng, n)
+        oracle = ts.box_scan_seq(view, free, evictable, vic_cnt,
+                                 vic_cost, shape)
+        n_pad = bucket(n)
+        coords = np.full((n_pad, topo.COORD_WIDTH), -1, np.int32)
+        coords[:n] = view.coords[:n]
+
+        def pad(a):
+            out = np.zeros((n_pad,), a.dtype)
+            out[:n] = a
+            return out
+
+        inp = ts.BoxInputs(coords, pad(free), pad(evictable),
+                           pad(vic_cnt), pad(vic_cost))
+        batched = np.asarray(ts.box_scan(inp, *shape))[:n]
+        np.testing.assert_array_equal(batched, oracle)
+
+    def test_sharded_leg_equals_single_chip(self):
+        from kube_batch_tpu.parallel.mesh import default_mesh
+        mesh = default_mesh()
+        if mesh is None:
+            pytest.skip("single-device platform")
+        rng = np.random.default_rng(7)
+        view = topo.build_view(_torus(4, 4, 2))
+        n = len(view.node_names)
+        n_pad = ((n + mesh.size - 1) // mesh.size) * mesh.size
+        coords = np.full((n_pad, topo.COORD_WIDTH), -1, np.int32)
+        coords[:n] = view.coords[:n]
+        free, evictable, vic_cnt, vic_cost = _random_masks(rng, n)
+
+        def pad(a):
+            out = np.zeros((n_pad,), a.dtype)
+            out[:n] = a
+            return out
+
+        inp = ts.BoxInputs(coords, pad(free), pad(evictable),
+                           pad(vic_cnt), pad(vic_cost))
+        single = np.asarray(ts.box_scan(inp, 2, 2, 2))
+        sharded = np.asarray(ts.box_scan_sharded(inp, 2, 2, 2, mesh))
+        np.testing.assert_array_equal(sharded, single)
+
+    def test_dispatch_is_the_kernel_and_counts_the_route(self):
+        view = topo.build_view(_torus(2, 2, 2))
+        n = len(view.node_names)
+        free = np.zeros((n,), bool)
+        free[:4] = True
+        zeros = np.zeros((n,), np.int32)
+        inp = ts.BoxInputs(view.coords[:n].copy(), free,
+                           np.zeros((n,), bool), zeros, zeros.copy())
+        out = ts.dispatch_box_scan(inp, (2, 2, 1))
+        np.testing.assert_array_equal(
+            out, ts.box_scan_seq(view, free, np.zeros((n,), bool),
+                                 zeros, zeros, (2, 2, 1)))
+
+
+# ----------------------------------------------------------------------
+# scenario generator determinism
+
+
+class TestScenarioDeterminism:
+    @pytest.mark.parametrize("kind", sg.KINDS)
+    def test_same_seed_byte_identical(self, kind):
+        for seed in (0, 3):
+            a = sg.scenario_bytes(sg.gen_scenario(kind, seed))
+            b = sg.scenario_bytes(sg.gen_scenario(kind, seed))
+            assert a == b
+
+    def test_canonical_bytes_round_trip(self):
+        spec = sg.gen_scenario("churn_storm", 5)
+        rt = json.loads(sg.scenario_bytes(spec))
+        assert sg.scenario_bytes(rt) == sg.scenario_bytes(spec)
+        assert rt["seed"] == 5 and rt["kind"] == "churn_storm"
+
+
+# ----------------------------------------------------------------------
+# e2e slice placement (fragmentation-pressure scenario)
+
+
+@pytest.fixture(scope="module")
+def frag_runs():
+    """One frag_pressure scenario run through both engines (shared by
+    the parity/outcome tests below — the arms are the expensive part)."""
+    chaos_plan.disable()
+    spec = sg.gen_scenario("frag_pressure", 0)
+    batched = sg.run_arm(spec, sequential=False, cycles_per_wave=2)
+    oracle = sg.run_arm(spec, sequential=True, cycles_per_wave=2)
+    return spec, batched, oracle
+
+
+class TestE2ESlicePlacement:
+    def test_batched_equals_sequential_oracle(self, frag_runs):
+        spec, batched, oracle = frag_runs
+        assert sg.check_invariants(spec, batched) == []
+        assert sg.check_invariants(spec, oracle) == []
+        assert sg.compare_arms(batched, oracle) == []
+
+    def test_slice_bound_contiguously(self, frag_runs):
+        _, batched, _ = frag_runs
+        hosts = {node for key, node in batched["bind_map"].items()
+                 if key.startswith(f"{sg.NS}/slice0-")}
+        assert len(hosts) == 8  # the whole 2x2x2 box, one task per node
+        # Contiguity: the 8 hosts are an axis-aligned 2x2x2 box of the
+        # torus (host names carry their coordinates).
+        coords = sorted(tuple(int(v) for v in h.split("-")[1:])
+                        for h in hosts)
+        x0, y0, z0 = coords[0]
+        dims = sg.gen_scenario("frag_pressure", 0)["inventory"]["nodes"]
+        dx = 1 + max(int(d["name"].split("-")[1]) for d in dims)
+        dy = 1 + max(int(d["name"].split("-")[2]) for d in dims)
+        dz = 1 + max(int(d["name"].split("-")[3]) for d in dims)
+        want = sorted(((x0 + ox) % dx, (y0 + oy) % dy, (z0 + oz) % dz)
+                      for ox in range(2) for oy in range(2)
+                      for oz in range(2))
+        assert coords == want
+
+    def test_frag_slo_published(self, frag_runs):
+        doc = topo.topo_table.snapshot()
+        assert doc["pools"], "topo table never published"
+        row = next(iter(doc["pools"].values()))
+        assert {"free", "largest_block", "frag_ratio"} <= set(row)
+        counts = metrics.topo_slice_counts()
+        assert counts.get("placed", 0) + counts.get("defrag_placed", 0) >= 1
+
+    def test_topology_off_is_bit_parity_with_unlisted_conf(self):
+        spec = sg.gen_scenario("frag_pressure", 2)
+        with sg._env({topo.TOPOLOGY_ENV: "0"}):
+            off = sg.run_arm(spec, sequential=False, cycles_per_wave=2)
+        flat_spec = dict(spec, conf="base")
+        control = sg.run_arm(flat_spec, sequential=False,
+                             cycles_per_wave=2)
+        assert off["bind_map"] == control["bind_map"]
+        assert off["pods"] == control["pods"]
+        assert off["deletes"] == control["deletes"]
+
+    def test_defrag_off_leaves_slice_pending(self):
+        spec = sg.gen_scenario("frag_pressure", 0)
+        with sg._env({topo.TOPO_DEFRAG_ENV: "0"}):
+            arm = sg.run_arm(spec, sequential=False, cycles_per_wave=2)
+        assert arm["quiesced"] and not arm["loop_deaths"]
+        slice_binds = [k for k in arm["bind_map"]
+                       if k.startswith(f"{sg.NS}/slice0-")]
+        assert slice_binds == []  # capacity alone can't make contiguity
+
+    def test_max_nodes_cap_degrades_not_dies_and_never_scatters(self):
+        spec = sg.gen_scenario("frag_pressure", 0)
+        before = metrics.topo_slice_counts().get("degraded", 0)
+        with sg._env({topo.TOPO_MAX_NODES_ENV: "2"}):
+            arm = sg.run_arm(spec, sequential=False, cycles_per_wave=2)
+        assert arm["quiesced"] and not arm["loop_deaths"]
+        assert metrics.topo_slice_counts().get("degraded", 0) > before
+        # Degraded means the slice WAITS — its tasks must not be
+        # scattered flat by the allocate family.
+        assert not any(k.startswith(f"{sg.NS}/slice0-")
+                       for k in arm["bind_map"])
+
+    def test_departed_pool_gauges_zeroed(self):
+        metrics.publish_topo_frag(
+            {"pool-x": {"frag_ratio": 0.5, "largest_block": 3, "free": 6}})
+        metrics.publish_topo_frag(
+            {"pool-y": {"frag_ratio": 0.25, "largest_block": 6, "free": 8}})
+        vals = {labels[0]: v
+                for labels, v in metrics.topo_frag_ratio.values().items()}
+        assert vals["pool-x"] == 0.0 and vals["pool-y"] == 0.25
+        blocks = {labels[0]: v for labels, v in
+                  metrics.topo_largest_free_block.values().items()}
+        assert blocks["pool-x"] == 0.0 and blocks["pool-y"] == 6.0
+
+
+# ----------------------------------------------------------------------
+# replay round trip
+
+
+class TestReplayRoundTrip:
+    def test_recorded_run_replays_bit_identically(self):
+        spec = sg.gen_scenario("frag_pressure", 1)
+        trace = sg.record_trace(spec, cycles_per_wave=2)
+        assert trace["recorded"]["bind_map"]  # non-vacuous
+        # The trace must survive its serialization (the incident file).
+        trace = json.loads(json.dumps(trace))
+        result = replay_mod.replay(trace)
+        assert replay_mod.compare(trace, result) == []
+
+    def test_capture_refuses_overflowed_ring(self, monkeypatch):
+        """A lineage ring that aged out pods during the recorded run is
+        not a complete workload record: capture must refuse loudly, not
+        hand back a trace that replays aged-out pods at wave 0."""
+        from kube_batch_tpu.trace.lineage import lineage
+        monkeypatch.setenv("KUBE_BATCH_TPU_LINEAGE_RING", "4")
+        lineage.refresh()
+        spec = sg.gen_scenario("frag_pressure", 0)
+        with pytest.raises(RuntimeError, match="overflowed"):
+            sg.record_trace(spec, cycles_per_wave=2)
+        monkeypatch.delenv("KUBE_BATCH_TPU_LINEAGE_RING")
+        lineage.refresh()
+
+    def test_pod_after_last_session_lands_after_the_loop(self):
+        """A tracked pod ingested AFTER the last recorded session open
+        (no ledger entry past its stamp) must replay after the session
+        loop, not be conflated with wave-0 inventory."""
+        from kube_batch_tpu.cache import Cluster
+        from kube_batch_tpu.trace.lineage import lineage
+        lineage.refresh()
+        cluster = Cluster()
+        archive = replay_mod.SpecArchive(cluster)
+        lineage.note_session_open()
+        lineage.note_session_open()
+        early = sg._pod_op("early-0", "g0")
+        late = sg._pod_op("late-0", "g0")
+        cluster.create_pod(replay_mod.build_pod(early))
+        lineage.note_ingest(f"{sg.NS}/early-0", None)
+        # A third open AFTER early's ingest: early's first-visible
+        # session is 3; late (ingested after every open) has none.
+        lineage.note_session_open()
+        cluster.create_pod(replay_mod.build_pod(late))
+        lineage.note_ingest(f"{sg.NS}/late-0", None)
+        trace = replay_mod.capture(archive, sg.BASE_CONF)
+        by_name = {p["name"]: p for p in trace["pods"]}
+        assert by_name["early-0"]["first_session"] == 3
+        assert by_name["late-0"]["first_session"] == \
+            int(trace["recorded_sessions"]) + 1
+        lineage.refresh()
+
+    def test_capture_requires_lineage_ring(self, monkeypatch):
+        from kube_batch_tpu.cache import Cluster
+        from kube_batch_tpu.trace.lineage import lineage
+        monkeypatch.setenv("KUBE_BATCH_TPU_LINEAGE", "0")
+        lineage.refresh()
+        archive = replay_mod.SpecArchive(Cluster())
+        with pytest.raises(RuntimeError, match="LINEAGE"):
+            replay_mod.capture(archive, sg.BASE_CONF)
+        monkeypatch.delenv("KUBE_BATCH_TPU_LINEAGE")
+        lineage.refresh()
+
+
+# ----------------------------------------------------------------------
+# chaos site topology.bad_coords
+
+
+class TestBadCoordsChaos:
+    def test_site_degrades_nodes_counts_and_survives(self):
+        before = metrics.topo_bad_coords.value()
+        chaos_plan.install(chaos_plan.FaultPlan(
+            seed=11, rate=1.0, sites=("topology.bad_coords",)))
+        view = topo.build_view(_torus(2, 2, 1))
+        assert view.n_valid == 0
+        assert metrics.topo_bad_coords.value() == before + 4
+        chaos_plan.disable()
+        assert topo.build_view(_torus(2, 2, 1)).n_valid == 4
+
+    def test_slice_refuses_organically_degraded_node(self):
+        """A slice whose only feasible box includes a node with malformed
+        coordinate labels stays pending — degraded means flat-list, and
+        a box may never include a flat node (doc/CHAOS.md)."""
+        nodes = [sg._node_doc(
+            f"t-{x}-{y}-0", "8", "16Gi",
+            {topo.POD_LABEL: "p", topo.RACK_LABEL: "0",
+             topo.AXIS_LABELS[0]: str(x), topo.AXIS_LABELS[1]: str(y),
+             topo.AXIS_LABELS[2]: "0"})
+            for x in (0, 1) for y in (0, 1)]
+        nodes[0]["labels"][topo.AXIS_LABELS[0]] = "oops"
+        w0 = [sg._pg_op("s", 4, "q0", ann={sg.SLICE_KEY: "2x2x1"})]
+        w0 += [sg._pod_op(f"s-{i}", "s", cpu="4", mem="4Gi",
+                          ts=float(i)) for i in range(4)]
+        spec = {"inventory": sg._inventory(nodes), "waves": [w0],
+                "conf": "topo", "kind": "mini", "seed": 0}
+        arm = sg.run_arm(spec, sequential=False, cycles_per_wave=2)
+        assert arm["quiesced"] and not arm["loop_deaths"]
+        assert not any(k.startswith(f"{sg.NS}/s-")
+                       for k in arm["bind_map"])
+
+    def test_chaos_e2e_loop_survives_full_degradation(self):
+        before = metrics.topo_bad_coords.value()
+        chaos_plan.install(chaos_plan.FaultPlan(
+            seed=3, rate=1.0, sites=("topology.bad_coords",)))
+        spec = sg.gen_scenario("frag_pressure", 3)
+        arm = sg.run_arm(spec, sequential=False, cycles_per_wave=2)
+        chaos_plan.disable()
+        assert arm["quiesced"] and not arm["loop_deaths"]
+        assert metrics.topo_bad_coords.value() > before

@@ -52,7 +52,7 @@ from kube_batch_tpu.chaos.breaker import device_breaker  # noqa: E402
 from kube_batch_tpu.scheduler import Scheduler  # noqa: E402
 
 SOAK_CONF = """
-actions: "tpu-allocate, preempt, backfill"
+actions: "topo-allocate, tpu-allocate, preempt, backfill"
 tiers:
 - plugins:
   - name: priority
@@ -75,7 +75,7 @@ tiers:
 FAKE_SITES = ("session.snapshot", "session.tensorize", "solve.device_error",
               "solve.slow", "solve.poison", "evict_solve.device_error",
               "bind.timeout", "bind.http5xx", "bind.ambiguous",
-              "evict.error", "evict.ambiguous")
+              "evict.error", "evict.ambiguous", "topology.bad_coords")
 EDGE_SITES = FAKE_SITES + ("watch.disconnect", "watch.truncate",
                            "watch.stale")
 
@@ -103,9 +103,20 @@ def _submit_job(cluster, name, replicas, min_member, queue, cpu="1",
                                    prio=prio))
 
 
-def _mk_node(name: str, cpu: str, mem: str) -> Node:
+def _mk_node(name: str, cpu: str, mem: str, ix: int = 0) -> Node:
+    # Coordinate labels (models/topology.py) make the topo action's
+    # view build run every cycle so `topology.bad_coords` is always
+    # reachable — but with NO slice jobs and NO frag-scoring plugin in
+    # SOAK_CONF the torus view is placement-neutral: a fired fault
+    # degrades fragmentation accounting only, so the convergence
+    # contract (bit-identical bind map vs the oracle) still holds.
+    from kube_batch_tpu.models.topology import (AXIS_LABELS, POD_LABEL,
+                                                RACK_LABEL)
     alloc = {"cpu": cpu, "memory": mem, "pods": 110}
-    return Node(metadata=ObjectMeta(name=name, uid=name),
+    labels = {POD_LABEL: "soak-pod", RACK_LABEL: "0",
+              AXIS_LABELS[0]: str(ix % 4), AXIS_LABELS[1]: str(ix // 4),
+              AXIS_LABELS[2]: "0"}
+    return Node(metadata=ObjectMeta(name=name, uid=name, labels=labels),
                 spec=NodeSpec(),
                 status=NodeStatus(allocatable=alloc, capacity=dict(alloc)))
 
@@ -125,7 +136,7 @@ def build_cluster(nodes: int) -> Cluster:
     cluster.create_priority_class(PriorityClass(
         metadata=ObjectMeta(name="low-priority"), value=1))
     for i in range(nodes):
-        cluster.create_node(_mk_node(f"node-{i:03d}", "2", "4Gi"))
+        cluster.create_node(_mk_node(f"node-{i:03d}", "2", "4Gi", ix=i))
     # Base load: nodes*2 cpu total, filled exactly by 1-cpu job members.
     # min_member=1 keeps members above the gang floor preemptable (a
     # min==replicas gang is veto-protected by the gang plugin and the
@@ -421,7 +432,12 @@ def run_soak(seeds, *, nodes: int = 8, cycles: int = 10,
                   ("evict_solve.*", min(1.0, rate * 1.6)),
                   # Fires only on micro-eligible cycles (see FAKE_SITES
                   # note): boost it so those cycles do get hit.
-                  ("incremental.stale_generation", min(1.0, rate * 1.6)))
+                  ("incremental.stale_generation", min(1.0, rate * 1.6)),
+                  # One activation per (cycle, labeled node) in the topo
+                  # view build; boosted so label corruption demonstrably
+                  # degrades nodes (not cycles) every sweep
+                  # (doc/CHAOS.md, doc/TOPOLOGY.md).
+                  ("topology.bad_coords", min(1.0, rate * 1.6)))
     seed_results = []
     sites_union = set()
     for seed in seeds:

@@ -1,0 +1,84 @@
+"""CI gate for `make bench-topo`: read the topology A/B artifact line
+from stdin and assert the subsystem's three contracts (doc/TOPOLOGY.md):
+
+1. PARITY — batched box-scan placement ≡ the sequential numpy oracle
+   (binds AND eviction sequence), including the FORCE_SHARD mesh leg.
+2. DEFRAG WINS — the defrag-aware evictor produced a STRICTLY larger
+   contiguous free block than the capacity-only evictor on the
+   fragmentation-pressure scenario.
+3. NON-VACUOUS — the defrag arm actually placed (and bound) at least
+   one slice, and the capacity arm did not accidentally match it (a
+   scenario where both arms succeed measures nothing).
+
+bench.py deliberately always exits 0 (the artifact-always-emits
+contract), so pass/fail lives here — the check_evict_ab discipline.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> int:
+    line = ""
+    for raw in sys.stdin:
+        raw = raw.strip()
+        if raw.startswith("{"):
+            line = raw  # last JSON-looking line wins (the artifact)
+    if not line:
+        print("check_topo_ab: no artifact line on stdin", file=sys.stderr)
+        return 1
+    out = json.loads(line)
+    if out.get("error"):
+        print(f"check_topo_ab: bench reported error: {out['error']}",
+              file=sys.stderr)
+        return 1
+    if out.get("topo_parity") is not True:
+        print("check_topo_ab: PARITY FAILURE — batched box scan diverged "
+              "from the sequential oracle "
+              f"(topo_parity={out.get('topo_parity')!r})", file=sys.stderr)
+        return 1
+    if out.get("topo_shard_parity") is not True:
+        print("check_topo_ab: MESH PARITY FAILURE — the FORCE_SHARD leg "
+              "diverged from the single-chip batched run "
+              f"(topo_shard_parity={out.get('topo_shard_parity')!r})",
+              file=sys.stderr)
+        return 1
+    ab = out.get("topo_ab") or {}
+    defrag = ab.get("defrag") or {}
+    capacity = ab.get("capacity") or {}
+    if not defrag or not capacity:
+        print("check_topo_ab: artifact carries no topo_ab arms",
+              file=sys.stderr)
+        return 1
+    d_block = defrag.get("largest_free_block", 0)
+    c_block = capacity.get("largest_free_block", 0)
+    if not d_block > c_block:
+        print("check_topo_ab: DEFRAG FAILURE — the defrag-aware evictor "
+              f"did not produce a strictly larger contiguous free block "
+              f"(defrag {d_block} vs capacity {c_block})", file=sys.stderr)
+        return 1
+    if defrag.get("slice_binds", 0) < 1:
+        print("check_topo_ab: VACUOUS — the defrag arm bound no slice "
+              "task; the A/B exercised no slice placement",
+              file=sys.stderr)
+        return 1
+    if defrag.get("evictions", 0) < 1:
+        print("check_topo_ab: VACUOUS — the defrag arm evicted nothing; "
+              "the scenario applied no fragmentation pressure",
+              file=sys.stderr)
+        return 1
+    print("topology A/B: parity OK (single-chip + mesh)")
+    print(f"  defrag   largest free block {d_block:3d}   "
+          f"evictions {defrag.get('evictions')}   "
+          f"slice binds {defrag.get('slice_binds')}")
+    print(f"  capacity largest free block {c_block:3d}   "
+          f"evictions {capacity.get('evictions')}   "
+          f"slice binds {capacity.get('slice_binds')}")
+    print(f"  slice outcomes: {out.get('topo_slices')}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -3,9 +3,9 @@ PYTHON ?= python
 COMPILE_CACHE ?= $(CURDIR)/.compile-cache
 
 .PHONY: lint lint-inventory test bench bench-cached bench-steady \
-	bench-evict bench-churn bench-shard bench-topo bench-gate \
-	bench-gate-baseline lineage-ab chaos chaos-smoke scenarios \
-	trace-demo clean-cache
+	bench-evict bench-churn bench-wire bench-shard bench-topo \
+	bench-gate bench-gate-baseline lineage-ab chaos chaos-smoke \
+	scenarios trace-demo clean-cache
 
 # The bench-gate shape: small enough for CI, big enough that the steady
 # path, delta shipping, and the residual floors all exercise (mirrors
@@ -82,6 +82,19 @@ bench-churn:
 		BENCH_CHURN_SWEEP=1 BENCH_TASKS=2000 \
 		BENCH_NODES=256 BENCH_JOBS=80 BENCH_QUEUES=4 \
 		$(PYTHON) bench.py | $(PYTHON) tools/check_churn_ab.py
+
+# Wire-to-tensor fast-path A/B smoke over the HTTP edge
+# (doc/INCREMENTAL.md "Wire fast path"): small-shape churn through a
+# real ApiServer + reflector on BOTH wire formats (native + k8s) with
+# KUBE_BATCH_TPU_WIRE_FAST on and off over identical deterministic
+# schedules — asserts bit-identical server-side binds and events, that
+# the fast arms actually delta-decoded (vacuous-gate guard), and that
+# the per-cycle decode floor populates.  The checker exits nonzero on
+# any violation (bench.py itself always exits 0), so CI fails loudly.
+bench-wire:
+	env JAX_PLATFORMS=cpu BENCH_WIRE_AB=1 BENCH_TASKS=240 \
+		BENCH_NODES=24 BENCH_JOBS=24 $(PYTHON) bench.py \
+		| $(PYTHON) tools/check_wire_ab.py
 
 # Sharded-vs-single-chip A/B smoke on the virtual 8-device CPU mesh
 # (doc/SHARDING.md): runs the 4-action storm with

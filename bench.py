@@ -918,6 +918,7 @@ def measure_churn_sweep(n_tasks, n_nodes, n_jobs, n_queues,
         GroupNameAnnotationKey
     from kube_batch_tpu.framework import close_session, open_session
     from kube_batch_tpu.metrics.metrics import (candidate_solve_counts,
+                                                compile_cache_counts,
                                                 cycle_floor_values,
                                                 generation_reuse_counts,
                                                 incremental_session_counts,
@@ -984,6 +985,7 @@ def measure_churn_sweep(n_tasks, n_nodes, n_jobs, n_queues,
             next_uid = n_tasks
             retire = []
             times, walls = [], []
+            recompiled = []  # per-round: fresh XLA compile in window
             rounds_meta = []  # per-round kind + floors + O(N)-work
             counts0 = incremental_session_counts()
             reuse0 = generation_reuse_counts()
@@ -1034,7 +1036,15 @@ def measure_churn_sweep(n_tasks, n_nodes, n_jobs, n_queues,
                                                 namespace="bench"),
                             spec=v1alpha1.PodGroupSpec(min_member=1)))
                 kmark = incremental_session_counts()
+                miss0 = compile_cache_counts()[1]
                 times.append(session_ms())
+                # A fresh in-process compile inside this round (churn
+                # crossing a bucket boundary, the first candidate
+                # bucket) makes its wall clock a compile measurement,
+                # not a steady one: mark it so the level summary can
+                # drop it — the same discipline the bench-gate steady
+                # window applies (doc/OBSERVABILITY.md).
+                recompiled.append(compile_cache_counts()[1] > miss0)
                 kafter = incremental_session_counts()
                 kind = next((kk for kk in ("micro", "full", "fallback")
                              if kafter.get(kk, 0) > kmark.get(kk, 0)), None)
@@ -1054,9 +1064,11 @@ def measure_churn_sweep(n_tasks, n_nodes, n_jobs, n_queues,
         # verified instead of silently narrowing to binds-only.
         truncated = len(cache.events) >= cache.events.maxlen
         events = None if truncated else list(cache.events)[events_mark:]
-        window = walls[1:]
+        window = [w for w, rec in zip(walls[1:], recompiled[1:])
+                  if not rec] or walls[1:]
         return {
             "times": times,
+            "recompiled": recompiled,
             "fingerprints": fingerprints,
             "events": events,
             "events_truncated": truncated,
@@ -1074,8 +1086,19 @@ def measure_churn_sweep(n_tasks, n_nodes, n_jobs, n_queues,
     def run_level(label, churn):
         arms = [run_arm(inc, churn)
                 for inc in (False, True, True, False)]
-        control = arms[0]["times"][1:] + arms[3]["times"][1:]
-        incr = arms[1]["times"][1:] + arms[2]["times"][1:]
+
+        def steady_times(arm):
+            # Drop round 0 (absorbs the settle echo) AND any round whose
+            # window saw a fresh XLA compile — its wall clock measures
+            # the recompile, not the steady cycle (falling back to the
+            # full window only if every round recompiled).
+            clean = [t for t, rec in zip(arm["times"][1:],
+                                         arm["recompiled"][1:])
+                     if not rec]
+            return clean or arm["times"][1:]
+
+        control = steady_times(arms[0]) + steady_times(arms[3])
+        incr = steady_times(arms[1]) + steady_times(arms[2])
         parity = all(
             arm["fingerprints"] == arms[0]["fingerprints"]
             and (arm["events"] is None or arms[0]["events"] is None
@@ -1090,20 +1113,25 @@ def measure_churn_sweep(n_tasks, n_nodes, n_jobs, n_queues,
         # regression shows up here as walked ~= objects.
         inc_meta = arms[1]["rounds_meta"] + arms[2]["rounds_meta"]
         floors = {}
-        for f in ("solve_wait", "snapshot", "close", "occupancy"):
+        for f in ("solve_wait", "snapshot", "close", "occupancy",
+                  "decode", "stage", "plugin_close"):
             vals = sorted(m["floors"].get(f, 0.0) for m in inc_meta)
             floors[f] = round(vals[len(vals) // 2], 3) if vals else None
         micro = [m for m in inc_meta if m["kind"] == "micro"]
         onwork = {"objects_total": n_nodes + n_jobs,
-                  "nodes_total": n_nodes, "jobs_total": n_jobs}
+                  "nodes_total": n_nodes, "jobs_total": n_jobs,
+                  "tasks_total": n_tasks}
         for key in ("snapshot_walked", "close_walked",
-                    "occupancy_rebuilt", "candidate_rows"):
+                    "occupancy_rebuilt", "candidate_rows",
+                    "stage_rows"):
             onwork[f"micro_{key}_max"] = (
                 max(int(m["onwork"].get(key, 0)) for m in micro)
                 if micro else None)
         sweep[label] = {
             "events_verified": not any(a["events_truncated"]
                                        for a in arms),
+            "recompiled_rounds": int(sum(arms[1]["recompiled"][1:])
+                                     + sum(arms[2]["recompiled"][1:])),
             "incremental_ms": med_i, "incremental_p90": p90_i,
             "control_ms": med_c, "control_p90": p90_c,
             "speedup": (round(med_c / med_i, 2) if med_i else None),
@@ -1152,6 +1180,256 @@ def measure_churn_sweep(n_tasks, n_nodes, n_jobs, n_queues,
         else:
             os.environ[INCREMENTAL_ENV] = prior
     return sweep, parity_all
+
+
+def measure_wire_ab(n_tasks, n_nodes, n_jobs, rounds: int = 3,
+                    wires=("native", "k8s")):
+    """Same-box counterbalanced A/B of the wire-to-tensor fast path over
+    the HTTP edge (`make bench-wire`, doc/INCREMENTAL.md "Wire fast
+    path").  Per wire mode, four fresh server+reflector arms run in
+    control/fast/fast/control order (KUBE_BATCH_TPU_WIRE_FAST) over an
+    IDENTICAL deterministic churn schedule: create pods/podgroups and
+    retire bound ones through the REST edge, wait for watch visibility,
+    run a real scheduling cycle, wait for the bind echo.  Parity = the
+    per-round SERVER-side bind maps plus the timestamp-stripped server
+    event log, bit-identical across all four arms (normalized sorted —
+    bind-egress worker interleaving does not order the truth store).
+    The fast arms must actually delta-decode (the vacuous-gate guard
+    tools/check_wire_ab.py enforces), and the per-cycle ``decode`` floor
+    is reported for both arms.
+
+    Returns {wire: level-record}, parity_all."""
+    from kube_batch_tpu.api import (Container, ObjectMeta, Pod, PodSpec,
+                                    PodStatus)
+    from kube_batch_tpu.apis.scheduling import v1alpha1
+    from kube_batch_tpu.apis.scheduling.v1alpha1 import \
+        GroupNameAnnotationKey
+    from kube_batch_tpu.api.objects import Node, NodeSpec, NodeStatus
+    from kube_batch_tpu.cache import Cluster, new_scheduler_cache
+    from kube_batch_tpu.edge import ApiServer, RemoteCluster
+    from kube_batch_tpu.metrics.metrics import (cycle_floor_values,
+                                                wire_fast_counts)
+    from kube_batch_tpu.models.incremental import WIRE_FAST_ENV
+    from kube_batch_tpu.scheduler import Scheduler
+
+    _register()
+
+    def make_pod(name: str, pg_name: str, uid: int):
+        return Pod(
+            metadata=ObjectMeta(
+                name=name, namespace="bench", uid=name,
+                annotations={GroupNameAnnotationKey: pg_name},
+                creation_timestamp=float(uid)),
+            spec=PodSpec(containers=[Container(
+                requests={"cpu": "500m", "memory": "512Mi"})]),
+            status=PodStatus(phase="Pending"))
+
+    def seed_cluster():
+        cluster = Cluster()
+        per_node = max(2, (n_tasks + n_nodes - 1) // n_nodes)
+        for i in range(n_nodes):
+            cluster.create_node(Node(
+                metadata=ObjectMeta(name=f"node-{i}", uid=f"node-{i}"),
+                spec=NodeSpec(),
+                status=NodeStatus(
+                    allocatable={"cpu": str(per_node),
+                                 "memory": f"{per_node}Gi", "pods": 110},
+                    capacity={"cpu": str(per_node),
+                              "memory": f"{per_node}Gi", "pods": 110})))
+        cluster.create_queue(v1alpha1.Queue(
+            metadata=ObjectMeta(name="default"),
+            spec=v1alpha1.QueueSpec(weight=1)))
+        for j in range(n_jobs):
+            cluster.create_pod_group(v1alpha1.PodGroup(
+                metadata=ObjectMeta(name=f"pg-{j}", namespace="bench"),
+                spec=v1alpha1.PodGroupSpec(min_member=1,
+                                           queue="default")))
+        for i in range(n_tasks):
+            cluster.create_pod(make_pod(f"pod-{i}", f"pg-{i % n_jobs}", i))
+        return cluster
+
+    def bind_map(cluster):
+        with cluster.lock:
+            return tuple(sorted((k, p.spec.node_name)
+                                for k, p in cluster.pods.items()
+                                if p.spec.node_name))
+
+    def event_log(cluster):
+        # Timestamps/autonames differ per arm by wall clock; everything
+        # semantically observable is kept, sorted (bind workers race the
+        # store, so arrival order is not part of the contract).
+        return tuple(sorted(
+            (e.reason, e.involved_object, e.type, e.message)
+            for e in cluster.events.values()))
+
+    def wait_until(check, what: str, timeout_s: float = 30.0):
+        deadline = time.time() + timeout_s
+        while time.time() < deadline:
+            if check():
+                return
+            time.sleep(0.01)
+        raise TimeoutError(f"wire A/B: {what} not visible after "
+                           f"{timeout_s:.0f}s")
+
+    def run_arm(fast: bool, wire: str):
+        os.environ[WIRE_FAST_ENV] = "1" if fast else "0"
+        cluster = seed_cluster()
+        server = ApiServer(cluster).start()
+        remote = None
+        try:
+            remote = RemoteCluster(server.url, timeout=30,
+                                   wire=wire).start(timeout=60)
+            cache = new_scheduler_cache(remote)
+            sched = Scheduler(cache)
+            wf0 = wire_fast_counts()
+            fingerprints = []
+            times = []
+            decode_floors = []
+            churn = max(1, n_tasks // 50)
+            retired = 0
+            next_uid = n_tasks
+
+            def cycle():
+                t0 = time.perf_counter()
+                sched.run_once()
+                times.append((time.perf_counter() - t0) * 1e3)
+                decode_floors.append(
+                    cycle_floor_values().get("decode"))
+
+            with _gc_posture():
+                cycle()  # cold: bind the seed wave
+
+                def seed_bound():
+                    with cluster.lock:
+                        return sum(1 for p in cluster.pods.values()
+                                   if p.spec.node_name) >= n_tasks
+                wait_until(seed_bound, "seed binds", 60.0)
+                # The bind ECHO must land in the mirror before churn
+                # deletes bound pods, or arms could diverge on timing.
+                def echo_visible():
+                    with remote.lock:
+                        return sum(1 for p in remote.pods.values()
+                                   if p.spec.node_name) >= n_tasks
+                wait_until(echo_visible, "seed bind echo", 60.0)
+                fingerprints.append(bind_map(cluster))
+                for rnd in range(rounds):
+                    for _ in range(churn):  # free capacity first
+                        remote.delete_pod("bench", f"pod-{retired}")
+                        retired += 1
+                    new_keys = []
+                    for i in range(churn):
+                        uid = next_uid
+                        next_uid += 1
+                        name = f"churn-{rnd}-{i}"
+                        remote.create_pod_group(v1alpha1.PodGroup(
+                            metadata=ObjectMeta(name=name,
+                                                namespace="bench"),
+                            spec=v1alpha1.PodGroupSpec(
+                                min_member=1, queue="default")))
+                        remote.create_pod(make_pod(name, name, uid))
+                        new_keys.append(f"bench/{name}")
+
+                    def wave_visible():
+                        with remote.lock:
+                            return all(k in remote.pods
+                                       for k in new_keys) and \
+                                f"bench/pod-{retired - 1}" \
+                                not in remote.pods
+                    wait_until(wave_visible, f"churn wave {rnd}")
+                    cycle()
+
+                    def wave_bound():
+                        with cluster.lock:
+                            return all(
+                                cluster.pods[k].spec.node_name
+                                for k in new_keys if k in cluster.pods)
+                    wait_until(wave_bound, f"churn binds {rnd}")
+
+                    def wave_echo():
+                        with remote.lock:
+                            return all(
+                                remote.pods[k].spec.node_name
+                                for k in new_keys if k in remote.pods)
+                    wait_until(wave_echo, f"churn bind echo {rnd}")
+                    fingerprints.append(bind_map(cluster))
+            # The event recorder drains asynchronously (a daemon thread
+            # POSTing to the edge): flush it and wait for the SERVER
+            # log to quiesce, or a fast arm reads fewer events than a
+            # slow one purely by timing.
+            recorder = getattr(cache, "event_recorder", None)
+            if recorder is not None:
+                recorder.flush(10.0)
+            stable_since, last_n = time.time(), -1
+            while time.time() - stable_since < 0.5:
+                n = len(cluster.events)
+                if n != last_n:
+                    last_n = n
+                    stable_since = time.time()
+                time.sleep(0.02)
+            wf1 = wire_fast_counts()
+            events = event_log(cluster)
+            return {
+                "fingerprints": fingerprints,
+                "events": events,
+                "times": times,
+                "decode_floor_ms": [f for f in decode_floors
+                                    if f is not None],
+                "wire_fast": {k: wf1.get(k, 0) - wf0.get(k, 0)
+                              for k in wf1},
+            }
+        finally:
+            if remote is not None:
+                remote.stop()
+            server.stop()
+
+    prior = os.environ.get(WIRE_FAST_ENV)
+    ab = {}
+    parity_all = True
+    try:
+        for wire in wires:
+            arms = [run_arm(fast, wire)
+                    for fast in (False, True, True, False)]
+            parity = all(
+                arm["fingerprints"] == arms[0]["fingerprints"]
+                and arm["events"] == arms[0]["events"]
+                for arm in arms[1:])
+            parity_all = parity_all and parity
+            control = arms[0]["times"][1:] + arms[3]["times"][1:]
+            fast_t = arms[1]["times"][1:] + arms[2]["times"][1:]
+            med_f, p90_f = _stats(fast_t)
+            med_c, p90_c = _stats(control)
+            fast_counts = {
+                k: arms[1]["wire_fast"].get(k, 0)
+                + arms[2]["wire_fast"].get(k, 0)
+                for k in set(arms[1]["wire_fast"])
+                | set(arms[2]["wire_fast"])}
+            ab[wire] = {
+                "parity": parity,
+                "fast_ms": med_f, "fast_p90": p90_f,
+                "control_ms": med_c, "control_p90": p90_c,
+                "speedup": (round(med_c / med_f, 2) if med_f else None),
+                "wire_fast": fast_counts,
+                "control_wire_fast": {
+                    k: arms[0]["wire_fast"].get(k, 0)
+                    + arms[3]["wire_fast"].get(k, 0)
+                    for k in set(arms[0]["wire_fast"])
+                    | set(arms[3]["wire_fast"])},
+                "decode_floor_ms": (
+                    # Pooled over BOTH fast arms, like every other
+                    # fast-arm statistic (cancels counterbalancing
+                    # order effects).
+                    round(statistics.median(
+                        arms[1]["decode_floor_ms"]
+                        + arms[2]["decode_floor_ms"]), 3)
+                    if arms[1]["decode_floor_ms"]
+                    + arms[2]["decode_floor_ms"] else None),
+            }
+    finally:
+        if prior is None:
+            os.environ.pop(WIRE_FAST_ENV, None)
+        else:
+            os.environ[WIRE_FAST_ENV] = prior
+    return ab, parity_all
 
 
 def _probe_backend(timeout_s: float):
@@ -1323,7 +1601,7 @@ def _fill_action_ab(out, n_tasks, n_nodes, n_jobs, n_queues,
 def _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline,
          steady_only=False, steady_rounds_n=5, evict_only=False,
          churn_only=False, shard_only=False, lineage_only=False,
-         topo_only=False):
+         topo_only=False, wire_only=False):
     if topo_only:
         # BENCH_TOPO_AB=1 (`make bench-topo`): ONLY the topology A/B —
         # defrag-vs-capacity eviction on the fragmentation-pressure
@@ -1371,6 +1649,17 @@ def _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline,
         out["churn_sweep"], out["churn_parity"] = measure_churn_sweep(
             n_tasks, n_nodes, n_jobs, n_queues,
             rounds=int(os.environ.get("BENCH_CHURN_ROUNDS", 6)))
+        return
+    if wire_only:
+        # BENCH_WIRE_AB=1 (`make bench-wire`): ONLY the wire-fast-path
+        # A/B over the HTTP edge — per-wire-mode medians, delta-decode/
+        # fallback counters, and the bind+event parity verdict
+        # tools/check_wire_ab.py gates CI on (doc/INCREMENTAL.md).
+        import jax as _jax
+        out["platform"] = _jax.default_backend()
+        out["wire_ab"], out["wire_parity"] = measure_wire_ab(
+            n_tasks, n_nodes, n_jobs,
+            rounds=int(os.environ.get("BENCH_WIRE_ROUNDS", 3)))
         return
     _run_full(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n,
               with_pipeline, steady_only, steady_rounds_n)
@@ -1556,6 +1845,12 @@ def main():
         # bit-parity verdict vs the KUBE_BATCH_TPU_INCREMENTAL=0 arm.
         "churn_sweep": None,
         "churn_parity": None,
+        # Wire-to-tensor fast path A/B (BENCH_WIRE_AB=1 /
+        # `make bench-wire`): per-wire-mode medians, delta-decode and
+        # fallback counters, and the bind+event parity verdict vs the
+        # KUBE_BATCH_TPU_WIRE_FAST=0 arm (doc/INCREMENTAL.md).
+        "wire_ab": None,
+        "wire_parity": None,
         # Sharded steady state (doc/SHARDING.md): per-device node-shard
         # delta bytes and chokepoint routing counters over the steady
         # window, plus the BENCH_SHARD_AB=1 (`make bench-shard`) A/B —
@@ -1621,6 +1916,7 @@ def main():
         steady_only = os.environ.get("BENCH_STEADY_ONLY") == "1"
         evict_only = os.environ.get("BENCH_EVICT_AB") == "1"
         churn_only = os.environ.get("BENCH_CHURN_SWEEP") == "1"
+        wire_only = os.environ.get("BENCH_WIRE_AB") == "1"
         shard_only = os.environ.get("BENCH_SHARD_AB") == "1"
         lineage_only = os.environ.get("BENCH_LINEAGE_AB") == "1"
         topo_only = os.environ.get("BENCH_TOPO_AB") == "1"
@@ -1630,6 +1926,7 @@ def main():
                          + (" [steady-only]" if steady_only else "")
                          + (" [evict-ab]" if evict_only else "")
                          + (" [churn-sweep]" if churn_only else "")
+                         + (" [wire-ab]" if wire_only else "")
                          + (" [shard-ab]" if shard_only else "")
                          + (" [lineage-ab]" if lineage_only else "")
                          + (" [topo-ab]" if topo_only else ""))
@@ -1671,7 +1968,7 @@ def main():
              steady_only=steady_only, steady_rounds_n=steady_rounds_n,
              evict_only=evict_only, churn_only=churn_only,
              shard_only=shard_only, lineage_only=lineage_only,
-             topo_only=topo_only)
+             topo_only=topo_only, wire_only=wire_only)
         # Last statement INSIDE the try: a signal landing here is still
         # caught below — no handlerless gap before the emit.
         _ignore_signals()

@@ -123,6 +123,20 @@ def _key_fn(resource: str):
     return lambda o: o.metadata.name
 
 
+def _raw_key(resource: str, doc) -> str:
+    """The mirror-store key straight from the RAW wire doc (both wire
+    formats carry ``metadata`` as a plain dict) — the fast path must find
+    the previous object BEFORE any decode runs.  Must agree with
+    ``_key_fn`` of the decoded object; KeyError/TypeError on a malformed
+    doc routes the frame to the full decode path."""
+    md = doc["metadata"]
+    if resource in ("pods", "podgroups", "pdbs", "pvcs"):
+        return f"{md.get('namespace', 'default')}/{md['name']}"
+    # nodes key on o.name == metadata.name (api/objects.Node.name);
+    # queues/priorityclasses key on metadata.name directly.
+    return md["name"]
+
+
 class RemoteCluster:
     """Duck-types the Cluster surface the scheduler wiring consumes:
     ``*_informer`` fan-outs + mirror stores (ingest) and the effector
@@ -202,6 +216,12 @@ class RemoteCluster:
                     for raw in resp:
                         if self._stop.is_set():
                             return
+                        # Frame-receipt stamp: the lineage ingest clock
+                        # starts HERE, not after materialization — the
+                        # fast path skips most of the decode and must
+                        # not silently shift the SLO baseline relative
+                        # to the full path (tests/test_wire_fast.py).
+                        frame_ts = time.monotonic()
                         # Chaos sites (doc/CHAOS.md): stream disconnect,
                         # stale-resume forcing a full relist, and a
                         # truncated frame (exercises the malformed-frame
@@ -254,7 +274,26 @@ class RemoteCluster:
                             break
                         if etype == "PING":
                             continue
-                        obj = self._decode(event["object"])
+                        edoc = event["object"]
+                        # Previous mirror object for this key = the
+                        # delta baseline.  Read without the lock: this
+                        # reflector thread is the store's ONLY writer,
+                        # and dict.get is atomic under the GIL.  A doc
+                        # too malformed to key routes to the full
+                        # decode, whose error handling is unchanged.
+                        try:
+                            prev = store.get(_raw_key(resource, edoc))
+                        except (KeyError, TypeError, AttributeError):
+                            # AttributeError included: a falsy/non-dict
+                            # metadata (None/[]/"") the FULL k8s decode
+                            # tolerates must route to it, not kill the
+                            # reflector thread.
+                            prev = None
+                        t_dec = time.perf_counter()
+                        obj = self._decode(edoc, prev=prev,
+                                           ingest_ts=frame_ts)
+                        metrics.note_decode_seconds(
+                            time.perf_counter() - t_dec)
                         key = key_of(obj)
                         with self.lock:
                             if etype == "ADDED":
@@ -360,19 +399,57 @@ class RemoteCluster:
         return (codec_k8s.to_k8s(obj) if self.wire == "k8s"
                 else codec.encode(obj))
 
-    def _decode(self, doc):
-        obj = (codec_k8s.from_k8s(doc) if self.wire == "k8s"
-               else codec.decode(doc))
-        # Pod-lineage ingest stamp (trace/lineage.py): the moment the
-        # object materialized off the wire, monotonic so the SLO clock
-        # survives wall-clock steps.  Stamped HERE (the client edge,
-        # both wire modes, one chokepoint) and not in the codecs — the
-        # server decodes through the same codec functions and must not
-        # mark ITS objects as scheduler-ingested.  An instance
-        # attribute: dataclass __eq__ ignores it, the codec never
-        # re-encodes it.
+    def _decode(self, doc, prev=None, ingest_ts=None):
+        """Decode one wire doc; ``prev`` (the mirror's current object for
+        the same key) arms the columnar fast path — changed fields only,
+        unchanged subtrees reused by identity (edge/codec.decode_delta /
+        codec_k8s.from_k8s_delta).  Any fast-path surprise degrades to
+        the full decode, counted by reason — a weird frame must never
+        kill the reflector thread (the ValueError contract below is
+        unchanged: a doc the FULL decode rejects still raises)."""
+        obj = None
+        if prev is not None and codec.wire_fast_enabled():
+            try:
+                obj = (codec_k8s.from_k8s_delta(doc, prev)
+                       if self.wire == "k8s"
+                       else codec.decode_delta(doc, prev))
+                metrics.note_wire_decode("delta")
+            except LookupError as exc:
+                # No usable baseline (first sight after a relist gap,
+                # foreign object) or a kind outside the delta plans —
+                # the codec names which; anything else folds into
+                # "baseline" so the label set stays bounded.
+                reason = str(exc)
+                metrics.note_wire_fast_fallback(
+                    reason if reason == "kind" else "baseline")
+            except ValueError:
+                # The full decode would reject this doc too: let the
+                # reflector's malformed-frame relist handle it.
+                raise
+            except Exception:  # lint: allow-swallow(fast-path isolation: the full decode below is always correct, and the degradation is counted)
+                metrics.note_wire_fast_fallback("error")
+        if obj is None:
+            obj = (codec_k8s.from_k8s(doc) if self.wire == "k8s"
+                   else codec.decode(doc))
+            metrics.note_wire_decode("full")
+            if codec.wire_fast_enabled():
+                # Baseline for the NEXT frame of this key (the delta
+                # compare needs the raw doc the object came from).
+                codec.remember_wire_doc(obj, doc)
+        # Pod-lineage ingest stamp (trace/lineage.py): monotonic so the
+        # SLO clock survives wall-clock steps.  ``ingest_ts`` carries the
+        # FRAME-RECEIPT stamp the reflector took before any decode ran,
+        # so the lineage timestamp does not silently shift between the
+        # fast path (near-zero decode) and the full path (the
+        # materialization delay the old stamp-after-decode absorbed).
+        # Stamped HERE (the client edge, both wire modes, one
+        # chokepoint) and not in the codecs — the server decodes through
+        # the same codec functions and must not mark ITS objects as
+        # scheduler-ingested.  An instance attribute: dataclass __eq__
+        # ignores it, the codec never re-encodes it.
         if isinstance(obj, _objects.Pod):
-            obj._ingest_ts = time.monotonic()
+            obj._ingest_ts = (ingest_ts if ingest_ts is not None
+                              else time.monotonic())
         return obj
 
     def _request(self, method: str, path: str, payload=None,

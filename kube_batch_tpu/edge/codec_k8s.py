@@ -269,40 +269,48 @@ def _pod_out(pod: O.Pod) -> dict:
             "spec": spec_doc, "status": status_doc}
 
 
-def _pod_in(doc: dict) -> O.Pod:
-    spec = doc.get("spec") or {}
-    status = doc.get("status") or {}
+def _pod_spec_in(spec: Optional[dict]) -> O.PodSpec:
+    spec = spec or {}
     volumes = []
     for v in spec.get("volumes") or []:
         pvc = v.get("persistentVolumeClaim")
         if pvc and pvc.get("claimName"):
             volumes.append(pvc["claimName"])
+    return O.PodSpec(
+        node_name=spec.get("nodeName", ""),
+        node_selector=dict(spec.get("nodeSelector") or {}),
+        priority=spec.get("priority"),
+        priority_class_name=spec.get("priorityClassName", ""),
+        scheduler_name=spec.get("schedulerName", "kube-batch"),
+        containers=[_container_in(c)
+                    for c in spec.get("containers") or []],
+        init_containers=[_container_in(c)
+                         for c in spec.get("initContainers") or []],
+        tolerations=[O.Toleration(key=t.get("key", ""),
+                                  operator=t.get("operator", "Equal"),
+                                  value=t.get("value", ""),
+                                  effect=t.get("effect", ""))
+                     for t in spec.get("tolerations") or []],
+        affinity=_affinity_in(spec.get("affinity")),
+        volumes=volumes)
+
+
+def _pod_status_in(status: Optional[dict]) -> O.PodStatus:
+    status = status or {}
+    return O.PodStatus(
+        phase=status.get("phase", "Pending"),
+        conditions=[O.PodCondition(type=c.get("type", ""),
+                                   status=c.get("status", ""),
+                                   reason=c.get("reason", ""),
+                                   message=c.get("message", ""))
+                    for c in status.get("conditions") or []])
+
+
+def _pod_in(doc: dict) -> O.Pod:
     return O.Pod(
         metadata=_meta_in(doc.get("metadata")),
-        spec=O.PodSpec(
-            node_name=spec.get("nodeName", ""),
-            node_selector=dict(spec.get("nodeSelector") or {}),
-            priority=spec.get("priority"),
-            priority_class_name=spec.get("priorityClassName", ""),
-            scheduler_name=spec.get("schedulerName", "kube-batch"),
-            containers=[_container_in(c)
-                        for c in spec.get("containers") or []],
-            init_containers=[_container_in(c)
-                             for c in spec.get("initContainers") or []],
-            tolerations=[O.Toleration(key=t.get("key", ""),
-                                      operator=t.get("operator", "Equal"),
-                                      value=t.get("value", ""),
-                                      effect=t.get("effect", ""))
-                         for t in spec.get("tolerations") or []],
-            affinity=_affinity_in(spec.get("affinity")),
-            volumes=volumes),
-        status=O.PodStatus(
-            phase=status.get("phase", "Pending"),
-            conditions=[O.PodCondition(type=c.get("type", ""),
-                                       status=c.get("status", ""),
-                                       reason=c.get("reason", ""),
-                                       message=c.get("message", ""))
-                        for c in status.get("conditions") or []]))
+        spec=_pod_spec_in(doc.get("spec")),
+        status=_pod_status_in(doc.get("status")))
 
 
 # -- node --------------------------------------------------------------------
@@ -325,21 +333,29 @@ def _node_out(node: O.Node) -> dict:
                                sorted(node.status.conditions.items())]}}
 
 
+def _node_spec_in(spec: Optional[dict]) -> O.NodeSpec:
+    spec = spec or {}
+    return O.NodeSpec(
+        taints=[O.Taint(key=t.get("key", ""), value=t.get("value", ""),
+                        effect=t.get("effect", "NoSchedule"))
+                for t in spec.get("taints") or []],
+        unschedulable=bool(spec.get("unschedulable", False)))
+
+
+def _node_status_in(status: Optional[dict]) -> O.NodeStatus:
+    status = status or {}
+    return O.NodeStatus(
+        allocatable=dict(status.get("allocatable") or {}),
+        capacity=dict(status.get("capacity") or {}),
+        conditions={c["type"]: c.get("status", "")
+                    for c in status.get("conditions") or []})
+
+
 def _node_in(doc: dict) -> O.Node:
-    spec = doc.get("spec") or {}
-    status = doc.get("status") or {}
     return O.Node(
         metadata=_meta_in(doc.get("metadata")),
-        spec=O.NodeSpec(
-            taints=[O.Taint(key=t.get("key", ""), value=t.get("value", ""),
-                            effect=t.get("effect", "NoSchedule"))
-                    for t in spec.get("taints") or []],
-            unschedulable=bool(spec.get("unschedulable", False))),
-        status=O.NodeStatus(
-            allocatable=dict(status.get("allocatable") or {}),
-            capacity=dict(status.get("capacity") or {}),
-            conditions={c["type"]: c.get("status", "")
-                        for c in status.get("conditions") or []}))
+        spec=_node_spec_in(doc.get("spec")),
+        status=_node_status_in(doc.get("status")))
 
 
 # -- CRDs + the rest ---------------------------------------------------------
@@ -512,4 +528,85 @@ def decode_any(doc: Dict[str, Any]):
         return codec.decode(doc)
     if "kind" in doc:
         return from_k8s(doc)
+    raise ValueError("document carries neither __kind__ nor kind")
+
+
+# ---------------------------------------------------------------------------
+# Columnar delta decode for the k8s wire (mirror of edge/codec.decode_delta
+# and the same contract: raw-section compare against the cached previous
+# wire doc, re-decoding only changed sections through the EXACT section
+# decoders the full path uses — so a delta result equals the full decode
+# bit for bit, and an unchanged ``spec`` section reuses the previous
+# PodSpec OBJECT, keeping models/tensor_snapshot._pod_static's identity-
+# keyed signature cache warm across watch echoes).  Scope: Pods and Nodes,
+# the churn-heavy kinds the fast path exists for; every other kind raises
+# LookupError and the client falls back to a counted full decode.
+# ---------------------------------------------------------------------------
+
+_DELTA_SECTIONS = {
+    # kind -> ((doc_key, field_name, section_decoder), ...)
+    "Pod": (("metadata", "metadata", _meta_in),
+            ("spec", "spec", _pod_spec_in),
+            ("status", "status", _pod_status_in)),
+    "Node": (("metadata", "metadata", _meta_in),
+             ("spec", "spec", _node_spec_in),
+             ("status", "status", _node_status_in)),
+}
+
+_DELTA_CLASSES = {"Pod": O.Pod, "Node": O.Node}
+
+
+def from_k8s_delta(doc: Dict[str, Any], prev):
+    """Decode a k8s-convention doc against the previously decoded
+    ``prev`` (whose raw doc edge/client stamped as ``_wire_doc``).
+    Raises ValueError exactly where ``from_k8s`` would; LookupError when
+    no delta is possible (unknown kind, missing/mismatched baseline) —
+    the caller falls back to the full decode."""
+    from .codec import _carry_tensor_static, remember_wire_doc
+
+    kind = doc.get("kind")
+    # Mirror from_k8s's group parse for effect: a frame whose apiVersion
+    # is not a string must raise the SAME TypeError here that the full
+    # decode raises, or the fast arm silently applies a frame the
+    # control arm relists on (divergence; pinned by the fuzz suite).
+    api_version = doc.get("apiVersion", "")
+    "/" in api_version  # noqa: B015 — type check by evaluation
+    try:
+        sections = _DELTA_SECTIONS.get(kind)
+        cls = _DELTA_CLASSES.get(kind)
+    except TypeError:
+        # Unhashable kind (malformed frame): the FULL decode owns the
+        # error shape (its == dispatch raises ValueError) — refuse the
+        # delta so the fallback reproduces it exactly.
+        raise LookupError("baseline") from None
+    if sections is None:
+        # Resource kind outside the delta plans (PodGroups, Queues, …):
+        # counted under its own fallback reason so operators can tell
+        # "unsupported kind" from "missing baseline".
+        raise LookupError("kind")
+    prev_data = getattr(prev, "_wire_doc", None)
+    if type(prev) is not cls or not isinstance(prev_data, dict):
+        raise LookupError("baseline")
+    kwargs = {}
+    for doc_key, field, section_in in sections:
+        v = doc.get(doc_key)
+        if doc_key in prev_data and v == prev_data[doc_key]:
+            kwargs[field] = getattr(prev, field)
+        else:
+            kwargs[field] = section_in(v)
+    obj = cls(**kwargs)
+    remember_wire_doc(obj, doc)
+    _carry_tensor_static(prev, obj)
+    return obj
+
+
+def decode_any_delta(doc: Dict[str, Any], prev):
+    """Delta-decode either wire format against ``prev``; LookupError
+    means "fall back to the full decode", ValueError means the doc is
+    malformed for the full path too."""
+    from . import codec
+    if "__kind__" in doc:
+        return codec.decode_delta(doc, prev)
+    if "kind" in doc:
+        return from_k8s_delta(doc, prev)
     raise ValueError("document carries neither __kind__ nor kind")

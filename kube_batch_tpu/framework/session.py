@@ -752,6 +752,10 @@ def open_session(cache, tiers: List[Tier],
         snapshot: ClusterInfo = cache.snapshot()
         metrics.set_cycle_floor("snapshot",
                                 time.perf_counter() - snap_start)
+    # Wire-decode floor: the wall time reflector threads spent decoding
+    # watch frames since the last session — attributed to the cycle that
+    # absorbs the churn (0 for in-process caches; the wire A/B reads it).
+    metrics.set_cycle_floor("decode", metrics.take_decode_seconds())
     # Pod-lineage session ledger: this open is the "first consider" for
     # every pod ingested since the previous one (trace/lineage.py).
     pod_lineage.note_session_open()
@@ -783,7 +787,18 @@ def open_session(cache, tiers: List[Tier],
 
     # Gate invalid jobs (gang minAvailable) out of the session, recording the
     # unschedulable condition (session.go:89-108).
+    #
+    # Wire fast path: jobs provably passing (valid >= minAvailable from
+    # the persistent per-job columns, the only check the stock gang
+    # validator performs) skip the validator chain — a passing job is
+    # unobservable through this gate, so the skip is bit-parity
+    # (models/incremental.job_valid_pass_uids; None = control arm or a
+    # non-stock validator registered, full walk below).
+    from ..models.incremental import job_valid_pass_uids
+    fast_pass = job_valid_pass_uids(ssn)
     for job in list(ssn.jobs.values()):
+        if fast_pass is not None and job.uid in fast_pass:
+            continue
         vr = ssn.job_valid(job)
         if vr is not None and not vr.pass_:
             if job.pod_group is not None:
@@ -862,12 +877,18 @@ def _close_is_silent(job: JobInfo) -> bool:
 
 
 def close_session(ssn: Session) -> None:
+    # plugin_close floor: the gang not-ready walk dominates this loop at
+    # scale; the vectorized form (plugins/gang.py) must actually kill it
+    # — the bench gate watches this number (doc/INCREMENTAL.md).
+    plugin_close_start = time.perf_counter()
     for plugin in ssn.plugins.values():
         start = time.time()
         with trace.span("plugin." + plugin.name(), on="close"):
             plugin.on_session_close(ssn)
         metrics.observe_plugin_latency(plugin.name(), "OnSessionClose",
                                        time.time() - start)
+    metrics.set_cycle_floor("plugin_close",
+                            time.perf_counter() - plugin_close_start)
 
     # PodGroup status writeback (session.go:119-144).  The status write is
     # gated on an actual change: a no-op UpdatePodGroup would differ from

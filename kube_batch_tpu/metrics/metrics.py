@@ -429,6 +429,22 @@ watch_reconnects = registry.register(Counter(
     f"{SUBSYSTEM}_watch_reconnects_total",
     "Reflector watch-stream reconnects, by resource and cause "
     "(disconnect | malformed)", ("resource", "cause")))
+# Wire-to-tensor fast path (edge/codec decode_delta, doc/INCREMENTAL.md
+# "Wire fast path"): how each reflector frame decoded (delta = changed
+# fields only against the cached baseline; full = first sight / control
+# arm / no baseline), and why a delta attempt degraded to a full decode.
+# Degradation is counted, never fatal — a malformed or surprising frame
+# must not kill the reflector thread (tests/test_wire_fast.py fuzzes).
+wire_fast_decode = registry.register(Counter(
+    "kube_batch_wire_fast_decode_total",
+    "Reflector frames by decode mode (delta = columnar fast path; "
+    "full = complete materialization)", ("mode",)))
+wire_fast_fallback = registry.register(Counter(
+    "kube_batch_wire_fast_fallback_total",
+    "Delta-decode attempts that degraded to a full decode, by reason "
+    "(error = delta raised unexpectedly; baseline = no/mismatched "
+    "cached doc; kind = resource kind outside the delta plans)",
+    ("reason",)))
 solve_deadline_exceeded = registry.register(Counter(
     f"{SUBSYSTEM}_solve_deadline_exceeded_total",
     "Session solves that overran the per-session deadline (counted as "
@@ -461,8 +477,8 @@ incremental_generation_reuse = registry.register(Counter(
 cycle_floor_ms = registry.register(Gauge(
     f"{SUBSYSTEM}_tpu_cycle_floor_ms",
     "Last cycle's cost of each residual floor stage "
-    "(solve_wait | snapshot | close | occupancy), milliseconds",
-    ("floor",)))
+    "(solve_wait | snapshot | close | occupancy | decode | stage | "
+    "plugin_close), milliseconds", ("floor",)))
 candidate_solve = registry.register(Counter(
     f"{SUBSYSTEM}_candidate_solve_total",
     "Allocate solves by node-axis scope (fired = candidate-row "
@@ -483,6 +499,11 @@ occupancy_rows_rebuilt = registry.register(Gauge(
     f"{SUBSYSTEM}_occupancy_rows_rebuilt",
     "Node occupancy (host-port/selector) rows rebuilt by the last "
     "tensorize; -1 = feature inactive this session"))
+stage_rows_staged = registry.register(Gauge(
+    f"{SUBSYSTEM}_stage_rows_staged",
+    "Candidate-task rows the last tensorize rewrote into the persistent "
+    "staging buffers (wire fast path); -1 = full concatenation path "
+    "(control arm / non-persistent cache)"))
 # Scheduling-SLO layer (trace/lineage.py, doc/OBSERVABILITY.md): the
 # quantity the scheduler actually promises users — how long a pod waits
 # from cluster arrival (edge-decode ingest stamp) to bind — plus where
@@ -784,6 +805,50 @@ def note_watch_reconnect(resource: str, cause: str) -> None:
     watch_reconnects.inc(1.0, resource, cause)
 
 
+def note_wire_decode(mode: str) -> None:
+    """Count one reflector frame's decode mode (delta | full)."""
+    wire_fast_decode.inc(1.0, mode)
+
+
+def note_wire_fast_fallback(reason: str) -> None:
+    """Count one delta-decode attempt degrading to a full decode."""
+    wire_fast_fallback.inc(1.0, reason)
+
+
+def wire_fast_counts() -> Dict[str, int]:
+    """{mode/reason: count} — the `make bench-wire` vacuous-gate guard
+    (a wire A/B whose fast arm never delta-decoded compared nothing)."""
+    out = {f"decode_{labels[0]}": int(v)
+           for labels, v in wire_fast_decode.values().items() if labels}
+    for labels, v in wire_fast_fallback.values().items():
+        if labels:
+            out[f"fallback_{labels[0]}"] = int(v)
+    return out
+
+
+# Wall time the reflector threads spent decoding watch frames since the
+# scheduling thread last collected it (the per-cycle ``decode`` floor:
+# open_session takes-and-resets, so the floor attributes asynchronous
+# edge decode to the cycle that absorbed its churn).
+_decode_time_lock = threading.Lock()
+_decode_seconds_acc = 0.0  # guarded-by: _decode_time_lock
+
+
+def note_decode_seconds(seconds: float) -> None:
+    global _decode_seconds_acc
+    with _decode_time_lock:
+        _decode_seconds_acc += seconds
+
+
+def take_decode_seconds() -> float:
+    """Drain the accumulated decode wall time (scheduling thread only)."""
+    global _decode_seconds_acc
+    with _decode_time_lock:
+        out = _decode_seconds_acc
+        _decode_seconds_acc = 0.0
+    return out
+
+
 def note_solve_deadline() -> None:
     solve_deadline_exceeded.inc()
 
@@ -854,6 +919,12 @@ def set_occupancy_rows_rebuilt(count: int) -> None:
     occupancy_rows_rebuilt.set(float(count))
 
 
+def set_stage_rows(count: int) -> None:
+    """Candidate-task rows the last tensorize restaged (-1 = the full
+    concatenation path ran — control arm or non-persistent cache)."""
+    stage_rows_staged.set(float(count))
+
+
 def observe_time_to_bind(queue: str, seconds: float) -> None:
     """One pod's ingest->bind SLO sample (trace/lineage.py emits exactly
     one per pod lifetime; queue label cardinality-capped)."""
@@ -915,6 +986,7 @@ def onwork_values() -> Dict[str, float]:
     out["close_walked"] = close_objects_walked.value()
     out["occupancy_rebuilt"] = occupancy_rows_rebuilt.value()
     out["candidate_rows"] = candidate_rows.value()
+    out["stage_rows"] = stage_rows_staged.value()
     return out
 
 

@@ -56,6 +56,12 @@ from ..trace import spans as trace
 # =0 restores the sequential control: full tensorize scans, uncached
 # plugin opens, a fresh solve every cycle, fixed-period scheduling.
 INCREMENTAL_ENV = "KUBE_BATCH_TPU_INCREMENTAL"
+# Wire-to-tensor fast path (doc/INCREMENTAL.md "Wire fast path"): =0 is
+# the sequential control for the L1 columnar watch-delta decode
+# (edge/codec), the persistent candidate-row staging buffers
+# (tensor_snapshot), and the vectorized drf/job-valid/gang-close walks
+# below — `make bench-wire` pins binds+events bit-identical across it.
+WIRE_FAST_ENV = "KUBE_BATCH_TPU_WIRE_FAST"
 # Periodic full-session floor (scheduler.py): every K cycles the loop
 # requests a full rebuild so incremental drift cannot accumulate
 # silently.  0 disables the floor.
@@ -74,6 +80,10 @@ _EXACT_LIMIT = float(2 ** 50)
 
 def incremental_enabled() -> bool:
     return os.environ.get(INCREMENTAL_ENV, "1") != "0"
+
+
+def wire_fast_enabled() -> bool:
+    return os.environ.get(WIRE_FAST_ENV, "1") != "0"
 
 
 def full_session_every() -> int:
@@ -161,6 +171,13 @@ class IncrementalState:
         self.last_kind: str = ""
         self.last_reason: str = ""
         self.stats = {"micro": 0, "full": 0, "fallback": 0}
+        # Persistent per-job aggregate columns (the wire-to-tensor fast
+        # path's plugin-layer leg, doc/INCREMENTAL.md "Wire fast path"):
+        # min_available / ready / valid task counts and the DRF open
+        # allocation vectors, patched for dirty jobs only and consumed
+        # as numpy column ops by plugins/drf.py's share computation, the
+        # open_session job_valid gate, and plugins/gang.py's close walk.
+        self.job_agg: Optional["JobAggregates"] = None
 
     def invalidate_solve(self) -> None:
         self.solve_gen = -1
@@ -521,6 +538,261 @@ def store_sig_mask(plan: Optional[SessionPlan], sig_tuples, sig_mask,
     live = set(st.sig_tuples)
     for sig in [s for s in st.sig_examples if s not in live]:
         del st.sig_examples[sig]
+
+
+# ---------------------------------------------------------------------------
+# Per-job aggregate columns (the plugin-layer leg of the wire-to-tensor
+# fast path).  The drf open used to recompute every job's dominant share
+# (`_calculate_share` — a Python loop over resource names per job), the
+# open_session job_valid gate re-validated every job, and the gang close
+# re-derived every job's readiness — all O(jobs) Python per cycle.  The
+# persistent columns below are patched for DIRTY jobs only (the same
+# snap_epoch discipline as the tensor blocks; session-mutated rows are
+# stamped always-dirty so the next open re-reads the fresh clone) and the
+# three walks become numpy column ops plus an O(affected) Python tail.
+# Everything degrades to the sequential control under
+# KUBE_BATCH_TPU_WIRE_FAST=0 / KUBE_BATCH_TPU_INCREMENTAL=0.
+# ---------------------------------------------------------------------------
+
+
+class JobAggregates:
+    """Persistent per-job columns, scheduling-thread only (the same
+    thread model as the rest of this module)."""
+
+    __slots__ = ("index", "uids", "clones", "epochs", "min_avail",
+                 "ready", "valid", "alloc", "axis", "shares", "n",
+                 "open_session_uid", "close_session_uid")
+
+    def __init__(self):
+        import numpy as np
+        self.index: Dict[str, int] = {}
+        self.uids: List[str] = []
+        # Row validity is (epoch, CLONE IDENTITY): a session-only
+        # mutation discards the pooled clone without moving truth's
+        # mod_epoch, so the next session's fresh clone arrives at the
+        # SAME snap_epoch — the identity check is what forces the
+        # refill (and re-seeds the per-clone _drf_open_alloc cache the
+        # lazy _DrfAttr materialization depends on).  Strong refs; rows
+        # are bounded by the compaction rule in job_aggregates_open.
+        self.clones: List[object] = []
+        self.n = 0
+        cap = 64
+        self.epochs = np.full((cap,), -1, np.int64)
+        self.min_avail = np.zeros((cap,), np.int64)
+        self.ready = np.zeros((cap,), np.int64)
+        self.valid = np.zeros((cap,), np.int64)
+        # DRF open-allocation vectors over ``axis``; float32 so the
+        # vectorized share division is the exact np.float32 operand
+        # rounding api.resource.share applies (bit parity).
+        self.alloc = np.zeros((cap, 2), np.float32)
+        self.axis: tuple = ("cpu", "memory")
+        self.shares = None
+        self.open_session_uid = ""
+        self.close_session_uid = ""
+
+    def _grow(self, need: int) -> None:
+        import numpy as np
+        cap = len(self.epochs)
+        if need <= cap:
+            return
+        new_cap = max(need, cap * 2)
+        pad = new_cap - cap
+        self.epochs = np.concatenate(
+            [self.epochs, np.full((pad,), -1, np.int64)])
+        for name in ("min_avail", "ready", "valid"):
+            arr = getattr(self, name)
+            setattr(self, name,
+                    np.concatenate([arr, np.zeros((pad,), np.int64)]))
+        self.alloc = np.concatenate(
+            [self.alloc,
+             np.zeros((pad, self.alloc.shape[1]), np.float32)])
+
+
+def _drf_alloc_of(job):
+    """The job clone's DRF open allocation — the exact walk
+    DrfPlugin.on_session_open performs, cached on the clone under the
+    same clone-identity validity token (``_drf_open_alloc``), so the
+    control arm and the fast path serve byte-identical Resources."""
+    from ..api import Resource, allocated_status
+    cached = getattr(job, "_drf_open_alloc", None)
+    if cached is not None:
+        return cached
+    acc = Resource.empty()
+    for status, tasks in job.task_status_index.items():
+        if allocated_status(status):
+            for t in tasks.values():
+                acc.add(t.resreq)
+    try:
+        job._drf_open_alloc = acc
+    except AttributeError:  # lint: allow-swallow(slotted/foreign clone: the walk simply re-runs next session, which is the control behavior)
+        pass
+    return acc
+
+
+def job_fast_enabled(ssn) -> bool:
+    return (wire_fast_enabled() and incremental_enabled()
+            and state_for(ssn.cache) is not None)
+
+
+def _fill_job_row(agg: JobAggregates, i: int, job) -> None:
+    agg.min_avail[i] = job.min_available
+    agg.ready[i] = job.ready_task_num()
+    agg.valid[i] = job.valid_task_num()
+    res = _drf_alloc_of(job)
+    row = agg.alloc[i]
+    row[:] = 0.0
+    for d, name in enumerate(agg.axis):
+        row[d] = res.get(name)
+
+
+def job_aggregates_open(ssn) -> Optional[JobAggregates]:
+    """Build or dirty-patch the persistent per-job columns for this
+    session's OPEN state (runs once per session; later callers get the
+    cached result).  Returns None on the control arm."""
+    if not job_fast_enabled(ssn):
+        return None
+    st = state_for(ssn.cache)
+    agg = st.job_agg
+    if agg is not None and len(agg.index) > 2 * max(len(ssn.jobs), 1) + 64:
+        agg = None  # compaction: churn left mostly-dead rows behind
+    if agg is None:
+        agg = st.job_agg = JobAggregates()
+    if agg.open_session_uid == ssn.uid:
+        return agg
+    agg.open_session_uid = ssn.uid
+    agg.close_session_uid = ""
+    agg._grow(len(agg.index) + len(ssn.jobs))
+    mutated = getattr(ssn, "mutated_jobs", set())
+    for uid, job in ssn.jobs.items():
+        i = agg.index.get(uid)
+        ep = (getattr(job, "snap_epoch", None)
+              if uid not in mutated else None)
+        if i is None:
+            i = len(agg.uids)
+            agg._grow(i + 1)
+            agg.index[uid] = i
+            agg.uids.append(uid)
+            agg.clones.append(None)
+            agg.n = i + 1
+        elif ep is not None and agg.epochs[i] == ep \
+                and agg.clones[i] is job:
+            continue  # clean row: bit-unchanged clone since last fill
+        _fill_job_row(agg, i, job)
+        agg.epochs[i] = ep if ep is not None else -1
+        agg.clones[i] = job
+    return agg
+
+
+def job_aggregates_close(ssn) -> Optional[JobAggregates]:
+    """The CLOSE-state view: open columns plus a re-read of every
+    session-mutated job's clone.  Mutated rows are stamped always-dirty
+    (-1): a session-only mutation (e.g. pipeline) does not move truth's
+    mod_epoch, so the next open must not mistake the close-state row for
+    the fresh clone's state."""
+    agg = job_aggregates_open(ssn)
+    if agg is None:
+        return None
+    if agg.close_session_uid == ssn.uid:
+        return agg
+    agg.close_session_uid = ssn.uid
+    for uid in getattr(ssn, "mutated_jobs", ()):
+        i = agg.index.get(uid)
+        job = ssn.jobs.get(uid)
+        if i is None or job is None:
+            continue
+        agg.min_avail[i] = job.min_available
+        agg.ready[i] = job.ready_task_num()
+        agg.valid[i] = job.valid_task_num()
+        agg.epochs[i] = -1
+        agg.clones[i] = job
+    return agg
+
+
+def drf_open_shares(ssn, total_resource) -> Optional[JobAggregates]:
+    """Vectorized DRF dominant shares at session open: one float32
+    column division + row max over the persistent allocation matrix,
+    bit-identical to the per-job ``_calculate_share`` loop because
+    ``api.resource.share`` is DEFINED as the correctly-rounded float32
+    division of float32-rounded operands — exactly the elementwise op
+    below — and max over exact f32→f64 widenings equals the widened f32
+    max.  Returns the aggregates with ``shares``/``index`` populated, or
+    None on the control arm."""
+    import numpy as np
+
+    agg = job_aggregates_open(ssn)
+    if agg is None:
+        return None
+    axis = ("cpu", "memory",
+            *sorted(total_resource.scalar_resources
+                    or ()))
+    if axis != agg.axis or agg.alloc.shape[1] != len(axis):
+        # Resource axis moved (a scalar appeared in/left the cluster
+        # total): refill every live row's vector from the cached per-
+        # clone Resources — O(jobs) Python, once per axis change.
+        agg.axis = axis
+        agg.alloc = np.zeros((len(agg.epochs), len(axis)), np.float32)
+        for uid, i in agg.index.items():
+            job = ssn.jobs.get(uid)
+            if job is not None:
+                res = _drf_alloc_of(job)
+                for d, name in enumerate(axis):
+                    agg.alloc[i, d] = res.get(name)
+    n = agg.n
+    total_vec = np.asarray([total_resource.get(name) for name in axis],
+                           np.float32)
+    a32 = agg.alloc[:n]
+    with np.errstate(divide="ignore", invalid="ignore"):
+        q = a32 / total_vec
+    zero_t = total_vec == 0
+    if zero_t.any():
+        # share(l, 0) is 0 for l == 0 and 1 otherwise (helpers.go:47-59).
+        q[:, zero_t] = np.where(a32[:, zero_t] != 0,
+                                np.float32(1.0), np.float32(0.0))
+    if n:
+        agg.shares = np.maximum(
+            q.max(axis=1), np.float32(0.0)).astype(np.float64)
+    else:
+        agg.shares = np.zeros((0,), np.float64)
+    return agg
+
+
+def job_valid_pass_uids(ssn) -> Optional[set]:
+    """Job uids provably PASSING the open_session job_valid gate, or
+    None when the fast path cannot decide (control arm, a non-gang
+    validator registered).  Passing jobs are unobservable through the
+    gate (no condition, no deletion), so skipping them is bit-parity;
+    every other job still runs the real validator chain."""
+    if not ssn.job_valid_fns or set(ssn.job_valid_fns) - {"gang"}:
+        return None
+    agg = job_aggregates_open(ssn)
+    if agg is None:
+        return None
+    import numpy as np
+    n = agg.n
+    ok = np.nonzero(agg.valid[:n] >= agg.min_avail[:n])[0]
+    uids = agg.uids
+    return {uids[int(i)] for i in ok}
+
+
+def gang_close_unready(ssn) -> Optional[list]:
+    """The session's not-ready jobs for the gang close pass (ready <
+    minAvailable from the close-state columns), or None on the control
+    arm.  Ready jobs are skipped without a Python visit; the returned
+    jobs run the exact per-job close body.  Cross-job order carries no
+    observable interaction (per-job conditions, name-labeled gauges,
+    monotonic counters), so aggregate row order is parity-safe."""
+    agg = job_aggregates_close(ssn)
+    if agg is None:
+        return None
+    import numpy as np
+    n = agg.n
+    rows = np.nonzero(agg.ready[:n] < agg.min_avail[:n])[0]
+    out = []
+    for i in rows:
+        job = ssn.jobs.get(agg.uids[int(i)])
+        if job is not None:
+            out.append(job)
+    return out
 
 
 def finish_tensorize(plan: Optional[SessionPlan], ssn, axis,

@@ -257,11 +257,21 @@ def _unpack_sharded(spec_rep, spec_shard, float_dtype, mesh, rep2d,
     return rep_leaves, list(fn(shard3d))
 
 
-def _pack_host(inp, float_dtype, pad_to: int = 1):
+def _pack_host(inp, float_dtype, pad_to: int = 1, out=None):
     """Flatten every leaf into one host byte buffer with final device
     dtypes applied; returns (spec, flat_u8, treedef).  ``pad_to`` zero-pads
     the tail so the buffer length is a stable multiple (the shipper's
-    block layout must not retrace per session)."""
+    block layout must not retrace per session).
+
+    ``out`` (wire fast path): a retired host buffer to pack into when
+    its length matches, so the steady cycle stops allocating a fresh
+    multi-MB flat buffer per ship.  Only buffers that never reached
+    ``jnp.asarray`` may be recycled — the CPU PJRT client zero-copies
+    aligned numpy arrays, so writing into a device-visible buffer would
+    corrupt the resident image.  Enforced by the shipper's
+    ``host_recyclable`` bookkeeping: full-ship baselines are stamped
+    non-recyclable and only delta/clean-path buffers re-enter
+    ``_scratch`` (see _ShipState and _ship_delta)."""
     fwidth = np.dtype(float_dtype).itemsize
     leaves, treedef = jax.tree.flatten(inp)
     spec = []
@@ -286,8 +296,17 @@ def _pack_host(inp, float_dtype, pad_to: int = 1):
     if not bufs:
         bufs.append(np.zeros(1, np.uint8))
         byte_off = 1
+    total = byte_off
     if pad_to > 1 and byte_off % pad_to:
-        bufs.append(np.zeros(pad_to - byte_off % pad_to, np.uint8))
+        pad = pad_to - byte_off % pad_to
+        bufs.append(np.zeros(pad, np.uint8))
+        total += pad
+    if out is not None and out.nbytes == total:
+        off = 0
+        for b in bufs:
+            out[off:off + b.size] = b
+            off += b.size
+        return tuple(spec), out, treedef
     return tuple(spec), np.concatenate(bufs), treedef
 
 
@@ -310,9 +329,13 @@ def ship_inputs(inp: SolverInputs, float_dtype=None) -> SolverInputs:
 
 
 class _ShipState:
-    """The device-resident image of the last shipped layout."""
+    """The device-resident image of the last shipped layout.
+    ``host_recyclable``: whether host_flat never reached jnp.asarray —
+    only such buffers may be recycled as pack scratch (the CPU PJRT
+    client zero-copies aligned numpy arrays into device buffers, so a
+    device-visible baseline must never be written again)."""
     __slots__ = ("layout", "spec", "treedef", "float_dtype",
-                 "host_flat", "device_flat", "inputs")
+                 "host_flat", "device_flat", "inputs", "host_recyclable")
 
 
 class _ShardShipState:
@@ -342,6 +365,11 @@ class DeviceResidentShipper:
 
     def __init__(self):
         self._state: _ShipState | None = None
+        # Retired host-only pack buffer (wire fast path): the steady
+        # delta cycle packs into it instead of allocating a fresh
+        # multi-MB flat per ship; _pack_host's docstring carries the
+        # never-device-visible recycling contract.
+        self._scratch = None
         self.last_mode: str = ""  # "full" | "delta" | "clean" (tests/obs)
         # Byte-generation of the resident image: moves whenever the
         # shipped bytes change (full or delta ship, or an invalidation)
@@ -390,13 +418,24 @@ class DeviceResidentShipper:
         if route == "sharded":
             return self._ship_sharded(inp, cfg, float_dtype, mesh)
 
-        spec, flat, treedef = _pack_host(inp, float_dtype, pad_to=_BLOCK)
+        from ..models.incremental import wire_fast_enabled
+        recycle = wire_fast_enabled()
+        scratch = None
+        if recycle:
+            scratch, self._scratch = self._scratch, None
+        spec, flat, treedef = _pack_host(inp, float_dtype, pad_to=_BLOCK,
+                                         out=scratch)
         layout = (spec, np.dtype(float_dtype).str, cfg)
         st = self._state
         if isinstance(st, _ShipState) and st.layout == layout:
             idx = self._dirty_blocks(st.host_flat, flat)
             if idx.size == 0:
                 self.last_mode = "clean"
+                if recycle:
+                    # flat never reached the device: recycle it (its
+                    # bytes equal the resident baseline anyway).  The
+                    # control arm keeps the old allocation profile.
+                    self._scratch = flat
                 metrics.note_ship("clean", 0)
                 trace.note_ship("clean", 0)
                 return st.inputs
@@ -424,6 +463,10 @@ class DeviceResidentShipper:
         # the delta ≡ full-ship bit-parity guarantee.  graftlint flags any
         # in-place write (doc/LINT.md rule 4); rebinding stays legal.
         st.host_flat = flat         # frozen-after: ship
+        # jnp.asarray below may ZERO-COPY flat on the CPU PJRT client:
+        # this buffer is device-visible and must never re-enter the
+        # pack-scratch pool.
+        st.host_recyclable = False
         st.device_flat = jnp.asarray(flat.reshape(-1, _BLOCK))
         # The reconstructed SolverInputs leaves are shared with every
         # consumer of this session's solve — same no-mutate contract.
@@ -458,7 +501,16 @@ class DeviceResidentShipper:
             warnings.simplefilter("ignore")
             st.device_flat = _scatter_blocks(
                 st.device_flat, jnp.asarray(idx_p), jnp.asarray(upd))
+        # Retire the replaced baseline into the pack-scratch pool when it
+        # was host-only (a baseline installed by a FULL ship may be
+        # zero-copy-aliased by the device and stays quarantined).  The
+        # control arm (WIRE_FAST=0) keeps the old allocation profile.
+        from ..models.incremental import wire_fast_enabled
+        old_flat = st.host_flat
+        if getattr(st, "host_recyclable", False) and wire_fast_enabled():
+            self._scratch = old_flat
         st.host_flat = flat
+        st.host_recyclable = True  # flat was only compared and sliced
         st.inputs = jax.tree.unflatten(
             st.treedef,
             _unpack_blocks(st.spec, st.float_dtype, st.device_flat))

@@ -341,7 +341,41 @@ class TensorCache:
         # the row views); rebinding whole matrices is the full rebuild.
         self.occ_ports = None   # frozen-after: occupancy
         self.occ_selcnt = None  # frozen-after: occupancy
+        # Persistent candidate-row staging (the wire-to-tensor fast
+        # path, doc/INCREMENTAL.md "Wire fast path"): the concatenated
+        # per-job task tensors — resource columns, quantized columns,
+        # GLOBAL signature ids — and the index->TaskInfo list, patched
+        # in place for dirty job spans instead of re-concatenated
+        # O(tasks) per session.  Valid only under stage_key (axis,
+        # padded bucket, width) and the recorded job layout; rows beyond
+        # stage_p_real are zero by construction (the leaf padding
+        # contract).  frozen-after: stage — in-place writes only through
+        # the one sanctioned patch path (_stage_candidate_rows binds the
+        # buffers to locals); rebinding whole buffers is the full
+        # restage.  The handed-out views feed SolverInputs staging and
+        # the apply aggregates within the SAME session only.
+        self.stage_key: Optional[tuple] = None
+        self.stage_jobs: Optional[list] = None  # [(uid, _JobBlock, clone)]
+        self.stage_p_real: int = 0
+        self.stage_tasks: Optional[list] = None
+        self.stage_res_f = None   # frozen-after: stage
+        self.stage_req_q = None   # frozen-after: stage
+        self.stage_res_q = None   # frozen-after: stage
+        self.stage_sig = None     # frozen-after: stage
         self.persistent = False
+
+    def drop_stage(self) -> None:
+        """Invalidate the persistent candidate staging (axis flush, the
+        global-id table flush — staged rows hold GLOBAL gids, so a table
+        reset would leave them pointing at the wrong tuples)."""
+        self.stage_key = None
+        self.stage_jobs = None
+        self.stage_p_real = 0
+        self.stage_tasks = None
+        self.stage_res_f = None
+        self.stage_req_q = None
+        self.stage_res_q = None
+        self.stage_sig = None
 
     def sig_id(self, sig: tuple) -> int:
         gid = self.sig_gid.get(sig)
@@ -604,6 +638,141 @@ def _fill_block_features(tc: TensorCache, b: _JobBlock, pending,
         from ..ops.resources import quantize_columns
         b.init_q = quantize_columns(b.init_f)
     # else: the bulk builder quantizes all jobs' init rows in one call.
+
+
+def _stage_candidate_rows(tc: TensorCache, ssn, job_uids, blocks,
+                          job_start, p_real: int, p_pad: int, r: int):
+    """The wire-to-tensor staging fast path: resolve the session's
+    concatenated candidate-task tensors from the PERSISTENT staging
+    buffers, rewriting only the row spans whose job block changed since
+    the last session — the micro-tensorize floor the full
+    ``np.concatenate`` over every job block used to pay O(tasks) for
+    (doc/INCREMENTAL.md "Wire fast path").
+
+    Returns (tasks, res_f, req_q64, res_q64, sig_g, staged_rows): views
+    of the persistent buffers ([p_pad(,R)] with zero rows beyond
+    ``p_real``) plus the index->TaskInfo list, and how many candidate
+    rows were actually rewritten.  Bit parity with the concatenation
+    path is by construction: each span is written from the SAME block
+    arrays the concatenation would copy, in the same job order, and
+    clean spans cannot have drifted (a job's block object is replaced
+    whenever its content is rebuilt — block identity is the validity
+    token, exactly like the clone-identity plugin caches).
+
+    In-place writes happen only here, through local bindings of the
+    buffers (the sanctioned patch path of the frozen-after: stage
+    contract declared in TensorCache.__init__)."""
+    key = (tc.axis, p_pad, r)
+    # Layout entries carry the JOB CLONE alongside the block: the block
+    # keys the tensor spans (content), the clone keys the TaskInfo list
+    # (identity).  A session-only mutation (pipeline, a condition write)
+    # discards the pooled clone WITHOUT moving truth's mod_epoch, so the
+    # next session reuses the block (epoch match) while ssn.jobs holds a
+    # FRESH clone — the tasks span must follow the clone, or the apply
+    # path mutates task objects disconnected from the session's job
+    # (tests/test_wire_fast.py pins this).
+    layout = [(uid, b, ssn.jobs[uid]) for uid, b in zip(job_uids, blocks)]
+    res_f = tc.stage_res_f
+    if tc.stage_key != key or res_f is None or tc.stage_jobs is None:
+        # Full (re)stage into fresh buffers: first session, padded
+        # bucket move, or resource-axis change.
+        res_f = np.zeros((p_pad, r), _F)
+        req_q = np.zeros((p_pad, r), np.int64)
+        res_q = np.zeros((p_pad, r), np.int64)
+        sig_g = np.zeros((p_pad,), np.int32)
+        tasks: List = []
+        s = 0
+        for _uid, b, job in layout:
+            c = b.count
+            if not c:
+                continue
+            e = s + c
+            res_f[s:e] = b.res_f
+            req_q[s:e] = b.req_q
+            res_q[s:e] = b.res_q
+            sig_g[s:e] = b.sig_g
+            jt = job.tasks
+            tasks.extend(jt[tuid] for tuid in b.uids)
+            s = e
+        tc.stage_key = key
+        tc.stage_jobs = layout
+        tc.stage_p_real = p_real
+        tc.stage_tasks = tasks
+        tc.stage_res_f = res_f    # frozen-after: stage
+        tc.stage_req_q = req_q    # frozen-after: stage
+        tc.stage_res_q = res_q    # frozen-after: stage
+        tc.stage_sig = sig_g      # frozen-after: stage
+        return tasks, res_f, req_q, res_q, sig_g, p_real
+    req_q = tc.stage_req_q
+    res_q = tc.stage_res_q
+    sig_g = tc.stage_sig
+    tasks = tc.stage_tasks
+    old = tc.stage_jobs
+    old_p_real = tc.stage_p_real
+    staged = 0
+    same_shape = len(layout) == len(old)
+    if same_shape:
+        for (uid, b, _job), (ouid, ob, _ojob) in zip(layout, old):
+            if uid != ouid or b.count != ob.count:
+                same_shape = False
+                break
+    if same_shape:
+        # Unchanged job layout (uids + counts): offsets are stable, so
+        # only spans whose block OR clone was replaced rewrite in place
+        # (a clone-only replacement rewrites just the task list — the
+        # reused block proves the tensor content is bit-unchanged).
+        s = 0
+        for ji, (uid, b, job) in enumerate(layout):
+            c = b.count
+            e = s + c
+            _ouid, ob, ojob = old[ji]
+            if c and (b is not ob or job is not ojob):
+                if b is not ob:
+                    res_f[s:e] = b.res_f
+                    req_q[s:e] = b.req_q
+                    res_q[s:e] = b.res_q
+                    sig_g[s:e] = b.sig_g
+                jt = job.tasks
+                tasks[s:e] = [jt[tuid] for tuid in b.uids]
+                staged += c
+            s = e
+    else:
+        # Jobs arrived/retired/resized: rows shift from the first
+        # diverging job on — rewrite the suffix (C-level span copies),
+        # keep the common prefix untouched.
+        d = 0
+        lim = min(len(layout), len(old))
+        while d < lim:
+            uid, b, job = layout[d]
+            ouid, ob, ojob = old[d]
+            if uid != ouid or b is not ob or job is not ojob:
+                break
+            d += 1
+        s = int(job_start[d]) if d < len(layout) else p_real
+        suffix_start = s
+        del tasks[s:]
+        for _uid, b, job in layout[d:]:
+            c = b.count
+            if not c:
+                continue
+            e = s + c
+            res_f[s:e] = b.res_f
+            req_q[s:e] = b.req_q
+            res_q[s:e] = b.res_q
+            sig_g[s:e] = b.sig_g
+            jt = job.tasks
+            tasks.extend(jt[tuid] for tuid in b.uids)
+            s = e
+        staged = p_real - suffix_start
+        if old_p_real > p_real:
+            # The leaf padding contract: rows past p_real must be zero.
+            res_f[p_real:old_p_real] = 0.0
+            req_q[p_real:old_p_real] = 0
+            res_q[p_real:old_p_real] = 0
+            sig_g[p_real:old_p_real] = 0
+    tc.stage_jobs = layout
+    tc.stage_p_real = p_real
+    return tasks, res_f, req_q, res_q, sig_g, staged
 
 
 def _node_row_vectors(node, axis):
@@ -916,6 +1085,7 @@ def tensorize_session(ssn) -> TensorSnapshot:
         tc.axis = tuple(axis)
         tc.jobs.clear()
         tc.pack = None
+        tc.drop_stage()
     if (len(tc.sig_list) + len(tc.port_list) + len(tc.sel_list)
             > _MAX_GLOBAL_IDS):
         # The append-only id tables are bounded by a full flush (blocks
@@ -929,6 +1099,7 @@ def tensorize_session(ssn) -> TensorSnapshot:
         tc.sel_gid.clear()
         tc.sel_list.clear()
         tc.jobs.clear()
+        tc.drop_stage()  # staged rows hold gids into the flushed tables
 
     # ---- nodes (packed quanta rows, refreshed from deltas) ----------------
     snap.node_names = node_names
@@ -1047,7 +1218,6 @@ def tensorize_session(ssn) -> TensorSnapshot:
         job_uids + [chr(0x10FFFF)] * (j_pad - j_real),
         dtype=object))).astype(_F)
 
-    tasks: List = []
     # With only stock plugins (guaranteed by the _SUPPORTED_PLUGINS gate
     # above) the task order is exactly (priority desc, creation ts, uid) —
     # a key sort; a non-stock order disables block reuse (the generic
@@ -1105,15 +1275,11 @@ def tensorize_session(ssn) -> TensorSnapshot:
         job_count[ji] = block.count
         job_init_alloc[ji] = block.init_f
         cursor += block.count
-        if block.count:
-            jt = job.tasks
-            tasks.extend(jt[tuid] for tuid in block.uids)
     # Bounded growth: drop blocks for jobs no longer in the cache.
     if truth_jobs is not None and len(tc.jobs) > 2 * len(truth_jobs) + 64:
         for uid in [u for u in tc.jobs if u not in truth_jobs]:
             del tc.jobs[uid]
 
-    snap.tasks = tasks
     snap.task_job = np.repeat(np.arange(j_real, dtype=np.int32),
                               job_count[:j_real])
     p_real = cursor
@@ -1131,26 +1297,62 @@ def tensorize_session(ssn) -> TensorSnapshot:
     snap.tasks_extra = extras
     p_total = p_real + len(extras)
     p_pad = bucket(max(p_total, 1))
-    task_res = np.zeros((p_pad, r), _F)
-    task_req_q64 = np.zeros((p_pad, r), np.int64)
-    task_res_q64 = np.zeros((p_pad, r), np.int64)
+    # ---- candidate-row staging ------------------------------------------
+    # Fast path (doc/INCREMENTAL.md "Wire fast path"): the concatenated
+    # task tensors and the index->TaskInfo list come from persistent
+    # staging buffers with only dirty job SPANS rewritten
+    # (_stage_candidate_rows; the clean-span bit-parity argument lives
+    # there).  KUBE_BATCH_TPU_WIRE_FAST=0 — or a cache that cannot
+    # persist — runs the original full concatenation, and the
+    # stage-rows gauge reads -1 so the vacuous-gate check in
+    # tools/check_churn_ab.py can tell "inactive" from "silently full".
+    from ..metrics.metrics import set_cycle_floor as _set_floor
+    from ..metrics.metrics import set_stage_rows as _set_stage_rows
+    stage_start = time.perf_counter()
+    fast_stage = (tc.persistent and _inc.wire_fast_enabled()
+                  and _inc.incremental_enabled())
+    sig_cand = None
+    if fast_stage:
+        (tasks, task_res, task_req_q64, task_res_q64, sig_cand,
+         staged_rows) = _stage_candidate_rows(
+            tc, ssn, job_uids, blocks, job_start, p_real, p_pad, r)
+        _set_stage_rows(staged_rows)
+    else:
+        tasks = []
+        for ji, b in enumerate(blocks):
+            if b.count:
+                jt = ssn.jobs[job_uids[ji]].tasks
+                tasks.extend(jt[tuid] for tuid in b.uids)
+        task_res = np.zeros((p_pad, r), _F)
+        task_req_q64 = np.zeros((p_pad, r), np.int64)
+        task_res_q64 = np.zeros((p_pad, r), np.int64)
+        if p_real:
+            live = [b for b in blocks if b.count]
+            task_res[:p_real] = np.concatenate([b.res_f for b in live])
+            task_req_q64[:p_real] = np.concatenate(
+                [b.req_q for b in live])
+            task_res_q64[:p_real] = np.concatenate(
+                [b.res_q for b in live])
+        _set_stage_rows(-1)
+    snap.tasks = tasks
     task_sig = np.zeros((p_pad,), np.int32)
     sig_tuples: List[tuple] = []
-    if p_real:
-        live = [b for b in blocks if b.count]
-        task_res[:p_real] = np.concatenate([b.res_f for b in live])
-        task_req_q64[:p_real] = np.concatenate([b.req_q for b in live])
-        task_res_q64[:p_real] = np.concatenate([b.res_q for b in live])
     if p_total:
         # Compact global signature ids to session-local mask rows
         # (candidate rows first, then the BestEffort rows, both in block
-        # order — matching their row layout).
-        sig_arrays = ([b.sig_g for b in blocks if b.count]
-                      + [b.be_sig for b in blocks if len(b.be_sig)])
-        present, inverse = np.unique(np.concatenate(sig_arrays),
-                                     return_inverse=True)
+        # order — matching their row layout).  The fast path reads the
+        # candidate gids straight from the persistent staging buffer.
+        be_arrays = [b.be_sig for b in blocks if len(b.be_sig)]
+        if sig_cand is not None:
+            sig_arrays = [sig_cand[:p_real]] + be_arrays
+        else:
+            sig_arrays = [b.sig_g for b in blocks if b.count] + be_arrays
+        present, inverse = np.unique(
+            np.concatenate(sig_arrays) if len(sig_arrays) != 1
+            else sig_arrays[0], return_inverse=True)
         task_sig[:p_total] = inverse.astype(np.int32)
         sig_tuples = [tc.sig_list[int(g)] for g in present]
+    _set_floor("stage", time.perf_counter() - stage_start)
     task_sorted = np.arange(p_pad, dtype=np.int32)  # already emitted in order
 
     # ---- dynamic-predicate tensors (block entries -> compacted ids) ------

@@ -72,11 +72,22 @@ class GangPlugin(Plugin):
 
     def on_session_close(self, ssn) -> None:
         """Write Unschedulable conditions + metrics for not-ready jobs
-        (gang.go:132-162)."""
+        (gang.go:132-162).
+
+        Wire fast path (doc/INCREMENTAL.md "Wire fast path"): the
+        reference walks EVERY job to find the not-ready ones; the
+        vectorized form reads the persistent per-job ready/minAvailable
+        columns (models/incremental.gang_close_unready — open columns
+        plus a re-read of this session's mutated jobs) so ready jobs
+        cost no Python visit.  Unready jobs run the identical per-job
+        body; KUBE_BATCH_TPU_WIRE_FAST=0 restores the full walk."""
+        from ..models.incremental import gang_close_unready
+        unready_jobs = gang_close_unready(ssn)
+        if unready_jobs is None:
+            unready_jobs = [job for job in ssn.jobs.values()
+                            if not job.ready()]
         unschedulable_jobs = 0
-        for job in ssn.jobs.values():
-            if job.ready():
-                continue
+        for job in unready_jobs:
             unready = job.min_available - job.ready_task_num()
             unschedulable_jobs += 1
             metrics.update_unschedule_task_count(job.name, int(unready))

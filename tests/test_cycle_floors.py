@@ -679,9 +679,10 @@ def test_cycle_floor_metrics_populate():
     cache, binder = make_synthetic_cache(30, 8, 5, 2)
     _cycle(cache, binder)
     floors = metrics.cycle_floor_values()
-    for key in ("solve_wait", "snapshot", "close", "occupancy"):
+    for key in ("solve_wait", "snapshot", "close", "occupancy",
+                "decode", "stage", "plugin_close"):
         assert key in floors, floors
     onwork = metrics.onwork_values()
     for key in ("snapshot_walked", "snapshot_reused", "close_walked",
-                "occupancy_rebuilt", "candidate_rows"):
+                "occupancy_rebuilt", "candidate_rows", "stage_rows"):
         assert key in onwork, onwork

@@ -61,8 +61,19 @@ def test_contract_annotations_cover_the_known_invariants():
         "VictimIndex guarded-by coverage shrank: "
         f"{[str(m) for m in vindex_guarded]}")
     frozen = {m.detail for m in by_kind.get("frozen-after", [])}
-    assert {"ship", "scores", "occupancy"} <= frozen, \
+    assert {"ship", "scores", "occupancy", "stage"} <= frozen, \
         f"frozen-after coverage shrank: {sorted(frozen)}"
+    # The persistent candidate-row staging buffers (wire fast path) stay
+    # under the no-mutate contract: losing these annotations silently
+    # re-legalizes in-place writes that bypass the one sanctioned patch
+    # path (_stage_candidate_rows).
+    stage_frozen = [m for m in by_kind.get("frozen-after", [])
+                    if m.detail == "stage"
+                    and m.path.replace("\\", "/").endswith(
+                        "models/tensor_snapshot.py")]
+    assert len(stage_frozen) >= 4, (
+        "staging frozen-after coverage shrank: "
+        f"{[str(m) for m in stage_frozen]}")
     # The incremental snapshot map's cache-side state (seq counter +
     # _SnapState handle) stays under the cache mutex: losing these
     # annotations silently exempts the informer-thread dirty feeds from

@@ -1,0 +1,806 @@
+"""Wire-to-tensor fast path (doc/INCREMENTAL.md "Wire fast path").
+
+The invariant everything stands on: with ``KUBE_BATCH_TPU_WIRE_FAST``
+on, every layer — the columnar watch-delta decode (edge/codec,
+edge/codec_k8s), the persistent candidate-row staging buffers
+(models/tensor_snapshot), the vectorized drf/job-valid/gang-close walks
+(models/incremental) and the recycled pack buffers (models/shipping) —
+is BIT-IDENTICAL to the =0 sequential control.  On top of that: the
+delta decode degrades to a counted full decode on anything surprising
+(fuzzed here — a malformed frame must never introduce a failure mode the
+full decode does not have), and the lineage ingest stamp rides the
+frame-receipt time on both paths.
+"""
+
+import copy
+import dataclasses as dc
+import json
+import random
+import time
+
+import numpy as np
+import pytest
+
+from kube_batch_tpu.actions.factory import register_default_actions
+from kube_batch_tpu.actions.tpu_allocate import TpuAllocateAction
+from kube_batch_tpu.api import (Affinity, Container, Node, NodeSpec,
+                                NodeStatus, ObjectMeta, Pod, PodSpec,
+                                PodStatus, Toleration, pod_key)
+from kube_batch_tpu.api import objects as O
+from kube_batch_tpu.apis.scheduling import v1alpha1, v1alpha2
+from kube_batch_tpu.apis.scheduling.v1alpha1 import GroupNameAnnotationKey
+from kube_batch_tpu.edge import codec, codec_k8s
+from kube_batch_tpu.framework import close_session, open_session
+from kube_batch_tpu.metrics import metrics
+from kube_batch_tpu.models import incremental
+from kube_batch_tpu.models.incremental import WIRE_FAST_ENV
+from kube_batch_tpu.models.synthetic import make_synthetic_cache
+from kube_batch_tpu.plugins.factory import register_default_plugins
+from kube_batch_tpu.scheduler import (DEFAULT_SCHEDULER_CONF,
+                                      load_scheduler_conf)
+
+register_default_actions()
+register_default_plugins()
+
+
+def _tiers():
+    return load_scheduler_conf(DEFAULT_SCHEDULER_CONF)[1]
+
+
+def _featured_pod(name="p1", ns="ns"):
+    return Pod(
+        metadata=ObjectMeta(name=name, namespace=ns, uid=name,
+                            labels={"team": "a"},
+                            annotations={"k": "v"},
+                            creation_timestamp=12.5),
+        spec=PodSpec(
+            containers=[Container(requests={"cpu": "1",
+                                            "memory": "1Gi"})],
+            node_selector={"pool": "x"},
+            tolerations=[Toleration("t", "Equal", "v", "NoSchedule")],
+            affinity=Affinity(required_node_terms=[{"zone": "z1"}],
+                              preferred_node_terms=[(2, {"zone": "z2"})]),
+            priority=5),
+        status=PodStatus(phase="Pending"))
+
+
+def _node(name="n1"):
+    return Node(
+        metadata=ObjectMeta(name=name, uid=name, labels={"pool": "x"}),
+        spec=NodeSpec(taints=[O.Taint("t", "v", "NoSchedule")]),
+        status=NodeStatus(allocatable={"cpu": "4", "memory": "8Gi"},
+                          capacity={"cpu": "4", "memory": "8Gi"},
+                          conditions={"Ready": "True"}))
+
+
+def _jsonify(doc):
+    return json.loads(json.dumps(doc))
+
+
+def _native_baseline(obj, doc):
+    data = {k: v for k, v in doc.items() if k != "__kind__"}
+    codec.remember_wire_doc(obj, data)
+    return obj
+
+
+# ---------------------------------------------------------------------------
+# 1. Columnar delta decode: parity + identity reuse
+# ---------------------------------------------------------------------------
+
+class TestNativeDelta:
+
+    def test_delta_equals_full_and_reuses_unchanged_subtrees(self):
+        pod = _featured_pod()
+        doc = _jsonify(codec.encode(pod))
+        prev = _native_baseline(codec.decode(doc), doc)
+        doc2 = copy.deepcopy(doc)
+        doc2["status"]["phase"] = "Running"
+        doc2["spec"]["node_name"] = "node-7"
+        out = codec.decode_delta(doc2, prev)
+        assert out == codec.decode(doc2)
+        # Unchanged subtrees come back by IDENTITY — what keeps the
+        # tensorizer's spec-keyed signature cache warm.
+        assert out.metadata is prev.metadata
+        assert out.spec is not prev.spec          # node_name changed
+        assert out.spec.containers is prev.spec.containers
+        assert out.spec.affinity is prev.spec.affinity
+
+    def test_status_only_echo_reuses_whole_spec(self):
+        pod = _featured_pod()
+        doc = _jsonify(codec.encode(pod))
+        prev = _native_baseline(codec.decode(doc), doc)
+        # Prime the signature cache on the previous object's spec.
+        from kube_batch_tpu.models.tensor_snapshot import _pod_static
+        sig_before = _pod_static(prev)
+        doc2 = copy.deepcopy(doc)
+        doc2["status"]["phase"] = "Running"
+        out = codec.decode_delta(doc2, prev)
+        assert out.spec is prev.spec
+        # The identity-keyed cache survives the echo: same tuple object.
+        assert _pod_static(out) is sig_before
+
+    def test_field_removal_matches_full_decode_default(self):
+        pod = _featured_pod()
+        doc = _jsonify(codec.encode(pod))
+        prev = _native_baseline(codec.decode(doc), doc)
+        doc2 = copy.deepcopy(doc)
+        del doc2["spec"]["node_selector"]
+        out = codec.decode_delta(doc2, prev)
+        assert out == codec.decode(doc2)
+        assert out.spec.node_selector == {}
+
+    def test_unknown_kind_raises_value_error(self):
+        with pytest.raises(ValueError):
+            codec.decode_delta({"__kind__": "Gizmo"}, object())
+
+    def test_missing_baseline_raises_lookup_error(self):
+        doc = _jsonify(codec.encode(_featured_pod()))
+        with pytest.raises(LookupError):
+            codec.decode_delta(doc, codec.decode(doc))  # no _wire_doc
+
+    def test_all_top_level_kinds_round_trip_delta(self):
+        objs = [
+            _featured_pod(), _node(),
+            O.PriorityClass(metadata=ObjectMeta(name="pc"), value=7),
+            O.PodDisruptionBudget(metadata=ObjectMeta(name="pdb",
+                                                      namespace="ns"),
+                                  min_available=2),
+            v1alpha1.PodGroup(
+                metadata=ObjectMeta(name="pg", namespace="ns"),
+                spec=v1alpha1.PodGroupSpec(min_member=3, queue="q")),
+            v1alpha2.Queue(metadata=ObjectMeta(name="q"),
+                           spec=v1alpha2.QueueSpec(weight=4)),
+        ]
+        for obj in objs:
+            doc = _jsonify(codec.encode(obj))
+            prev = _native_baseline(codec.decode(doc), doc)
+            doc2 = copy.deepcopy(doc)
+            doc2["metadata"]["labels"] = {"x": "y"}
+            assert codec.decode_delta(doc2, prev) == codec.decode(doc2)
+
+
+class TestK8sDelta:
+
+    def test_pod_delta_equals_full_and_reuses_sections(self):
+        pod = _featured_pod()
+        doc = _jsonify(codec_k8s.to_k8s(pod))
+        prev = codec_k8s.from_k8s(doc)
+        codec.remember_wire_doc(prev, doc)
+        doc2 = copy.deepcopy(doc)
+        doc2["status"]["phase"] = "Running"
+        out = codec_k8s.from_k8s_delta(doc2, prev)
+        assert out == codec_k8s.from_k8s(doc2)
+        assert out.spec is prev.spec
+        assert out.metadata is prev.metadata
+
+    def test_node_delta_equals_full(self):
+        node = _node()
+        doc = _jsonify(codec_k8s.to_k8s(node))
+        prev = codec_k8s.from_k8s(doc)
+        codec.remember_wire_doc(prev, doc)
+        doc2 = copy.deepcopy(doc)
+        doc2["status"]["allocatable"]["cpu"] = "8"
+        out = codec_k8s.from_k8s_delta(doc2, prev)
+        assert out == codec_k8s.from_k8s(doc2)
+        assert out.spec is prev.spec
+
+    def test_non_delta_kind_raises_lookup_error(self):
+        pg = v1alpha1.PodGroup(
+            metadata=ObjectMeta(name="pg", namespace="ns"),
+            spec=v1alpha1.PodGroupSpec(min_member=1, queue="q"))
+        doc = _jsonify(codec_k8s.to_k8s(pg))
+        prev = codec_k8s.from_k8s(doc)
+        codec.remember_wire_doc(prev, doc)
+        with pytest.raises(LookupError):
+            codec_k8s.from_k8s_delta(doc, prev)
+
+
+# ---------------------------------------------------------------------------
+# 2. Codec robustness fuzz: malformed/truncated/unknown-field docs
+# ---------------------------------------------------------------------------
+
+def _mutate_doc(doc, rng):
+    """One random structural mutation: alter/delete a (possibly nested)
+    field, inject an unknown field, type-flip a subtree, or truncate a
+    list — the shapes a broken producer or chaos-truncated frame
+    yields."""
+    doc = copy.deepcopy(doc)
+    paths = []
+
+    def walk(node, path):
+        if isinstance(node, dict):
+            for k in node:
+                paths.append(path + (k,))
+                walk(node[k], path + (k,))
+        elif isinstance(node, list):
+            for i in range(len(node)):
+                walk(node[i], path + (i,))
+
+    walk(doc, ())
+    if not paths:
+        return doc
+    path = paths[rng.randrange(len(paths))]
+    parent = doc
+    for step in path[:-1]:
+        parent = parent[step]
+    key = path[-1]
+    op = rng.randrange(5)
+    if op == 0:
+        del parent[key]
+    elif op == 1:
+        parent[key] = rng.choice([None, 0, 1.5, "junk", [], {},
+                                  ["x", 1], {"zz": 1}])
+    elif op == 2 and isinstance(parent, dict):
+        parent[f"unknown_{rng.randrange(100)}"] = "extra"
+    elif op == 3 and isinstance(parent.get(key) if isinstance(parent, dict)
+                                else None, list):
+        parent[key] = parent[key][: len(parent[key]) // 2]
+    else:
+        parent[key] = {"surprise": [1, 2, 3]}
+    return doc
+
+
+def _eq_mod_auto_uid(a, b):
+    """Equality with both sides' metadata.uid blanked — the one impure
+    decode output (ObjectMeta mints an auto-uid when the doc carries
+    none)."""
+    try:
+        am = copy.copy(a.metadata)
+        bm = copy.copy(b.metadata)
+        am.uid = bm.uid = ""
+        a2, b2 = copy.copy(a), copy.copy(b)
+        a2.metadata, b2.metadata = am, bm
+        return a2 == b2
+    except (AttributeError, TypeError):
+        return False
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_fuzz_delta_never_diverges_or_invents_failures(seed):
+    """For ANY mutated doc: if the full decode succeeds, the delta path
+    (with fallback, as edge/client runs it) produces an EQUAL object; if
+    the full decode raises, the delta+fallback path raises the same
+    exception class.  The fast path can refuse (LookupError -> counted
+    fallback) but can never diverge or die differently."""
+    rng = random.Random(seed)
+    templates = []
+    for maker, enc in ((lambda: _featured_pod(f"p{seed}"), codec.encode),
+                       (_node, codec.encode),
+                       (lambda: _featured_pod(f"k{seed}"),
+                        codec_k8s.to_k8s),
+                       (_node, codec_k8s.to_k8s)):
+        obj = maker()
+        templates.append(_jsonify(enc(obj)))
+    for doc in templates:
+        prev = codec_k8s.decode_any(doc)
+        codec.remember_wire_doc(prev, doc if "kind" in doc else
+                                {k: v for k, v in doc.items()
+                                 if k != "__kind__"})
+        for _ in range(40):
+            mutated = _mutate_doc(doc, rng)
+            full_exc = full = None
+            try:
+                full = codec_k8s.decode_any(mutated)
+            except Exception as exc:  # noqa: BLE001 — classifying
+                full_exc = exc
+            delta_exc = out = None
+            try:
+                try:
+                    out = codec_k8s.decode_any_delta(mutated, prev)
+                except LookupError:
+                    out = codec_k8s.decode_any(mutated)  # the fallback
+            except Exception as exc:  # noqa: BLE001 — classifying
+                delta_exc = exc
+            if full_exc is None:
+                assert delta_exc is None, (mutated, delta_exc)
+                if out != full and not _eq_mod_auto_uid(out, full):
+                    # Decode is pure EXCEPT ObjectMeta's auto-uid
+                    # counter (a doc whose metadata lost its uid mints a
+                    # fresh one per decode) — compare modulo that.
+                    raise AssertionError((mutated, out, full))
+            else:
+                assert delta_exc is not None, (mutated, full_exc)
+                assert type(delta_exc) is type(full_exc), (
+                    mutated, delta_exc, full_exc)
+
+
+def test_raw_key_malformed_docs_stay_in_the_routed_exception_set():
+    """Review-pass regression: the reflector routes _raw_key failures to
+    the full decode via (KeyError, TypeError, AttributeError) — a
+    malformed frame raising anything ELSE would kill the reflector
+    thread.  Fuzz the doc shapes (falsy/non-dict metadata included; the
+    full k8s decode tolerates metadata: null, so the fast path must
+    too)."""
+    from kube_batch_tpu.edge.client import _raw_key
+    rng = random.Random(99)
+    docs = [{"metadata": bad, "kind": "Pod"}
+            for bad in (None, [], "", 0, 1.5, {"namespace": "x"},
+                        {"name": None}, ["oops"])]
+    base = _jsonify(codec_k8s.to_k8s(_featured_pod()))
+    docs += [_mutate_doc(base, rng) for _ in range(60)]
+    for resource in ("pods", "nodes", "podgroups", "queues"):
+        for doc in docs:
+            try:
+                _raw_key(resource, doc)
+            except (KeyError, TypeError, AttributeError):
+                pass  # routed to the full decode by the reflector
+    # {"metadata": None} specifically: the full k8s decode accepts it.
+    assert codec_k8s.from_k8s({"kind": "Pod", "apiVersion": "v1",
+                               "metadata": None}) is not None
+
+
+def test_fallback_counter_moves_and_reflector_contract_holds():
+    """Through the CLIENT chokepoint: a delta failure degrades to the
+    counted full decode; a doc the full decode rejects still raises
+    ValueError (the reflector's malformed-frame relist path)."""
+    from kube_batch_tpu.edge.client import RemoteCluster
+    rc = RemoteCluster("http://127.0.0.1:1")  # never started
+    pod = _featured_pod()
+    doc = _jsonify(codec.encode(pod))
+    before = metrics.wire_fast_counts()
+    # prev without a baseline -> fallback("baseline") + full decode.
+    out = rc._decode(doc, prev=codec.decode(doc))
+    after = metrics.wire_fast_counts()
+    assert out == codec.decode(doc)
+    assert after.get("fallback_baseline", 0) == \
+        before.get("fallback_baseline", 0) + 1
+    # Malformed doc: ValueError propagates (full-path contract).
+    with pytest.raises(ValueError):
+        rc._decode({"__kind__": "Gizmo"}, prev=None)
+
+
+def test_ingest_ts_stamped_at_frame_receipt_on_both_paths():
+    """Satellite: lineage's ingest stamp must not shift when the fast
+    path skips materialization — both paths stamp the FRAME-RECEIPT
+    time the reflector passes down."""
+    from kube_batch_tpu.edge.client import RemoteCluster
+    rc = RemoteCluster("http://127.0.0.1:1")
+    pod = _featured_pod()
+    doc = _jsonify(codec.encode(pod))
+    full = rc._decode(doc, ingest_ts=123.5)
+    assert full._ingest_ts == 123.5
+    prev = rc._decode(doc, ingest_ts=1.0)  # stamps the delta baseline
+    delta = rc._decode(doc, prev=prev, ingest_ts=456.25)
+    assert delta._ingest_ts == 456.25
+    # Without a frame stamp (egress reads) the old behavior holds.
+    t0 = time.monotonic()
+    solo = rc._decode(doc)
+    assert t0 <= solo._ingest_ts <= time.monotonic()
+
+
+def test_wire_fast_off_never_delta_decodes(monkeypatch):
+    from kube_batch_tpu.edge.client import RemoteCluster
+    monkeypatch.setenv(WIRE_FAST_ENV, "0")
+    rc = RemoteCluster("http://127.0.0.1:1")
+    doc = _jsonify(codec.encode(_featured_pod()))
+    prev = codec.decode(doc)
+    codec.remember_wire_doc(prev,
+                            {k: v for k, v in doc.items()
+                             if k != "__kind__"})
+    before = metrics.wire_fast_counts()
+    out = rc._decode(doc, prev=prev)
+    after = metrics.wire_fast_counts()
+    assert out == prev
+    assert after.get("decode_delta", 0) == before.get("decode_delta", 0)
+    # The control arm must not even stamp baselines (no hidden state).
+    assert not hasattr(out, "_wire_doc")
+
+
+# ---------------------------------------------------------------------------
+# 3. Session-level parity: staging + drf/job_valid/gang vs the control
+# ---------------------------------------------------------------------------
+
+def _echo(cache, binder):
+    podmap = {}
+    for job in cache.jobs.values():
+        for t in job.tasks.values():
+            podmap[pod_key(t.pod)] = t.pod
+    for key, node in sorted(binder.binds.items()):
+        old = podmap.get(key)
+        if old is None:
+            continue
+        new = dc.replace(old, spec=dc.replace(old.spec, node_name=node),
+                         status=PodStatus(phase="Running"))
+        cache.update_pod(old, new)
+    binder.binds.clear()
+    updater = cache.status_updater
+    for pg in updater.pod_groups:
+        cache.add_pod_group(pg)
+    updater.pod_groups.clear()
+
+
+def _add_churn_job(cache, tag, n_pods=3, min_member=1):
+    pg = f"churn-{tag}"
+    cache.add_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name=pg, namespace="bench"),
+        spec=v1alpha1.PodGroupSpec(min_member=min_member, queue="q0")))
+    for i in range(n_pods):
+        cache.add_pod(Pod(
+            metadata=ObjectMeta(
+                name=f"{pg}-{i}", namespace="bench", uid=f"{pg}-{i}",
+                annotations={GroupNameAnnotationKey: pg},
+                creation_timestamp=1e6 + i),
+            spec=PodSpec(containers=[Container(
+                requests={"cpu": "500m", "memory": "1Gi"})]),
+            status=PodStatus(phase="Pending")))
+
+
+def _drive_arm(fast: bool, monkeypatch, cycles=4):
+    """Deterministic churn drive; returns the observable record: binds
+    per cycle, events, drf shares at each open, gang conditions."""
+    monkeypatch.setenv(WIRE_FAST_ENV, "1" if fast else "0")
+    cache, binder = make_synthetic_cache(120, 16, 10, 2)
+    action = TpuAllocateAction()
+    record = {"binds": [], "events": None, "shares": [], "conds": []}
+    for c in range(cycles):
+        if c == 1:
+            # A gang that can never be ready plus fresh work: exercises
+            # the job_valid gate AND the gang close walk.
+            _add_churn_job(cache, f"stuck-{c}", n_pods=1, min_member=99)
+            _add_churn_job(cache, f"ok-{c}", n_pods=3)
+        elif c > 1:
+            _add_churn_job(cache, f"ok-{c}", n_pods=2)
+        ssn = open_session(cache, _tiers())
+        try:
+            drf = ssn.plugins.get("drf")
+            if drf is not None:
+                record["shares"].append(
+                    sorted((uid, attr.share)
+                           for uid, attr in drf.job_attrs.items()))
+            action.execute(ssn)
+        finally:
+            close_session(ssn)
+        conds = []
+        for uid in sorted(cache.jobs):
+            job = cache.jobs[uid]
+            if job.pod_group is not None:
+                conds.extend(
+                    (uid, cc.type, cc.status, cc.reason, cc.message)
+                    for cc in job.pod_group.status.conditions)
+        record["conds"].append(conds)
+        record["binds"].append(tuple(sorted(binder.binds.items())))
+        _echo(cache, binder)
+    record["events"] = list(cache.events)
+    return record
+
+
+def test_session_parity_fast_vs_control(monkeypatch):
+    a = _drive_arm(False, monkeypatch)
+    b = _drive_arm(True, monkeypatch)
+    assert a["binds"] == b["binds"]
+    assert a["events"] == b["events"]
+    assert a["shares"] == b["shares"]
+    assert a["conds"] == b["conds"]
+
+
+def test_stage_rows_scale_with_churn(monkeypatch):
+    """The staging fast path must actually patch, not silently re-stage
+    the world (the check_churn_ab discipline, pinned as a unit test)."""
+    monkeypatch.setenv(WIRE_FAST_ENV, "1")
+    cache, binder = make_synthetic_cache(200, 16, 20, 2)
+    action = TpuAllocateAction()
+
+    def cycle():
+        ssn = open_session(cache, _tiers())
+        try:
+            action.execute(ssn)
+        finally:
+            close_session(ssn)
+        _echo(cache, binder)
+
+    cycle()           # cold: full restage
+    cycle()           # settle the mass echo
+    cycle()           # steady: no new work
+    onwork = metrics.onwork_values()
+    assert onwork["stage_rows"] >= 0, "fast staging inactive"
+    assert onwork["stage_rows"] <= 200 / 2, onwork
+    floors = metrics.cycle_floor_values()
+    for key in ("stage", "decode", "plugin_close"):
+        assert key in floors, floors
+
+
+def test_control_arm_reports_stage_inactive(monkeypatch):
+    monkeypatch.setenv(WIRE_FAST_ENV, "0")
+    cache, binder = make_synthetic_cache(40, 8, 5, 2)
+    ssn = open_session(cache, _tiers())
+    try:
+        TpuAllocateAction().execute(ssn)
+    finally:
+        close_session(ssn)
+    assert metrics.onwork_values()["stage_rows"] == -1
+
+
+def test_drf_lazy_allocated_matches_eager(monkeypatch):
+    """The lazy _DrfAttr materialization equals the control arm's eager
+    clone, and mutations through the event handlers stay private."""
+    monkeypatch.setenv(WIRE_FAST_ENV, "1")
+    cache, _binder = make_synthetic_cache(60, 8, 6, 2)
+    ssn = open_session(cache, _tiers())
+    try:
+        drf = ssn.plugins["drf"]
+        for uid, job in ssn.jobs.items():
+            attr = drf.job_attrs[uid]
+            expect = incremental._drf_alloc_of(job)
+            assert attr.allocated == expect
+            # Mutating the materialized Resource must not corrupt the
+            # per-clone cache the next session will clone from.
+            attr.allocated.add(attr.allocated.clone())
+            assert incremental._drf_alloc_of(job) == expect
+    finally:
+        close_session(ssn)
+
+
+def test_job_aggregates_track_session_mutations(monkeypatch):
+    """A pipeline (session-only mutation) must re-dirty the row so the
+    NEXT session re-reads the fresh clone instead of serving the
+    close-state counts."""
+    monkeypatch.setenv(WIRE_FAST_ENV, "1")
+    cache, binder = make_synthetic_cache(30, 8, 3, 1)
+    ssn = open_session(cache, _tiers())
+    try:
+        TpuAllocateAction().execute(ssn)
+        agg = incremental.job_aggregates_close(ssn)
+        assert agg is not None
+        for uid in ssn.mutated_jobs:
+            i = agg.index[uid]
+            assert agg.epochs[i] == -1  # always-dirty stamp
+            job = ssn.jobs[uid]
+            assert agg.ready[i] == job.ready_task_num()
+            assert agg.valid[i] == job.valid_task_num()
+    finally:
+        close_session(ssn)
+    _echo(cache, binder)
+    ssn2 = open_session(cache, _tiers())
+    try:
+        agg2 = incremental.job_aggregates_open(ssn2)
+        for uid, job in ssn2.jobs.items():
+            i = agg2.index[uid]
+            assert agg2.ready[i] == job.ready_task_num(), uid
+            assert agg2.valid[i] == job.valid_task_num(), uid
+            assert agg2.min_avail[i] == job.min_available, uid
+    finally:
+        close_session(ssn2)
+
+
+def test_drf_share_vector_bit_parity_on_awkward_floats(monkeypatch):
+    """The vectorized f32 share must equal api.resource.share exactly,
+    including the r==0 branches and non-representable f32 operands."""
+    from kube_batch_tpu.api import Resource, share
+    monkeypatch.setenv(WIRE_FAST_ENV, "1")
+    cache, _b = make_synthetic_cache(20, 4, 2, 1)
+    ssn = open_session(cache, _tiers())
+    try:
+        drf = ssn.plugins["drf"]
+        total = drf.total_resource
+        for uid, attr in drf.job_attrs.items():
+            alloc = incremental._drf_alloc_of(ssn.jobs[uid])
+            expect = 0.0
+            for rn in total.resource_names():
+                s = share(alloc.get(rn), total.get(rn))
+                if s > expect:
+                    expect = s
+            assert attr.share == expect, uid
+    finally:
+        close_session(ssn)
+    # Direct engine check with zero totals and awkward mantissas.
+    st = incremental.state_for(cache)
+    st.job_agg = None
+
+    class _FakeJob:
+        def __init__(self, uid, vec):
+            self.uid = uid
+            self.vec = vec
+            self.min_available = 1
+            self.snap_epoch = None
+
+        def ready_task_num(self):
+            return 0
+
+        def valid_task_num(self):
+            return 1
+
+    class _FakeSsn:
+        pass
+
+    fssn = _FakeSsn()
+    fssn.uid = "fake-ssn"
+    fssn.cache = cache
+    fssn.mutated_jobs = set()
+    vals = [0.1, 1 / 3, 2.0 ** -60, 7e18, 0.0]
+    fssn.jobs = {}
+    for i, v in enumerate(vals):
+        job = _FakeJob(f"j{i}", v)
+        res = Resource.empty()
+        res.milli_cpu = v
+        res.memory = float(i)
+        job._drf_open_alloc = res
+        fssn.jobs[job.uid] = job
+    total = Resource.empty()
+    total.milli_cpu = 0.3
+    total.memory = 0.0  # exercises the x/0 -> 1 and 0/0 -> 0 branches
+    agg = incremental.drf_open_shares(fssn, total)
+    for i, v in enumerate(vals):
+        expect = max(0.0, share(v, 0.3), share(float(i), 0.0))
+        got = float(agg.shares[agg.index[f"j{i}"]])
+        assert got == expect, (v, got, expect)
+
+
+def test_staged_tasks_follow_fresh_clones_after_session_only_mutation(
+        monkeypatch):
+    """Review-pass regression: a session-only mutation (here a condition
+    write routed through _dirty_job) discards the pooled clone WITHOUT
+    moving truth's mod_epoch, so the next session reuses the tensor
+    block at the same snap_epoch while ssn.jobs holds a FRESH clone —
+    the staged TaskInfo list must follow the clone, or the apply path
+    mutates objects disconnected from the session's job."""
+    from kube_batch_tpu.api.pod_group_info import (PodGroupCondition,
+                                                   PodGroupUnschedulableType)
+    from kube_batch_tpu.models.tensor_snapshot import tensorize_session
+    monkeypatch.setenv(WIRE_FAST_ENV, "1")
+    cache, _binder = make_synthetic_cache(60, 8, 6, 2)
+    ssn = open_session(cache, _tiers())
+    try:
+        snap = tensorize_session(ssn)
+        assert snap.tasks
+        uid = snap.tasks[0].job
+        job = ssn.jobs[uid]
+        assert job.pod_group is not None
+        ssn.update_job_condition(job, PodGroupCondition(
+            type=PodGroupUnschedulableType, status="True",
+            transition_id=ssn.uid, last_transition_time=1.0,
+            reason="test", message="session-only dirty"))
+        assert uid in ssn.mutated_jobs
+    finally:
+        close_session(ssn)
+    ssn2 = open_session(cache, _tiers())
+    try:
+        snap2 = tensorize_session(ssn2)
+        for t in snap2.tasks:
+            assert t is ssn2.jobs[t.job].tasks[t.uid], (
+                f"staged TaskInfo for {t.uid} is a stale clone's object")
+    finally:
+        close_session(ssn2)
+
+
+def test_drf_open_alloc_seeded_after_session_only_mutation_without_gang(
+        monkeypatch):
+    """Review-pass regression: with drf but WITHOUT gang (no close-walk
+    stamping), a session-only mutation must still dirty the aggregate
+    row (clone identity) so the fresh clone's _drf_open_alloc is seeded
+    at OPEN — a lazy materialization walking task_status_index at EVENT
+    time would double-count the just-allocated task."""
+    from kube_batch_tpu.api.pod_group_info import (PodGroupCondition,
+                                                   PodGroupUnschedulableType)
+    from kube_batch_tpu.scheduler import load_scheduler_conf
+    conf = DEFAULT_SCHEDULER_CONF.replace("  - name: gang\n", "")
+    tiers = load_scheduler_conf(conf)[1]
+    assert "gang" not in {o.name for t in tiers for o in t.plugins}
+    monkeypatch.setenv(WIRE_FAST_ENV, "1")
+    cache, _binder = make_synthetic_cache(60, 8, 6, 2)
+    ssn = open_session(cache, tiers)
+    try:
+        uid = next(iter(ssn.jobs))
+        job = ssn.jobs[uid]
+        if job.pod_group is not None:
+            ssn.update_job_condition(job, PodGroupCondition(
+                type=PodGroupUnschedulableType, status="True",
+                transition_id=ssn.uid, last_transition_time=1.0,
+                reason="test", message="session-only dirty"))
+        else:
+            ssn._dirty_job(uid)
+    finally:
+        close_session(ssn)
+    ssn2 = open_session(cache, tiers)
+    try:
+        drf = ssn2.plugins["drf"]
+        job2 = ssn2.jobs[uid]
+        # The open must have seeded the fresh clone's cache...
+        assert getattr(job2, "_drf_open_alloc", None) is not None
+        # ...and the lazy attr materializes the OPEN-time value even
+        # after an allocate-status move (no event-time walk).
+        attr = drf.job_attrs[uid]
+        expect = job2._drf_open_alloc.clone()
+        assert attr.allocated == expect
+    finally:
+        close_session(ssn2)
+
+
+# ---------------------------------------------------------------------------
+# 4. Shipper pack-buffer recycling
+# ---------------------------------------------------------------------------
+
+def test_pack_scratch_recycling_keeps_bit_parity(monkeypatch):
+    monkeypatch.setenv(WIRE_FAST_ENV, "1")
+    from kube_batch_tpu.models.shipping import (DeviceResidentShipper,
+                                                ship_inputs)
+    from kube_batch_tpu.models.synthetic import make_synthetic_inputs
+    inp, cfg = make_synthetic_inputs(64, 16, 8, 2)
+    staged = __import__("jax").tree.map(np.asarray, inp)
+    sh = DeviceResidentShipper()
+    sh.ship(staged, cfg)                      # full: quarantined buffer
+    assert sh._scratch is None                # full ships never recycle
+    dirty = staged._replace(node_used=staged.node_used.copy())
+    dirty.node_used[0, 0] += 1
+    out = sh.ship(dirty, cfg)                 # delta
+    _assert_leaves_equal(out, ship_inputs(dirty))
+    out2 = sh.ship(dirty, cfg)                # clean: flat recycled
+    _assert_leaves_equal(out2, ship_inputs(dirty))
+    assert sh._scratch is not None
+    assert sh._scratch is not sh._state.host_flat
+    dirty2 = staged._replace(node_used=staged.node_used.copy())
+    dirty2.node_used[1, 0] += 2
+    out3 = sh.ship(dirty2, cfg)               # delta packed into scratch
+    _assert_leaves_equal(out3, ship_inputs(dirty2))
+    assert sh._scratch is not sh._state.host_flat
+
+
+def _assert_leaves_equal(a, b):
+    for field in a._fields:
+        x = np.asarray(getattr(a, field))
+        y = np.asarray(getattr(b, field))
+        assert x.dtype == y.dtype, field
+        assert np.array_equal(x, y), field
+
+
+# ---------------------------------------------------------------------------
+# 5. Client over a live edge: fast mirror == control mirror
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("wire", ["native", "k8s"])
+def test_reflector_mirror_parity_over_live_edge(wire, monkeypatch):
+    from kube_batch_tpu.cache import Cluster
+    from kube_batch_tpu.edge import ApiServer, RemoteCluster
+
+    def drive(fast: bool):
+        monkeypatch.setenv(WIRE_FAST_ENV, "1" if fast else "0")
+        cluster = Cluster()
+        cluster.create_queue(v1alpha1.Queue(
+            metadata=ObjectMeta(name="default"),
+            spec=v1alpha1.QueueSpec(weight=1)))
+        cluster.create_node(_node("n-1"))
+        for i in range(6):
+            cluster.create_pod(_featured_pod(f"p-{i}", ns="bench"))
+        server = ApiServer(cluster).start()
+        try:
+            remote = RemoteCluster(server.url, wire=wire).start(
+                timeout=30)
+            try:
+                before = metrics.wire_fast_counts()
+                # Updates for known pods: the delta path's bread and
+                # butter (status echo + a bind).
+                for i in range(6):
+                    old = cluster.get_pod("bench", f"p-{i}")
+                    new = dc.replace(
+                        old, spec=dc.replace(old.spec,
+                                             node_name="n-1"),
+                        status=PodStatus(phase="Running"))
+                    cluster.update_pod(new)
+                deadline = time.time() + 20
+                while time.time() < deadline:
+                    with remote.lock:
+                        done = all(
+                            p.spec.node_name == "n-1"
+                            for p in remote.pods.values()) and \
+                            len(remote.pods) == 6
+                    if done:
+                        break
+                    time.sleep(0.02)
+                after = metrics.wire_fast_counts()
+                with remote.lock:
+                    mirror = {k: remote.pods[k]
+                              for k in sorted(remote.pods)}
+                return mirror, {
+                    k: after.get(k, 0) - before.get(k, 0)
+                    for k in after}
+            finally:
+                remote.stop()
+        finally:
+            server.stop()
+
+    control, ccounts = drive(False)
+    fast, fcounts = drive(True)
+    assert list(control) == list(fast)
+    for key in control:
+        assert control[key] == fast[key], key
+    assert fcounts.get("decode_delta", 0) >= 6, fcounts
+    assert ccounts.get("decode_delta", 0) == 0, ccounts

@@ -72,6 +72,18 @@ GATED_KEYS = {
     "floors_ms.occupancy": {
         "path": ("floors_ms", "occupancy"), "direction": "down",
         "band": 3.0, "abs_slack": 5.0},
+    # Wire-to-tensor fast-path floors (doc/INCREMENTAL.md "Wire fast
+    # path"): floors only go down; a change that stops emitting one
+    # fails the gate via the missing-key rule below.
+    "floors_ms.decode": {
+        "path": ("floors_ms", "decode"), "direction": "down",
+        "band": 3.0, "abs_slack": 5.0},
+    "floors_ms.stage": {
+        "path": ("floors_ms", "stage"), "direction": "down",
+        "band": 3.0, "abs_slack": 5.0},
+    "floors_ms.plugin_close": {
+        "path": ("floors_ms", "plugin_close"), "direction": "down",
+        "band": 3.0, "abs_slack": 5.0},
     # Full-bench keys: absent from steady-only artifacts (so they never
     # enter the bench-gate baseline) but extracted into the trajectory
     # when a full 50k-shape run is appended — the cross-PR history the

@@ -102,6 +102,35 @@ def main() -> int:
                       "occupancy update regressed to a full rebuild",
                       file=sys.stderr)
                 return 1
+            # Wire-fast staging guard (doc/INCREMENTAL.md "Wire fast
+            # path"): on micro cycles the candidate-row staging must
+            # patch dirty spans, not re-concatenate the whole [P]
+            # block — and the floor metrics must actually populate (a
+            # change that stops emitting them would silently un-gate
+            # this check, the vacuous-gate failure mode).
+            floors = rec.get("floors_ms") or {}
+            for key in ("decode", "stage", "plugin_close"):
+                if floors.get(key) is None:
+                    print(f"check_churn_ab: level {label} floor "
+                          f"{key!r} never populated — the wire-fast "
+                          "floor attribution stopped emitting",
+                          file=sys.stderr)
+                    return 1
+            tasks = onwork.get("tasks_total") or 0
+            stage_max = onwork.get("micro_stage_rows_max")
+            if stage_max is not None and stage_max >= 0 and tasks and \
+                    stage_max > tasks / 2:
+                print(f"check_churn_ab: level {label} micro staging "
+                      f"rewrote {stage_max}/{tasks} task rows — the "
+                      "in-place candidate staging regressed to the "
+                      "full concatenation", file=sys.stderr)
+                return 1
+            if stage_max is not None and stage_max < 0:
+                print(f"check_churn_ab: level {label} ran micro cycles "
+                      "with the staging fast path INACTIVE (stage_rows "
+                      "= -1) — the staging A/B is vacuous",
+                      file=sys.stderr)
+                return 1
     if micro_total == 0:
         print("check_churn_ab: the incremental arm never ran a micro "
               "session — the A/B compared two control arms",

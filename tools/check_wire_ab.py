@@ -1,0 +1,83 @@
+"""CI gate for `make bench-wire`: read the wire-A/B artifact line from
+stdin, assert the wire-to-tensor fast path's bit-parity verdict on BOTH
+wire formats, and refuse vacuous runs.
+
+bench.py deliberately always exits 0 (the artifact-always-emits
+contract), so the smoke's pass/fail lives here — a parity break, a
+missing A/B, a fast arm that never delta-decoded (comparing two control
+arms), or a control arm that somehow delta-decoded (a leaked env gate)
+exits nonzero and fails the CI job.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> int:
+    line = ""
+    for raw in sys.stdin:
+        raw = raw.strip()
+        if raw.startswith("{"):
+            line = raw  # last JSON-looking line wins (the artifact)
+    if not line:
+        print("check_wire_ab: no artifact line on stdin", file=sys.stderr)
+        return 1
+    out = json.loads(line)
+    if out.get("error"):
+        print(f"check_wire_ab: bench reported error: {out['error']}",
+              file=sys.stderr)
+        return 1
+    ab = out.get("wire_ab") or {}
+    if not ab:
+        print("check_wire_ab: artifact carries no wire_ab",
+              file=sys.stderr)
+        return 1
+    if out.get("wire_parity") is not True:
+        print("check_wire_ab: PARITY FAILURE — the wire fast path "
+              "diverged from the KUBE_BATCH_TPU_WIRE_FAST=0 control "
+              f"(wire_parity={out.get('wire_parity')!r})",
+              file=sys.stderr)
+        return 1
+    for wire in ("native", "k8s"):
+        rec = ab.get(wire)
+        if rec is None:
+            print(f"check_wire_ab: wire mode {wire!r} missing from the "
+                  "A/B", file=sys.stderr)
+            return 1
+        wf = rec.get("wire_fast") or {}
+        cwf = rec.get("control_wire_fast") or {}
+        print(f"wire {wire:>6s}  fast {rec['fast_ms']:8.1f} ms   "
+              f"control {rec['control_ms']:8.1f} ms   "
+              f"({rec.get('speedup')}x; fast-arm decodes {wf}, "
+              f"decode floor {rec.get('decode_floor_ms')} ms)")
+        if rec.get("parity") is not True:
+            print(f"check_wire_ab: wire {wire} lost parity",
+                  file=sys.stderr)
+            return 1
+        if wf.get("decode_delta", 0) <= 0:
+            # Vacuous-gate guard (the check_churn_ab discipline): a
+            # fast arm that never took the delta path compared two
+            # control arms and proved nothing.
+            print(f"check_wire_ab: wire {wire} fast arm never "
+                  "delta-decoded — the A/B is vacuous "
+                  f"(counters {wf})", file=sys.stderr)
+            return 1
+        if cwf.get("decode_delta", 0) > 0:
+            print(f"check_wire_ab: wire {wire} CONTROL arm "
+                  "delta-decoded — the KUBE_BATCH_TPU_WIRE_FAST=0 gate "
+                  f"leaked (counters {cwf})", file=sys.stderr)
+            return 1
+        if rec.get("decode_floor_ms") is None:
+            print(f"check_wire_ab: wire {wire} decode floor never "
+                  "populated — the wire-fast floor attribution stopped "
+                  "emitting", file=sys.stderr)
+            return 1
+    print("wire A/B: binds+events bit-identical across "
+          "KUBE_BATCH_TPU_WIRE_FAST on both wire formats")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -5,7 +5,7 @@ COMPILE_CACHE ?= $(CURDIR)/.compile-cache
 .PHONY: lint lint-inventory test bench bench-cached bench-steady \
 	bench-evict bench-churn bench-wire bench-shard bench-topo \
 	bench-gate bench-gate-baseline lineage-ab chaos chaos-smoke \
-	scenarios trace-demo clean-cache
+	scenarios soak-replicas trace-demo clean-cache
 
 # The bench-gate shape: small enough for CI, big enough that the steady
 # path, delta shipping, and the residual floors all exercise (mirrors
@@ -179,6 +179,19 @@ chaos:
 chaos-smoke:
 	env JAX_PLATFORMS=cpu $(PYTHON) tools/chaos_soak.py --seeds 2 \
 		--cycles 10
+
+# Replica-federation convergence soak (doc/TENANCY.md): 3 active-active
+# in-process replicas (one over the ApiServer+RemoteCluster wire) each
+# claiming queue-shards via per-shard CAS leases, driven through seeded
+# churn + a budgeted lease-fault storm (lease.cas_conflict /
+# lease.clock_skew) + a mid-run replica KILL (crash semantics, no lease
+# release).  Exits nonzero unless: zero ACCEPTED double-binds at truth,
+# every orphaned shard stolen within one lease duration, every tenant's
+# demand bound across replica boundaries, and the adoption served from
+# the shared compile cache (hit counter moves, miss counter does not).
+soak-replicas:
+	env JAX_PLATFORMS=cpu $(PYTHON) tools/replica_soak.py --replicas 3 \
+		--shards 3 --churn-rounds 12 --edge
 
 # Record a small live session with the flight recorder on and write its
 # Chrome trace-event JSON (doc/OBSERVABILITY.md): open doc/trace_demo.json

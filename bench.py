@@ -420,6 +420,130 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
     return round(cold, 1), steady[1:], stats
 
 
+def measure_tenancy_steady(n_tasks, n_nodes, n_jobs, n_queues,
+                           rounds: int = 4):
+    """Per-tenant micro-session pacing over the queue-shard engine
+    (kube_batch_tpu/tenancy/, doc/TENANCY.md): a fresh synthetic cache
+    is split into one shard per queue (ShardView slices the same cache
+    the global engine would see), the NOISY tenant (q0) churns 10% of
+    its pods per round while the QUIET tenant (q1) churns nothing, and
+    both tenants' micro-sessions are timed per round.  The artifact
+    carries per-tenant ``sessions_per_sec`` — the quiet tenant's pace
+    must not degrade with the noisy tenant's storm (the isolation
+    contract tests/test_tenancy.py pins with bands) — plus the
+    shard-rebalance counter delta, which a steady single-replica run
+    pins at ZERO (rebalances only happen in federation failover)."""
+    from kube_batch_tpu.actions.tpu_allocate import TpuAllocateAction
+    from kube_batch_tpu.api import (Container, ObjectMeta, Pod, PodSpec,
+                                    PodStatus, pod_key)
+    from kube_batch_tpu.apis.scheduling import v1alpha1
+    from kube_batch_tpu.apis.scheduling.v1alpha1 import GroupNameAnnotationKey
+    from kube_batch_tpu.framework import close_session, open_session
+    from kube_batch_tpu.metrics.metrics import (compile_cache_counts,
+                                                shard_rebalance_counts)
+    from kube_batch_tpu.models.synthetic import make_synthetic_cache
+    from kube_batch_tpu.tenancy import ShardMap, ShardView
+
+    _register()
+    cache, binder = make_synthetic_cache(n_tasks, n_nodes, n_jobs, n_queues)
+    tiers = _tiers()
+    action = TpuAllocateAction()
+    shard_map = ShardMap(n_queues, {f"q{i}": i for i in range(n_queues)})
+    views = [ShardView(cache, i, shard_map) for i in range(n_queues)]
+    podmap = {}
+    for job in cache.jobs.values():
+        for t in job.tasks.values():
+            podmap[pod_key(t.pod)] = t.pod
+
+    def micro(shard) -> float:
+        start = time.perf_counter()
+        ssn = open_session(views[shard], tiers)
+        try:
+            action.execute(ssn)
+        finally:
+            close_session(ssn)
+        return (time.perf_counter() - start) * 1e3
+
+    def echo():
+        import dataclasses as dc
+        binds = dict(binder.binds)
+        binder.binds.clear()
+        for key, node in binds.items():
+            old = podmap.get(key)
+            if old is None:
+                continue
+            new = dc.replace(old, spec=dc.replace(old.spec, node_name=node),
+                             status=PodStatus(phase="Running"))
+            podmap[key] = new
+            cache.update_pod(old, new)
+        updater = cache.status_updater
+        if getattr(updater, "pod_groups", None):
+            for pg in updater.pod_groups:
+                cache.add_pod_group(pg)
+            updater.pod_groups.clear()
+
+    rebal0 = sum(shard_rebalance_counts().values())
+    with _gc_posture():
+        # Warm pass: every shard's first (cold, compiling) session.
+        for shard in range(n_queues):
+            micro(shard)
+        echo()
+        k = max(1, n_tasks // (10 * n_queues))  # 10% of q0's share
+        next_uid = 10 * n_tasks
+        noisy_wall, quiet_ms, recompiled = [], [], []
+        sessions = 0
+        for rnd in range(rounds + 1):
+            round_start = time.perf_counter()
+            pg_name = f"tenchurn-{rnd}"
+            cache.add_pod_group(v1alpha1.PodGroup(
+                metadata=ObjectMeta(name=pg_name, namespace="bench"),
+                spec=v1alpha1.PodGroupSpec(min_member=max(1, k * 4 // 5),
+                                           queue="q0")))
+            for _ in range(k):
+                uid = next_uid
+                next_uid += 1
+                pod = Pod(
+                    metadata=ObjectMeta(
+                        name=f"t{uid}", namespace="bench", uid=f"t{uid}",
+                        annotations={GroupNameAnnotationKey: pg_name},
+                        creation_timestamp=float(uid)),
+                    spec=PodSpec(containers=[Container(
+                        requests={"cpu": "500m", "memory": "1Gi"})]),
+                    status=PodStatus(phase="Pending"))
+                podmap[pod_key(pod)] = pod
+                cache.add_pod(pod)
+            _h0, m0 = compile_cache_counts()
+            micro(0)                 # the noisy tenant's micro-session
+            q = micro(1 % n_queues)  # the quiet tenant rides along
+            _h1, m1 = compile_cache_counts()
+            echo()
+            sessions += 2
+            if rnd == 0:
+                continue  # re-absorb round, like the steady window
+            recompiled.append(m1 > m0)
+            quiet_ms.append(q)
+            noisy_wall.append((time.perf_counter() - round_start) * 1e3)
+    clean_noisy = [w for w, r in zip(noisy_wall, recompiled) if not r] \
+        or noisy_wall
+    clean_quiet = [q for q, r in zip(quiet_ms, recompiled) if not r] \
+        or quiet_ms
+    noisy_med, _ = _stats(clean_noisy) if clean_noisy else (None, None)
+    quiet_med, _ = _stats(clean_quiet) if clean_quiet else (None, None)
+    return {
+        "shards": n_queues,
+        "micro_sessions": sessions,
+        "churn_per_round": k,
+        "noisy_round_ms": noisy_med,
+        "quiet_session_ms": quiet_med,
+        "sessions_per_sec": {
+            "noisy": (round(1e3 / noisy_med, 3) if noisy_med else None),
+            "quiet": (round(1e3 / quiet_med, 3) if quiet_med else None)},
+        "recompiled_rounds": int(sum(recompiled)),
+        "shard_rebalances":
+            sum(shard_rebalance_counts().values()) - rebal0,
+    }
+
+
 def _fill_lineage_ab(out, n_tasks, n_nodes, n_jobs, n_queues, rounds):
     """BENCH_LINEAGE_AB=1 (`make lineage-ab`): same-box counterbalanced
     A/B of the pod-lineage layer's steady-cycle overhead — OFF/ON/ON/OFF
@@ -1376,6 +1500,11 @@ def measure_wire_ab(n_tasks, n_nodes, n_jobs, rounds: int = 3,
                                     if f is not None],
                 "wire_fast": {k: wf1.get(k, 0) - wf0.get(k, 0)
                               for k in wf1},
+                # Retained raw-doc baseline memory per kind at the end
+                # of the arm (ROADMAP item 1 accounting): ~0 on control
+                # arms (nothing retained with the fast path off).
+                "wire_baseline_bytes": (remote.wire_baseline_bytes()
+                                        if remote is not None else None),
             }
         finally:
             if remote is not None:
@@ -1409,6 +1538,9 @@ def measure_wire_ab(n_tasks, n_nodes, n_jobs, rounds: int = 3,
                 "control_ms": med_c, "control_p90": p90_c,
                 "speedup": (round(med_c / med_f, 2) if med_f else None),
                 "wire_fast": fast_counts,
+                # The memory-budget target: what the fast arm's mirrors
+                # retained as delta baselines, per resource kind.
+                "wire_baseline_bytes": arms[1]["wire_baseline_bytes"],
                 "control_wire_fast": {
                     k: arms[0]["wire_fast"].get(k, 0)
                     + arms[3]["wire_fast"].get(k, 0)
@@ -1777,6 +1909,17 @@ def _run_full(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n,
     # tools/bench_compare.py gates (doc/OBSERVABILITY.md).
     out["floors_ms"] = steady_stats.get("floors_ms")
 
+    # Queue-shard tenancy pacing (doc/TENANCY.md): per-tenant micro-
+    # session rates under an asymmetric noisy/quiet churn split, plus
+    # the shard-rebalance counter a steady run pins at zero.  Optional
+    # (BENCH_TENANCY=0 skips) and failure-isolated like stages_ms.
+    if os.environ.get("BENCH_TENANCY", "1") != "0":
+        try:
+            out["tenancy"] = measure_tenancy_steady(
+                n_tasks, n_nodes, n_jobs, n_queues)
+        except Exception as exc:  # noqa: BLE001 — artifact stays honest
+            out["tenancy_error"] = f"{type(exc).__name__}: {exc}"
+
     if not steady_only:
         _, steady_het_rounds, _het_stats = measure_steady_session(
             n_tasks, n_nodes, n_jobs, n_queues, n_signatures=64)
@@ -1867,6 +2010,11 @@ def main():
         # `make lineage-ab`) — doc/OBSERVABILITY.md.
         "floors_ms": None,
         "lineage_ab": None,
+        # Queue-shard tenancy pacing (doc/TENANCY.md): per-tenant
+        # micro-session sessions/sec (noisy vs quiet) over ShardViews
+        # of the steady cache + the shard-rebalance counter (pinned 0
+        # outside federation failover).
+        "tenancy": None,
         # Topology A/B (BENCH_TOPO_AB=1 / `make bench-topo`): defrag vs
         # capacity eviction contrast + batched/sequential/mesh parity
         # (doc/TOPOLOGY.md; gated by tools/check_topo_ab.py).

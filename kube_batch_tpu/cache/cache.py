@@ -252,6 +252,16 @@ class SchedulerCache(Cache):
         # lock of its own; it is installed once before cache.run().
         self.churn_event = None  # Optional[threading.Event]
 
+        # Per-shard churn attribution (kube_batch_tpu/tenancy/,
+        # doc/TENANCY.md): when the tenancy engine runs, it installs
+        # ShardChurn.note here and every external ingestion path passes
+        # the affected QUEUE alongside the wake — so one tenant's churn
+        # dirties one shard instead of waking a global cycle.  None
+        # (queue unresolvable) over-approximates to all shards, which is
+        # always safe.  Installed once before cache.run(), like
+        # churn_event; the callable takes its own lock.
+        self.shard_churn = None  # Optional[Callable[[Optional[str]], None]]
+
     # ------------------------------------------------------------------
     # epoch stamping + clone pool
 
@@ -320,11 +330,26 @@ class SchedulerCache(Cache):
             if st is not None:
                 st.dirty_nodes.add(name)
 
-    def _note_churn(self) -> None:
-        """Wake the scheduler loop: external cluster state changed."""
+    def _note_churn(self, queue: Optional[str] = None) -> None:
+        """Wake the scheduler loop: external cluster state changed.
+        ``queue`` attributes the churn to one tenant's shard when the
+        tenancy engine runs (None = affects every shard)."""
+        sc = self.shard_churn
+        if sc is not None:
+            sc(queue)
         ev = self.churn_event
         if ev is not None:
             ev.set()
+
+    def _queue_of_job(self, job_uid: Optional[str]) -> Optional[str]:  # holds-lock: mutex
+        """The churn-attribution queue for a job key, or None when it
+        cannot be resolved (the safe all-shards over-approximation)."""
+        if not job_uid:
+            return None
+        job = self.jobs.get(job_uid)
+        if job is None:
+            return None
+        return job.queue or None
 
     @staticmethod
     def _pg_fingerprint(pg) -> tuple:
@@ -482,52 +507,74 @@ class SchedulerCache(Cache):
 
     def add_pod(self, pod: Pod) -> None:
         lin = None
+        queue = None
         with self.mutex:
             self.epoch += 1
             ti = self._task_info(pod)
             if ti is not None:
                 self._add_task(ti)
                 lin = self._lineage_capture(ti, pod)
+                queue = self._queue_of_job(ti.job)
         self._lineage_emit(lin, "echo")
-        self._note_churn()
+        self._note_churn(queue)
 
     def update_pod(self, old_pod: Pod, new_pod: Pod) -> None:
         lin = None
+        queue = old_queue = None
         with self.mutex:
             self.epoch += 1
             old_ti = self._task_info(old_pod)
             if old_ti is not None:
+                # Resolve BEFORE the delete: if the task is moving to a
+                # job in another queue, the SOURCE queue's shard must be
+                # dirtied too or its stale state strands until the next
+                # periodic pass.
+                old_queue = self._queue_of_job(old_ti.job)
                 self._delete_task(old_ti)
             ti = self._task_info(new_pod)
             if ti is not None:
                 self._add_task(ti)
                 lin = self._lineage_capture(ti, new_pod)
+                queue = self._queue_of_job(ti.job)
         self._lineage_emit(lin, "echo")
-        self._note_churn()
+        if old_queue is not None and old_queue != queue:
+            self._note_churn(old_queue)
+        self._note_churn(queue)
 
     def delete_pod(self, pod: Pod) -> None:
+        queue = None
         with self.mutex:
             self.epoch += 1
             ti = self._task_info(pod)
             if ti is not None:
+                # Resolve BEFORE the delete: a last-task delete drops
+                # the terminated job from self.jobs.
+                queue = self._queue_of_job(ti.job)
                 self._delete_task(ti)
         pod_lineage.note_deleted(pod_key(pod))
-        self._note_churn()
+        self._note_churn(queue)
 
     def sync_task(self, old_task: TaskInfo, cluster_pod: Optional[Pod]) -> None:
         """Refetch ground truth for a task whose effect failed
         (event_handlers.go:101-119)."""
         lin = None
+        queue = None
         with self.mutex:
             self.epoch += 1
+            old_queue = self._queue_of_job(old_task.job)
             self._delete_task(old_task)
             if cluster_pod is not None:
                 ti = self._task_info(cluster_pod)
                 if ti is not None:
                     self._add_task(ti)
                     lin = self._lineage_capture(ti, cluster_pod)
+                    queue = self._queue_of_job(ti.job)
         self._lineage_emit(lin, "resync")
-        self._note_churn()
+        # Both sides dirty when ground truth moved the task across
+        # queues: the source shard must re-observe the departure.
+        if old_queue is not None and old_queue != queue:
+            self._note_churn(old_queue)
+        self._note_churn(queue if queue is not None else old_queue)
 
     # ------------------------------------------------------------------
     # node ingestion (event_handlers.go:296-365)
@@ -591,12 +638,19 @@ class SchedulerCache(Cache):
             self_echo = (getattr(job, "_pushed_status_fp", None)
                          == self._pg_fingerprint(internal)
                          and job._pushed_status_fp is not None)
+            # The job's previous queue, BEFORE the spec lands: a
+            # PodGroup whose spec.queue moved must dirty the SOURCE
+            # shard too (it still mirrors the job until it re-snapshots).
+            old_queue = job.queue or None
             job.set_pod_group(internal)
             if not job.queue:
                 job.queue = self.default_queue
             self._touch_job(job)
+            queue = job.queue or None
         if not self_echo:
-            self._note_churn()
+            if old_queue is not None and old_queue != queue:
+                self._note_churn(old_queue)
+            self._note_churn(queue)
 
     def update_pod_group(self, old_pg, new_pg) -> None:
         self.add_pod_group(new_pg)
@@ -609,6 +663,7 @@ class SchedulerCache(Cache):
             job = self.jobs.get(key)
             if job is None:
                 return
+            queue = job.queue or None
             job.unset_pod_group()
             self._touch_job(job)
             if job_terminated(job):
@@ -616,14 +671,14 @@ class SchedulerCache(Cache):
                 self._pooled_jobs.pop(key, None)
             else:
                 self.deleted_jobs.append(job)
-        self._note_churn()
+        self._note_churn(queue)
 
     def add_queue(self, queue) -> None:
         q = queue if isinstance(queue, Queue) else queue_from_versioned(queue)
         with self.mutex:
             self.queues[q.metadata.name] = q
             self._snap_full_invalidate()
-        self._note_churn()
+        self._note_churn(q.metadata.name)
 
     def update_queue(self, old_queue, new_queue) -> None:
         self.add_queue(new_queue)
@@ -633,7 +688,7 @@ class SchedulerCache(Cache):
         with self.mutex:
             self.queues.pop(name, None)
             self._snap_full_invalidate()
-        self._note_churn()
+        self._note_churn(name)
 
     def add_pdb(self, pdb) -> None:
         """Legacy gang source; PDB jobs land in the default queue
@@ -648,7 +703,7 @@ class SchedulerCache(Cache):
             job.set_pdb(pdb)
             job.queue = self.default_queue
             self._touch_job(job)
-        self._note_churn()
+        self._note_churn(self.default_queue)
 
     def update_pdb(self, old_pdb, new_pdb) -> None:
         self.add_pdb(new_pdb)
@@ -660,6 +715,7 @@ class SchedulerCache(Cache):
             job = self.jobs.get(key)
             if job is None:
                 return
+            queue = job.queue or None
             job.unset_pdb()
             self._touch_job(job)
             if job_terminated(job):
@@ -667,7 +723,7 @@ class SchedulerCache(Cache):
                 self._pooled_jobs.pop(key, None)
             else:
                 self.deleted_jobs.append(job)
-        self._note_churn()
+        self._note_churn(queue)
 
     def add_priority_class(self, pc) -> None:
         if not self.priority_class_enabled:
@@ -995,7 +1051,12 @@ class SchedulerCache(Cache):
         for name, queue in self.queues.items():
             info.queues[name] = QueueInfo(queue)
 
-        st.recloned_jobs = set()
+        # recloned accumulates across walks and is consumed per close
+        # (note_close_results): with the global engine every close
+        # consumes the whole set (bit-identical to the old wholesale
+        # replace); with the tenancy engine each shard's close consumes
+        # only its own jobs, so a fresh clone of shard B's job survives
+        # shard A's intervening snapshot/close pair.
         inserts = []
         for uid in st.dirty_jobs:
             walked += 1
@@ -1004,16 +1065,19 @@ class SchedulerCache(Cache):
                 st.jobs.pop(uid, None)
                 st.jobs_seq.pop(uid, None)
                 st.no_spec.pop(uid, None)
+                st.recloned_jobs.discard(uid)
                 continue
             if job.pod_group is None and job.pdb is None:
                 st.jobs.pop(uid, None)
                 st.jobs_seq.pop(uid, None)
                 st.no_spec[uid] = self._obj_seq_of(job)
+                st.recloned_jobs.discard(uid)
                 continue
             st.no_spec.pop(uid, None)
             if job.queue not in info.queues:
                 st.jobs.pop(uid, None)
                 st.jobs_seq.pop(uid, None)
+                st.recloned_jobs.discard(uid)
                 continue
             clone = self._clone_job_locked(uid, job)
             st.recloned_jobs.add(uid)
@@ -1043,6 +1107,9 @@ class SchedulerCache(Cache):
             walked, len(info.nodes) + len(info.jobs) + len(st.no_spec))
         return info
 
+    # ------------------------------------------------------------------
+    # close_session bookkeeping (shared with the tenancy ShardView)
+
     def close_plan(self):
         """close_session's O(touched) walk plan: (active, recloned,
         seqmap), or None when the whole-session walk must run (first
@@ -1054,13 +1121,28 @@ class SchedulerCache(Cache):
             return (set(st.close_active), set(st.recloned_jobs),
                     dict(st.jobs_seq))
 
-    def note_close_results(self, active: set) -> None:
+    def note_close_results(self, active: set, universe=None) -> None:
         """Record which jobs' close outcome was NOT provably silent —
-        the re-process set for the next incremental close."""
+        the re-process set for the next incremental close.
+
+        ``universe`` scopes the result to the jobs this close actually
+        walked (the tenancy ShardView's shard slice): verdicts for jobs
+        OUTSIDE the universe are preserved instead of replaced, so one
+        shard's close cannot clear another shard's active flags.  None
+        (the global engine) replaces wholesale, the pre-tenancy
+        behavior.  Either way, the walked jobs' pending fresh-reclone
+        marks are consumed (see _snapshot_incremental_locked)."""
         with self.mutex:
             st = self._snap_state
-            if st is not None:
+            if st is None:
+                return
+            if universe is None:
                 st.close_active = set(active)
+                st.recloned_jobs.clear()
+            else:
+                scope = set(universe)
+                st.close_active = (st.close_active - scope) | set(active)
+                st.recloned_jobs -= scope
 
     # ------------------------------------------------------------------
     # effectors (cache.go:425-535)

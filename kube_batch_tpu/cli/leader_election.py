@@ -110,6 +110,30 @@ class StoreLock:
             return False
 
 
+def cas_release(lock, identity: str,
+                lease_duration: float = DEFAULT_LEASE_DURATION) -> bool:
+    """CAS-clear a lease THIS identity holds so the next contender can
+    acquire immediately instead of waiting out the expiry.  Returns
+    False (never raises) when the lease is not ours, the CAS loses, or
+    the store is unreachable — release is best-effort by design: an
+    unreleased lease simply expires on schedule.  Shared by the global
+    elector's embedders and the per-shard federation
+    (tenancy/leases.ShardLeaseManager, doc/TENANCY.md)."""
+    try:
+        version, record = lock.get()
+    except Exception:  # lint: allow-swallow(unreachable store: the lease will expire on schedule, which is the release fallback)
+        return False
+    if (record or {}).get("holderIdentity") != identity:
+        return False
+    released = {"holderIdentity": "", "renewTime": 0.0,
+                "leaseDurationSeconds": lease_duration,
+                "releasedBy": identity, "releasedAt": time.time()}
+    try:
+        return bool(lock.cas(released, version))
+    except Exception:  # lint: allow-swallow(CAS conflict means someone already replaced the record; expiry remains the fallback)
+        return False
+
+
 @dataclass
 class LeaderElectionConfig:
     lock_path: str = ""

@@ -46,12 +46,33 @@ class ServerOption:
     # directory to capture a device trace around every session's solve
     # window (actions/tpu_allocate.PROFILE_ENV hook).
     jax_profile_dir: str = ""
+    # Queue-shard tenancy engine + active-active replica federation
+    # (kube_batch_tpu/tenancy/, doc/TENANCY.md): shard count (0 defers
+    # to KUBE_BATCH_TPU_TENANCY / disabled), per-shard CAS leases in the
+    # shared store instead of one global leader, and the shard lease
+    # timing (renew deadline is derived as 3/5 of the duration, the
+    # global elector's 15s/10s/5s ratio).
+    tenancy_shards: int = 0
+    replica_federation: bool = False
+    shard_lease_duration: float = 5.0
 
     def check_option_or_die(self) -> None:
         """options.go:81-88: leader election requires a lock namespace."""
         if self.enable_leader_election and not self.lock_object_namespace:
             raise ValueError(
                 "lock-object-namespace must not be nil when LeaderElection is enabled")
+        if self.replica_federation:
+            if self.enable_leader_election:
+                raise ValueError(
+                    "--replica-federation replaces --leader-elect: "
+                    "per-shard leases ARE the election — enable one, "
+                    "not both (doc/TENANCY.md)")
+            if not self.lock_object_namespace:
+                raise ValueError(
+                    "lock-object-namespace must not be nil when replica "
+                    "federation is enabled (the shard leases live there)")
+            if self.shard_lease_duration <= 0:
+                raise ValueError("shard-lease-duration must be > 0")
 
 
 def add_flags(parser: argparse.ArgumentParser) -> None:
@@ -100,6 +121,23 @@ def add_flags(parser: argparse.ArgumentParser) -> None:
                         help="Directory for JAX's persistent compilation "
                              "cache; solver compiles survive process "
                              "restarts and leader failover")
+    parser.add_argument("--tenancy-shards", type=int, default=0,
+                        help="Queue-shard count for the tenancy engine: "
+                             "per-tenant micro-sessions pipeline per "
+                             "shard instead of one global cycle "
+                             "(0 defers to KUBE_BATCH_TPU_TENANCY; "
+                             "doc/TENANCY.md)")
+    parser.add_argument("--replica-federation", action="store_true",
+                        default=False,
+                        help="Active-active replicas: claim queue-shards "
+                             "via per-shard CAS leases in the shared "
+                             "store (replaces --leader-elect; requires "
+                             "--tenancy-shards and "
+                             "--lock-object-namespace)")
+    parser.add_argument("--shard-lease-duration", type=float, default=5.0,
+                        help="Per-shard lease duration in seconds; an "
+                             "orphaned shard is stolen within one "
+                             "duration of its owner's death")
     parser.add_argument("--jax-profile-dir", default="",
                         help="Capture a jax.profiler trace of each "
                              "session's device solve window into this "
@@ -123,4 +161,7 @@ def parse_options(argv=None) -> ServerOption:
         cluster_state=ns.cluster_state,
         warmup_buckets=ns.warmup_buckets,
         compile_cache_dir=ns.compile_cache_dir,
-        jax_profile_dir=ns.jax_profile_dir)
+        jax_profile_dir=ns.jax_profile_dir,
+        tenancy_shards=ns.tenancy_shards,
+        replica_federation=ns.replica_federation,
+        shard_lease_duration=ns.shard_lease_duration)

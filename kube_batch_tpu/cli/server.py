@@ -82,6 +82,9 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                            "echo, with time-to-bind",
         "/debug/tenants": "per-queue fairness: share vs deserved, "
                           "pending demand, starvation age",
+        "/debug/shards": "queue-shard tenancy: shard -> owner -> queues "
+                         "-> lease expiry, per-shard session counts "
+                         "(doc/TENANCY.md)",
         "/debug/topology": "per-pool fragmentation: free nodes, largest "
                            "contiguous free block, frag ratio, slice "
                            "placement outcomes",
@@ -115,6 +118,11 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             self._send_json(answer)
         elif path == "/debug/tenants":
             self._send_json(tenant_table.snapshot())
+        elif path == "/debug/shards":
+            from ..tenancy import shard_table
+            doc = shard_table.snapshot()
+            doc["rebalances"] = metrics.shard_rebalance_counts()
+            self._send_json(doc)
         elif path == "/debug/topology":
             from ..models.topology import topo_table
             doc = topo_table.snapshot()
@@ -125,6 +133,11 @@ class _MetricsHandler(BaseHTTPRequestHandler):
                              "capacity": flight_recorder.capacity,
                              "evictions_total":
                                  metrics.evictions_by_action(),
+                             # Mirror-memory accounting (ROADMAP item 1):
+                             # retained raw-doc delta baselines per
+                             # resource kind ({} for in-process caches).
+                             "wire_baseline_bytes":
+                                 metrics.wire_baseline_totals(),
                              "tracing_enabled":
                                  _trace_enabled()})
         elif path == "/debug/trace":
@@ -285,8 +298,16 @@ class ServerRuntime:
                 conf_str = f.read()
         self.scheduler = Scheduler(self.cache, scheduler_conf=conf_str,
                                    schedule_period=opt.schedule_period)
+        # Queue-shard tenancy by flag (doc/TENANCY.md): the env route
+        # (KUBE_BATCH_TPU_TENANCY) already built an engine inside the
+        # Scheduler; --tenancy-shards builds one here when it did not.
+        if opt.tenancy_shards and self.scheduler.tenancy is None:
+            from ..tenancy import ShardMap, TenancyEngine
+            self.scheduler.tenancy = TenancyEngine(
+                self.scheduler, ShardMap.from_env(opt.tenancy_shards))
         self.metrics_server: Optional[ThreadingHTTPServer] = None
         self.elector: Optional[LeaderElector] = None
+        self.shard_leases = None  # Optional[tenancy.ShardLeaseManager]
 
     def run(self) -> None:
         """server.go Run(): metrics endpoint, then leader-elect or start."""
@@ -309,7 +330,38 @@ class ServerRuntime:
                 self.warmup = SolverWarmup(
                     self._warmup_buckets, cfg=cfg,
                     cache_dir=self.opt.compile_cache_dir or None).start()
-        if self.opt.enable_leader_election:
+        if self.opt.replica_federation:
+            # Active-active federation (doc/TENANCY.md): no global
+            # leader — this replica claims queue-shards via per-shard
+            # CAS leases in the SHARED store and schedules exactly what
+            # it owns; the shard lease fences each shard's write egress
+            # the way the global write fence fences a lost leadership.
+            self.opt.check_option_or_die()
+            engine = self.scheduler.tenancy
+            if engine is None:
+                raise ValueError(
+                    "--replica-federation requires the tenancy engine: "
+                    "pass --tenancy-shards N (or KUBE_BATCH_TPU_TENANCY)")
+            if not (self._cluster_shared
+                    and hasattr(self.cluster, "cas_lease")):
+                raise ValueError(
+                    "replica federation needs a SHARED store for its "
+                    "shard leases (point every replica at one cluster "
+                    "edge via --master); a process-private store would "
+                    "elect this replica onto every shard in its own "
+                    "world")
+            from ..tenancy import ShardLeaseManager
+            duration = self.opt.shard_lease_duration
+            self.shard_leases = ShardLeaseManager(
+                self.cluster, self.opt.lock_object_namespace,
+                engine.map.num_shards,
+                lease_duration=duration,
+                renew_deadline=duration * 0.6,
+                retry_period=max(0.05, duration / 5.0))
+            engine.attach_leases(self.shard_leases)
+            self.shard_leases.start()
+            self.scheduler.run()
+        elif self.opt.enable_leader_election:
             self.opt.check_option_or_die()
             # The HA lock lives IN THE STORE whenever the cluster edge
             # supports leases (in-process simulator or the HTTP edge) —
@@ -383,6 +435,11 @@ class ServerRuntime:
         if self.elector is not None:
             self.elector.stop()
         self.scheduler.stop()
+        if self.shard_leases is not None:
+            # AFTER the loop stops (no further egress), release every
+            # owned shard so surviving replicas claim immediately
+            # instead of waiting out the expiry.
+            self.shard_leases.stop(release=True)
         recorder = getattr(self.cache, "event_recorder", None)
         if recorder is not None and hasattr(recorder, "stop"):
             recorder.stop()

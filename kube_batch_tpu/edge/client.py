@@ -173,6 +173,17 @@ class RemoteCluster:
         self._stop = threading.Event()
         self._threads = []
         self._synced: Dict[str, threading.Event] = {}
+        # Retained raw-doc baseline memory, per resource kind (ROADMAP
+        # item 1 accounting): the wire fast path keeps each mirror
+        # object's raw wire doc (`_wire_doc`, edge/codec.py) as its
+        # delta baseline — roughly one raw dict per pod.  Each frame's
+        # byte length approximates its doc's retained footprint; the
+        # running per-kind totals land on the
+        # ``kube_batch_wire_baseline_bytes{kind}`` gauge so the 1M-pod
+        # memory-budget work has a measurable target.  One int per
+        # resource, written only by that resource's reflector thread.
+        self._baseline_bytes: Dict[str, int] = {
+            r: 0 for r in _WATCHED}
 
     # -- ingest: reflectors -------------------------------------------------
 
@@ -254,7 +265,10 @@ class RemoteCluster:
                             with self.lock:
                                 for stale in [k for k in store
                                               if k not in replay_seen]:
-                                    informer.fire_delete(store.pop(stale))
+                                    gone = store.pop(stale)
+                                    self._note_baseline(resource, gone,
+                                                        None)
+                                    informer.fire_delete(gone)
                             replaying = False
                             self._synced[resource].set()
                             backoff = _WATCH_BACKOFF_BASE_S  # healthy again
@@ -294,6 +308,13 @@ class RemoteCluster:
                                            ingest_ts=frame_ts)
                         metrics.note_decode_seconds(
                             time.perf_counter() - t_dec)
+                        # Baseline footprint stamp: the retained
+                        # `_wire_doc` came from (roughly) this frame's
+                        # bytes; nothing is retained with the fast path
+                        # off.  Instance attribute like _ingest_ts —
+                        # dataclass __eq__ ignores it.
+                        if codec.wire_fast_enabled():
+                            obj._wire_nbytes = len(raw)
                         key = key_of(obj)
                         with self.lock:
                             if etype == "ADDED":
@@ -301,6 +322,7 @@ class RemoteCluster:
                                     replay_seen.add(key)
                                 old = store.get(key)
                                 store[key] = obj
+                                self._note_baseline(resource, old, obj)
                                 if old is None:
                                     informer.fire_add(obj)
                                 else:  # relist upsert of a known object
@@ -308,12 +330,14 @@ class RemoteCluster:
                             elif etype == "MODIFIED":
                                 old = store.get(key)
                                 store[key] = obj
+                                self._note_baseline(resource, old, obj)
                                 if old is None:
                                     informer.fire_add(obj)
                                 else:
                                     informer.fire_update(old, obj)
                             elif etype == "DELETED":
-                                store.pop(key, None)
+                                old = store.pop(key, None)
+                                self._note_baseline(resource, old, None)
                                 informer.fire_delete(obj)
                         if frame_rv is not None:  # applied successfully
                             last_rv = max(last_rv, int(frame_rv))
@@ -366,6 +390,23 @@ class RemoteCluster:
                        f"{', '.join(alive)})" if alive else ""))
         self._refresh_pvcs()
         return self
+
+    def _note_baseline(self, resource: str, old, new) -> None:
+        """Apply one mirror-store entry change (old -> new, either side
+        None) to the per-kind retained-baseline byte total and publish
+        the gauge.  Reflector thread only (each resource has exactly one
+        writer)."""
+        delta = (getattr(new, "_wire_nbytes", 0) if new is not None else 0) \
+            - (getattr(old, "_wire_nbytes", 0) if old is not None else 0)
+        if delta:
+            total = self._baseline_bytes.get(resource, 0) + delta
+            self._baseline_bytes[resource] = total
+            metrics.set_wire_baseline(resource, total)
+
+    def wire_baseline_bytes(self) -> Dict[str, int]:
+        """{kind: retained raw-doc baseline bytes} — the mirror-memory
+        accounting surfaced on /debug/sessions and the bench artifact."""
+        return dict(self._baseline_bytes)
 
     def _refresh_pvcs(self) -> None:
         """PVCs are list-only; _PvcStore refetches on a miss so claims

@@ -37,6 +37,11 @@ class Session:
     def __init__(self, cache):
         self.uid: str = str(uuid.uuid4())
         self.cache = cache
+        # Queue-shard scope (doc/TENANCY.md): the owning shard when this
+        # session runs over a tenancy ShardView, else None (the global
+        # engine).  Plugins use it to publish shard-SCOPED fairness rows
+        # (metrics/tenants.py) instead of wholesale table replaces.
+        self.shard = getattr(cache, "shard", None)
 
         self.jobs: Dict[str, JobInfo] = {}
         self.nodes: Dict[str, NodeInfo] = {}

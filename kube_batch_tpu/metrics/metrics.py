@@ -568,6 +568,45 @@ tenant_max_job_share = registry.register(Gauge(
     f"{SUBSYSTEM}_tenant_max_job_share",
     "Largest drf job share inside each queue at the last session open",
     ("queue",)))
+# Queue-shard tenancy engine + replica federation (kube_batch_tpu/
+# tenancy/, doc/TENANCY.md): which replica owns each queue-shard, how
+# old its lease is, every lease transition (claim | steal | release |
+# renew loss | fenced write), per-shard micro-session outcomes, bind
+# egress stamped with the owning replica, and the federation's
+# rebalance ledger (the bench artifact's shard_rebalances counter).
+shard_owner_info = registry.register(Gauge(
+    f"{SUBSYSTEM}_shard_owner_info",
+    "1 while the labeled replica owns the queue-shard (0 after it loses "
+    "or releases the lease)", ("shard", "replica")))
+shard_lease_age = registry.register(Gauge(
+    f"{SUBSYSTEM}_shard_lease_age_seconds",
+    "Seconds since the shard's lease record was last renewed at the "
+    "store (any holder)", ("shard",)))
+shard_lease_transitions = registry.register(Counter(
+    f"{SUBSYSTEM}_shard_lease_transitions_total",
+    "Shard lease state transitions (claim | steal | release | "
+    "renew_timeout | stolen_from | clock_skew | fenced_write)",
+    ("shard", "kind")))
+shard_sessions = registry.register(Counter(
+    f"{SUBSYSTEM}_shard_sessions_total",
+    "Shard-scoped micro-sessions run, by outcome (ok | error)",
+    ("shard", "result")))
+shard_binds = registry.register(Counter(
+    f"{SUBSYSTEM}_shard_binds_total",
+    "Bind egress per shard, stamped with the owning replica",
+    ("shard", "replica")))
+shard_rebalance = registry.register(Counter(
+    f"{SUBSYSTEM}_shard_rebalance_total",
+    "Shard ownership rebalances across the federation (claim | steal | "
+    "release | lost)", ("kind",)))
+# Wire-edge memory accounting (ROADMAP item 1, doc/INCREMENTAL.md "Wire
+# fast path"): raw-doc delta baselines (`_wire_doc`) retained by the
+# mirror stores, per resource kind — the measurable target of the
+# 1M-pod memory-budget work.
+wire_baseline = registry.register(Gauge(
+    "kube_batch_wire_baseline_bytes",
+    "Approximate bytes of raw wire-doc delta baselines retained by the "
+    "mirror stores, per resource kind", ("kind",)))
 # Topology / fragmentation SLO (models/topology.py, doc/TOPOLOGY.md):
 # per-pool fragmentation computed in the topo action's occupancy walk
 # and surfaced on /debug/topology + the bench-topo artifact.
@@ -988,6 +1027,92 @@ def onwork_values() -> Dict[str, float]:
     out["candidate_rows"] = candidate_rows.value()
     out["stage_rows"] = stage_rows_staged.value()
     return out
+
+
+# Shard ownership gauge bookkeeping: set_shard_owner flips the previous
+# holder's info row to 0 so exactly one (shard, replica) pair reads 1.
+# Multiple writers (each replica's lease thread in the in-process soak),
+# so the last-owner map takes a lock.
+_shard_owner_lock = threading.Lock()
+_shard_owner_last: Dict[str, str] = {}  # guarded-by: _shard_owner_lock
+
+
+def set_shard_owner(shard: int, replica: str) -> None:
+    s = str(shard)
+    # Gauge writes INSIDE the lock: concurrent publishers (every
+    # replica's lease thread reports store-observed ownership in the
+    # in-process soak) must see zero-the-old + one-the-new as a unit,
+    # or an interleaving leaves two replicas' rows at 1 — the lock is
+    # what makes "exactly one (shard, replica) pair reads 1" true.
+    with _shard_owner_lock:
+        prev = _shard_owner_last.get(s)
+        _shard_owner_last[s] = replica
+        if prev is not None and prev != replica:
+            shard_owner_info.set(0.0, s, prev)
+        shard_owner_info.set(1.0, s, replica)
+
+
+def clear_shard_owner(shard: int, replica: str) -> None:
+    """The replica lost/released the shard; zero its info row (the next
+    owner's set_shard_owner publishes the replacement)."""
+    s = str(shard)
+    with _shard_owner_lock:
+        if _shard_owner_last.get(s) == replica:
+            _shard_owner_last.pop(s, None)
+        shard_owner_info.set(0.0, s, replica)
+
+
+def set_shard_lease_age(shard: int, age_s: float) -> None:
+    shard_lease_age.set(round(float(age_s), 3), str(shard))
+
+
+def note_shard_lease(shard: int, kind: str) -> None:
+    shard_lease_transitions.inc(1.0, str(shard), kind)
+
+
+def note_shard_rebalance(kind: str) -> None:
+    shard_rebalance.inc(1.0, kind)
+
+
+def shard_rebalance_counts() -> Dict[str, int]:
+    """{kind: count} so far — bench artifact + replica soak."""
+    return {labels[0]: int(v)
+            for labels, v in shard_rebalance.values().items() if labels}
+
+
+def note_shard_session(shard: int, result: str) -> None:
+    shard_sessions.inc(1.0, str(shard), result)
+
+
+def shard_session_counts() -> Dict[str, int]:
+    """{"shard/result": count} so far — soak + tests."""
+    return {f"{labels[0]}/{labels[1]}": int(v)
+            for labels, v in shard_sessions.values().items()
+            if len(labels) == 2}
+
+
+def note_shard_binds(shard: int, replica: str, count: int) -> None:
+    if count:
+        shard_binds.inc(float(count), str(shard), replica)
+
+
+def shard_bind_counts() -> Dict[str, int]:
+    """{"shard/replica": binds} so far — the replica soak's stamped
+    bind-egress ledger."""
+    return {f"{labels[0]}/{labels[1]}": int(v)
+            for labels, v in shard_binds.values().items()
+            if len(labels) == 2}
+
+
+def set_wire_baseline(kind: str, nbytes: int) -> None:
+    wire_baseline.set(float(max(0, nbytes)), kind)
+
+
+def wire_baseline_totals() -> Dict[str, int]:
+    """{kind: retained baseline bytes} — /debug/sessions meta + the
+    bench wire artifact (ROADMAP item 1's memory-budget target)."""
+    return {labels[0]: int(v)
+            for labels, v in wire_baseline.values().items() if labels}
 
 
 _topo_pools_seen: set = set()  # single writer: the scheduling thread's topo action

@@ -142,7 +142,12 @@ class DrfPlugin(Plugin):
                 if q_cur is None or attr.share > q_cur:
                     q_max[job.queue] = attr.share
         from ..metrics.tenants import tenant_table
-        tenant_table.note_drf_job_shares(q_max)
+        # Shard-scoped sessions merge over their own queue universe —
+        # the shard map's membership test, so deleted queues still
+        # depart (doc/TENANCY.md): see the proportion open's publish.
+        universe = (ssn.cache.owns_queue if getattr(ssn, "shard", None)
+                    is not None else None)
+        tenant_table.note_drf_job_shares(q_max, universe=universe)
 
         def preemptable_fn(preemptor: TaskInfo,
                            preemptees: List[TaskInfo]) -> List[TaskInfo]:

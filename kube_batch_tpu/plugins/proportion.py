@@ -217,7 +217,15 @@ class ProportionPlugin(Plugin):
                 "starvation_s": round(starvation, 3),
                 "starved": bool(pend[0]) and attr.share < 1.0,
             }
-        tenant_table.publish(rows, session_uid=ssn.uid)
+        # Shard-scoped sessions (doc/TENANCY.md) publish a MERGE over
+        # their own queue universe: shard A's table write must not zero
+        # shard B's gauges the way a wholesale replace would.  The
+        # universe is the shard map's MEMBERSHIP TEST, not the session's
+        # queue set — a deleted queue is in no session's queues but its
+        # stale row is still this shard's departure to zero.
+        universe = (ssn.cache.owns_queue if getattr(ssn, "shard", None)
+                    is not None else None)
+        tenant_table.publish(rows, session_uid=ssn.uid, universe=universe)
 
         def queue_order_fn(l: QueueInfo, r: QueueInfo) -> int:
             ls = self.queue_attrs[l.uid].share

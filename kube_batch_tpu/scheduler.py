@@ -140,6 +140,7 @@ class Scheduler:
         # full (non-incremental) rebuild so micro-session drift cannot
         # accumulate unrevalidated (models/incremental.py).
         self._cycles_since_full = 0
+        self._force_full_pending = False  # consumed by the tenancy engine
         try:
             self._max_backoff = float(os.environ.get(
                 MAX_CYCLE_BACKOFF_ENV, _DEF_MAX_CYCLE_BACKOFF_S))
@@ -153,6 +154,15 @@ class Scheduler:
         # Log<->trace correlation: every loop record carries [s=<id>]
         # while a traced session is active (doc/OBSERVABILITY.md).
         trace.install_log_correlation()
+        # Queue-shard tenancy engine (kube_batch_tpu/tenancy/,
+        # doc/TENANCY.md): when KUBE_BATCH_TPU_TENANCY asks for shards,
+        # run_once pipelines one shard-scoped micro-session per dirty
+        # shard instead of one global cycle.  None = the single global
+        # engine (the bit-parity control arm).  Embedders (ServerRuntime
+        # federation wiring, the replica soak) may replace it with an
+        # engine carrying a ShardLeaseManager.
+        from .tenancy import engine_from_env
+        self.tenancy = engine_from_env(self)
 
     def _log_cycle_error(self, stage: str) -> None:
         """Count and log a swallowed loop exception.  The counter moves on
@@ -180,7 +190,19 @@ class Scheduler:
                   "but not re-logged):\n%s", stage, traceback.format_exc())
 
     def run_once(self) -> None:
-        """One scheduling cycle (scheduler.go:88-102).
+        """One scheduling cycle (scheduler.go:88-102): the global
+        session, or — with the tenancy engine active — one shard-scoped
+        micro-session per dirty shard (doc/TENANCY.md)."""
+        if self.tenancy is not None:
+            force_full, self._force_full_pending = \
+                self._force_full_pending, False
+            self.tenancy.run_cycle(force_full=force_full)
+            return
+        self.session_once(self.cache)
+
+    def session_once(self, cache, shard=None) -> None:
+        """One scheduling session over ``cache`` (the whole cluster, or
+        a tenancy ShardView scoping it to one queue-shard).
 
         The cyclic GC pauses while a cycle runs: a 50k-task session creates
         millions of (acyclic — refcount-freed) objects, and collector scans
@@ -194,10 +216,12 @@ class Scheduler:
         trace.begin_session(actions=[a.name() for a in self.actions])
         try:
             with trace.span("open_session"):
-                ssn = open_session(self.cache, self.tiers)
+                ssn = open_session(cache, self.tiers)
             trace.set_uid(ssn.uid)
             trace.set_meta(jobs=len(ssn.jobs), nodes=len(ssn.nodes),
                            queues=len(ssn.queues))
+            if shard is not None:
+                trace.set_meta(shard=shard)
             try:
                 for action in self.actions:
                     action_start = time.time()
@@ -234,6 +258,11 @@ class Scheduler:
             if force_full:
                 from .models import incremental
                 incremental.request_full(self.cache)
+                # The tenancy engine reads (and clears) this flag to run
+                # its full pass; a flag instead of a run_once kwarg so
+                # test doubles that replace run_once with a bare
+                # callable keep working.
+                self._force_full_pending = True
             self.run_once()
         except Exception:  # loop must survive a bad cycle
             ok = False

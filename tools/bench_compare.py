@@ -84,6 +84,22 @@ GATED_KEYS = {
     "floors_ms.plugin_close": {
         "path": ("floors_ms", "plugin_close"), "direction": "down",
         "band": 3.0, "abs_slack": 5.0},
+    # Queue-shard tenancy pacing (doc/TENANCY.md): per-tenant
+    # micro-session rates under the asymmetric noisy/quiet churn split.
+    # The QUIET tenant's rate is the isolation promise — the noisy
+    # tenant's storm must not drag it down; the rebalance counter is
+    # deterministic and must stay ZERO in a steady single-replica run
+    # (rebalances only happen in federation failover), so it runs with
+    # no band at all.
+    "tenancy_noisy_sps": {
+        "path": ("tenancy", "sessions_per_sec", "noisy"),
+        "direction": "up", "band": 0.6, "abs_slack": 0.0},
+    "tenancy_quiet_sps": {
+        "path": ("tenancy", "sessions_per_sec", "quiet"),
+        "direction": "up", "band": 0.6, "abs_slack": 0.0},
+    "tenancy_shard_rebalances": {
+        "path": ("tenancy", "shard_rebalances"), "direction": "down",
+        "band": 0.0, "abs_slack": 0.0},
     # Full-bench keys: absent from steady-only artifacts (so they never
     # enter the bench-gate baseline) but extracted into the trajectory
     # when a full 50k-shape run is appended — the cross-PR history the

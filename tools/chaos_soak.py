@@ -437,7 +437,13 @@ def run_soak(seeds, *, nodes: int = 8, cycles: int = 10,
                   # view build; boosted so label corruption demonstrably
                   # degrades nodes (not cycles) every sweep
                   # (doc/CHAOS.md, doc/TOPOLOGY.md).
-                  ("topology.bad_coords", min(1.0, rate * 1.6)))
+                  ("topology.bad_coords", min(1.0, rate * 1.6)),
+                  # Shard-lease sites (doc/TENANCY.md): inert here —
+                  # this soak runs the single global engine — but kept
+                  # in the rate table so a tenancy-enabled soak inherits
+                  # damped lease churn; tools/replica_soak.py is the
+                  # harness that activates them.
+                  ("lease.*", min(rate, 0.5) * 0.4))
     seed_results = []
     sites_union = set()
     for seed in seeds:

@@ -1,0 +1,466 @@
+"""Replica-federation convergence soak (doc/TENANCY.md).
+
+Drives 2-3 ACTIVE-ACTIVE scheduler replicas in one process — each with
+its own SchedulerCache + Scheduler + TenancyEngine over ONE shared truth
+store, each claiming queue-shards via per-shard CAS leases
+(tenancy/leases.ShardLeaseManager; with ``--edge`` the last replica
+speaks to the store over a real ApiServer + RemoteCluster wire, leases
+included) — through seeded churn, an optional seeded lease-fault phase
+(chaos sites ``lease.cas_conflict`` / ``lease.clock_skew``), and a
+MID-RUN REPLICA KILL (crash semantics: the dead replica's leases are NOT
+released and must expire), then asserts the federation contract:
+
+  * no bind is ever ACCEPTED by the truth store for an already-bound pod
+    (rejected duplicate POSTs — the 409 backstop working — are recorded
+    and legal);
+  * every orphaned shard is reclaimed by a survivor within one lease
+    duration (+ one retry tick of scheduling slack);
+  * fairness holds across replica boundaries: after convergence every
+    queue's demand is fully bound, regardless of which replica owned its
+    shard when;
+  * the adopting replica's first sessions on the stolen shards are
+    served by the shared compile cache — the hit counter moves, the miss
+    counter does NOT (failover never pays a fresh XLA compile);
+  * bind egress is stamped with the owning replica
+    (kube_batch_shard_binds_total) and ownership is queryable end to end
+    (shard_owner_info / /debug/shards rows).
+
+Always prints exactly one JSON artifact line; exits nonzero on any
+violated invariant (CI gates on it via ``make soak-replicas``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import pathlib
+import random
+import sys
+import threading
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+os.environ.setdefault("KUBE_BATCH_TPU_SCAN_MIN_NODES", "0")
+
+from kube_batch_tpu.api.objects import (Container, Node, NodeSpec,  # noqa: E402
+                                        NodeStatus, ObjectMeta, Pod,
+                                        PodSpec, PodStatus)
+from kube_batch_tpu.apis.scheduling import v1alpha1  # noqa: E402
+from kube_batch_tpu.cache import Cluster, new_scheduler_cache  # noqa: E402
+from kube_batch_tpu.chaos import plan as chaos_plan  # noqa: E402
+from kube_batch_tpu.metrics.metrics import (compile_cache_counts,  # noqa: E402
+                                            shard_bind_counts,
+                                            shard_rebalance_counts,
+                                            shard_session_counts)
+from kube_batch_tpu.scheduler import Scheduler  # noqa: E402
+from kube_batch_tpu.tenancy import (ShardLeaseManager, ShardMap,  # noqa: E402
+                                    TenancyEngine)
+
+
+def _mk_pod(name, group, ns="soak", cpu="1", mem="1Gi"):
+    return Pod(
+        metadata=ObjectMeta(
+            name=name, namespace=ns,
+            annotations={v1alpha1.GroupNameAnnotationKey: group}),
+        spec=PodSpec(node_name="",
+                     containers=[Container(
+                         requests={"cpu": cpu, "memory": mem})]),
+        status=PodStatus(phase="Pending"))
+
+
+def _submit_job(cluster, name, replicas, queue, ns="soak"):
+    cluster.create_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name=name, namespace=ns),
+        spec=v1alpha1.PodGroupSpec(min_member=replicas, queue=queue)))
+    for i in range(replicas):
+        cluster.create_pod(_mk_pod(f"{name}-{i}", name, ns=ns))
+
+
+class TruthMonitor:
+    """Double-bind detector at the truth store (the chaos_soak pattern):
+    an ACCEPTED re-bind is a violation, a REJECTED one (the store's 409
+    path) is the backstop doing its job."""
+
+    def __init__(self, cluster: Cluster):
+        self.violations: list = []
+        self.binds: list = []
+        self.rejected_rebinds: list = []
+        orig_bind = cluster.bind_pod
+
+        def checked_bind(ns, name, hostname):
+            key = f"{ns}/{name}"
+            with cluster.lock:
+                pod = cluster.pods.get(key)
+                existing = pod.spec.node_name if pod is not None else None
+            try:
+                result = orig_bind(ns, name, hostname)
+            except Exception:
+                if existing:
+                    self.rejected_rebinds.append((key, existing, hostname))
+                raise
+            if existing:
+                self.violations.append(
+                    f"double bind ACCEPTED: {key} already on {existing}, "
+                    f"re-bound to {hostname}")
+            self.binds.append((key, hostname, time.time()))
+            return result
+
+        cluster.bind_pod = checked_bind
+
+
+class Replica:
+    """One active-active scheduler replica: cache + scheduler + tenancy
+    engine + shard lease manager, driven by its own loop thread."""
+
+    def __init__(self, name: str, truth: Cluster, shard_map: ShardMap,
+                 lease_duration: float, target_shards: int,
+                 edge: bool = False, period: float = 0.15):
+        self.name = name
+        self.period = period
+        self._server = self._remote = None
+        if edge:
+            from kube_batch_tpu.edge import ApiServer, RemoteCluster
+            self._server = ApiServer(truth).start()
+            self._remote = RemoteCluster(self._server.url).start()
+            store = self._remote
+        else:
+            store = truth
+        self.cache = new_scheduler_cache(store)
+        self.scheduler = Scheduler(self.cache, schedule_period=3600)
+        self.leases = ShardLeaseManager(
+            store, "soak", shard_map.num_shards, identity=name,
+            lease_duration=lease_duration,
+            renew_deadline=lease_duration * 0.6,
+            retry_period=max(0.02, lease_duration / 10.0),
+            target_shards=target_shards)
+        self.engine = TenancyEngine(self.scheduler, shard_map,
+                                    lease_mgr=self.leases)
+        self.scheduler.tenancy = self.engine
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True,
+                                        name=f"replica-{name}")
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self.scheduler.cycle()
+            self._stop.wait(self.period)
+
+    def start(self) -> "Replica":
+        self.leases.start()
+        self._thread.start()
+        return self
+
+    def owned(self):
+        return self.leases.owned_shards()
+
+    def kill(self) -> None:
+        """Crash semantics: the loop dies, the leases are NOT released —
+        survivors must wait out the expiry and steal."""
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self.leases.stop(release=False)
+        self._teardown_edge()
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        self.leases.stop(release=True)
+        self._teardown_edge()
+
+    def _teardown_edge(self) -> None:
+        if self._remote is not None:
+            self._remote.stop()
+            self._remote = None
+        if self._server is not None:
+            self._server.stop()
+            self._server = None
+
+
+def run_soak(*, replicas: int = 3, shards: int = 3, nodes: int = 12,
+             churn_rounds: int = 20, seed: int = 1,
+             lease_duration: float = 1.5, edge: bool = False,
+             lease_chaos_rate: float = 0.15) -> dict:
+    truth = Cluster()
+    monitor = TruthMonitor(truth)
+    queues = [f"q{i}" for i in range(shards)]
+    shard_map = ShardMap(shards, {q: i for i, q in enumerate(queues)})
+    for q in queues:
+        truth.create_queue(v1alpha1.Queue(
+            metadata=ObjectMeta(name=q),
+            spec=v1alpha1.QueueSpec(weight=1)))
+    for i in range(nodes):
+        alloc = {"cpu": "2", "memory": "4Gi", "pods": 110}
+        truth.create_node(Node(
+            metadata=ObjectMeta(name=f"node-{i:03d}", uid=f"node-{i:03d}"),
+            spec=NodeSpec(),
+            status=NodeStatus(allocatable=alloc, capacity=dict(alloc))))
+    # Base demand: per queue, two 2-member gangs = 4 cpu/queue, well
+    # under nodes*2 total so every pod MUST eventually bind (the
+    # cross-replica fairness invariant below).
+    expected = {}
+    for qi, q in enumerate(queues):
+        for g in range(2):
+            _submit_job(truth, f"base-{qi}-{g}", 2, q)
+            expected[q] = expected.get(q, 0) + 2
+
+    target = max(1, (shards + replicas - 1) // replicas)
+    fleet = [Replica(f"rep-{i}", truth, shard_map, lease_duration, target,
+                     edge=(edge and i == replicas - 1))
+             for i in range(replicas)]
+    problems: list = []
+    rng = random.Random(seed)
+    try:
+        for rep in fleet:
+            rep.start()
+
+        def owned_union():
+            out = set()
+            for rep in fleet:
+                if not rep._stop.is_set():
+                    out.update(rep.owned())
+            return out
+
+        def unbound():
+            with truth.lock:
+                return [k for k, p in truth.pods.items()
+                        if not p.spec.node_name]
+
+        deadline = time.time() + 10 * lease_duration
+        while len(owned_union()) < shards and time.time() < deadline:
+            time.sleep(0.05)
+        if len(owned_union()) < shards:
+            problems.append(
+                f"federation never covered all shards: {sorted(owned_union())}")
+
+        # Warm-up barrier: the base demand binds (every shard solved and
+        # compiled its bucket) before churn and the fault phase begin.
+        deadline = time.time() + 60
+        while unbound() and time.time() < deadline:
+            time.sleep(0.05)
+        if unbound():
+            problems.append("base demand never bound during warm-up")
+
+        # Seeded churn, optionally under seeded lease faults: create a
+        # gang in a random queue each round, retire an old churn gang
+        # two rounds later (its pods are deleted at truth).
+        if lease_chaos_rate > 0:
+            # Budgeted: the seeded lease-fault storm exercises the CAS
+            # conflict and clock-skew abandon paths, then drains so the
+            # churn phase also observes fault-free renewals.
+            chaos_plan.install(chaos_plan.FaultPlan(
+                seed=seed, rate=lease_chaos_rate, budget=40,
+                sites=("lease.cas_conflict", "lease.clock_skew")))
+        retire = []
+        kill_at = churn_rounds // 2
+        killed = None
+        kill_t = orphaned = None
+        miss_before_kill = hits_before_kill = None
+        reclaim_s = None
+        for rnd in range(churn_rounds):
+            # Round-robin queue choice keeps every shard's session shape
+            # inside the bucket envelope it reached BEFORE the kill (the
+            # first pass over the queues maxes each one out), so the
+            # zero-fresh-compile failover assertion below measures
+            # FAILOVER, not a churn-driven bucket crossing.  The rng
+            # seeds the inter-round timing jitter instead.
+            q = queues[rnd % len(queues)]
+            name = f"churn-{rnd}"
+            # Retire BEFORE submitting: the retiree is this same queue's
+            # previous churn gang (round r-3, same residue), so the
+            # queue's job count never transiently exceeds its envelope —
+            # a mid-round snapshot cannot cross a bucket boundary.
+            if len(retire) >= len(queues):
+                old, oq = retire.pop(0)
+                for i in range(2):
+                    try:
+                        truth.delete_pod("soak", f"{old}-{i}")
+                    except KeyError:
+                        pass
+                truth.delete_pod_group("soak", old)
+                expected[oq] -= 2
+            _submit_job(truth, name, 2, q)
+            expected[q] = expected.get(q, 0) + 2
+            retire.append((name, q))
+            if rnd == kill_at:
+                # Catch-up barrier: every shape churn has produced so
+                # far must be solved (and its executable compiled)
+                # before the baseline counters are recorded — the
+                # zero-fresh-compile assertion measures the ADOPTION,
+                # not a pre-kill compile still in flight.
+                deadline = time.time() + 60
+                while unbound() and time.time() < deadline:
+                    time.sleep(0.05)
+                # Lease faults stop before the kill so the reclaim
+                # clock below measures failover, not injected conflict.
+                chaos_plan.disable()
+                killed = fleet[0]
+                orphaned = set(killed.owned())
+                hits_before_kill, miss_before_kill = \
+                    compile_cache_counts()
+                survivors = [r for r in fleet if r is not killed]
+                kill_t = time.time()
+                killed.kill()
+                # Reclaim watcher: sample the survivors' ownership from
+                # the moment of the kill so reclaim_s measures the steal
+                # itself, not when the churn loop got around to looking.
+                reclaim_box: dict = {}
+
+                def _watch_reclaim():
+                    while time.time() - kill_t < 60.0:
+                        holders = set()
+                        for rep in survivors:
+                            holders.update(rep.owned())
+                        if orphaned <= holders:
+                            reclaim_box["s"] = time.time() - kill_t
+                            return
+                        time.sleep(0.02)
+
+                watcher = threading.Thread(target=_watch_reclaim,
+                                           daemon=True)
+                watcher.start()
+            time.sleep(0.08 + rng.random() * 0.04)
+        chaos_plan.disable()
+
+        if killed is None:
+            problems.append("kill phase never ran (too few churn rounds)")
+        else:
+            retry = killed.leases.retry_period
+            # One lease duration is the failover contract; the slack
+            # covers lease ticks and GIL contention from the other
+            # replicas' live sessions (one process impersonating a
+            # fleet; the edge leg adds reflector + HTTP threads).
+            slack = 4 * retry + (4.0 if edge else 2.0)
+            deadline = kill_t + lease_duration + slack
+            while "s" not in reclaim_box and time.time() < deadline:
+                time.sleep(0.02)
+            reclaim_s = reclaim_box.get("s")
+            if reclaim_s is None:
+                holders = set()
+                for rep in survivors:
+                    holders.update(rep.owned())
+                problems.append(
+                    f"orphaned shards {sorted(orphaned - holders)} not "
+                    f"reclaimed within one lease duration "
+                    f"({lease_duration}s + {slack:.1f}s slack) of the kill")
+
+        # Convergence: every queue's remaining demand fully bound at
+        # truth, across replica boundaries.
+        deadline = time.time() + 60 * (2 if edge else 1)
+        while unbound() and time.time() < deadline:
+            time.sleep(0.1)
+        leftovers = unbound()
+        if leftovers:
+            problems.append(
+                f"{len(leftovers)} pods never bound after convergence "
+                f"wait (cross-replica fairness broke): "
+                f"{sorted(leftovers)[:6]}")
+
+        # Warm-failover contract: the adoption window paid ZERO fresh
+        # XLA compiles and the hit counter moved (the adopted shard's
+        # first sessions ran against already-compiled executables).
+        hits_after, miss_after = compile_cache_counts()
+        if killed is not None and miss_before_kill is not None:
+            if miss_after != miss_before_kill:
+                problems.append(
+                    f"failover paid {miss_after - miss_before_kill} fresh "
+                    "XLA compiles (the shared compile cache did not cover "
+                    "the adopted shards)")
+            if hits_after <= hits_before_kill:
+                problems.append(
+                    "no compile-cache hits recorded after the kill — the "
+                    "adoption window scheduled nothing (vacuous failover)")
+
+        # Per-queue bound counts at truth == expected demand.
+        with truth.lock:
+            bound_by_queue: dict = {}
+            pgq = {k.split("/", 1)[1]: pg.spec.queue
+                   for k, pg in truth.pod_groups.items()}
+            for key, pod in truth.pods.items():
+                if not pod.spec.node_name:
+                    continue
+                group = (pod.metadata.annotations or {}).get(
+                    v1alpha1.GroupNameAnnotationKey, "")
+                q = pgq.get(group)
+                if q:
+                    bound_by_queue[q] = bound_by_queue.get(q, 0) + 1
+        for q, want in expected.items():
+            if bound_by_queue.get(q, 0) != want:
+                problems.append(
+                    f"queue {q}: {bound_by_queue.get(q, 0)} bound vs "
+                    f"{want} expected (per-tenant demand not met)")
+
+        problems.extend(monitor.violations)
+        stamped = shard_bind_counts()
+        if not stamped:
+            problems.append("no bind egress was stamped with an owning "
+                            "replica (kube_batch_shard_binds_total empty)")
+        return {
+            "replicas": replicas,
+            "shards": shards,
+            "edge": edge,
+            "lease_duration_s": lease_duration,
+            "churn_rounds": churn_rounds,
+            "seed": seed,
+            "binds": len(monitor.binds),
+            "rejected_rebinds": len(monitor.rejected_rebinds),
+            "orphaned_shards": sorted(orphaned or ()),
+            "reclaim_s": (round(reclaim_s, 3)
+                          if reclaim_s is not None else None),
+            "bound_by_queue": bound_by_queue,
+            "expected_by_queue": expected,
+            "shard_sessions": shard_session_counts(),
+            "shard_binds": stamped,
+            "rebalances": shard_rebalance_counts(),
+            "compile_cache": {"hits_before_kill": hits_before_kill,
+                              "misses_before_kill": miss_before_kill,
+                              "hits_after": hits_after,
+                              "misses_after": miss_after},
+            "problems": problems,
+            "ok": not problems,
+        }
+    finally:
+        chaos_plan.disable()
+        for rep in fleet:
+            if not rep._stop.is_set():
+                rep.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--replicas", type=int, default=3)
+    parser.add_argument("--shards", type=int, default=3)
+    parser.add_argument("--nodes", type=int, default=12)
+    parser.add_argument("--churn-rounds", type=int, default=20)
+    parser.add_argument("--seed", type=int, default=1)
+    parser.add_argument("--lease-duration", type=float, default=1.5)
+    parser.add_argument("--lease-chaos-rate", type=float, default=0.15,
+                        help="seeded lease.cas_conflict/clock_skew rate "
+                             "during the churn phase (0 disables)")
+    parser.add_argument("--edge", action="store_true",
+                        help="run the last replica over ApiServer + "
+                             "RemoteCluster (leases ride the wire too)")
+    parser.add_argument("--json", type=str, default="",
+                        help="also write the artifact to this path")
+    args = parser.parse_args(argv)
+
+    artifact = run_soak(replicas=args.replicas, shards=args.shards,
+                        nodes=args.nodes, churn_rounds=args.churn_rounds,
+                        seed=args.seed, lease_duration=args.lease_duration,
+                        edge=args.edge,
+                        lease_chaos_rate=args.lease_chaos_rate)
+    line = json.dumps(artifact, sort_keys=True)
+    print(line)
+    if args.json:
+        pathlib.Path(args.json).write_text(line + "\n")
+    if not artifact["ok"]:
+        print("REPLICA SOAK FAILED:", file=sys.stderr)
+        for problem in artifact["problems"]:
+            print(f"  - {problem}", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,9 +3,9 @@ PYTHON ?= python
 COMPILE_CACHE ?= $(CURDIR)/.compile-cache
 
 .PHONY: lint lint-inventory test bench bench-cached bench-steady \
-	bench-evict bench-churn bench-wire bench-shard bench-topo \
-	bench-gate bench-gate-baseline lineage-ab chaos chaos-smoke \
-	scenarios soak-replicas trace-demo clean-cache
+	bench-evict bench-commit bench-churn bench-wire bench-shard \
+	bench-topo bench-gate bench-gate-baseline lineage-ab chaos \
+	chaos-smoke scenarios soak-replicas trace-demo clean-cache
 
 # The bench-gate shape: small enough for CI, big enough that the steady
 # path, delta shipping, and the residual floors all exercise (mirrors
@@ -65,6 +65,21 @@ bench-evict:
 		BENCH_NODES=256 BENCH_JOBS=80 BENCH_QUEUES=4 \
 		KUBE_BATCH_TPU_SCAN_MIN_NODES=0 $(PYTHON) bench.py \
 		| $(PYTHON) tools/check_evict_ab.py
+
+# Batched-vs-sequential commit/apply A/B smoke at a small CPU shape
+# (doc/EVICTION.md "Batched commit"): runs the 4-action storm pipeline
+# with KUBE_BATCH_TPU_BATCH_COMMIT on and off (two back-to-back
+# sessions per run, so the truth mirror's dict-order side effects feed
+# the second snapshot), asserts bit-identical victims, victim order,
+# binds and events, that the batched arm actually flushed, and prints
+# both arms' commit/apply floors.  The checker exits nonzero on a
+# parity break or a vacuous run (bench.py itself always exits 0), so
+# CI fails loudly.
+bench-commit:
+	env JAX_PLATFORMS=cpu BENCH_COMMIT_AB=1 BENCH_TASKS=2000 \
+		BENCH_NODES=256 BENCH_JOBS=80 BENCH_QUEUES=4 \
+		KUBE_BATCH_TPU_SCAN_MIN_NODES=0 $(PYTHON) bench.py \
+		| $(PYTHON) tools/check_commit_ab.py
 
 # Incremental-vs-control churn sweep at a small CPU shape
 # (doc/INCREMENTAL.md): runs 0.1% / 1% / 10% churn — plus one

@@ -892,6 +892,122 @@ def measure_action_pipeline(n_tasks, n_nodes, n_jobs, n_queues,
     }
 
 
+def measure_commit_ab(n_tasks, n_nodes, n_jobs, n_queues, cycles: int = 2,
+                      inner_cycles: int = 2):
+    """Same-box counterbalanced batched-vs-sequential COMMIT/APPLY A/B
+    (doc/EVICTION.md "Batched commit"; the ``make bench-commit`` CI gate
+    via tools/check_commit_ab.py).
+
+    Per pair of ``cycles``, one storm run (the shipped 4-action conf on
+    a fresh deterministic make_churn_cache, ``inner_cycles`` sessions
+    back-to-back so the mirror's dict-order side effects feed the next
+    snapshot) runs with KUBE_BATCH_TPU_BATCH_COMMIT=1 (per-action
+    flush + columnar apply, the shipped default) and one with =0 (the
+    per-task sequential control), in off/on/on/off order.  Parity is
+    the hard gate: ordered victim sequence, binds AND the cache event
+    stream must be bit-identical across arms.  Reported per arm: the
+    ``commit``/``apply`` cycle-floor medians (the post-solve tail the
+    tentpole vectorizes) and the per-action wall medians; the batched
+    arm's flush-counter delta rides along (the checker requires >= 1
+    batched flush — the engine must actually have flushed)."""
+    from kube_batch_tpu.framework import close_session, open_session
+    from kube_batch_tpu.framework.commit import BATCH_COMMIT_ENV
+    from kube_batch_tpu.metrics.metrics import (commit_flush_counts,
+                                                cycle_floor_values)
+    from kube_batch_tpu.models.synthetic import make_churn_cache
+    from kube_batch_tpu.scheduler import load_scheduler_conf
+
+    _register()
+    conf_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "config", "kube-batch-conf.yaml")
+    with open(conf_path) as fh:
+        conf = fh.read().replace('"reclaim, allocate, backfill, preempt"',
+                                 '"reclaim, tpu-allocate, backfill, '
+                                 'preempt"')
+    actions, tiers = load_scheduler_conf(conf)
+
+    def one_run():
+        cache, binder = make_churn_cache(n_tasks, n_nodes, n_jobs, n_queues)
+        floors = []
+        action_ms: dict = {}
+        with _gc_posture():
+            for _ in range(inner_cycles):
+                ssn = open_session(cache, tiers)
+                for a in actions:
+                    t0 = time.perf_counter()
+                    a.execute(ssn)
+                    action_ms.setdefault(a.name(), []).append(
+                        (time.perf_counter() - t0) * 1e3)
+                close_session(ssn)
+                fl = cycle_floor_values()
+                floors.append((fl.get("commit", 0.0), fl.get("apply", 0.0)))
+        return (list(cache.evictor.evicts), dict(binder.binds),
+                list(cache.events), floors, action_ms)
+
+    prior = os.environ.get(BATCH_COMMIT_ENV)
+    per_arm: dict = {True: [], False: []}
+    footprint: dict = {}
+    flushes0 = flushes1 = None
+    try:
+        for arm in (True, False):  # absorb both arms' jit compiles
+            os.environ[BATCH_COMMIT_ENV] = "1" if arm else "0"
+            one_run()
+        arms = [False, True, True, False] * ((cycles + 1) // 2)
+        flushes0 = commit_flush_counts()
+        for arm in arms[:2 * cycles]:
+            os.environ[BATCH_COMMIT_ENV] = "1" if arm else "0"
+            evicts, binds, events, floors, action_ms = one_run()
+            per_arm[arm].append((floors, action_ms))
+            footprint.setdefault(arm, (evicts, binds, events))
+        flushes1 = commit_flush_counts()
+    finally:
+        if prior is None:
+            os.environ.pop(BATCH_COMMIT_ENV, None)
+        else:
+            os.environ[BATCH_COMMIT_ENV] = prior
+
+    def arm_stats(runs):
+        commits = [f[0] for floors, _a in runs for f in floors]
+        applies = [f[1] for floors, _a in runs for f in floors]
+        acts: dict = {}
+        for _floors, action_ms in runs:
+            for name, vals in action_ms.items():
+                acts.setdefault(name, []).extend(vals)
+        return {
+            "commit_ms": round(statistics.median(commits), 3),
+            "apply_ms": round(statistics.median(applies), 3),
+            "actions_ms": {name: round(statistics.median(vals), 2)
+                           for name, vals in acts.items()},
+        }
+
+    batched = arm_stats(per_arm[True])
+    sequential = arm_stats(per_arm[False])
+    evicts_b = footprint[True][0]
+    parity = footprint[True] == footprint[False]
+    assert evicts_b, "commit A/B storm evicted nothing"
+    flush_delta = {k: flushes1.get(k, 0) - flushes0.get(k, 0)
+                   for k in flushes1}
+    flush_delta = {k: v for k, v in flush_delta.items() if v}
+
+    def speed(a, b):
+        return round(a / b, 2) if b else None
+
+    return {
+        "batched": batched,
+        "sequential": sequential,
+        "speedup": {
+            "commit": speed(sequential["commit_ms"], batched["commit_ms"]),
+            "apply": speed(sequential["apply_ms"], batched["apply_ms"]),
+            "commit_apply": speed(
+                sequential["commit_ms"] + sequential["apply_ms"],
+                batched["commit_ms"] + batched["apply_ms"]),
+        },
+        "evictions": len(evicts_b),
+        "flushes": flush_delta,
+        "parity": parity,
+    }
+
+
 def measure_shard_ab(n_tasks, n_nodes, n_jobs, n_queues, cycles: int = 2):
     """Same-box counterbalanced sharded-vs-single-chip A/B on the
     virtual device mesh (doc/SHARDING.md; the ``make bench-shard`` CI
@@ -1733,7 +1849,19 @@ def _fill_action_ab(out, n_tasks, n_nodes, n_jobs, n_queues,
 def _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline,
          steady_only=False, steady_rounds_n=5, evict_only=False,
          churn_only=False, shard_only=False, lineage_only=False,
-         topo_only=False, wire_only=False):
+         topo_only=False, wire_only=False, commit_only=False):
+    if commit_only:
+        # BENCH_COMMIT_AB=1 (`make bench-commit`): ONLY the batched-vs-
+        # sequential commit/apply A/B — storm parity plus the
+        # commit/apply floor split tools/check_commit_ab.py gates CI on
+        # (doc/EVICTION.md "Batched commit").
+        import jax as _jax
+        out["platform"] = _jax.default_backend()
+        ab = measure_commit_ab(n_tasks, n_nodes, n_jobs, n_queues)
+        out["commit_ab"] = ab
+        out["commit_parity"] = ab["parity"]
+        out["commit_flushes"] = ab["flushes"]
+        return
     if topo_only:
         # BENCH_TOPO_AB=1 (`make bench-topo`): ONLY the topology A/B —
         # defrag-vs-capacity eviction on the fragmentation-pressure
@@ -2063,6 +2191,7 @@ def main():
         with_pipeline = os.environ.get("BENCH_PIPELINE", "1") != "0"
         steady_only = os.environ.get("BENCH_STEADY_ONLY") == "1"
         evict_only = os.environ.get("BENCH_EVICT_AB") == "1"
+        commit_only = os.environ.get("BENCH_COMMIT_AB") == "1"
         churn_only = os.environ.get("BENCH_CHURN_SWEEP") == "1"
         wire_only = os.environ.get("BENCH_WIRE_AB") == "1"
         shard_only = os.environ.get("BENCH_SHARD_AB") == "1"
@@ -2073,6 +2202,7 @@ def main():
                          f"x {n_nodes} nodes (gang+DRF+proportion)"
                          + (" [steady-only]" if steady_only else "")
                          + (" [evict-ab]" if evict_only else "")
+                         + (" [commit-ab]" if commit_only else "")
                          + (" [churn-sweep]" if churn_only else "")
                          + (" [wire-ab]" if wire_only else "")
                          + (" [shard-ab]" if shard_only else "")
@@ -2116,7 +2246,8 @@ def main():
              steady_only=steady_only, steady_rounds_n=steady_rounds_n,
              evict_only=evict_only, churn_only=churn_only,
              shard_only=shard_only, lineage_only=lineage_only,
-             topo_only=topo_only, wire_only=wire_only)
+             topo_only=topo_only, wire_only=wire_only,
+             commit_only=commit_only)
         # Last statement INSIDE the try: a signal landing here is still
         # caught below — no handlerless gap before the emit.
         _ignore_signals()

@@ -29,6 +29,15 @@ class PreemptAction(Action):
         return "preempt"
 
     def execute(self, ssn) -> None:
+        # Batched commit (framework/commit.py): every Statement.commit
+        # of this walk hands its evictions to the per-action sink; ONE
+        # bulk egress + fused cache update flushes them at exit, in the
+        # exact commit order (doc/EVICTION.md "Batched commit").
+        from ..framework.commit import action_commit
+        with action_commit(ssn, self.name()):
+            self._execute(ssn)
+
+    def _execute(self, ssn) -> None:
         preemptors_map: Dict[str, PriorityQueue] = {}
         preemptor_tasks: Dict[str, PriorityQueue] = {}
         under_request: List = []

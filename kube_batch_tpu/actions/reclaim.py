@@ -21,6 +21,15 @@ class ReclaimAction(Action):
         return "reclaim"
 
     def execute(self, ssn) -> None:
+        # Batched commit (framework/commit.py): the session-side evicts
+        # of this walk accumulate in the per-action sink and flush as
+        # ONE bulk egress + fused cache update at exit (including the
+        # exception path — mirrored effects must reach the cluster).
+        from ..framework.commit import action_commit
+        with action_commit(ssn, self.name()):
+            self._execute(ssn)
+
+    def _execute(self, ssn) -> None:
         scanner = None
         scanner_built = False
         queues = PriorityQueue(ssn.queue_order_fn)

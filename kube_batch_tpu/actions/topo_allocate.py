@@ -296,13 +296,22 @@ class TopoAllocateAction(Action):
     # -- the action ----------------------------------------------------
 
     def execute(self, ssn) -> None:
+        from ..models.topology import topology_enabled
+        if not topology_enabled():
+            return
+        # Batched commit (framework/commit.py): the defrag/capacity
+        # evictions of this walk accumulate in the per-action sink and
+        # flush as ONE bulk egress + fused cache update at exit, like
+        # preempt/reclaim (doc/EVICTION.md "Batched commit").
+        from ..framework.commit import action_commit
+        with action_commit(ssn, self.name()):
+            self._execute(ssn)
+
+    def _execute(self, ssn) -> None:
         from ..api import TaskStatus
         from ..models.topology import (build_view, job_slice_shape,
                                        topo_defrag_enabled, topo_max_nodes,
                                        topo_table, topology_enabled)
-
-        if not topology_enabled():
-            return
         slice_jobs = []
         for job in ssn.jobs.values():
             shape = job_slice_shape(job)

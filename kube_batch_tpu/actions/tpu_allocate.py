@@ -299,34 +299,52 @@ class TpuAllocateAction(Action):
         else:
             breaker.success()
 
-        # Apply placements in device-solve order through the batched path:
-        # end state (status indexes, node accounting, plugin shares, gang
-        # dispatch) is identical to per-task ssn.allocate/pipeline calls,
-        # at one vector op per node instead of seven per task.
-        apply_start = time.time()
+        # Apply placements in device-solve order through the columnar
+        # batched path: end state (status indexes, node accounting,
+        # plugin shares, gang dispatch) is identical to per-task
+        # ssn.allocate/pipeline calls, fed straight from the solver's
+        # arrays and the staged index->TaskInfo table — no per-placement
+        # tuple materialization (Session.batch_apply_solved).
+        apply_start = time.perf_counter()
         with trace.span("apply", placed=int(ordered.size)):
             if scaffold is None:
                 scaffold = prepare_apply_scaffold(snap)
             agg = build_apply_aggregates(snap, assignment, kind, ordered,
                                          scaffold=scaffold)
-            kinds = kind[ordered].tolist()
-            hostnames = scaffold.node_names_arr[assignment[ordered]].tolist()
             # Pod lineage: batch_apply records the bulk "placed" stage;
             # the cycle context names which engine decided it (shown on
             # /debug/lineage as e.g. "via tpu-allocate/sharded").
+            from ..framework.commit import batch_commit_enabled
             from ..trace.lineage import lineage as pod_lineage
             pod_lineage.cycle_context = f"via {self.name()}/{route}"
             try:
-                ssn.batch_apply(
-                    zip(scaffold.tasks_arr[ordered].tolist(), hostnames,
-                        kinds),
-                    agg=agg)
+                if batch_commit_enabled():
+                    ssn.batch_apply_solved(
+                        scaffold.tasks_arr, scaffold.node_names_arr,
+                        assignment, kind, ordered, snap.task_job,
+                        snap.job_uids, agg)
+                else:
+                    # KUBE_BATCH_TPU_BATCH_COMMIT=0: the pre-columnar
+                    # tuple fan-out — the bit-parity control for the
+                    # whole commit/apply tail (doc/EVICTION.md
+                    # "Batched commit").
+                    kinds = kind[ordered].tolist()
+                    hostnames = scaffold.node_names_arr[
+                        assignment[ordered]].tolist()
+                    ssn.batch_apply(
+                        zip(scaffold.tasks_arr[ordered].tolist(),
+                            hostnames, kinds),
+                        agg=agg)
             finally:
                 pod_lineage.cycle_context = ""
+        # The ``apply`` floor is the placement apply alone (the stage the
+        # columnar path vectorizes); the histogram keeps its historical
+        # span (apply + fit-delta recording).
+        ssn._floor_apply += time.perf_counter() - apply_start
         with trace.span("fit_deltas"):
             self._record_fit_deltas(ssn, snap, kind, assignment, order,
                                     scaffold=scaffold)
-        metrics.observe_tpu_apply_latency(time.time() - apply_start)
+        metrics.observe_tpu_apply_latency(time.perf_counter() - apply_start)
         # After the latency observation: the tally walk must not inflate
         # the histogram the recorder's spans are validated against.
         if trace.current_session_id() is not None:

@@ -128,6 +128,35 @@ class NodeInfo:
         self.remove_task(ti)
         self.add_task(ti)
 
+    def release_resident(self, ti: TaskInfo) -> None:
+        """update_task fast path for an idle-consuming resident moving
+        to Releasing (the batched commit flush's truth mirror,
+        cache.evict_many): end state identical to
+        ``update_task(ti-with-status-Releasing)`` — releasing grows by
+        the stored resreq, idle/used are net-unchanged, the stored
+        entry moves to the END of the tasks dict exactly as the
+        remove+add round trip leaves it (snapshot/occupancy walks
+        iterate this dict; order is part of the bit-parity contract) —
+        without the redundant already-resident validations, the idle
+        add/sub round trip, or the fresh clone (the stored clone is
+        node-private; only its status flips).  Falls back to the exact
+        remove+add pair for Releasing/Pipelined residents, whose
+        transition arithmetic is not a pure releasing add."""
+        key = pod_key(ti.pod)
+        task = self.tasks.get(key)
+        if task is None:
+            raise KeyError(
+                f"failed to find task {ti.namespace}/{ti.name} on host "
+                f"{self.name}")
+        if task.status in (TaskStatus.Releasing, TaskStatus.Pipelined):
+            self.update_task(ti)
+            return
+        if self.node is not None:
+            self.releasing.add(task.resreq)
+        task.status = TaskStatus.Releasing
+        del self.tasks[key]
+        self.tasks[key] = task
+
     def pods(self):
         return [t.pod for t in self.tasks.values()]
 

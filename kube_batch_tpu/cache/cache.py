@@ -1383,6 +1383,113 @@ class SchedulerCache(Cache):
                     except (KeyError, ValueError):
                         pass
 
+    def evict_many(self, pairs) -> list:
+        """Bulk evict [(task, reason)] — the batched commit flush's
+        fused cache update (doc/EVICTION.md "Batched commit"): one
+        fence check, one bulk egress (evictor.evict_many, the
+        bind_pods_many twin), ONE mutex acquisition for the whole truth
+        mirror, one events extend, and one lineage batch, instead of
+        the per-task round-trip evict() pays.  Event content and order
+        equal the sequential loop's — pairs are egressed and mirrored
+        in decision order.
+
+        Chaos sites (doc/CHAOS.md): ``commit.flush_error`` aborts the
+        bulk egress mid-batch (one activation per flush; the magnitude
+        picks the abort point), so the suffix fails wholesale — the
+        caller's degradation path re-drives it per task.  With any plan
+        active the egress runs per task through the instrumented
+        single-evict sites (``evict.error``/``evict.ambiguous``) so
+        existing fault schedules see every evict.
+
+        Returns [(task, reason, exc)] failures, in order, not mirrored.
+        AMBIGUOUS failures are resync-queued here (they must never be
+        blindly re-driven); other failures are the caller's to drive —
+        the commit flush retries them through the per-task evict(),
+        which queues its own resync on failure, so each failed effect
+        is queued exactly once."""
+        pairs = list(pairs)
+        if not pairs:
+            return []
+        if self.evictor is None:
+            raise RuntimeError("no evictor configured")
+        self._check_write_fence()
+        plan = chaos_plan.PLAN
+        results: List[tuple] = []  # (task, reason, exc | None)
+        if plan is None:
+            failures = self.evictor.evict_many([t.pod for t, _ in pairs])
+            failed_uid = {pod.metadata.uid: exc for pod, exc in failures}
+            results = [(t, r, failed_uid.get(t.pod.metadata.uid))
+                       for t, r in pairs]
+        else:
+            fault = plan.fire("commit.flush_error")
+            abort_at = (int(fault.magnitude * len(pairs))
+                        if fault is not None else len(pairs))
+            aborted = RuntimeError(
+                "chaos: bulk evict egress aborted mid-batch (injected)")
+            for i, (t, r) in enumerate(pairs):
+                if i >= abort_at:
+                    results.append((t, r, aborted))
+                    continue
+                try:
+                    if plan.fire("evict.error"):
+                        raise OSError("chaos: evict DELETE failed before "
+                                      "send (injected)")
+                    ambiguous = plan.fire("evict.ambiguous")
+                    self.evictor.evict(t.pod)
+                    if ambiguous is not None:
+                        raise AmbiguousOutcomeError(
+                            "chaos: connection lost after the evict DELETE "
+                            "was delivered (injected)")
+                except Exception as exc:  # lint: allow-swallow(per-task failure isolation: the exception rides the results row back to the flush's degradation path)
+                    results.append((t, r, exc))
+                else:
+                    results.append((t, r, None))
+        landed = [(t, r) for t, r, exc in results if exc is None]
+        failures = [(t, r, exc) for t, r, exc in results
+                    if exc is not None]
+        if landed:
+            if pod_lineage.cfg().enabled:
+                pod_lineage.note_evicted_many(
+                    [(pod_key(t.pod), r) for t, r in landed])
+            # One mutex acquisition for the whole truth mirror (the
+            # per-task evict() re-acquires per victim), with the fused
+            # status-flip fast paths: move_task_status skips the
+            # delete/re-add Resource churn (Running -> Releasing is one
+            # allocated-vector sub either way), release_resident skips
+            # the node-side idle round trip and re-clone.  Both
+            # replicate the slow paths' dict-order side effect (the
+            # moved task lands at the END of the job/node task dicts —
+            # the next snapshot's iteration order depends on it, and
+            # iteration order feeds the solver's tie-breaks).
+            with self.mutex:
+                self.epoch += 1
+                for t, _r in landed:
+                    job = self.jobs.get(t.job)
+                    if job is None:
+                        continue
+                    truth = job.tasks.get(t.uid)
+                    if truth is None:
+                        continue
+                    job.move_task_status(truth, TaskStatus.Releasing)
+                    del job.tasks[truth.uid]
+                    job.tasks[truth.uid] = truth
+                    self._touch_job(job)
+                    node = self.nodes.get(t.node_name)
+                    if node is not None:
+                        self._touch_node(node)
+                        try:
+                            node.release_resident(truth)
+                        except (KeyError, ValueError):
+                            pass
+            self.events.extend(("Evict", pod_key(t.pod), r)
+                               for t, r in landed)
+        ambiguous_failures = [t for t, _r, exc in failures
+                              if isinstance(exc, AmbiguousOutcomeError)]
+        if ambiguous_failures:
+            with self.mutex:
+                self.err_tasks.extend(ambiguous_failures)
+        return failures
+
     def _resync_task(self, task: TaskInfo) -> None:
         with self.mutex:
             self.err_tasks.append(task)

@@ -425,6 +425,15 @@ class ClusterEvictor(Evictor):
     def evict(self, pod) -> None:
         self.cluster.delete_pod(pod.metadata.namespace, pod.metadata.name)
 
+    def evict_many(self, pods) -> list:
+        # A remote edge amortizes the wire: concurrent keep-alive
+        # connections instead of one serial round trip per evict
+        # (edge/client.py evict_pods_many — the bind_pods_many twin).
+        many = getattr(self.cluster, "evict_pods_many", None)
+        if many is not None:
+            return many(pods)
+        return super().evict_many(pods)
+
 
 class ClusterVolumeBinder(VolumeBinder):
     """Two-phase volume binding against the simulator's PVC store: the

@@ -48,6 +48,14 @@ class FakeEvictor(Evictor):
             self.evicts.append(key)
             self.channel.append(key)
 
+    def evict_many(self, pods) -> list:
+        with self.lock:  # one lock round-trip for the whole batch
+            for pod in pods:
+                key = pod_key(pod)
+                self.evicts.append(key)
+                self.channel.append(key)
+        return []
+
 
 class FakeStatusUpdater(StatusUpdater):
     def __init__(self):

@@ -46,6 +46,21 @@ class Cache(abc.ABC):
     @abc.abstractmethod
     def evict(self, task: TaskInfo, reason: str) -> None: ...
 
+    def evict_many(self, pairs) -> list:
+        """Bulk evict [(task, reason)] in decision order; returns
+        [(task, reason, exc)] failures.  Default loops evict() with the
+        same per-task failure isolation the sequential commit loop had;
+        SchedulerCache overrides with the fused single-mutex mirror +
+        single bulk egress (the batched commit flush target,
+        framework/commit.py)."""
+        failures = []
+        for task, reason in pairs:
+            try:
+                self.evict(task, reason)
+            except Exception as exc:  # per-task failure isolation
+                failures.append((task, reason, exc))
+        return failures
+
     @abc.abstractmethod
     def update_job_status(self, job: JobInfo) -> JobInfo: ...
 
@@ -77,6 +92,19 @@ class Binder(abc.ABC):
 class Evictor(abc.ABC):
     @abc.abstractmethod
     def evict(self, pod) -> None: ...
+
+    def evict_many(self, pods) -> list:
+        """Evict pods in bulk; returns [(pod, exc)] failures.  Default
+        loops evict(); implementations override to amortize locking or
+        wire round-trips (edge/client.py evict_pods_many is the
+        bind_pods_many twin)."""
+        failures = []
+        for pod in pods:
+            try:
+                self.evict(pod)
+            except Exception as exc:  # per-pod failure isolation
+                failures.append((pod, exc))
+        return failures
 
 
 class StatusUpdater(abc.ABC):

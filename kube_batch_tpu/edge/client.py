@@ -634,6 +634,72 @@ class RemoteCluster:
         except Exception:  # lint: allow-swallow(read-back probe: any failure means "unproven", and False makes the retry path surface the original error)
             return False
 
+    def evict_pods_many(self, pods, workers: int = 8) -> list:
+        """Evict (DELETE) pods concurrently over persistent
+        connections; returns [(pod, exc)] failures — the bind_pods_many
+        twin for the batched commit flush (framework/commit.py).
+
+        Simpler than the bind pool: a pod DELETE is idempotent (the
+        object either exists or it does not), so a connection that dies
+        mid-request retries once on a fresh connection and a 404 on the
+        retry proves the first attempt landed."""
+        if not pods:
+            return []
+        from urllib.parse import urlsplit
+
+        parts = urlsplit(self.base_url)
+        prefix = parts.path.rstrip("/")
+        conn_cls = (_NodelayHTTPSConnection if parts.scheme == "https"
+                    else _NodelayConnection)
+        failures = []
+        flock = threading.Lock()
+        pods = list(pods)
+        workers = max(1, min(workers, len(pods)))
+
+        def delete(conn, pod):
+            path = prefix + self._object_path(
+                "pods", pod.metadata.namespace, pod.metadata.name)
+            for attempt in (0, 1):
+                try:
+                    conn.request("DELETE", path)
+                    resp = conn.getresponse()
+                    data = resp.read()
+                except (http.client.HTTPException, OSError):
+                    conn.close()  # next request auto-reconnects
+                    if attempt:
+                        raise
+                    continue  # DELETE is idempotent: one clean retry
+                if resp.status == 404 and attempt:
+                    return  # first attempt landed; the retry's 404 proves it
+                if resp.status >= 400:
+                    err = KeyError(f"DELETE {path}: {resp.status} "
+                                   f"{data.decode(errors='replace')}")
+                    err.status = resp.status  # type: ignore[attr-defined]
+                    raise err
+                return
+
+        def run(chunk):
+            conn = conn_cls(parts.hostname, parts.port,
+                            timeout=self.timeout)
+            try:
+                for pod in chunk:
+                    try:
+                        delete(conn, pod)
+                    except Exception as exc:  # per-pod failure isolation
+                        with flock:
+                            failures.append((pod, exc))
+            finally:
+                conn.close()
+
+        threads = [threading.Thread(
+            target=run, args=(pods[i::workers],), daemon=True)
+            for i in range(workers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return failures
+
     def bind_pod(self, namespace: str, name: str, hostname: str) -> None:
         path, payload = self._bind_request(namespace, name, hostname)
         self._request("POST", path, payload)

@@ -84,6 +84,21 @@ class Session:
         self.job_valid_fns: Dict[str, Callable] = {}
         self.node_order_fns: Dict[str, List] = {}
 
+        # Batched commit (framework/commit.py): the active per-action
+        # effect sink, installed by ``action_commit`` for the duration
+        # of one eviction action's execute.  None = the sequential
+        # per-task effector path (the KUBE_BATCH_TPU_BATCH_COMMIT=0
+        # control, and every action that never evicts).
+        self._commit_sink = None
+        # Per-session commit/apply floor accumulators (published as
+        # ``cycle_floor_ms{floor="commit"|"apply"}`` at close): the
+        # effect-side wall time — sequential per-task effector calls or
+        # batched flushes for commit; the placement apply phase for
+        # apply — so storm regressions are attributable in the bench
+        # gate (doc/EVICTION.md "Batched commit").
+        self._floor_commit = 0.0
+        self._floor_apply = 0.0
+
         # Lazily resolved tier-walk chains for the order comparators:
         # heap-heavy actions (a preemption storm pushes/pops thousands
         # of jobs and tasks) call these per comparison, and the
@@ -562,7 +577,20 @@ class Session:
                 touched_jobs[task.job] = job
                 applied_append(task)
 
+        self._settle_batch(node_alloc, node_pipe, touched_jobs, applied,
+                           skipped, agg, alloc_moves, pipe_moves)
+
+    def _settle_batch(self, node_alloc, node_pipe, touched_jobs, applied,
+                      skipped, agg, alloc_moves, pipe_moves) -> None:
+        """The result-independent back half of a batch apply, shared by
+        the placement-tuple path (batch_apply) and the columnar path
+        (batch_apply_solved): deferred status-index moves, dirty marks,
+        lineage, skip settlement, per-node/per-job accounting, the
+        plugin batch event, and the gang dispatch barrier — in exactly
+        the order the tuple path always ran them."""
         if alloc_moves or pipe_moves:
+            allocated_st, pipelined_st = (TaskStatus.Allocated,
+                                          TaskStatus.Pipelined)
             for uid, job in touched_jobs.items():
                 to_alloc = alloc_moves.get(uid, ())
                 to_pipe = pipe_moves.get(uid, ())
@@ -698,13 +726,175 @@ class Session:
                 [now - t.pod.metadata.creation_timestamp
                  for t in dispatching])
 
+    def batch_apply_solved(self, tasks_arr, node_names_arr, assignment,
+                           kind, ordered, jobix, job_uids, agg) -> None:
+        """Columnar apply of a device solve: the same end state as
+        ``batch_apply`` over (task, hostname, kind) tuples, fed directly
+        from the solver's arrays and the staged index->TaskInfo table —
+        no per-placement tuple materialization, no per-placement
+        job/node dict resolution, and the status-index move lists
+        grouped by numpy instead of per-task setdefault/append.
+
+        Bit parity with the tuple path (pinned by the pipeline/churn/
+        commit parity gates): the per-placement walk runs in solve
+        order, ``touched_jobs`` keeps first-touch order (the gang
+        dispatch barrier iterates it — bind order depends on it), and
+        the per-job move lists keep placement order via stable sorts
+        (status-index dict order feeds the bind batch).
+
+        ``tasks_arr``: [P_real+] object ndarray (index -> TaskInfo);
+        ``node_names_arr``: [N] object ndarray of node names;
+        ``assignment``/``kind``: [P] result vectors; ``ordered``:
+        placed rows in placement order; ``jobix``: [P_real] task -> job
+        index; ``job_uids``: job index -> uid; ``agg``:
+        BatchAggregates (required — the pre-check and accounting read
+        it)."""
+        import numpy as np
+
+        sel = ordered
+        n_idx = assignment[sel]
+
+        # Feasibility pre-check, identical to batch_apply: an overdrawn
+        # node total means the solver and session disagree — replay the
+        # whole batch through the exact per-task path.
+        for accs, pool in ((agg.node_alloc, "idle"),
+                           (agg.node_pipe, "releasing")):
+            for hostname, acc in accs.items():
+                node = self.nodes.get(hostname)
+                if node is not None and not acc.less_equal(
+                        getattr(node, pool)):
+                    self._apply_sequential(
+                        list(zip(tasks_arr[sel].tolist(),
+                                 node_names_arr[n_idx].tolist(),
+                                 kind[sel].tolist())))
+                    return
+
+        # Native columns walk: the same C per-placement pass the tuple
+        # path runs (kube_batch_tpu/native), fed three parallel lists —
+        # no per-placement tuple packing.  Returns exactly the settle
+        # inputs, with touched_jobs/moves in first-touch placement
+        # order by dict-insertion construction.
+        if native_apply is not None:
+            (applied, skipped, touched_jobs, alloc_moves,
+             pipe_moves) = native_apply(
+                self.jobs, self.nodes,
+                (tasks_arr[sel].tolist(), node_names_arr[n_idx].tolist(),
+                 kind[sel].tolist()),
+                self.cache.allocate_volumes)
+            self._settle_batch(agg.node_alloc, agg.node_pipe,
+                               touched_jobs, applied, skipped, agg,
+                               alloc_moves, pipe_moves)
+            return
+
+        # Python columnar fallback: object fan-out resolves each unique
+        # node/job once, then numpy takes; the per-task loop keeps only
+        # the work that is inherently per object.
+        node_objs = np.empty(len(node_names_arr), dtype=object)
+        node_objs[:] = [self.nodes.get(n)
+                        for n in node_names_arr.tolist()]
+        job_objs = np.empty(len(job_uids), dtype=object)
+        job_objs[:] = [self.jobs.get(u) for u in job_uids]
+
+        t_col = tasks_arr[sel]
+        k_list = kind[sel].tolist()
+        node_col = node_objs[n_idx]
+        job_col = job_objs[jobix[sel]]
+
+        applied: List[TaskInfo] = []
+        applied_append = applied.append
+        skip_pos: List[int] = []
+        allocate_volumes = self.cache.allocate_volumes
+        pos = 0
+        for task, node, job, k in zip(t_col, node_col, job_col, k_list):
+            if job is None or node is None:
+                skip_pos.append(pos)
+                pos += 1
+                continue
+            key = pod_key(task.pod)
+            ntasks = node.tasks
+            if key in ntasks:  # add_task would raise; log-and-skip
+                skip_pos.append(pos)
+                pos += 1
+                continue
+            if k == 1 and task.pod.spec.volumes:
+                try:
+                    allocate_volumes(task, node.name)
+                except (KeyError, ValueError):
+                    skip_pos.append(pos)
+                    pos += 1
+                    continue
+            task.node_name = node.name
+            ntasks[key] = task.clone_lite()
+            applied_append(task)
+            pos += 1
+
+        # Applied rows + numpy grouping for the deferred status moves.
+        if skip_pos:
+            mask = np.ones(sel.shape[0], dtype=bool)
+            mask[skip_pos] = False
+            applied_sel = sel[mask]
+            skipped = [(t_col[i], node_names_arr[int(n_idx[i])], k_list[i])
+                       for i in skip_pos]
+        else:
+            applied_sel = sel
+            skipped = []
+
+        jseq = jobix[applied_sel]
+        # touched_jobs in FIRST-TOUCH order (np.unique sorts by job
+        # index; argsort of the first-occurrence positions restores the
+        # placement-order first touch the tuple path records).
+        uniq, first = np.unique(jseq, return_index=True)
+        touch_order = uniq[np.argsort(first, kind="stable")].tolist()
+        touched_jobs = {job_uids[i]: job_objs[i] for i in touch_order}
+
+        alloc_moves: dict = {}
+        pipe_moves: dict = {}
+        k_arr = kind[applied_sel]
+        for kk, moves in ((1, alloc_moves), (2, pipe_moves)):
+            rows = applied_sel[k_arr == kk]
+            if not rows.size:
+                continue
+            jr = jobix[rows]
+            o = np.argsort(jr, kind="stable")  # placement order per job
+            rows_sorted = rows[o]
+            jr_sorted = jr[o]
+            groups, starts = np.unique(jr_sorted, return_index=True)
+            bounds = np.append(starts, rows_sorted.shape[0])
+            for gi, j in enumerate(groups.tolist()):
+                moves[job_uids[j]] = tasks_arr[
+                    rows_sorted[bounds[gi]:bounds[gi + 1]]].tolist()
+
+        self._settle_batch(agg.node_alloc, agg.node_pipe, touched_jobs,
+                           applied, skipped, agg, alloc_moves, pipe_moves)
+
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
-        """Evict through the cache, then mirror in-session (session.go:317-345)."""
-        self.cache.evict(reclaimee, reason)
-        metrics.note_eviction(reason)  # "reclaim" on the direct path
-        trace.note_evict(reason)
+        """Evict through the cache, then mirror in-session (session.go:317-345).
+
+        Batched commit (framework/commit.py): with the action's
+        CommitSink active, the session mirror applies immediately (the
+        rest of the walk depends on it) and the cluster effect defers
+        to the action's single flush — same mirror, same decision
+        order, one egress.  The sequential body below is the
+        KUBE_BATCH_TPU_BATCH_COMMIT=0 control."""
+        # The ``commit`` floor times exactly the CLUSTER-EFFECT side
+        # (the machinery the batched flush replaces): the per-task
+        # cache.evict round-trip here, or the sink flush.  The session
+        # mirror below is identical work in both arms and deliberately
+        # outside the floor.
+        sink = self._commit_sink
+        if sink is None:
+            start = time.perf_counter()
+            self.cache.evict(reclaimee, reason)
+            metrics.note_eviction(reason)  # "reclaim" on the direct path
+            trace.note_evict(reason)
+            self._floor_commit += time.perf_counter() - start
         job = self.jobs.get(reclaimee.job)
         if job is None:
+            if sink is not None:
+                # The sequential path has already egressed by the time
+                # it discovers the missing job: keep the effect (the
+                # flush will evict) and surface the same error.
+                sink.add_evict(reclaimee, reason)
             raise KeyError(f"failed to find job {reclaimee.job}")
         self._dirty_job(reclaimee.job)
         job.update_task_status(reclaimee, TaskStatus.Releasing)
@@ -713,6 +903,8 @@ class Session:
             self._dirty_node(reclaimee.node_name)
             node.update_task(reclaimee)
         self._fire_deallocate(reclaimee)
+        if sink is not None:
+            sink.add_evict(reclaimee, reason)
 
     def update_job_condition(self, job_info: JobInfo, cond: PodGroupCondition):
         """Upsert a PodGroup condition by type (session.go:348-369)."""
@@ -945,6 +1137,15 @@ def close_session(ssn: Session) -> None:
         ssn.cache.note_close_results(active)
     metrics.set_close_objects_walked(walked)
     metrics.set_cycle_floor("close", time.perf_counter() - close_start)
+
+    # Commit/apply floors (doc/EVICTION.md "Batched commit"): the
+    # session's accumulated effect-side wall time — what the eviction
+    # actions paid committing effects to the cluster (batched flushes
+    # or the sequential per-task control) and what tpu-allocate paid
+    # applying placements.  Published every session so the bench gate
+    # and the commit A/B can attribute storm regressions.
+    metrics.set_cycle_floor("commit", ssn._floor_commit)
+    metrics.set_cycle_floor("apply", ssn._floor_apply)
 
     # Publish the cycle's mutation footprint: the dirty-set sizes that
     # bound the next cycle's incremental staging and delta ship.  The

@@ -13,6 +13,33 @@ from ..api import TaskInfo, TaskStatus
 from .events import Event
 
 
+def unevict_session(ssn, reclaimee: TaskInfo) -> None:
+    """Restore one evicted task's session state (the rollback side of a
+    session eviction): status back to Running, node accounting, the
+    deallocate event reversed, and the session-shared VictimIndex
+    counted back in.  Shared by Statement rollback (discard and
+    commit-failure) and the batched commit flush's degradation path
+    (framework/commit.py) so every restore runs the same altitude."""
+    job = ssn.jobs.get(reclaimee.job)
+    if job is not None:
+        ssn._dirty_job(reclaimee.job)
+        job.update_task_status(reclaimee, TaskStatus.Running)
+    node = ssn.nodes.get(reclaimee.node_name)
+    if node is not None:
+        ssn._dirty_node(reclaimee.node_name)
+        node.update_task(reclaimee)
+    ssn._fire_allocate(reclaimee)
+    # Count the restored Running resident back into the session-shared
+    # VictimIndex (the evicting action counted it out at evict time).
+    # Living here covers every rollback path — discard, commit-failure,
+    # and the batched flush's degradation — at one altitude; an
+    # under-counted index would let later preemptors skip nodes holding
+    # victims.
+    idx = getattr(ssn, "_victim_index", None)
+    if idx is not None and job is not None:
+        idx.on_restore(reclaimee.node_name, job.queue, reclaimee.job)
+
+
 class Statement:
 
     def __init__(self, ssn):
@@ -53,23 +80,7 @@ class Statement:
     # out of the snapshot pool for this cycle)
 
     def _unevict(self, reclaimee: TaskInfo) -> None:
-        job = self.ssn.jobs.get(reclaimee.job)
-        if job is not None:
-            self.ssn._dirty_job(reclaimee.job)
-            job.update_task_status(reclaimee, TaskStatus.Running)
-        node = self.ssn.nodes.get(reclaimee.node_name)
-        if node is not None:
-            self.ssn._dirty_node(reclaimee.node_name)
-            node.update_task(reclaimee)
-        self.ssn._fire_allocate(reclaimee)
-        # Count the restored Running resident back into the session-
-        # shared VictimIndex (the evicting action counted it out at
-        # stmt.evict time).  Living here covers BOTH rollback paths —
-        # discard and commit-failure — at one altitude; an under-counted
-        # index would let later preemptors skip nodes holding victims.
-        idx = getattr(self.ssn, "_victim_index", None)
-        if idx is not None and job is not None:
-            idx.on_restore(reclaimee.node_name, job.queue, reclaimee.job)
+        unevict_session(self.ssn, reclaimee)
 
     def _unpipeline(self, task: TaskInfo) -> None:
         job = self.ssn.jobs.get(task.job)
@@ -96,9 +107,26 @@ class Statement:
 
     def commit(self) -> None:
         """Replay evictions against the cluster; pipelines stay session-only
-        (go:210-220)."""
+        (go:210-220).
+
+        Batched commit (framework/commit.py): with the action's
+        CommitSink active, the committed evictions hand off to the
+        per-action accumulator instead of egressing here — the sink's
+        single flush replays them in this exact order, so the victim
+        sequence and event stream equal the sequential loop below (the
+        KUBE_BATCH_TPU_BATCH_COMMIT=0 control)."""
+        import time
+
         from ..metrics import metrics
         from ..trace import spans as trace
+        sink = getattr(self.ssn, "_commit_sink", None)
+        if sink is not None:
+            for name, args in self.operations:
+                if name == "evict":
+                    sink.add_evict(args[0], args[1])
+            self.operations.clear()
+            return
+        start = time.perf_counter()
         for name, args in self.operations:
             if name == "evict":
                 reclaimee, reason = args
@@ -112,3 +140,4 @@ class Statement:
                     metrics.note_eviction(reason)
                     trace.note_evict(reason)
         self.operations.clear()
+        self.ssn._floor_commit += time.perf_counter() - start

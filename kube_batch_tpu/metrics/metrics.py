@@ -386,6 +386,20 @@ victim_index_events = registry.register(Counter(
     f"{SUBSYSTEM}_victim_index_events_total",
     "VictimIndex life-cycle events (rebuild | evict | restore)",
     ("kind",)))
+# Batched statement commit (doc/EVICTION.md "Batched commit"): the
+# per-action effect flushes — how many flushed cleanly vs degraded to
+# the per-task sequential path, and how many effects each flush carried
+# (the batch-size distribution a storm regression shows up in).
+commit_flushes = registry.register(Counter(
+    "kube_batch_commit_flushes_total",
+    "Per-action commit flushes, by outcome (batched = one fused bulk "
+    "egress; degraded = mid-batch failure re-driven per task)",
+    ("action", "mode")))
+commit_batch_size = registry.register(Histogram(
+    "kube_batch_commit_batch_size",
+    "Effects carried per commit flush (evicts accumulated by one "
+    "action before its single bulk egress)",
+    _exp_buckets(1.0, 2.0, 14)))
 # Chaos engine + graceful degradation (doc/CHAOS.md): the injected-fault
 # ledger, the degraded-mode surface (which degradation source is active
 # and what the device-solve breaker is doing), and the failure counters
@@ -781,6 +795,31 @@ def note_eviction(action: str) -> None:
     evictions_total.inc(1.0, action)
 
 
+def note_evictions(action: str, count: int) -> None:
+    """Bulk form for the batched commit flush: ``count`` committed
+    evictions decided by ``action`` in one counter update."""
+    if count:
+        evictions_total.inc(float(count), action)
+
+
+def note_commit_flush(action: str, mode: str, size: int) -> None:
+    """Record one per-action commit flush: ``mode`` is "batched" (the
+    fused bulk egress landed every effect) or "degraded" (a mid-batch
+    failure re-drove the remainder through the per-task sequential
+    path); ``size`` is the effect count the flush carried."""
+    commit_flushes.inc(1.0, action, mode)
+    commit_batch_size.observe(float(size))
+
+
+def commit_flush_counts() -> Dict[str, int]:
+    """{"action/mode": count} so far — the bench-commit vacuous-gate
+    guard (a commit A/B whose batched arm never flushed compared
+    nothing) and the /debug surfaces."""
+    return {f"{labels[0]}/{labels[1]}": int(v)
+            for labels, v in commit_flushes.values().items()
+            if len(labels) == 2}
+
+
 def evictions_by_action() -> Dict[str, int]:
     """{action: count} so far — bench artifact + /debug/sessions."""
     return {labels[0]: int(v)
@@ -922,7 +961,8 @@ def generation_reuse_counts() -> Dict[str, int]:
 
 def set_cycle_floor(floor: str, seconds: float) -> None:
     """Record what the current cycle paid for one residual floor stage
-    (solve_wait | snapshot | close | occupancy)."""
+    (solve_wait | snapshot | close | occupancy | decode | stage |
+    plugin_close | commit | apply)."""
     cycle_floor_ms.set(round(seconds * 1e3, 3), floor)
 
 

@@ -42,6 +42,11 @@ class TensorSnapshot:
     resource_names: List[str] = field(default_factory=list)
     fallback_reason: str = ""       # non-empty -> host path required
     task_job: Optional[np.ndarray] = None    # [P_real] i32 job index
+    # Persistent object-array mirror of ``tasks`` (the staging layer's
+    # stage_tasks_arr) when the fast-stage path served this session:
+    # prepare_apply_scaffold hands it to the columnar apply instead of
+    # rebuilding an O(tasks) object array per cycle.
+    tasks_arr: Optional[np.ndarray] = None
     task_res_f64: Optional[np.ndarray] = None  # [P_pad, R] f64 staging
     port_index: Dict[tuple, int] = field(default_factory=dict)
     selectors: List[dict] = field(default_factory=list)
@@ -88,8 +93,14 @@ class ApplyScaffold:
 
 
 def prepare_apply_scaffold(snap: "TensorSnapshot") -> ApplyScaffold:
-    tasks_arr = np.empty(len(snap.tasks), dtype=object)
-    tasks_arr[:] = snap.tasks
+    # The staged object-array mirror (stage_tasks_arr) serves directly
+    # when the fast-stage path produced this session — the O(tasks)
+    # fan-out below is only paid by control-arm / non-persistent
+    # sessions.
+    tasks_arr = snap.tasks_arr
+    if tasks_arr is None or len(tasks_arr) != len(snap.tasks):
+        tasks_arr = np.empty(len(snap.tasks), dtype=object)
+        tasks_arr[:] = snap.tasks
     names_arr = np.empty(len(snap.node_names), dtype=object)
     names_arr[:] = snap.node_names
     return ApplyScaffold(
@@ -362,6 +373,11 @@ class TensorCache:
         self.stage_req_q = None   # frozen-after: stage
         self.stage_res_q = None   # frozen-after: stage
         self.stage_sig = None     # frozen-after: stage
+        # Object-array mirror of stage_tasks (index -> TaskInfo), kept
+        # in lockstep by the staging patch so the columnar apply's
+        # task fan-out (Session.batch_apply_solved) never rebuilds an
+        # O(tasks) object array per session.
+        self.stage_tasks_arr = None  # frozen-after: stage
         self.persistent = False
 
     def drop_stage(self) -> None:
@@ -376,6 +392,7 @@ class TensorCache:
         self.stage_req_q = None
         self.stage_res_q = None
         self.stage_sig = None
+        self.stage_tasks_arr = None
 
     def sig_id(self, sig: tuple) -> int:
         gid = self.sig_gid.get(sig)
@@ -702,6 +719,10 @@ def _stage_candidate_rows(tc: TensorCache, ssn, job_uids, blocks,
         tc.stage_req_q = req_q    # frozen-after: stage
         tc.stage_res_q = res_q    # frozen-after: stage
         tc.stage_sig = sig_g      # frozen-after: stage
+        tasks_arr = np.empty(len(tasks), dtype=object)
+        if tasks:
+            tasks_arr[:] = tasks
+        tc.stage_tasks_arr = tasks_arr  # frozen-after: stage
         return tasks, res_f, req_q, res_q, sig_g, p_real
     req_q = tc.stage_req_q
     res_q = tc.stage_res_q
@@ -716,6 +737,7 @@ def _stage_candidate_rows(tc: TensorCache, ssn, job_uids, blocks,
             if uid != ouid or b.count != ob.count:
                 same_shape = False
                 break
+    tasks_arr = tc.stage_tasks_arr
     if same_shape:
         # Unchanged job layout (uids + counts): offsets are stable, so
         # only spans whose block OR clone was replaced rewrite in place
@@ -733,7 +755,9 @@ def _stage_candidate_rows(tc: TensorCache, ssn, job_uids, blocks,
                     res_q[s:e] = b.res_q
                     sig_g[s:e] = b.sig_g
                 jt = job.tasks
-                tasks[s:e] = [jt[tuid] for tuid in b.uids]
+                span = [jt[tuid] for tuid in b.uids]
+                tasks[s:e] = span
+                tasks_arr[s:e] = span
                 staged += c
             s = e
     else:
@@ -770,6 +794,13 @@ def _stage_candidate_rows(tc: TensorCache, ssn, job_uids, blocks,
             req_q[p_real:old_p_real] = 0
             res_q[p_real:old_p_real] = 0
             sig_g[p_real:old_p_real] = 0
+        # Layout change: the task list length moved — rebuild the
+        # object-array mirror wholesale (same cost class as the suffix
+        # rewrite itself; the steady same-shape path never lands here).
+        tasks_arr = np.empty(len(tasks), dtype=object)
+        if tasks:
+            tasks_arr[:] = tasks
+        tc.stage_tasks_arr = tasks_arr  # frozen-after: stage
     tc.stage_jobs = layout
     tc.stage_p_real = p_real
     return tasks, res_f, req_q, res_q, sig_g, staged
@@ -1317,6 +1348,7 @@ def tensorize_session(ssn) -> TensorSnapshot:
          staged_rows) = _stage_candidate_rows(
             tc, ssn, job_uids, blocks, job_start, p_real, p_pad, r)
         _set_stage_rows(staged_rows)
+        snap.tasks_arr = tc.stage_tasks_arr
     else:
         tasks = []
         for ji, b in enumerate(blocks):

@@ -143,6 +143,22 @@ get_pod_key(PyObject *pod)
     return key;
 }
 
+static int
+append_skip(PyObject *skipped, PyObject *entry, PyObject *task,
+            PyObject *hostname, PyObject *kind_obj)
+{
+    /* Tuple rows carry their entry; columnar rows materialize the
+     * (task, hostname, kind) triple only when actually skipped. */
+    if (entry != NULL)
+        return PyList_Append(skipped, entry);
+    PyObject *t = PyTuple_Pack(3, task, hostname, kind_obj);
+    if (t == NULL)
+        return -1;
+    int rc = PyList_Append(skipped, t);
+    Py_DECREF(t);
+    return rc;
+}
+
 static PyObject *
 apply_placements(PyObject *self, PyObject *args)
 {
@@ -150,10 +166,31 @@ apply_placements(PyObject *self, PyObject *args)
     if (!PyArg_ParseTuple(args, "OOOO", &jobs, &nodes, &placements,
                           &allocate_volumes))
         return NULL;
+    /* Columnar form (Session.batch_apply_solved): placements may be a
+     * 3-tuple of equal-length lists (tasks, hostnames, kinds) instead
+     * of a list of 3-tuples — same walk, no per-placement tuple
+     * packing.  Skip entries are materialized as tuples on demand
+     * (skips are rare). */
+    PyObject *col_tasks = NULL, *col_hosts = NULL, *col_kinds = NULL;
+    if (PyTuple_Check(placements) && PyTuple_GET_SIZE(placements) == 3) {
+        col_tasks = PyTuple_GET_ITEM(placements, 0);
+        col_hosts = PyTuple_GET_ITEM(placements, 1);
+        col_kinds = PyTuple_GET_ITEM(placements, 2);
+        if (!PyList_Check(col_tasks) || !PyList_Check(col_hosts)
+            || !PyList_Check(col_kinds)
+            || PyList_GET_SIZE(col_tasks) != PyList_GET_SIZE(col_hosts)
+            || PyList_GET_SIZE(col_tasks) != PyList_GET_SIZE(col_kinds)) {
+            PyErr_SetString(PyExc_TypeError,
+                            "columnar placements must be three "
+                            "equal-length lists");
+            return NULL;
+        }
+    }
     if (!PyDict_Check(jobs) || !PyDict_Check(nodes)
-        || !PyList_Check(placements)) {
+        || (col_tasks == NULL && !PyList_Check(placements))) {
         PyErr_SetString(PyExc_TypeError,
-                        "jobs/nodes must be dicts, placements a list");
+                        "jobs/nodes must be dicts, placements a list "
+                        "or a (tasks, hostnames, kinds) column tuple");
         return NULL;
     }
 
@@ -172,17 +209,25 @@ apply_placements(PyObject *self, PyObject *args)
     if (node_cache == NULL)
         goto fail;
 
-    Py_ssize_t n = PyList_GET_SIZE(placements);
+    Py_ssize_t n = col_tasks ? PyList_GET_SIZE(col_tasks)
+                             : PyList_GET_SIZE(placements);
     for (Py_ssize_t i = 0; i < n; i++) {
-        PyObject *entry = PyList_GET_ITEM(placements, i);  /* borrowed */
-        if (!PyTuple_Check(entry) || PyTuple_GET_SIZE(entry) != 3) {
-            PyErr_SetString(PyExc_TypeError,
-                            "placement entries must be 3-tuples");
-            goto fail;
+        PyObject *entry = NULL, *task, *hostname, *kind_obj;
+        if (col_tasks != NULL) {  /* columnar row: three parallel lists */
+            task = PyList_GET_ITEM(col_tasks, i);      /* borrowed */
+            hostname = PyList_GET_ITEM(col_hosts, i);  /* borrowed */
+            kind_obj = PyList_GET_ITEM(col_kinds, i);  /* borrowed */
+        } else {
+            entry = PyList_GET_ITEM(placements, i);  /* borrowed */
+            if (!PyTuple_Check(entry) || PyTuple_GET_SIZE(entry) != 3) {
+                PyErr_SetString(PyExc_TypeError,
+                                "placement entries must be 3-tuples");
+                goto fail;
+            }
+            task = PyTuple_GET_ITEM(entry, 0);
+            hostname = PyTuple_GET_ITEM(entry, 1);
+            kind_obj = PyTuple_GET_ITEM(entry, 2);
         }
-        PyObject *task = PyTuple_GET_ITEM(entry, 0);
-        PyObject *hostname = PyTuple_GET_ITEM(entry, 1);
-        PyObject *kind_obj = PyTuple_GET_ITEM(entry, 2);
         long kind = PyLong_AsLong(kind_obj);
         if (kind == -1 && PyErr_Occurred())
             goto fail;
@@ -245,7 +290,7 @@ apply_placements(PyObject *self, PyObject *args)
         }
         if (job == NULL || node == NULL) {
             Py_DECREF(job_uid);
-            if (PyList_Append(skipped, entry) < 0)
+            if (append_skip(skipped, entry, task, hostname, kind_obj) < 0)
                 goto fail;
             continue;
         }
@@ -273,7 +318,7 @@ apply_placements(PyObject *self, PyObject *args)
             Py_DECREF(key);
             Py_DECREF(pod);
             Py_DECREF(job_uid);
-            if (PyList_Append(skipped, entry) < 0)
+            if (append_skip(skipped, entry, task, hostname, kind_obj) < 0)
                 goto fail;
             continue;
         }
@@ -304,7 +349,8 @@ apply_placements(PyObject *self, PyObject *args)
                         Py_DECREF(key);
                         Py_DECREF(pod);
                         Py_DECREF(job_uid);
-                        if (PyList_Append(skipped, entry) < 0)
+                        if (append_skip(skipped, entry, task, hostname,
+                                        kind_obj) < 0)
                             goto fail;
                         continue;
                     }

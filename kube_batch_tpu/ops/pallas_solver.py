@@ -110,8 +110,11 @@ def _solve_kernel(r: int, np_pad: int, ns_pad: int, cfg: SolverConfig,
         return jnp.sum(jnp.where(hot, row, 0.0))
 
     def scalar_at_i(row, hot):
-        """Extract row value at the one-hot lane (int rows)."""
-        return jnp.sum(jnp.where(hot, row, 0))
+        """Extract row value at the one-hot lane (int rows).  The sum
+        dtype is pinned: under jax_enable_x64 an unpinned integer sum
+        widens to int64, which poisons the while-loop carries and the
+        int32 ref writebacks."""
+        return jnp.sum(jnp.where(hot, row, 0), dtype=jnp.int32)
 
     def lex_first(mask, keys):
         m = mask

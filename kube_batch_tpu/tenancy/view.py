@@ -141,6 +141,15 @@ class ShardView:
         self._check_shard_fence()
         self._cache.evict(task, reason)
 
+    def evict_many(self, pairs) -> list:
+        """Fenced form of the batched commit flush's bulk evict
+        (SchedulerCache.evict_many): without this override the flush
+        would fall through __getattr__ to the unfenced cache method and
+        a lease-lost replica could bulk-DELETE a whole victim batch
+        into a shard another replica already owns."""
+        self._check_shard_fence()
+        return self._cache.evict_many(pairs)
+
     def update_job_status(self, job):
         self._check_shard_fence()
         return self._cache.update_job_status(job)

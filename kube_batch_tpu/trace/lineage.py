@@ -423,6 +423,23 @@ class LineageRecorder:
                 rec.echoed = False
                 rec.events.append(("evicted", now, reason))
 
+    def note_evicted_many(self, pairs) -> None:
+        """Bulk eviction notes [(key, reason)] in decision order: ONE
+        recorder-lock acquisition for the whole commit flush
+        (cache.evict_many), same per-pod timeline writes as
+        note_evicted."""
+        if not self.cfg().enabled:
+            return
+        now = time.monotonic()
+        with self._lock:
+            pods = self._pods
+            for key, reason in pairs:
+                rec = pods.get(key)
+                if rec is not None and not rec.closed:
+                    rec.awaiting_rebind = True
+                    rec.echoed = False
+                    rec.events.append(("evicted", now, reason))
+
     def note_deleted(self, key: str) -> None:
         if not self.cfg().enabled:
             return

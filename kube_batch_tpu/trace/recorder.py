@@ -86,10 +86,20 @@ class FlightRecorder:
         out = []
         for tr in reversed(self.traces()):
             evictions: Dict[str, int] = {}
-            for name, _ts, _value in tr.counters:
+            commit_flushes: Dict[str, int] = {}
+            for name, _ts, value in tr.counters:
                 if name.startswith("evictions."):
+                    # Sum VALUES, not entries: the batched commit flush
+                    # records one entry per flush carrying the whole
+                    # count (trace.note_evicts), the sequential path one
+                    # entry of value 1 per evict — identical totals.
                     action = name[len("evictions."):]
-                    evictions[action] = evictions.get(action, 0) + 1
+                    evictions[action] = (evictions.get(action, 0)
+                                         + int(value))
+                elif name.startswith("commit.flush."):
+                    action = name[len("commit.flush."):]
+                    commit_flushes[action] = (
+                        commit_flushes.get(action, 0) + int(value))
             out.append({
                 "session": tr.sid,
                 "uid": tr.uid,
@@ -100,6 +110,12 @@ class FlightRecorder:
                 "verdicts": len(tr.verdicts),
                 "tallies": len(tr.tallies),
                 "evictions": evictions,
+                # Batched commit flushes per action (trace counter
+                # ``commit.flush.<action>``, value = effects carried):
+                # a storm session shows e.g. {"preempt": 5001} here —
+                # the per-session form of kube_batch_commit_flushes_total
+                # (doc/EVICTION.md "Batched commit").
+                "commit_flushes": commit_flushes,
                 # Degraded-mode reasons (trace.note_degraded): which
                 # cycles ran on a fallback path and why (doc/CHAOS.md).
                 # Excluded from the meta copy below — one source of truth.

@@ -254,6 +254,16 @@ def note_evict(action: str) -> None:
         tr.counters.append((f"evictions.{action}", tr.now_us(), 1))
 
 
+def note_evicts(action: str, count: int) -> None:
+    """Bulk form for the batched commit flush: one counter entry
+    carrying the whole flush's committed-eviction count (the recorder's
+    summaries sum entry VALUES, so per-session eviction counts equal
+    the sequential control's)."""
+    tr = getattr(_tls, "trace", None)
+    if tr is not None and count:
+        tr.counters.append((f"evictions.{action}", tr.now_us(), count))
+
+
 # Degraded-mode reasons are bounded per session (a pathological cycle
 # could otherwise append one note per failing task).
 _MAX_DEGRADED_NOTES = 16

@@ -1,0 +1,546 @@
+"""Batched statement commit: bit-parity and machinery tests
+(doc/EVICTION.md "Batched commit").
+
+The contract: ``KUBE_BATCH_TPU_BATCH_COMMIT=1`` (default) accumulates
+each eviction action's cluster-side effects and flushes them as ONE
+fused cache update + ONE bulk egress per action — producing EXACTLY the
+binds, victims, victim ORDER, cache event stream, and lineage samples
+of the ``=0`` per-task sequential control; a mid-batch flush failure
+degrades to the per-task path counted, never dropping or
+double-applying an effect; and a discarded Statement after a partial
+accumulate restores the session exactly.
+"""
+
+import os
+
+import pytest
+
+from kube_batch_tpu.api import ObjectMeta, TaskStatus
+from kube_batch_tpu.api.queue_info import Queue
+from kube_batch_tpu.apis.scheduling import v1alpha1
+from kube_batch_tpu.cache import (FakeBinder, FakeEvictor, FakeStatusUpdater,
+                                  FakeVolumeBinder, SchedulerCache)
+from kube_batch_tpu.chaos import plan as chaos_plan
+from kube_batch_tpu.framework import close_session, open_session
+from kube_batch_tpu.framework.commit import BATCH_COMMIT_ENV
+from kube_batch_tpu.scheduler import (DEFAULT_SCHEDULER_CONF,
+                                      load_scheduler_conf)
+from tests.test_utils import build_node, build_pod, build_resource_list
+
+
+@pytest.fixture(autouse=True)
+def _register(monkeypatch):
+    from kube_batch_tpu.actions.factory import register_default_actions
+    from kube_batch_tpu.plugins.factory import register_default_plugins
+    register_default_actions()
+    register_default_plugins()
+    monkeypatch.setenv("KUBE_BATCH_TPU_SCAN_MIN_NODES", "0")
+    yield
+    chaos_plan.disable()
+
+
+def _storm_cache(n_nodes=3, lows_per_node=2, highs=2, high_min=2,
+                 starved_queue=True):
+    """Full nodes of low-priority Running pods + a high-priority Pending
+    gang (the preempt path) + a starved second queue (the reclaim
+    cross-queue path): both direct-evict and statement-commit flows
+    accumulate into the per-action sinks."""
+    binder = FakeBinder()
+    evictor = FakeEvictor()
+    cache = SchedulerCache(binder=binder, evictor=evictor,
+                           status_updater=FakeStatusUpdater(),
+                           volume_binder=FakeVolumeBinder())
+    cache.add_queue(Queue(metadata=ObjectMeta(name="q1"), weight=1))
+    if starved_queue:
+        cache.add_queue(Queue(metadata=ObjectMeta(name="q2"), weight=1))
+    for i in range(n_nodes):
+        cache.add_node(build_node(
+            f"n{i}", build_resource_list(str(2 * lows_per_node),
+                                         f"{4 * lows_per_node}Gi",
+                                         pods=110)))
+    cache.add_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name="low", namespace="ns"),
+        spec=v1alpha1.PodGroupSpec(min_member=1, queue="q1")))
+    cache.add_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name="high", namespace="ns"),
+        spec=v1alpha1.PodGroupSpec(min_member=high_min, queue="q1")))
+    if starved_queue:
+        cache.add_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name="starved", namespace="ns"),
+            spec=v1alpha1.PodGroupSpec(min_member=1, queue="q2")))
+    k = 0
+    for i in range(n_nodes):
+        for _ in range(lows_per_node):
+            cache.add_pod(build_pod("ns", f"lo{k}", f"n{i}", "Running",
+                                    build_resource_list("2", "4Gi"), "low",
+                                    priority=1, ts=float(k)))
+            k += 1
+    for i in range(highs):
+        cache.add_pod(build_pod("ns", f"hi{i}", "", "Pending",
+                                build_resource_list("2", "4Gi"), "high",
+                                priority=100, ts=float(100 + i)))
+    if starved_queue:
+        cache.add_pod(build_pod("ns", "starved0", "", "Pending",
+                                build_resource_list("2", "4Gi"), "starved",
+                                priority=50, ts=200.0))
+    for job in cache.jobs.values():
+        for t in job.tasks.values():
+            t.priority = (100 if t.name.startswith("hi")
+                          else 50 if t.name.startswith("starved") else 1)
+    if "ns/high" in cache.jobs:
+        cache.jobs["ns/high"].priority = 100
+    if "ns/starved" in cache.jobs:
+        cache.jobs["ns/starved"].priority = 50
+    cache.jobs["ns/low"].priority = 1
+    return cache, binder, evictor
+
+
+def _session_state(ssn):
+    return sorted((t.uid, t.status.name, t.node_name)
+                  for job in ssn.jobs.values() for t in job.tasks.values())
+
+
+def _actions():
+    from kube_batch_tpu.actions.backfill import BackfillAction
+    from kube_batch_tpu.actions.preempt import PreemptAction
+    from kube_batch_tpu.actions.reclaim import ReclaimAction
+    from kube_batch_tpu.actions.tpu_allocate import TpuAllocateAction
+    return [ReclaimAction(), TpuAllocateAction(), BackfillAction(),
+            PreemptAction()]
+
+
+def _run_storm(cache, cycles=2):
+    _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+    actions = _actions()
+    states = []
+    for _ in range(cycles):
+        ssn = open_session(cache, tiers)
+        try:
+            for a in actions:
+                a.execute(ssn)
+            states.append(_session_state(ssn))
+        finally:
+            close_session(ssn)
+    return states
+
+
+def _lineage_evicted():
+    """{pod: [(stage, reason)...]} eviction timelines of tracked pods."""
+    from kube_batch_tpu.trace.lineage import lineage
+    out = {}
+    for rec in lineage.dump().get("pods") or []:
+        evs = [(s["stage"], s.get("detail"))
+               for s in rec["stages"] if s["stage"] == "evicted"]
+        if evs:
+            out[rec["pod"]] = evs
+    return out
+
+
+class TestStormParity:
+    """Batched == sequential, bit for bit, on the full 4-action storm
+    pipeline (preempt + reclaim + backfill + the allocate binds)."""
+
+    def _both_arms(self, monkeypatch, cycles=2, lineage=False):
+        results = {}
+        for arm in ("0", "1"):
+            monkeypatch.setenv(BATCH_COMMIT_ENV, arm)
+            if lineage:
+                from kube_batch_tpu.trace.lineage import lineage as lin
+                monkeypatch.setenv("KUBE_BATCH_TPU_LINEAGE", "1")
+                lin.refresh()
+            cache, binder, evictor = _storm_cache()
+            states = _run_storm(cache, cycles=cycles)
+            results[arm] = {
+                "states": states,
+                "victims": list(evictor.evicts),  # ORDER is the contract
+                "binds": dict(binder.binds),
+                "bind_order": list(binder.channel),
+                "events": list(cache.events),
+                "lineage": _lineage_evicted() if lineage else None,
+            }
+        return results
+
+    def test_multi_cycle_storm_bit_parity(self, monkeypatch):
+        """Two back-to-back sessions on one cache: the truth mirror's
+        dict-order side effects feed the second snapshot, so any
+        ordering drift in the fused mirror shows up as a different
+        second-cycle decision."""
+        res = self._both_arms(monkeypatch, cycles=2)
+        assert res["1"]["victims"] == res["0"]["victims"]
+        assert res["1"]["binds"] == res["0"]["binds"]
+        assert res["1"]["bind_order"] == res["0"]["bind_order"]
+        assert res["1"]["events"] == res["0"]["events"]
+        assert res["1"]["states"] == res["0"]["states"]
+        assert res["0"]["victims"], "storm evicted nothing (vacuous)"
+
+    def test_lineage_samples_identical(self, monkeypatch):
+        """The per-pod eviction timelines (trace/lineage.py) record the
+        same pods with the same reasons in either arm."""
+        res = self._both_arms(monkeypatch, cycles=1, lineage=True)
+        assert res["1"]["lineage"] == res["0"]["lineage"]
+        assert res["1"]["victims"] == res["0"]["victims"]
+
+    def test_batched_arm_actually_flushed(self, monkeypatch):
+        from kube_batch_tpu.metrics.metrics import commit_flush_counts
+        monkeypatch.setenv(BATCH_COMMIT_ENV, "1")
+        before = commit_flush_counts()
+        cache, _binder, evictor = _storm_cache()
+        _run_storm(cache, cycles=1)
+        after = commit_flush_counts()
+        delta = {k: after.get(k, 0) - before.get(k, 0) for k in after}
+        assert evictor.evicts
+        assert sum(v for k, v in delta.items()
+                   if k.endswith("/batched")) >= 1, delta
+
+    def test_sessions_meta_surfaces_flushes(self, monkeypatch):
+        """/debug/sessions summaries carry per-action eviction totals
+        AND the commit-flush effect counts for the batched arm."""
+        from kube_batch_tpu.trace import flight_recorder
+        from kube_batch_tpu.trace import spans as tspans
+        monkeypatch.setenv(BATCH_COMMIT_ENV, "1")
+        cache, _binder, evictor = _storm_cache()
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        tspans.begin_session(test="batch-commit")
+        ssn = open_session(cache, tiers)
+        try:
+            for a in _actions():
+                a.execute(ssn)
+        finally:
+            close_session(ssn)
+            tspans.end_session()
+        summary = flight_recorder.summaries()[0]
+        total_evicts = sum(summary["evictions"].values())
+        total_flushed = sum(summary["commit_flushes"].values())
+        assert total_evicts == len(evictor.evicts)
+        assert total_flushed == len(evictor.evicts)
+
+
+class TestDiscardAfterPartialAccumulate:
+    def test_statement_discard_restores_exactly(self, monkeypatch):
+        """stmt.evict several victims, then discard: session state is
+        restored bit-exactly, nothing reaches the sink, and the action
+        flush egresses nothing."""
+        monkeypatch.setenv(BATCH_COMMIT_ENV, "1")
+        from kube_batch_tpu.framework.commit import action_commit
+        cache, _binder, evictor = _storm_cache()
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        try:
+            baseline = _session_state(ssn)
+            with action_commit(ssn, "preempt") as sink:
+                stmt = ssn.statement()
+                victims = [t for job in ssn.jobs.values()
+                           for t in job.tasks.values()
+                           if t.status == TaskStatus.Running][:3]
+                assert len(victims) == 3
+                for v in victims:
+                    stmt.evict(v, "preempt")
+                assert _session_state(ssn) != baseline
+                stmt.discard()
+                assert _session_state(ssn) == baseline
+                assert sink.evicts == []
+        finally:
+            close_session(ssn)
+        assert evictor.evicts == []
+        assert not any(e[0] == "Evict" for e in cache.events)
+
+    def test_commit_then_discard_flushes_only_committed(self, monkeypatch):
+        """A committed statement's evicts flush; a later discarded
+        statement's do not — and the flush egresses them in commit
+        order."""
+        monkeypatch.setenv(BATCH_COMMIT_ENV, "1")
+        from kube_batch_tpu.api import pod_key
+        from kube_batch_tpu.framework.commit import action_commit
+        cache, _binder, evictor = _storm_cache()
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        try:
+            running = [t for job in ssn.jobs.values()
+                       for t in job.tasks.values()
+                       if t.status == TaskStatus.Running]
+            with action_commit(ssn, "preempt"):
+                stmt = ssn.statement()
+                stmt.evict(running[0], "preempt")
+                stmt.evict(running[1], "preempt")
+                stmt.commit()
+                stmt2 = ssn.statement()
+                stmt2.evict(running[2], "preempt")
+                stmt2.discard()
+                assert evictor.evicts == []  # nothing egressed yet
+        finally:
+            close_session(ssn)
+        assert evictor.evicts == [pod_key(running[0].pod),
+                                  pod_key(running[1].pod)]
+
+
+class TestFlushDegradation:
+    """doc/CHAOS.md site ``commit.flush_error``: a mid-batch bulk-egress
+    abort degrades the remainder to the per-task sequential path —
+    counted, with no effect dropped or double-applied."""
+
+    def _chaos(self, sites, rate=1.0, budget=None):
+        chaos_plan.install(chaos_plan.FaultPlan(
+            seed=7, rate=rate, sites=sites, budget=budget))
+
+    def test_flush_error_degrades_without_drop_or_dup(self, monkeypatch):
+        from kube_batch_tpu.metrics.metrics import commit_flush_counts
+        monkeypatch.setenv(BATCH_COMMIT_ENV, "1")
+        before = commit_flush_counts()
+        self._chaos(("commit.flush_error",), rate=1.0, budget=1)
+        cache, _binder, evictor = _storm_cache()
+        states = _run_storm(cache, cycles=1)
+        chaos_plan.disable()
+
+        # Oracle: the same storm fault-free, sequential control.
+        monkeypatch.setenv(BATCH_COMMIT_ENV, "0")
+        cache2, _binder2, evictor2 = _storm_cache()
+        states2 = _run_storm(cache2, cycles=1)
+
+        # Every effect landed exactly once (no drop, no double-apply):
+        # the aborted suffix was re-driven through the per-task path in
+        # order, so the victim sequence equals the fault-free control's.
+        assert list(evictor.evicts) == list(evictor2.evicts)
+        assert states == states2
+        evict_events = [e for e in cache.events if e[0] == "Evict"]
+        assert len(evict_events) == len(evictor.evicts)
+        after = commit_flush_counts()
+        delta = {k: after.get(k, 0) - before.get(k, 0) for k in after}
+        assert sum(v for k, v in delta.items()
+                   if k.endswith("/degraded")) >= 1, delta
+
+    def test_evict_error_on_retry_restores_session(self, monkeypatch):
+        """When the degraded per-task retry ALSO fails, the session is
+        restored exactly as the sequential path's per-victim failure
+        handling would: the victim keeps running, nothing is lost."""
+        monkeypatch.setenv(BATCH_COMMIT_ENV, "1")
+        from kube_batch_tpu.framework.commit import action_commit
+        cache, _binder, evictor = _storm_cache()
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        try:
+            baseline = _session_state(ssn)
+            victim = next(t for job in ssn.jobs.values()
+                          for t in job.tasks.values()
+                          if t.status == TaskStatus.Running)
+            # Both the bulk egress AND the per-task retry fail.
+            self._chaos(("commit.flush_error", "evict.error"), rate=1.0)
+            with action_commit(ssn, "preempt"):
+                stmt = ssn.statement()
+                stmt.evict(victim, "preempt")
+                stmt.commit()
+            chaos_plan.disable()
+            # flush ran at the `with` exit: the failed effect was
+            # restored (victim Running again), and a resync was queued.
+            assert _session_state(ssn) == baseline
+            assert evictor.evicts == []
+            with cache.mutex:
+                assert len(cache.err_tasks) == 1
+        finally:
+            close_session(ssn)
+
+
+class TestEvictMany:
+    def test_bulk_evict_mirrors_truth_in_order(self):
+        cache, _binder, evictor = _storm_cache()
+        _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+        ssn = open_session(cache, tiers)
+        try:
+            victims = [t for job in ssn.jobs.values()
+                       for t in job.tasks.values()
+                       if t.status == TaskStatus.Running][:4]
+            epoch0 = cache.epoch
+            failures = cache.evict_many([(v, "preempt") for v in victims])
+            assert failures == []
+            from kube_batch_tpu.api import pod_key
+            assert evictor.evicts == [pod_key(v.pod) for v in victims]
+            evict_events = [e for e in cache.events if e[0] == "Evict"]
+            assert [e[1] for e in evict_events] == list(evictor.evicts)
+            assert cache.epoch > epoch0
+            with cache.mutex:
+                for v in victims:
+                    truth = cache.jobs[v.job].tasks[v.uid]
+                    assert truth.status == TaskStatus.Releasing
+                    node = cache.nodes[v.node_name]
+                    stored = node.tasks[pod_key(v.pod)]
+                    assert stored.status == TaskStatus.Releasing
+        finally:
+            close_session(ssn)
+
+    def test_truth_dict_order_matches_sequential(self):
+        """The fused mirror's move_task_status + reinsert must leave the
+        truth job/node task dicts in the same iteration order as the
+        sequential update_task_status/update_task round trips (the next
+        snapshot's tensor order depends on it)."""
+        from kube_batch_tpu.api import pod_key
+        orders = {}
+        for arm in ("seq", "bulk"):
+            cache, _binder, _evictor = _storm_cache()
+            _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+            ssn = open_session(cache, tiers)
+            try:
+                victims = [t for job in ssn.jobs.values()
+                           for t in job.tasks.values()
+                           if t.status == TaskStatus.Running][1:3]
+                if arm == "seq":
+                    for v in victims:
+                        cache.evict(v, "preempt")
+                else:
+                    assert cache.evict_many(
+                        [(v, "preempt") for v in victims]) == []
+                with cache.mutex:
+                    orders[arm] = (
+                        {uid: list(job.tasks)
+                         for uid, job in cache.jobs.items()},
+                        {name: list(node.tasks)
+                         for name, node in cache.nodes.items()},
+                        {uid: {st.name: list(b) for st, b in
+                               job.task_status_index.items()}
+                         for uid, job in cache.jobs.items()},
+                    )
+            finally:
+                close_session(ssn)
+        assert orders["bulk"] == orders["seq"]
+
+
+class TestEdgeWire:
+    """The bulk egress over the real HTTP edge: evict_pods_many (the
+    bind_pods_many twin) and the ClusterEvictor delegation."""
+
+    @pytest.fixture()
+    def api(self):
+        from kube_batch_tpu.cache.cluster import Cluster
+        from kube_batch_tpu.edge import ApiServer
+        cluster = Cluster()
+        server = ApiServer(cluster).start()
+        yield cluster, server
+        server.stop()
+
+    def _seed(self, cluster, n):
+        cluster.create_node(build_node(
+            "n0", build_resource_list(str(n), f"{n}Gi", pods=2 * n)))
+        for i in range(n):
+            cluster.create_pod(build_pod(
+                "ns", f"p{i}", "n0", "Running",
+                build_resource_list("1", "1Gi")))
+
+    def test_bulk_evict_lands_server_side(self, api):
+        from kube_batch_tpu.edge import RemoteCluster
+        cluster, server = api
+        self._seed(cluster, 12)
+        remote = RemoteCluster(server.url).start()
+        try:
+            with remote.lock:
+                pods = [remote.pods[f"ns/p{i}"] for i in range(12)]
+            failures = remote.evict_pods_many(pods, workers=4)
+        finally:
+            remote.stop()
+        assert failures == []
+        with cluster.lock:
+            assert not cluster.pods
+
+    def test_per_evict_failure_isolation(self, api):
+        from kube_batch_tpu.edge import RemoteCluster
+        cluster, server = api
+        self._seed(cluster, 5)
+        remote = RemoteCluster(server.url).start()
+        try:
+            with remote.lock:
+                pods = [remote.pods[f"ns/p{i}"] for i in range(5)]
+            ghost = build_pod("ns", "ghost", "", "Running",
+                              build_resource_list("1", "1Gi"))
+            failures = remote.evict_pods_many(
+                pods[:2] + [ghost] + pods[2:], workers=3)
+        finally:
+            remote.stop()
+        assert len(failures) == 1
+        assert failures[0][0].metadata.name == "ghost"
+        with cluster.lock:
+            assert not cluster.pods
+
+    def test_cluster_evictor_delegates(self, api):
+        from kube_batch_tpu.cache.cluster import ClusterEvictor
+        from kube_batch_tpu.edge import RemoteCluster
+        cluster, server = api
+        self._seed(cluster, 4)
+        remote = RemoteCluster(server.url).start()
+        try:
+            with remote.lock:
+                pods = [remote.pods[f"ns/p{i}"] for i in range(4)]
+            assert ClusterEvictor(remote).evict_many(pods) == []
+        finally:
+            remote.stop()
+        with cluster.lock:
+            assert not cluster.pods
+
+    def test_edge_commit_flow_parity(self, monkeypatch):
+        """The real commit machinery (Statement accumulate -> per-action
+        flush) over a SchedulerCache wired to the wire edge: batched
+        and sequential arms evict the same pods from server-side truth
+        in the same order, with identical local event streams."""
+        import time as _time
+
+        from kube_batch_tpu.cache.cluster import (Cluster,
+                                                  new_scheduler_cache)
+        from kube_batch_tpu.edge import ApiServer, RemoteCluster
+        from kube_batch_tpu.framework.commit import action_commit
+        results = {}
+        for arm in ("0", "1"):
+            monkeypatch.setenv(BATCH_COMMIT_ENV, arm)
+            cluster = Cluster()
+            server = ApiServer(cluster).start()
+            remote = RemoteCluster(server.url).start()
+            try:
+                cache = new_scheduler_cache(remote)
+                cluster.create_queue(v1alpha1.Queue(
+                    metadata=ObjectMeta(name="default"),
+                    spec=v1alpha1.QueueSpec(weight=1)))
+                cluster.create_node(build_node(
+                    "n0", build_resource_list("8", "16Gi", pods=110)))
+                cluster.create_pod_group(v1alpha1.PodGroup(
+                    metadata=ObjectMeta(name="low", namespace="ns"),
+                    spec=v1alpha1.PodGroupSpec(min_member=1)))
+                for k in range(4):
+                    cluster.create_pod(build_pod(
+                        "ns", f"lo{k}", "n0", "Running",
+                        build_resource_list("2", "4Gi"), "low",
+                        priority=1, ts=float(k)))
+                deadline = _time.time() + 10.0
+                while _time.time() < deadline:
+                    with cache.mutex:
+                        job = cache.jobs.get("ns/low")
+                        n_tasks = len(job.tasks) if job is not None else 0
+                    if n_tasks == 4:
+                        break
+                    _time.sleep(0.02)
+                _, tiers = load_scheduler_conf(DEFAULT_SCHEDULER_CONF)
+                ssn = open_session(cache, tiers)
+                try:
+                    victims = sorted(
+                        (t for job in ssn.jobs.values()
+                         for t in job.tasks.values()
+                         if t.status == TaskStatus.Running),
+                        key=lambda t: t.name)
+                    assert len(victims) == 4
+                    with action_commit(ssn, "preempt"):
+                        stmt = ssn.statement()
+                        for v in victims:
+                            stmt.evict(v, "preempt")
+                        stmt.commit()
+                finally:
+                    close_session(ssn)
+                deadline = _time.time() + 5.0
+                while _time.time() < deadline:
+                    with cluster.lock:
+                        if not cluster.pods:
+                            break
+                    _time.sleep(0.02)
+                with cluster.lock:
+                    results[arm] = sorted(cluster.pods)
+                results[arm + "_events"] = [
+                    e for e in cache.events if e[0] == "Evict"]
+            finally:
+                remote.stop()
+                server.stop()
+        assert results["1"] == results["0"] == []
+        assert results["1_events"] == results["0_events"]
+        assert len(results["0_events"]) == 4

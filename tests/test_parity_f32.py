@@ -17,7 +17,10 @@ from tests.test_tpu_parity import assert_parity, _plugins  # noqa: F401
 
 @pytest.fixture(autouse=True)
 def _no_x64():
-    with jax.enable_x64(False):
+    # jax.enable_x64 left the top-level namespace; the experimental
+    # context manager is the supported spelling of the same switch.
+    from jax.experimental import disable_x64
+    with disable_x64():
         yield
 
 

@@ -84,6 +84,16 @@ GATED_KEYS = {
     "floors_ms.plugin_close": {
         "path": ("floors_ms", "plugin_close"), "direction": "down",
         "band": 3.0, "abs_slack": 5.0},
+    # Batched commit/apply tail (doc/EVICTION.md "Batched commit"):
+    # the post-solve effect-side floors the tentpole vectorized —
+    # directional down; a change that stops emitting one fails the
+    # gate via the missing-key rule.
+    "floors_ms.commit": {
+        "path": ("floors_ms", "commit"), "direction": "down",
+        "band": 3.0, "abs_slack": 5.0},
+    "floors_ms.apply": {
+        "path": ("floors_ms", "apply"), "direction": "down",
+        "band": 3.0, "abs_slack": 5.0},
     # Queue-shard tenancy pacing (doc/TENANCY.md): per-tenant
     # micro-session rates under the asymmetric noisy/quiet churn split.
     # The QUIET tenant's rate is the isolation promise — the noisy
@@ -115,6 +125,15 @@ GATED_KEYS = {
         "band": 1.0, "abs_slack": 5.0},
     "preempt_ms": {
         "path": ("actions_ms", "preempt"), "direction": "down",
+        "band": 1.0, "abs_slack": 5.0},
+    # TRAJECTORY-ONLY like preempt_ms above: actions_ms never appears
+    # in the steady-only gate artifact, so these keys cannot enter the
+    # committed baseline (adding them would trip the missing-key rule
+    # on every gate run).  The CI gate for the commit/apply tail is
+    # `make bench-commit` (tools/check_commit_ab.py: parity + vacuous-
+    # flush), plus the gated floors_ms.commit/apply above.
+    "reclaim_ms": {
+        "path": ("actions_ms", "reclaim"), "direction": "down",
         "band": 1.0, "abs_slack": 5.0},
 }
 

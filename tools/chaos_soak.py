@@ -75,7 +75,8 @@ tiers:
 FAKE_SITES = ("session.snapshot", "session.tensorize", "solve.device_error",
               "solve.slow", "solve.poison", "evict_solve.device_error",
               "bind.timeout", "bind.http5xx", "bind.ambiguous",
-              "evict.error", "evict.ambiguous", "topology.bad_coords")
+              "evict.error", "evict.ambiguous", "commit.flush_error",
+              "topology.bad_coords")
 EDGE_SITES = FAKE_SITES + ("watch.disconnect", "watch.truncate",
                            "watch.stale")
 
@@ -433,6 +434,13 @@ def run_soak(seeds, *, nodes: int = 8, cycles: int = 10,
                   # Fires only on micro-eligible cycles (see FAKE_SITES
                   # note): boost it so those cycles do get hit.
                   ("incremental.stale_generation", min(1.0, rate * 1.6)),
+                  # One activation per per-action commit FLUSH (not per
+                  # effect): the batched commit's bulk-egress abort
+                  # (doc/EVICTION.md "Batched commit") — boosted so the
+                  # mid-batch degradation path demonstrably exercises
+                  # every sweep (the degraded per-task retries then feed
+                  # the evict.* sites above).
+                  ("commit.flush_error", min(1.0, rate * 1.6)),
                   # One activation per (cycle, labeled node) in the topo
                   # view build; boosted so label corruption demonstrably
                   # degrades nodes (not cycles) every sweep

@@ -1,0 +1,69 @@
+"""CI gate for `make bench-commit`: read the bench artifact line from
+stdin, assert the batched commit/apply tail's bit-parity verdict and
+that the batched arm actually flushed, and print both arms'
+commit/apply floors and per-action timings.
+
+bench.py deliberately always exits 0 (the artifact-always-emits
+contract), so the smoke's pass/fail lives here: a parity break, a
+missing A/B, or a vacuous zero-batched-flush run exits nonzero and
+fails the CI job (doc/EVICTION.md "Batched commit").
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> int:
+    line = ""
+    for raw in sys.stdin:
+        raw = raw.strip()
+        if raw.startswith("{"):
+            line = raw  # last JSON-looking line wins (the artifact)
+    if not line:
+        print("check_commit_ab: no artifact line on stdin", file=sys.stderr)
+        return 1
+    out = json.loads(line)
+    if out.get("error"):
+        print(f"check_commit_ab: bench reported error: {out['error']}",
+              file=sys.stderr)
+        return 1
+    if out.get("commit_parity") is not True:
+        print("check_commit_ab: PARITY FAILURE — batched commit/apply tail "
+              "diverged from the sequential control "
+              f"(commit_parity={out.get('commit_parity')!r})",
+              file=sys.stderr)
+        return 1
+    ab = out.get("commit_ab") or {}
+    if not ab:
+        print("check_commit_ab: artifact carries no commit_ab measurements",
+              file=sys.stderr)
+        return 1
+    flushes = out.get("commit_flushes") or {}
+    batched_flushes = sum(v for k, v in flushes.items()
+                          if k.endswith("/batched"))
+    if batched_flushes <= 0:
+        print("check_commit_ab: VACUOUS RUN — the batched arm recorded "
+              f"zero batched flushes (flushes={flushes}); the A/B "
+              "compared the sequential path against itself",
+              file=sys.stderr)
+        return 1
+    print("batched commit A/B: parity OK "
+          f"({ab.get('evictions')} evictions, flushes: {flushes})")
+    sp = ab.get("speedup") or {}
+    for part in ("commit", "apply"):
+        b = ab["batched"][f"{part}_ms"]
+        s = ab["sequential"][f"{part}_ms"]
+        print(f"  {part:8s} batched {b:8.3f} ms   "
+              f"sequential {s:8.3f} ms   ({sp.get(part)}x)")
+    print(f"  commit+apply combined speedup: {sp.get('commit_apply')}x")
+    for name, b in sorted(ab["batched"]["actions_ms"].items()):
+        s = ab["sequential"]["actions_ms"].get(name)
+        print(f"  action {name:13s} batched {b:8.1f} ms   "
+              f"sequential {s:8.1f} ms")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

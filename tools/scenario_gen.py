@@ -79,6 +79,7 @@ SEQUENTIAL_CONTROLS = {
     "KUBE_BATCH_TPU_CANDIDATE_SOLVE": "0",
     "KUBE_BATCH_TPU_TOPO_BATCH": "0",
     "KUBE_BATCH_TPU_WIRE_FAST": "0",
+    "KUBE_BATCH_TPU_BATCH_COMMIT": "0",
 }
 
 BASE_CONF = """

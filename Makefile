@@ -4,8 +4,9 @@ COMPILE_CACHE ?= $(CURDIR)/.compile-cache
 
 .PHONY: lint lint-inventory test bench bench-cached bench-steady \
 	bench-evict bench-commit bench-churn bench-wire bench-shard \
-	bench-topo bench-gate bench-gate-baseline lineage-ab chaos \
-	chaos-smoke scenarios soak-replicas trace-demo clean-cache
+	bench-topo bench-tenancy bench-gate bench-gate-baseline \
+	lineage-ab chaos chaos-smoke scenarios soak-replicas trace-demo \
+	clean-cache
 
 # The bench-gate shape: small enough for CI, big enough that the steady
 # path, delta shipping, and the residual floors all exercise (mirrors
@@ -138,6 +139,22 @@ bench-topo:
 		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
 		BENCH_TOPO_AB=1 $(PYTHON) bench.py \
 		| $(PYTHON) tools/check_topo_ab.py
+
+# Concurrent-vs-sequential shard micro-session A/B on the virtual
+# 8-device CPU mesh (doc/TENANCY.md "Concurrent micro-sessions"):
+# counterbalanced off/on/on/off multi-dirty-shard storm through a real
+# Scheduler + TenancyEngine with KUBE_BATCH_TPU_CONCURRENT_SHARDS
+# toggled per arm — asserts bit-identical binds, events, and lineage
+# bind samples (single-chip AND the FORCE_SHARD mesh leg) and that the
+# concurrent arm actually overlapped (a zero-overlap run is vacuous and
+# fails).  The checker exits nonzero on any violation (bench.py itself
+# always exits 0), so CI fails loudly.
+bench-tenancy:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		BENCH_TENANCY_AB=1 BENCH_TASKS=2000 BENCH_NODES=256 \
+		BENCH_JOBS=80 BENCH_QUEUES=4 $(PYTHON) bench.py \
+		| $(PYTHON) tools/check_tenancy_ab.py
 
 # Adversarial scenario sweep (doc/TOPOLOGY.md "Scenario harness"):
 # seeded generated workloads (gang deadlocks, priority inversions,

@@ -529,7 +529,7 @@ def measure_tenancy_steady(n_tasks, n_nodes, n_jobs, n_queues,
         or quiet_ms
     noisy_med, _ = _stats(clean_noisy) if clean_noisy else (None, None)
     quiet_med, _ = _stats(clean_quiet) if clean_quiet else (None, None)
-    return {
+    out = {
         "shards": n_queues,
         "micro_sessions": sessions,
         "churn_per_round": k,
@@ -542,6 +542,308 @@ def measure_tenancy_steady(n_tasks, n_nodes, n_jobs, n_queues,
         "shard_rebalances":
             sum(shard_rebalance_counts().values()) - rebal0,
     }
+    # Concurrent-pipeline leg (doc/TENANCY.md "Concurrent
+    # micro-sessions"): one fresh multi-dirty-shard storm through the
+    # real TenancyEngine pipeline — the per-round overlapped host time
+    # and the in-flight high water are bench-gate keys (overlap
+    # silently collapsing to zero is the regression the gate watches).
+    try:
+        storm = _tenancy_storm_arm(True, n_tasks, n_nodes, n_jobs,
+                                   n_queues, rounds=3)
+        overlap_rounds = sorted(storm["overlap_ms_rounds"])
+        out["shard_overlap_ms"] = (
+            round(overlap_rounds[len(overlap_rounds) // 2], 3)
+            if overlap_rounds else None)
+        out["shard_inflight"] = storm["inflight"]
+        out["pipeline"] = storm["pipeline"]
+    except Exception as exc:  # failure-isolated like the other legs
+        out["pipeline_error"] = f"{type(exc).__name__}: {exc}"
+    return out
+
+
+def _tenancy_storm_arm(concurrent, n_tasks, n_nodes, n_jobs, n_queues,
+                       rounds: int = 4, churn_frac: float = 0.05):
+    """One arm of the multi-dirty-shard storm (doc/TENANCY.md
+    "Concurrent micro-sessions"): ``n_queues`` tenants on DISJOINT
+    node-selector pools (cross-tenant placement independence — the
+    tenancy parity precondition), every tenant submitting one fresh
+    placeable gang per round so EVERY shard is dirty EVERY round, driven
+    through a real Scheduler + TenancyEngine with
+    KUBE_BATCH_TPU_CONCURRENT_SHARDS toggled per arm.  Gangs two rounds
+    old retire, so pools never fill.  Returns whole-round walls, bind
+    fingerprints + the cluster event log (the parity material), overlap/
+    in-flight/pipeline counters, and per-pod lineage sample counts."""
+    import dataclasses as dc
+
+    from kube_batch_tpu.api import (Container, Node, NodeSpec, NodeStatus,
+                                    ObjectMeta, Pod, PodSpec, PodStatus,
+                                    pod_key)
+    from kube_batch_tpu.api.queue_info import Queue
+    from kube_batch_tpu.apis.scheduling import v1alpha1
+    from kube_batch_tpu.apis.scheduling.v1alpha1 import \
+        GroupNameAnnotationKey
+    from kube_batch_tpu.cache import (FakeBinder, FakeEvictor,
+                                      FakeStatusUpdater, FakeVolumeBinder,
+                                      SchedulerCache)
+    from kube_batch_tpu.cache.cache import _EventDeque
+    from kube_batch_tpu.metrics.metrics import (compile_cache_counts,
+                                                shard_cycle_stats,
+                                                shard_overlap_total_ms,
+                                                shard_pipeline_counts)
+    from kube_batch_tpu.scheduler import Scheduler
+    from kube_batch_tpu.tenancy import CONCURRENT_ENV
+    from kube_batch_tpu.tenancy.shards import SHARD_MAP_ENV, TENANCY_ENV
+
+    _register()
+    saved = {k: os.environ.get(k)
+             for k in (CONCURRENT_ENV, TENANCY_ENV, SHARD_MAP_ENV)}
+    os.environ[CONCURRENT_ENV] = "1" if concurrent else "0"
+    os.environ[TENANCY_ENV] = str(n_queues)
+    os.environ[SHARD_MAP_ENV] = "|".join(
+        f"q{i}:{i}" for i in range(n_queues))
+    try:
+        binder = FakeBinder()
+        cache = SchedulerCache(binder=binder, evictor=FakeEvictor(),
+                               status_updater=FakeStatusUpdater(),
+                               volume_binder=FakeVolumeBinder())
+        cache.events = _EventDeque(maxlen=max(200000, 4 * n_tasks + 20000))
+        for q in range(n_queues):
+            cache.add_queue(Queue(
+                metadata=ObjectMeta(name=f"q{q}",
+                                    creation_timestamp=float(q)),
+                weight=1))
+        alloc = {"cpu": "16", "memory": "64Gi", "pods": 110}
+        for i in range(n_nodes):
+            pool = f"q{i % n_queues}"
+            name = f"n{i:05d}"
+            cache.add_node(Node(
+                metadata=ObjectMeta(name=name, uid=name,
+                                    labels={"pool": pool}),
+                spec=NodeSpec(),
+                status=NodeStatus(allocatable=dict(alloc),
+                                  capacity=dict(alloc))))
+        scheduler = Scheduler(cache, schedule_period=3600)
+        assert scheduler.tenancy is not None
+        # Per-arm lineage ledger: the ring is process-global, so each
+        # arm starts it fresh and its bound-sample set is the arm's own.
+        from kube_batch_tpu.trace.lineage import lineage as pod_lineage
+        pod_lineage.clear()
+
+        podmap = {}
+
+        def submit_gang(tenant: int, name: str, size: int):
+            cache.add_pod_group(v1alpha1.PodGroup(
+                metadata=ObjectMeta(name=name, namespace="bench"),
+                spec=v1alpha1.PodGroupSpec(
+                    min_member=max(1, size * 4 // 5),
+                    queue=f"q{tenant}")))
+            keys = []
+            for i in range(size):
+                uid = f"{name}-{i}"
+                pod = Pod(
+                    metadata=ObjectMeta(
+                        name=uid, namespace="bench", uid=uid,
+                        annotations={GroupNameAnnotationKey: name},
+                        creation_timestamp=float(len(podmap))),
+                    spec=PodSpec(
+                        node_selector={"pool": f"q{tenant}"},
+                        containers=[Container(
+                            requests={"cpu": "500m", "memory": "1Gi"})]),
+                    status=PodStatus(phase="Pending"))
+                podmap[pod_key(pod)] = pod
+                keys.append(pod_key(pod))
+                cache.add_pod(pod)
+            return keys
+
+        def echo():
+            binds = dict(binder.binds)
+            binder.binds.clear()
+            for key, node in binds.items():
+                old = podmap.get(key)
+                if old is None:
+                    continue
+                new = dc.replace(
+                    old, spec=dc.replace(old.spec, node_name=node),
+                    status=PodStatus(phase="Running"))
+                podmap[key] = new
+                cache.update_pod(old, new)
+            updater = cache.status_updater
+            if getattr(updater, "pod_groups", None):
+                for pg in updater.pod_groups:
+                    cache.add_pod_group(pg)
+                updater.pod_groups.clear()
+
+        gang = max(4, int(n_tasks * churn_frac) // max(n_queues, 1))
+        with _gc_posture():
+            # Warm pass: one small gang per tenant compiles every
+            # shard's solver family.
+            for t in range(n_queues):
+                submit_gang(t, f"warm-{t}", 4)
+            scheduler.run_once()
+            echo()
+            scheduler.run_once()  # absorb the echo
+            echo()
+            fingerprints = []
+            events_mark = len(cache.events)
+            overlap0 = shard_overlap_total_ms()
+            pipe0 = shard_pipeline_counts()
+            retire = []
+            walls = []
+            recompiled = []
+            inflight_hw = 1
+            overlap_rounds = []
+            for rnd in range(rounds):
+                round_start = time.perf_counter()
+                new_keys = []
+                for t in range(n_queues):
+                    new_keys.extend(
+                        submit_gang(t, f"storm-{rnd}-t{t}", gang))
+                if len(retire) >= 2:
+                    old_keys = retire.pop(0)
+                    for key in old_keys:
+                        pod = podmap.pop(key, None)
+                        if pod is not None:
+                            cache.delete_pod(pod)
+                o0 = shard_overlap_total_ms()
+                miss0 = compile_cache_counts()[1]
+                scheduler.run_once()
+                # The recompile-round discipline every steady window
+                # applies (doc/OBSERVABILITY.md): a fresh XLA compile
+                # inside the round makes its wall a compile measurement.
+                recompiled.append(compile_cache_counts()[1] > miss0)
+                overlap_rounds.append(
+                    round(shard_overlap_total_ms() - o0, 3))
+                if concurrent:
+                    inflight_hw = max(inflight_hw,
+                                      shard_cycle_stats()[1])
+                fingerprints.append(tuple(sorted(binder.binds.items())))
+                echo()
+                retire.append(new_keys)
+                walls.append((time.perf_counter() - round_start) * 1e3)
+            pipe1 = shard_pipeline_counts()
+        truncated = len(cache.events) >= cache.events.maxlen
+        events = None if truncated else list(cache.events)[events_mark:]
+        from kube_batch_tpu.trace.lineage import lineage as pod_lineage
+        dump = pod_lineage.dump()
+        samples = sorted(p["pod"] for p in dump.get("pods", [])
+                         if p.get("bound"))
+        clean = [w for w, rec in zip(walls, recompiled) if not rec] \
+            or walls
+        return {
+            "samples": samples,
+            "walls_ms": walls,
+            "clean_walls_ms": clean,
+            "recompiled_rounds": int(sum(recompiled)),
+            "fingerprints": fingerprints,
+            "events": events,
+            "events_truncated": truncated,
+            "sessions_per_sec": (round(len(clean) * n_queues
+                                       / (sum(clean) / 1e3), 3)
+                                 if clean and sum(clean) > 0 else None),
+            "overlap_ms_rounds": overlap_rounds,
+            "overlap_ms_total": round(
+                shard_overlap_total_ms() - overlap0, 3),
+            "inflight": inflight_hw,
+            "pipeline": {k: pipe1.get(k, 0) - pipe0.get(k, 0)
+                         for k in set(pipe0) | set(pipe1)},
+            "gang": gang,
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def measure_tenancy_ab(n_tasks, n_nodes, n_jobs, n_queues,
+                       rounds: int = 4):
+    """Counterbalanced multi-dirty-shard storm A/B
+    (KUBE_BATCH_TPU_CONCURRENT_SHARDS off/on/on/off, fresh cache per
+    arm, identical deterministic schedules): the concurrent pipeline
+    must produce bit-identical binds + events while overlapping shard
+    host phases through the dispatch window (`make bench-tenancy` /
+    tools/check_tenancy_ab.py).  Adds one FORCE_SHARD pair so the
+    8-device mesh leg carries the same parity."""
+    arms = [_tenancy_storm_arm(conc, n_tasks, n_nodes, n_jobs, n_queues,
+                               rounds=rounds)
+            for conc in (False, True, True, False)]
+    parity = all(
+        arm["fingerprints"] == arms[0]["fingerprints"]
+        and (arm["events"] is None or arms[0]["events"] is None
+             or arm["events"] == arms[0]["events"])
+        for arm in arms[1:])
+    lineage_parity = all(arm["samples"] == arms[0]["samples"]
+                         for arm in arms[1:])
+    seq = arms[0]["clean_walls_ms"] + arms[3]["clean_walls_ms"]
+    conc = arms[1]["clean_walls_ms"] + arms[2]["clean_walls_ms"]
+    med_s, p90_s = _stats(seq)
+    med_c, p90_c = _stats(conc)
+
+    def sps(walls):
+        return (round(len(walls) * n_queues / (sum(walls) / 1e3), 3)
+                if walls and sum(walls) > 0 else None)
+
+    mesh = {"parity": None, "skipped": "single-device host"}
+    import jax
+    if len(jax.devices()) >= 2:
+        from kube_batch_tpu.ops.solver import (FORCE_SHARD_ENV,
+                                               refresh_shard_knobs)
+        prior = os.environ.get(FORCE_SHARD_ENV)
+        os.environ[FORCE_SHARD_ENV] = "1"
+        refresh_shard_knobs()
+        try:
+            m_seq = _tenancy_storm_arm(False, n_tasks, n_nodes, n_jobs,
+                                       n_queues, rounds=2)
+            m_conc = _tenancy_storm_arm(True, n_tasks, n_nodes, n_jobs,
+                                        n_queues, rounds=2)
+            mesh = {
+                "parity": (m_conc["fingerprints"] == m_seq["fingerprints"]
+                           and (m_conc["events"] is None
+                                or m_seq["events"] is None
+                                or m_conc["events"] == m_seq["events"])),
+                "overlap_ms_total": m_conc["overlap_ms_total"],
+                "binds": sum(len(f) for f in m_seq["fingerprints"]),
+            }
+        finally:
+            if prior is None:
+                os.environ.pop(FORCE_SHARD_ENV, None)
+            else:
+                os.environ[FORCE_SHARD_ENV] = prior
+            refresh_shard_knobs()
+    return {
+        "shards": n_queues,
+        "rounds": rounds,
+        "gang": arms[0]["gang"],
+        "parity": parity,
+        "events_verified": not any(a["events_truncated"] for a in arms),
+        "lineage_parity": lineage_parity,
+        "concurrent": {
+            "round_ms": med_c, "round_p90": p90_c,
+            "sessions_per_sec": sps(conc),
+            "overlap_ms_total": arms[1]["overlap_ms_total"]
+            + arms[2]["overlap_ms_total"],
+            "inflight": max(arms[1]["inflight"], arms[2]["inflight"]),
+            "pipeline": arms[1]["pipeline"],
+        },
+        "sequential": {
+            "round_ms": med_s, "round_p90": p90_s,
+            "sessions_per_sec": sps(seq),
+            "inflight": max(arms[0]["inflight"], arms[3]["inflight"]),
+        },
+        "speedup": (round(med_s / med_c, 3) if med_c else None),
+        "mesh": mesh,
+    }
+
+
+def _fill_tenancy_ab(out, n_tasks, n_nodes, n_jobs, n_queues,
+                     rounds: int = 4) -> None:
+    ab = measure_tenancy_ab(n_tasks, n_nodes, n_jobs, n_queues,
+                            rounds=rounds)
+    out["tenancy_ab"] = ab
+    out["tenancy_parity"] = bool(
+        ab["parity"] and ab["lineage_parity"]
+        and (ab["mesh"].get("parity") is not False))
 
 
 def _fill_lineage_ab(out, n_tasks, n_nodes, n_jobs, n_queues, rounds):
@@ -1849,7 +2151,22 @@ def _fill_action_ab(out, n_tasks, n_nodes, n_jobs, n_queues,
 def _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline,
          steady_only=False, steady_rounds_n=5, evict_only=False,
          churn_only=False, shard_only=False, lineage_only=False,
-         topo_only=False, wire_only=False, commit_only=False):
+         topo_only=False, wire_only=False, commit_only=False,
+         tenancy_only=False):
+    if tenancy_only:
+        # BENCH_TENANCY_AB=1 (`make bench-tenancy`): ONLY the
+        # concurrent-vs-sequential shard micro-session A/B — the
+        # counterbalanced multi-dirty-shard storm with bind/event/
+        # lineage parity and the overlap/inflight counters
+        # tools/check_tenancy_ab.py gates CI on (doc/TENANCY.md
+        # "Concurrent micro-sessions").
+        import jax as _jax
+        out["platform"] = _jax.default_backend()
+        out["mesh_devices"] = len(_jax.devices())
+        _fill_tenancy_ab(out, n_tasks, n_nodes, n_jobs, n_queues,
+                         rounds=int(os.environ.get("BENCH_TENANCY_ROUNDS",
+                                                   "4")))
+        return
     if commit_only:
         # BENCH_COMMIT_AB=1 (`make bench-commit`): ONLY the batched-vs-
         # sequential commit/apply A/B — storm parity plus the
@@ -2147,6 +2464,13 @@ def main():
         # capacity eviction contrast + batched/sequential/mesh parity
         # (doc/TOPOLOGY.md; gated by tools/check_topo_ab.py).
         "topo_ab": None,
+        # Concurrent shard micro-sessions A/B (BENCH_TENANCY_AB=1 /
+        # `make bench-tenancy`): multi-dirty-shard storm, concurrent
+        # pipeline vs the CONCURRENT_SHARDS=0 sequential control —
+        # bind/event/lineage parity + overlap/inflight counters
+        # (doc/TENANCY.md "Concurrent micro-sessions").
+        "tenancy_ab": None,
+        "tenancy_parity": None,
         "topo_parity": None,
         "topo_shard_parity": None,
         "topo_slices": None,
@@ -2197,6 +2521,7 @@ def main():
         shard_only = os.environ.get("BENCH_SHARD_AB") == "1"
         lineage_only = os.environ.get("BENCH_LINEAGE_AB") == "1"
         topo_only = os.environ.get("BENCH_TOPO_AB") == "1"
+        tenancy_only = os.environ.get("BENCH_TENANCY_AB") == "1"
         steady_rounds_n = int(os.environ.get("BENCH_STEADY_ROUNDS", 5))
         out["metric"] = (f"sched-session solve latency @ {n_tasks} tasks "
                          f"x {n_nodes} nodes (gang+DRF+proportion)"
@@ -2207,7 +2532,8 @@ def main():
                          + (" [wire-ab]" if wire_only else "")
                          + (" [shard-ab]" if shard_only else "")
                          + (" [lineage-ab]" if lineage_only else "")
-                         + (" [topo-ab]" if topo_only else ""))
+                         + (" [topo-ab]" if topo_only else "")
+                         + (" [tenancy-ab]" if tenancy_only else ""))
 
         # Wall-clock backstop for hangs the signal guard cannot reach
         # (a device call blocked in an extension never returns to the
@@ -2247,7 +2573,7 @@ def main():
              evict_only=evict_only, churn_only=churn_only,
              shard_only=shard_only, lineage_only=lineage_only,
              topo_only=topo_only, wire_only=wire_only,
-             commit_only=commit_only)
+             commit_only=commit_only, tenancy_only=tenancy_only)
         # Last statement INSIDE the try: a signal landing here is still
         # caught below — no handlerless gap before the emit.
         _ignore_signals()

@@ -107,7 +107,26 @@ class TpuAllocateAction(Action):
                     "unplaced tasks")
 
     def execute(self, ssn) -> None:
-        from ..chaos.breaker import device_breaker, solve_deadline_s
+        finish = self.execute_begin(ssn)
+        if finish is not None:
+            finish()
+
+    def execute_begin(self, ssn):
+        """The HOST half of the action — tensorize, ship, async solve
+        dispatch, device-wait-window apply preparation — with every
+        cluster-mutating step deferred into the returned continuation.
+
+        Returns None when the action fully completed (nothing to solve),
+        else a zero-argument continuation that finishes it: device fetch,
+        result validation, placement apply, fit deltas — or the host
+        fallback when the begin half already decided to degrade.  The
+        split is what the concurrent shard pipeline overlaps: shard K+1
+        runs this begin half while shard K's dispatch executes on device,
+        and the continuations retire in shard order so binds and events
+        stay sequential-identical (doc/TENANCY.md "Concurrent
+        micro-sessions").  ``execute`` composes the halves back-to-back,
+        which is the exact pre-split control flow."""
+        from ..chaos.breaker import device_breaker
         from ..models.tensor_snapshot import tensorize_session
 
         breaker = device_breaker()
@@ -115,25 +134,31 @@ class TpuAllocateAction(Action):
             # OPEN within cooldown: the device path is quarantined and
             # the host oracle serves this cycle.  Once the cooldown
             # elapses, allow() turns the breaker half-open and the next
-            # cycle probes the device path again.
-            trace.note_degraded(
-                "device breaker open: tpu-allocate ran the host path")
-            self._run_host_fallback(ssn)
-            return
+            # cycle probes the device path again.  The fallback mutates
+            # the session and binds, so it is retire-phase work.
+            def finish_breaker_open():
+                trace.note_degraded(
+                    "device breaker open: tpu-allocate ran the host path")
+                self._run_host_fallback(ssn)
+            ssn._pipeline_reads_all = True
+            return finish_breaker_open
 
         start = time.time()
         try:
             with trace.span("tensorize"):
                 snap = tensorize_session(ssn)
         except Exception as exc:
-            self._fallback_on_failure(ssn, breaker, "tensorize", exc)
-            return
+            ssn._pipeline_reads_all = True
+            # Bind via default: `exc` is unbound once the except block
+            # exits, and the continuation runs later.
+            return lambda err=exc: self._fallback_on_failure(
+                ssn, breaker, "tensorize", err)
         if snap.needs_fallback:
             # A tensorization GAP, not a device failure: the breaker
             # stays untouched (needs_fallback is the expressiveness
             # boundary, the breaker is the health boundary).
-            self._run_host_fallback(ssn)
-            return
+            ssn._pipeline_reads_all = True
+            return lambda: self._run_host_fallback(ssn)
         metrics.observe_tpu_transfer_latency(time.time() - start)
 
         # Backfill pre-scan: the tensorizer already collected every
@@ -150,7 +175,8 @@ class TpuAllocateAction(Action):
             ssn.prescan["has_best_effort"] = False
 
         if not snap.tasks:
-            return
+            self._publish_read_fence(ssn, snap, empty=True)
+            return None
 
         from ..models.shipping import resident_shipper
         from ..ops.solver import (best_solve_allocate, dispatch_solve,
@@ -163,7 +189,12 @@ class TpuAllocateAction(Action):
         # (device error, poisoned readback, dead tunnel) safely degrades
         # this cycle to the host path and feeds the breaker.  From the
         # apply phase on, failures propagate as before — the session is
-        # mutated and a re-run would double-place.
+        # mutated and a re-run would double-place.  The begin half below
+        # stops at the async dispatch; fetch/validate/apply live in the
+        # returned continuation.
+        pending = None
+        assignment = kind = order = ordered = None
+        begin_solve_elapsed = 0.0
         try:
             ship_start = time.time()
             # Device-resident delta shipping: steady cycles move only the
@@ -234,9 +265,10 @@ class TpuAllocateAction(Action):
                 elif pipelined:
                     # Dispatch, overlap the result-independent apply
                     # preparation with the executing device program, then
-                    # block only when the result is actually consumed.  The
-                    # packed readback also forces completion
-                    # (block_until_ready is unreliable on the axon tunnel).
+                    # block only when the result is actually consumed
+                    # (the continuation below).  The packed readback also
+                    # forces completion (block_until_ready is unreliable
+                    # on the axon tunnel).
                     with trace.span("dispatch"):
                         pending = dispatch_solve(inputs, snap.config,
                                                  candidates=candidates)
@@ -248,13 +280,6 @@ class TpuAllocateAction(Action):
                         scaffold = prepare_apply_scaffold(snap)
                     metrics.observe_host_overlap_latency(
                         time.perf_counter() - overlap_start)
-                    wait_start = time.perf_counter()
-                    with trace.span("device_wait"):
-                        assignment, kind, order, ordered = \
-                            fetch_solve(pending)
-                    wait_elapsed = time.perf_counter() - wait_start
-                    metrics.observe_device_wait_latency(wait_elapsed)
-                    metrics.set_cycle_floor("solve_wait", wait_elapsed)
                 else:
                     with trace.span("solve"):
                         result = best_solve_allocate(inputs, snap.config)
@@ -266,89 +291,193 @@ class TpuAllocateAction(Action):
                     ordered = placed[np.argsort(order[placed],
                                                 kind="stable")]
                     scaffold = None
-            solve_elapsed = time.time() - solve_start
-            metrics.observe_tpu_solve_latency(solve_elapsed)
-            self._validate_result(snap, assignment, kind, order, ordered)
+            begin_solve_elapsed = time.time() - solve_start
         except Exception as exc:
-            self._fallback_on_failure(ssn, breaker, "solve", exc)
-            return
+            if pending is not None:
+                # The dispatch landed before the failure (e.g. the
+                # scaffold prep raised): retire the handle from the
+                # in-flight ledger — nothing will ever fetch it.
+                from ..ops.solver import discard_solve
+                discard_solve(pending)
+            ssn._pipeline_reads_all = True
+            return lambda err=exc: self._fallback_on_failure(
+                ssn, breaker, "solve", err)
 
-        if inc_state is not None and cached_solve is None:
-            # Cache AFTER validation only: a poisoned readback must
-            # never become a reusable "known-good" result.
-            inc_state.solve_gen = shipper.generation
-            inc_state.solve_cfg = snap.config
-            inc_state.solve_result = (assignment, kind, order, ordered)
-            inc_state.solve_route = route
-            metrics.note_generation_reuse(False)
+        # Publish the successor-conflict read fence BEFORE pausing: the
+        # pipeline compares predecessors' mutated nodes against this
+        # session's statically-feasible node union (doc/TENANCY.md
+        # "Concurrent micro-sessions" — the solve's outcome provably
+        # depends on node state only inside sig-feasible columns).
+        self._publish_read_fence(ssn, snap)
 
-        deadline = solve_deadline_s()
-        if cached_solve is not None:
-            # A reused result is no device health evidence either way:
-            # the breaker and the solve deadline see nothing.
-            pass
-        elif deadline and solve_elapsed > deadline:
-            # Detective, not preemptive: the (valid) late result is still
-            # applied, but a repeatedly-slow device trips the breaker to
-            # the host path exactly like an erroring one.
-            breaker.failure()
-            metrics.note_solve_deadline()
-            trace.note_degraded(
-                f"session solve exceeded deadline "
-                f"({solve_elapsed * 1e3:.0f} ms > {deadline * 1e3:.0f} ms)")
-        else:
-            breaker.success()
-
-        # Apply placements in device-solve order through the columnar
-        # batched path: end state (status indexes, node accounting,
-        # plugin shares, gang dispatch) is identical to per-task
-        # ssn.allocate/pipeline calls, fed straight from the solver's
-        # arrays and the staged index->TaskInfo table — no per-placement
-        # tuple materialization (Session.batch_apply_solved).
-        apply_start = time.perf_counter()
-        with trace.span("apply", placed=int(ordered.size)):
-            if scaffold is None:
-                scaffold = prepare_apply_scaffold(snap)
-            agg = build_apply_aggregates(snap, assignment, kind, ordered,
-                                         scaffold=scaffold)
-            # Pod lineage: batch_apply records the bulk "placed" stage;
-            # the cycle context names which engine decided it (shown on
-            # /debug/lineage as e.g. "via tpu-allocate/sharded").
-            from ..framework.commit import batch_commit_enabled
-            from ..trace.lineage import lineage as pod_lineage
-            pod_lineage.cycle_context = f"via {self.name()}/{route}"
+        def finish():
+            nonlocal scaffold, assignment, kind, order, ordered
+            from ..chaos.breaker import solve_deadline_s
             try:
-                if batch_commit_enabled():
-                    ssn.batch_apply_solved(
-                        scaffold.tasks_arr, scaffold.node_names_arr,
-                        assignment, kind, ordered, snap.task_job,
-                        snap.job_uids, agg)
+                if pending is not None:
+                    wait_start = time.perf_counter()
+                    with trace.span("device_wait"):
+                        assignment, kind, order, ordered = \
+                            fetch_solve(pending)
+                    wait_elapsed = time.perf_counter() - wait_start
+                    metrics.observe_device_wait_latency(wait_elapsed)
+                    metrics.set_cycle_floor("solve_wait", wait_elapsed)
+                    solve_elapsed = begin_solve_elapsed + wait_elapsed
                 else:
-                    # KUBE_BATCH_TPU_BATCH_COMMIT=0: the pre-columnar
-                    # tuple fan-out — the bit-parity control for the
-                    # whole commit/apply tail (doc/EVICTION.md
-                    # "Batched commit").
-                    kinds = kind[ordered].tolist()
-                    hostnames = scaffold.node_names_arr[
-                        assignment[ordered]].tolist()
-                    ssn.batch_apply(
-                        zip(scaffold.tasks_arr[ordered].tolist(),
-                            hostnames, kinds),
-                        agg=agg)
-            finally:
-                pod_lineage.cycle_context = ""
-        # The ``apply`` floor is the placement apply alone (the stage the
-        # columnar path vectorizes); the histogram keeps its historical
-        # span (apply + fit-delta recording).
-        ssn._floor_apply += time.perf_counter() - apply_start
-        with trace.span("fit_deltas"):
-            self._record_fit_deltas(ssn, snap, kind, assignment, order,
-                                    scaffold=scaffold)
-        metrics.observe_tpu_apply_latency(time.perf_counter() - apply_start)
-        # After the latency observation: the tally walk must not inflate
-        # the histogram the recorder's spans are validated against.
-        if trace.current_session_id() is not None:
-            self._record_why_tallies(ssn, snap, kind)
+                    solve_elapsed = begin_solve_elapsed
+                metrics.observe_tpu_solve_latency(solve_elapsed)
+                self._validate_result(snap, assignment, kind, order,
+                                      ordered)
+            except Exception as exc:
+                if ssn._pipeline_stale:
+                    # A predecessor committed after this session's
+                    # snapshot, and the conflict fence only cleared the
+                    # NARROW solve footprint: the host fallback would
+                    # read arbitrary (stale) node state.  Nothing has
+                    # been mutated yet, so abort for the pipeline's
+                    # fresh sequential rerun instead of degrading here
+                    # (tenancy/pipeline.StaleSessionAbort).  The breaker
+                    # still sees the device failure.
+                    from ..tenancy.pipeline import StaleSessionAbort
+                    breaker.failure()
+                    metrics.note_device_failure("solve")
+                    raise StaleSessionAbort(
+                        f"device solve failed mid-pipeline over a stale "
+                        f"snapshot ({type(exc).__name__}: {exc})") from exc
+                self._fallback_on_failure(ssn, breaker, "solve", exc)
+                return
+
+            if inc_state is not None and cached_solve is None:
+                # Cache AFTER validation only: a poisoned readback must
+                # never become a reusable "known-good" result.
+                inc_state.solve_gen = shipper.generation
+                inc_state.solve_cfg = snap.config
+                inc_state.solve_result = (assignment, kind, order, ordered)
+                inc_state.solve_route = route
+                metrics.note_generation_reuse(False)
+
+            deadline = solve_deadline_s()
+            if cached_solve is not None:
+                # A reused result is no device health evidence either
+                # way: the breaker and the solve deadline see nothing.
+                pass
+            elif deadline and solve_elapsed > deadline:
+                # Detective, not preemptive: the (valid) late result is
+                # still applied, but a repeatedly-slow device trips the
+                # breaker to the host path exactly like an erroring one.
+                # (Pipelined pause time is excluded: solve_elapsed is
+                # dispatch-half plus fetch wall time, never the window a
+                # successor shard's begin half ran in.)
+                breaker.failure()
+                metrics.note_solve_deadline()
+                trace.note_degraded(
+                    f"session solve exceeded deadline "
+                    f"({solve_elapsed * 1e3:.0f} ms > "
+                    f"{deadline * 1e3:.0f} ms)")
+            else:
+                breaker.success()
+
+            # Apply placements in device-solve order through the columnar
+            # batched path: end state (status indexes, node accounting,
+            # plugin shares, gang dispatch) is identical to per-task
+            # ssn.allocate/pipeline calls, fed straight from the solver's
+            # arrays and the staged index->TaskInfo table — no
+            # per-placement tuple materialization
+            # (Session.batch_apply_solved).
+            apply_start = time.perf_counter()
+            with trace.span("apply", placed=int(ordered.size)):
+                if scaffold is None:
+                    scaffold = prepare_apply_scaffold(snap)
+                agg = build_apply_aggregates(snap, assignment, kind,
+                                             ordered, scaffold=scaffold)
+                # Pod lineage: batch_apply records the bulk "placed"
+                # stage; the cycle context names which engine decided it
+                # (shown on /debug/lineage as e.g.
+                # "via tpu-allocate/sharded").
+                from ..framework.commit import batch_commit_enabled
+                from ..trace.lineage import lineage as pod_lineage
+                pod_lineage.cycle_context = f"via {self.name()}/{route}"
+                try:
+                    if batch_commit_enabled():
+                        ssn.batch_apply_solved(
+                            scaffold.tasks_arr, scaffold.node_names_arr,
+                            assignment, kind, ordered, snap.task_job,
+                            snap.job_uids, agg)
+                    else:
+                        # KUBE_BATCH_TPU_BATCH_COMMIT=0: the pre-columnar
+                        # tuple fan-out — the bit-parity control for the
+                        # whole commit/apply tail (doc/EVICTION.md
+                        # "Batched commit").
+                        kinds = kind[ordered].tolist()
+                        hostnames = scaffold.node_names_arr[
+                            assignment[ordered]].tolist()
+                        ssn.batch_apply(
+                            zip(scaffold.tasks_arr[ordered].tolist(),
+                                hostnames, kinds),
+                            agg=agg)
+                finally:
+                    pod_lineage.cycle_context = ""
+            # The ``apply`` floor is the placement apply alone (the stage
+            # the columnar path vectorizes); the histogram keeps its
+            # historical span (apply + fit-delta recording).
+            ssn._floor_apply += time.perf_counter() - apply_start
+            with trace.span("fit_deltas"):
+                self._record_fit_deltas(ssn, snap, kind, assignment, order,
+                                        scaffold=scaffold)
+            metrics.observe_tpu_apply_latency(
+                time.perf_counter() - apply_start)
+            # After the latency observation: the tally walk must not
+            # inflate the histogram the recorder's spans are validated
+            # against.
+            if trace.current_session_id() is not None:
+                self._record_why_tallies(ssn, snap, kind)
+
+        finish.pending = pending
+        return finish
+
+    @staticmethod
+    def _publish_read_fence(ssn, snap, empty: bool = False) -> None:
+        """Stash this session's retire-phase node READ footprint for the
+        shard pipeline's conflict fence: the union over pending task
+        signatures of statically-feasible nodes.  Infeasible nodes can
+        carry any state without changing the solve (their score is
+        masked to -inf and they can never be the argmax), so a
+        predecessor mutation outside this union provably leaves the
+        optimistic result identical to the sequential arm's.  Sessions
+        whose retire can read arbitrary node state — volumed tasks
+        (global binder state), an unanswered BestEffort prescan (the
+        backfill walk), any fallback — publish reads-all instead.
+
+        Only pipelined sessions pay for this: outside the shard
+        pipeline (the global engine, the CONCURRENT_SHARDS=0 control, a
+        single dirty shard) nothing reads the fence, and the control
+        arm must keep its exact per-session work profile."""
+        import numpy as np
+        if not ssn._pipeline_active:
+            return
+        if empty:
+            # No candidate tasks: the retire phase touches nodes only if
+            # backfill places BestEffort work.
+            if ssn.prescan.get("has_best_effort") is False:
+                ssn._pipeline_fence = ((), None)
+            else:
+                ssn._pipeline_reads_all = True
+            return
+        try:
+            if ssn.prescan.get("has_best_effort") is not False or any(
+                    t.pod.spec.volumes for t in snap.tasks):
+                ssn._pipeline_reads_all = True
+                return
+            p = len(snap.tasks)
+            sigs = np.unique(np.asarray(snap.inputs.task_sig)[:p])
+            mask = np.logical_or.reduce(
+                np.asarray(snap.inputs.sig_mask)[sigs], axis=0)
+            mask = mask & np.asarray(snap.inputs.node_exists)
+            n = len(snap.node_names)
+            ssn._pipeline_fence = (snap.node_names, mask[:n])
+        except Exception:  # lint: allow-swallow(fence derivation is an optimization gate: an unknown footprint degrades to reads-all, which only forces a sequential rerun — counted, never wrong)
+            metrics.note_swallowed("pipeline_fence")
+            ssn._pipeline_reads_all = True
 
     @staticmethod
     def _record_why_tallies(ssn, snap, kind) -> None:

@@ -195,6 +195,27 @@ class JobInfo:
         elif was_alloc and not now_alloc:
             self.allocated.sub(task.resreq)
 
+    def release_task(self, task: TaskInfo) -> None:
+        """update_task_status(task, Releasing) fast path for a task this
+        job already tracks — the SESSION-clone twin of the truth mirror's
+        fused transition in ``SchedulerCache.evict_many`` (the eviction
+        decision walk calls this once per victim, so the delete/re-add
+        Resource churn was the walk's per-task floor).  End state
+        identical, including the dict-order side effect: the task lands
+        at the END of ``tasks`` exactly as delete_task_info/add_task_info
+        leave it (snapshot and tensorize iteration order feed the
+        solver's tie-breaks, so order is part of the bit-parity
+        contract).  Falls back to the exact slow path when the passed
+        object is not the tracked one with a matching status (the slow
+        path's bucket removal keys on the TRACKED entry's status)."""
+        tracked = self.tasks.get(task.uid)
+        if tracked is None or tracked.status != task.status:
+            self.update_task_status(task, TaskStatus.Releasing)
+            return
+        self.move_task_status(task, TaskStatus.Releasing)
+        del self.tasks[task.uid]
+        self.tasks[task.uid] = task
+
     def get_tasks(self, *statuses: TaskStatus) -> List[TaskInfo]:
         out: List[TaskInfo] = []
         for status in statuses:

@@ -80,13 +80,42 @@ from collections import deque as _deque
 class _EventDeque(_deque):
     """The cache's local event deque, tee'd into the cluster event
     recorder: every append (3-tuples of reason, object key, message)
-    also egresses asynchronously when a recorder is configured."""
+    also egresses asynchronously when a recorder is configured.
+
+    Defer window (doc/TENANCY.md "Concurrent micro-sessions"): the shard
+    pipeline runs a successor shard's snapshot BEFORE its predecessors'
+    commits retire, but the snapshot can append events (the no-spec
+    FailedScheduling replay).  ``begin_defer``/``end_defer`` redirect
+    appends FROM THE CALLING THREAD ONLY into a buffer the pipeline
+    flushes at that shard's retire slot, so the event sequence stays
+    bit-identical to the sequential arm.  Reflector threads keep
+    appending straight through a window."""
 
     def __init__(self, maxlen=10000, recorder=None):
         super().__init__(maxlen=maxlen)
         self._recorder = recorder
+        self._defer_tid = None   # thread id owning the defer window
+        self._deferred = None
+
+    def begin_defer(self) -> None:
+        import threading as _threading
+        self._deferred = []
+        self._defer_tid = _threading.get_ident()
+
+    def end_defer(self) -> list:
+        """Close the window and hand back what it captured (the caller
+        replays it with extend() at the owning retire slot)."""
+        out = self._deferred or []
+        self._defer_tid = None
+        self._deferred = None
+        return out
 
     def append(self, item):
+        if self._defer_tid is not None:
+            import threading as _threading
+            if _threading.get_ident() == self._defer_tid:
+                self._deferred.append(item)
+                return
         super().append(item)
         if self._recorder is not None:
             try:
@@ -98,7 +127,7 @@ class _EventDeque(_deque):
                 metrics.note_swallowed("event_record")
 
     def extend(self, items):
-        if self._recorder is None:
+        if self._recorder is None and self._defer_tid is None:
             super().extend(items)
             return
         for item in items:

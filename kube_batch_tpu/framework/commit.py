@@ -20,7 +20,12 @@ interleave within an action (binds egress at the gang-dispatch barrier
 inside ``batch_apply``, session-only pipelines never egress), so the
 cache event stream, the evictor's victim sequence, and the lineage
 sample order are identical to the sequential control —
-``KUBE_BATCH_TPU_BATCH_COMMIT=0``.
+``KUBE_BATCH_TPU_BATCH_COMMIT=0``.  The concurrent shard pipeline
+(tenancy/pipeline.py) extends the same contract ACROSS shards: actions
+— and therefore their sinks' flushes — run only in a micro-session's
+retire half, and retire halves execute in deterministic shard order, so
+per-shard flush sequences never interleave no matter how many shard
+dispatches are in flight (doc/TENANCY.md "Concurrent micro-sessions").
 
 Failure contract (doc/CHAOS.md site ``commit.flush_error``): an effect
 the bulk egress could not land is re-driven once through the per-task

@@ -90,6 +90,32 @@ class Session:
         # per-task effector path (the KUBE_BATCH_TPU_BATCH_COMMIT=0
         # control, and every action that never evicts).
         self._commit_sink = None
+        # Shard-pipeline de-alias hook (tenancy/pipeline.py): called with
+        # an iterable of node names BEFORE the first session mutation of
+        # each node, so in-flight successor sessions sharing pooled
+        # clones can take private copies before the object changes.
+        # None outside a pipelined retire (zero overhead: one attribute
+        # read per first-touch)  — doc/TENANCY.md "Concurrent
+        # micro-sessions".
+        self._dirty_node_hook = None
+        # Shard-pipeline conflict fence (set by tpu-allocate's begin
+        # half): (node_names, feasible_mask) naming the nodes whose state
+        # this session's outcome can depend on, or _pipeline_reads_all
+        # when the footprint is unbounded (fallback/backfill/volumes) —
+        # the pipeline reruns this session when a predecessor mutates
+        # inside the footprint.
+        self._pipeline_fence = None
+        self._pipeline_reads_all = False
+        # True only for sessions opened by the shard pipeline's begin
+        # half (Scheduler.begin_shard_session): fence derivation is
+        # skipped everywhere else, so the sequential control keeps its
+        # exact per-session work profile.
+        self._pipeline_active = False
+        # Set by the pipeline when ANY predecessor committed mutations
+        # after this session's snapshot: a retire half that then needs
+        # the unbounded host fallback must abort for the sequential
+        # rerun instead of reading stale state (StaleSessionAbort).
+        self._pipeline_stale = False
         # Per-session commit/apply floor accumulators (published as
         # ``cycle_floor_ms{floor="commit"|"apply"}`` at close): the
         # effect-side wall time — sequential per-task effector calls or
@@ -384,10 +410,28 @@ class Session:
 
     def _dirty_node(self, name: str) -> None:
         if name not in self.mutated_nodes:
+            hook = self._dirty_node_hook
+            if hook is not None:
+                # Every mutation path dirties BEFORE touching the clone
+                # (the contract above), so the pipeline's de-alias guard
+                # always runs while the object is still bit-identical to
+                # its snapshot.  Batch walks that mutate before their
+                # settle-phase dirty marks pre-declare via
+                # _predeclare_nodes instead.
+                hook((name,))
             self.mutated_nodes.add(name)
             discard = getattr(self.cache, "discard_pooled_node", None)
             if discard is not None:
                 discard(name)
+
+    def _predeclare_nodes(self, names) -> None:
+        """Announce the node set a batch walk is about to mutate (the
+        native/columnar apply writes node clones before its settle-phase
+        _dirty_node calls): gives the shard pipeline's de-alias guard its
+        before-the-mutation window.  No-op outside a pipelined retire."""
+        hook = self._dirty_node_hook
+        if hook is not None:
+            hook(names)
 
     def _fire_allocate(self, task: TaskInfo):
         for eh in self.event_handlers:
@@ -517,6 +561,8 @@ class Session:
                     self._apply_sequential(placements)
                     return
 
+        if self._dirty_node_hook is not None:
+            self._predeclare_nodes({h for _t, h, _k in placements})
         node_alloc: dict = check_alloc
         node_pipe: dict = check_pipe
         touched_jobs: dict = {}
@@ -769,6 +815,9 @@ class Session:
                                  kind[sel].tolist())))
                     return
 
+        if self._dirty_node_hook is not None:
+            self._predeclare_nodes(set(node_names_arr[n_idx].tolist()))
+
         # Native columns walk: the same C per-placement pass the tuple
         # path runs (kube_batch_tpu/native), fed three parallel lists —
         # no per-placement tuple packing.  Returns exactly the settle
@@ -896,12 +945,19 @@ class Session:
                 # flush will evict) and surface the same error.
                 sink.add_evict(reclaimee, reason)
             raise KeyError(f"failed to find job {reclaimee.job}")
+        # Fused Releasing transition (ROADMAP 5a): the session-clone twin
+        # of the truth mirror's evict_many fast path — one status-index
+        # move plus a releasing add per victim instead of the
+        # delete/re-add Resource churn and the node-side remove/clone/add
+        # round trip, with the same dict-order side effects (both tasks
+        # dicts end with the victim at the END, exactly as the slow pair
+        # leaves them).
         self._dirty_job(reclaimee.job)
-        job.update_task_status(reclaimee, TaskStatus.Releasing)
+        job.release_task(reclaimee)
         node = self.nodes.get(reclaimee.node_name)
         if node is not None:
             self._dirty_node(reclaimee.node_name)
-            node.update_task(reclaimee)
+            node.release_resident(reclaimee)
         self._fire_deallocate(reclaimee)
         if sink is not None:
             sink.add_evict(reclaimee, reason)

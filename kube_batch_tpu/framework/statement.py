@@ -49,15 +49,24 @@ class Statement:
     # -- forward ops --------------------------------------------------------
 
     def evict(self, reclaimee: TaskInfo, reason: str) -> None:
-        """Session-side eviction, logged for commit/rollback (go:36-76)."""
+        """Session-side eviction, logged for commit/rollback (go:36-76).
+
+        The mirror transition is the fused Releasing fast path
+        (JobInfo.release_task + NodeInfo.release_resident, ROADMAP 5a):
+        the eviction decision walk calls this once per victim, and the
+        old update_task_status + node.update_task pair paid a
+        delete/re-add Resource round trip and a fresh task clone per
+        call.  End state — including both tasks dicts' iteration order —
+        is identical to the slow pair (pinned by the evict/commit parity
+        gates)."""
         job = self.ssn.jobs.get(reclaimee.job)
         if job is not None:
             self.ssn._dirty_job(reclaimee.job)
-            job.update_task_status(reclaimee, TaskStatus.Releasing)
+            job.release_task(reclaimee)
         node = self.ssn.nodes.get(reclaimee.node_name)
         if node is not None:
             self.ssn._dirty_node(reclaimee.node_name)
-            node.update_task(reclaimee)
+            node.release_resident(reclaimee)
         self.ssn._fire_deallocate(reclaimee)
         self.operations.append(("evict", (reclaimee, reason)))
 

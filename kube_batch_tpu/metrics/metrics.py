@@ -598,7 +598,7 @@ shard_lease_age = registry.register(Gauge(
     "store (any holder)", ("shard",)))
 shard_lease_transitions = registry.register(Counter(
     f"{SUBSYSTEM}_shard_lease_transitions_total",
-    "Shard lease state transitions (claim | steal | release | "
+    "Shard lease state transitions (claim | steal | release | shed | "
     "renew_timeout | stolen_from | clock_skew | fenced_write)",
     ("shard", "kind")))
 shard_sessions = registry.register(Counter(
@@ -612,7 +612,35 @@ shard_binds = registry.register(Counter(
 shard_rebalance = registry.register(Counter(
     f"{SUBSYSTEM}_shard_rebalance_total",
     "Shard ownership rebalances across the federation (claim | steal | "
-    "release | lost)", ("kind",)))
+    "release | shed | lost)", ("kind",)))
+# Concurrent shard micro-sessions (doc/TENANCY.md "Concurrent
+# micro-sessions"): the bounded-depth shard pipeline's ledger — how many
+# stages entered/retired, how often a predecessor's retire invalidated a
+# successor's optimistic work (conflict_rerun), and how much host time
+# ran inside a predecessor's device-dispatch window (the overlap the
+# tentpole exists to create).
+shard_pipeline = registry.register(Counter(
+    f"{SUBSYSTEM}_shard_pipeline_total",
+    "Shard-pipeline stage events (begun | retired | conflict_rerun | "
+    "abandoned | overlapped)", ("event",)))
+shard_overlap_seconds = registry.register(Counter(
+    f"{SUBSYSTEM}_shard_overlap_seconds_total",
+    "Host wall time spent running a successor shard's begin phases "
+    "inside a predecessor's in-flight device-dispatch window"))
+shard_overlap_last_ms = registry.register(Gauge(
+    "kube_batch_tpu_shard_overlap_ms",
+    "Overlapped host time of the last pipelined loop iteration (ms)"))
+shard_inflight = registry.register(Gauge(
+    "kube_batch_tpu_shard_inflight",
+    "High-water in-flight shard micro-sessions of the last pipelined "
+    "loop iteration (1 = sequential)"))
+shard_load = registry.register(Gauge(
+    "kube_batch_tpu_shard_load",
+    "Per-shard load EWMA (pod count + churn rate) feeding the "
+    "federation's load-weighted claim targets", ("shard",)))
+solver_inflight = registry.register(Gauge(
+    "kube_batch_tpu_solver_inflight",
+    "Device solve dispatches issued but not yet fetched or discarded"))
 # Wire-edge memory accounting (ROADMAP item 1, doc/INCREMENTAL.md "Wire
 # fast path"): raw-doc delta baselines (`_wire_doc`) retained by the
 # mirror stores, per resource kind — the measurable target of the
@@ -1134,6 +1162,49 @@ def shard_session_counts() -> Dict[str, int]:
 def note_shard_binds(shard: int, replica: str, count: int) -> None:
     if count:
         shard_binds.inc(float(count), str(shard), replica)
+
+
+def note_shard_pipeline(event: str, count: int = 1) -> None:
+    if count:
+        shard_pipeline.inc(float(count), event)
+
+
+def shard_pipeline_counts() -> Dict[str, int]:
+    """{event: count} so far — bench artifact + the tenancy A/B's
+    vacuous-overlap guard."""
+    return {labels[0]: int(v)
+            for labels, v in shard_pipeline.values().items() if labels}
+
+
+def note_shard_overlap(seconds: float) -> None:
+    if seconds > 0:
+        shard_overlap_seconds.inc(float(seconds))
+
+
+def shard_overlap_total_ms() -> float:
+    """Running overlapped-host-time sum in ms (bench reads deltas)."""
+    return float(shard_overlap_seconds.value()) * 1e3
+
+
+def set_shard_cycle_stats(overlap_s: float, inflight_hw: int) -> None:
+    """Last pipelined loop iteration's overlap + in-flight high water."""
+    shard_overlap_last_ms.set(round(overlap_s * 1e3, 3))
+    shard_inflight.set(float(inflight_hw))
+
+
+def shard_cycle_stats() -> tuple:
+    """(overlap_ms, inflight high-water) of the last pipelined loop
+    iteration — bench artifact keys."""
+    return (float(shard_overlap_last_ms.value()),
+            int(shard_inflight.value()))
+
+
+def set_shard_load(shard: int, load: float) -> None:
+    shard_load.set(round(float(load), 3), str(shard))
+
+
+def set_solver_inflight(count: int) -> None:
+    solver_inflight.set(float(count))
 
 
 def shard_bind_counts() -> Dict[str, int]:

@@ -380,6 +380,10 @@ class DeviceResidentShipper:
         # deterministic solve result may be reused without a device
         # round-trip (doc/INCREMENTAL.md).
         self.generation: int = 0
+        # Owning cache/view identity (resident_shipper's cross-shard
+        # aliasing guard); None for throwaway/direct-constructed
+        # shippers, which are never shared.
+        self._owner_id = None
 
     def invalidate(self) -> None:
         """Drop the resident image so the next ship is a full one.  The
@@ -727,7 +731,17 @@ def dirty_shard_probe(inp: SolverInputs, cfg=None) -> dict:
 def resident_shipper(cache) -> DeviceResidentShipper:
     """The cache's persistent shipper, created on first use; a throwaway
     instance (always full ship) for cache objects that refuse attributes
-    — mirroring tensor_snapshot._tensor_cache's persistence gate."""
+    — mirroring tensor_snapshot._tensor_cache's persistence gate.
+
+    Cross-shard aliasing guard (doc/TENANCY.md "Concurrent
+    micro-sessions"): each tenancy ShardView declares ``_ship_cache``
+    as its OWN attachment point, so every shard owns an independent
+    resident image — that independence is what lets the concurrent
+    pipeline keep several dispatches in flight without their delta
+    baselines corrupting each other.  A shipper observed under two
+    different owners means a view delegated the attribute to the shared
+    cache (or an embedder wired one shipper into two views): that is a
+    delta-parity time bomb, so it fails LOUDLY here instead."""
     sh = getattr(cache, "_ship_cache", None)
     if sh is None:
         sh = DeviceResidentShipper()
@@ -735,4 +749,11 @@ def resident_shipper(cache) -> DeviceResidentShipper:
             cache._ship_cache = sh
         except AttributeError:
             pass
+        else:
+            sh._owner_id = id(cache)
+    elif sh._owner_id is not None and sh._owner_id != id(cache):
+        raise RuntimeError(
+            "DeviceResidentShipper aliased across caches/shard-views: "
+            "each shard must own its resident image (a shared delta "
+            "baseline would silently corrupt bit-parity)")
     return sh

@@ -29,6 +29,7 @@ is replicated and updated identically on every device.
 from __future__ import annotations
 
 import functools
+import threading
 from typing import NamedTuple
 
 import jax
@@ -465,9 +466,51 @@ class PendingSolve(NamedTuple):
     session engine is built on (doc/PIPELINE.md).  ``remap`` is set by
     the candidate-row dispatch (ops/prefilter.py): the packed assignment
     column then holds candidate-LOCAL rows and fetch_solve scatters them
-    back into full-space node indices."""
+    back into full-space node indices.
+
+    Handles are INDEPENDENT: the concurrent shard pipeline
+    (doc/TENANCY.md "Concurrent micro-sessions") keeps several
+    outstanding at once — each owns its own packed result buffer (and
+    each shard its own resident SolverInputs, models/shipping.py), so
+    dispatch order imposes nothing on fetch order.  Every dispatched
+    handle must end in exactly one ``fetch_solve`` or ``discard_solve``;
+    the ``kube_batch_tpu_solver_inflight`` gauge audits the ledger."""
     packed: jnp.ndarray  # [4, P]: assignment / kind / order / placed-perm
     remap: object = None  # np [C_pad] int32 full node row per program row
+
+
+# In-flight dispatch ledger (process-wide): dispatched-but-not-consumed
+# PendingSolve handles.  A plain guarded int — dispatch/fetch run on the
+# scheduler loop thread, but tests and multi-replica soaks drive several
+# engines per process.
+_inflight_lock = threading.Lock()
+_inflight = 0  # guarded-by: _inflight_lock
+
+
+def _note_dispatch(delta: int) -> None:
+    global _inflight
+    from ..metrics import metrics
+    with _inflight_lock:
+        _inflight = max(0, _inflight + delta)
+        metrics.set_solver_inflight(_inflight)
+
+
+def solver_inflight() -> int:
+    """Outstanding dispatch handles (tests + /metrics)."""
+    with _inflight_lock:
+        return _inflight
+
+
+def discard_solve(pending: PendingSolve) -> None:
+    """Abandon a dispatched solve without reading it back: the device
+    work completes (or completed) on its own and the buffer is dropped —
+    the fetch-and-discard half of the pipeline's conflict/drain paths.
+    The resident input image is NOT invalidated here: the ship that fed
+    this dispatch completed, so it remains the correct delta baseline
+    (callers that cannot prove that — stop() on a wedged loop — pair the
+    discard with DeviceResidentShipper.invalidate)."""
+    if pending is not None:
+        _note_dispatch(-1)
 
 
 @jax.jit
@@ -550,13 +593,16 @@ def dispatch_solve(inp: SolverInputs, cfg: SolverConfig,
     with trace.span("solver.dispatch"):
         if candidates is not None:
             result = _solve_candidates(inp, cfg, candidates)
-            return PendingSolve(
+            pending = PendingSolve(
                 _pack_result_ordered(result.assignment, result.kind,
                                      result.order),
                 remap=candidates.remap)
-        result = best_solve_allocate(inp, cfg)
-        return PendingSolve(_pack_result_ordered(result.assignment,
-                                                 result.kind, result.order))
+        else:
+            result = best_solve_allocate(inp, cfg)
+            pending = PendingSolve(_pack_result_ordered(
+                result.assignment, result.kind, result.order))
+    _note_dispatch(+1)
+    return pending
 
 
 def fetch_solve(pending: PendingSolve):
@@ -571,8 +617,13 @@ def fetch_solve(pending: PendingSolve):
     import numpy as np
 
     from ..trace import spans as trace
-    with trace.span("solver.fetch"):
-        packed = np.asarray(pending.packed)
+    try:
+        with trace.span("solver.fetch"):
+            packed = np.asarray(pending.packed)
+    finally:
+        # Consumed either way: a fetch that raises (dead tunnel) still
+        # retires the handle from the in-flight ledger.
+        _note_dispatch(-1)
     packed, _ = _chaos_fetch(packed)
     assignment, kind, order, perm = packed
     if pending.remap is not None:

@@ -110,6 +110,24 @@ def _mini_yaml(conf_str: str) -> dict:
     return data
 
 
+class _ShardSessionHandle:
+    """One shard micro-session paused between its host half and its
+    retire half (doc/TENANCY.md "Concurrent micro-sessions")."""
+
+    __slots__ = ("ssn", "shard", "cont", "resume_idx", "action_elapsed",
+                 "start", "trace_obj")
+
+    def __init__(self, ssn, shard, cont, resume_idx, action_elapsed,
+                 start):
+        self.ssn = ssn
+        self.shard = shard
+        self.cont = cont
+        self.resume_idx = resume_idx
+        self.action_elapsed = action_elapsed
+        self.start = start
+        self.trace_obj = None
+
+
 class Scheduler:
     """Periodic runner (scheduler.go:33-102)."""
 
@@ -243,6 +261,131 @@ class Scheduler:
                 gc.enable()
         metrics.observe_e2e_latency(time.time() - start)
 
+    # ------------------------------------------------------------------
+    # Split session halves for the concurrent shard pipeline
+    # (tenancy/pipeline.py, doc/TENANCY.md "Concurrent micro-sessions").
+    # session_once stays the exact sequential composition — the
+    # KUBE_BATCH_TPU_CONCURRENT_SHARDS=0 control arm never touches these.
+
+    def begin_shard_session(self, cache, shard=None):
+        """First half of a shard micro-session: open + the leading
+        action's host phases (snapshot, tensorize, ship, async dispatch).
+        Suspends the session's trace so other shards' halves can
+        interleave on this thread; ``finish_shard_session`` retires it.
+        GC posture is the caller's (the pipeline disables collection
+        around the whole pipelined iteration).  Raises like session_once
+        would — the caller owns failure isolation."""
+        handle = None
+        start = time.time()
+        trace.begin_session(actions=[a.name() for a in self.actions])
+        try:
+            with trace.span("open_session"):
+                ssn = open_session(cache, self.tiers)
+            # Fence derivation and stale tracking apply to pipelined
+            # sessions only (tpu_allocate._publish_read_fence gates on
+            # this, keeping the sequential control's work profile
+            # exact).
+            ssn._pipeline_active = True
+            trace.set_uid(ssn.uid)
+            trace.set_meta(jobs=len(ssn.jobs), nodes=len(ssn.nodes),
+                           queues=len(ssn.queues))
+            if shard is not None:
+                trace.set_meta(shard=shard)
+            try:
+                cont = None
+                resume_idx = 0
+                action_elapsed = 0.0
+                if self.actions:
+                    action = self.actions[0]
+                    begin = getattr(action, "execute_begin", None)
+                    if begin is not None:
+                        action_start = time.time()
+                        with trace.span("action." + action.name()):
+                            cont = begin(ssn)
+                        action_elapsed = time.time() - action_start
+                        resume_idx = 1
+            except Exception:
+                # Mirror session_once's finally: an action exception
+                # after a successful open still closes the session
+                # (plugin closes, status writeback, incremental close
+                # bookkeeping) before the failure reaches the caller's
+                # per-shard isolation — the control arm's failure path.
+                with trace.span("close_session"):
+                    close_session(ssn)
+                raise
+            handle = _ShardSessionHandle(ssn, shard, cont, resume_idx,
+                                         action_elapsed, start)
+            return handle
+        finally:
+            suspended = trace.suspend_session()
+            if handle is not None:
+                handle.trace_obj = suspended
+            else:
+                # The begin half died: finalize the trace here so the
+                # recorder still sees the partial session, then let the
+                # exception reach the caller's failure isolation.
+                trace.resume_session(suspended)
+                trace.end_session()
+
+    def finish_shard_session(self, handle) -> None:
+        """Retire half: device fetch + validate + apply/commit (the
+        begin half's continuation), the remaining actions, and
+        close_session — the only part of a micro-session that mutates
+        the cluster, so the pipeline runs it in deterministic shard
+        order."""
+        from .tenancy.pipeline import StaleSessionAbort
+        trace.resume_session(handle.trace_obj)
+        handle.trace_obj = None
+        ssn = handle.ssn
+        stale_abort = False
+        try:
+            try:
+                if handle.resume_idx:
+                    action = self.actions[0]
+                    if handle.cont is not None:
+                        action_start = time.time()
+                        with trace.span("action." + action.name()):
+                            handle.cont()
+                        handle.action_elapsed += time.time() - action_start
+                    metrics.observe_action_latency(action.name(),
+                                                   handle.action_elapsed)
+                for action in self.actions[handle.resume_idx:]:
+                    action_start = time.time()
+                    with trace.span("action." + action.name()):
+                        action.execute(ssn)
+                    metrics.observe_action_latency(
+                        action.name(), time.time() - action_start)
+            except StaleSessionAbort:
+                # The retire half aborted BEFORE mutating anything (see
+                # tenancy/pipeline.StaleSessionAbort): the pipeline
+                # reruns the shard fresh, so this session must NOT run
+                # its remaining actions or close (a close would emit
+                # events/status writes the rerun emits again).
+                stale_abort = True
+                trace.set_meta(pipeline_discarded="stale_fallback")
+                raise
+            finally:
+                if not stale_abort:
+                    with trace.span("close_session"):
+                        close_session(ssn)
+                    trace.set_meta(floors=metrics.cycle_floor_values(),
+                                   onwork=metrics.onwork_values())
+        finally:
+            trace.end_session()
+        metrics.observe_e2e_latency(time.time() - handle.start)
+
+    def abandon_shard_session(self, handle, reason: str) -> None:
+        """Discard a begun-but-not-retired micro-session (conflict
+        rerun, drain, shutdown): finalize its trace with the discard
+        reason and drop the device handle WITHOUT applying anything —
+        the session never reached its mutating half, so nothing needs
+        rolling back."""
+        trace.resume_session(handle.trace_obj)
+        handle.trace_obj = None
+        trace.note_degraded(f"shard pipeline discarded session: {reason}")
+        trace.set_meta(pipeline_discarded=reason)
+        trace.end_session()
+
     def cycle(self, force_full: bool = False) -> bool:
         """One protected loop iteration: run_once + the repair workers,
         never raising — the loop-survival contract (scheduler.go:63-86),
@@ -375,6 +518,14 @@ class Scheduler:
         # until the remaining schedule_period (or the full crash-loop
         # backoff delay) elapses before the loop re-checks _stop.
         self._wake.set()
+        # Concurrent shard pipeline: ask the loop thread to stop issuing
+        # new shard dispatches and drain what is in flight before it
+        # exits (the pipeline checks this between stages) — the stop
+        # contract now covers multiple outstanding device handles
+        # (doc/TENANCY.md "Concurrent micro-sessions").
+        tenancy = getattr(self, "tenancy", None)
+        if tenancy is not None:
+            tenancy.request_drain()
         thread = self._thread
         if thread is not None:
             thread.join(timeout=timeout)
@@ -387,3 +538,16 @@ class Scheduler:
                     "scheduler loop thread still running %.1fs after "
                     "stop(); a cycle is wedged — the daemon thread will "
                     "be abandoned at process exit", timeout)
+        if tenancy is not None:
+            # Anything still registered in flight means the loop never
+            # reached its own drain (wedged mid-pipeline): abandon each
+            # stage — drop the device handle, invalidate that shard's
+            # resident ship image so a half-consumed dispatch can never
+            # seed a future delta baseline — and name the stuck shards.
+            stuck = tenancy.abandon_inflight()
+            if stuck:
+                log.warning(
+                    "scheduler stop(): abandoned %d in-flight shard "
+                    "dispatch(es) with resident images invalidated — "
+                    "stuck shard id(s): %s",
+                    len(stuck), ", ".join(str(s) for s in stuck))

@@ -3,29 +3,39 @@
 Tenancy as a SCALING axis instead of an accounting row (ROADMAP item 2):
 queues are deterministically assigned to shards (``ShardMap``), cache
 churn is attributed to the owning shard (``ShardChurn``), and the
-scheduler loop pipelines one shard-scoped micro-session per dirty shard
+scheduler loop runs one shard-scoped micro-session per dirty shard
 (``TenancyEngine`` + ``ShardView``) instead of one global cycle — so a
 churn storm in tenant A's queues cannot serialize tenant B's
-time-to-bind behind it.  ``ShardLeaseManager`` takes the same axis
+time-to-bind behind it.  ``ShardPipeline`` overlaps those micro-sessions
+through the async device-dispatch window (host phases of shard K+1 run
+while shard K's solve executes; retire halves stay in shard order —
+"Concurrent micro-sessions").  ``ShardLeaseManager`` takes the same axis
 horizontal: N active-active replicas each claim queue-shards via
 per-shard CAS leases in the shared store (the per-shard form of the
 ConfigMap-lock LeaderElector already ported in cli/leader_election.py),
-with steal-on-expiry failover and the truth store's 409 re-bind
-rejection as the cross-replica double-bind backstop.
+with steal-on-expiry failover, load-weighted claim targets
+(``ShardLoad``), and the truth store's 409 re-bind rejection as the
+cross-replica double-bind backstop.
 
-Kill switch: ``KUBE_BATCH_TPU_TENANCY`` unset/``0`` keeps the single
-global engine — the bit-parity control arm the tenancy tests pin.
+Kill switches: ``KUBE_BATCH_TPU_TENANCY`` unset/``0`` keeps the single
+global engine — the bit-parity control arm the tenancy tests pin —
+and ``KUBE_BATCH_TPU_CONCURRENT_SHARDS=0`` keeps the strictly
+sequential shard walk (the concurrency parity control).
 """
 
 from .debug import shard_table
 from .engine import TenancyEngine, engine_from_env
 from .leases import ShardLeaseManager
-from .shards import (SHARD_MAP_ENV, TENANCY_ENV, ShardChurn, ShardMap,
-                     tenancy_shards)
+from .pipeline import (CONCURRENT_ENV, INFLIGHT_ENV, ShardPipeline,
+                       concurrent_shards_enabled, shard_inflight_depth)
+from .shards import (SHARD_MAP_ENV, TENANCY_ENV, ShardChurn, ShardLoad,
+                     ShardMap, tenancy_shards)
 from .view import ShardView
 
 __all__ = [
-    "SHARD_MAP_ENV", "TENANCY_ENV", "ShardChurn", "ShardLeaseManager",
-    "ShardMap", "ShardView", "TenancyEngine", "engine_from_env",
+    "CONCURRENT_ENV", "INFLIGHT_ENV", "SHARD_MAP_ENV", "TENANCY_ENV",
+    "ShardChurn", "ShardLeaseManager", "ShardLoad", "ShardMap",
+    "ShardPipeline", "ShardView", "TenancyEngine",
+    "concurrent_shards_enabled", "engine_from_env", "shard_inflight_depth",
     "shard_table", "tenancy_shards",
 ]

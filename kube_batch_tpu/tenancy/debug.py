@@ -22,15 +22,19 @@ class ShardTable:
         self._updated_wall = 0.0           # guarded-by: _lock
 
     def note_session(self, shard: int, queues, jobs: int,
-                     replica: str = "") -> None:
+                     replica: str = "", load: Optional[float] = None
+                     ) -> None:
         """One shard micro-session closed: record what it actually
-        scoped (the queues the shard map resolved this cycle)."""
+        scoped (the queues the shard map resolved this cycle) and the
+        refreshed load EWMA feeding the claim targets (ROADMAP 2c)."""
         with self._lock:
             row = self._rows.setdefault(int(shard), {})
             row["queues"] = sorted(queues)
             row["jobs"] = int(jobs)
             row["sessions"] = row.get("sessions", 0) + 1
             row["last_session"] = round(time.time(), 3)
+            if load is not None:
+                row["load"] = round(float(load), 3)
             if replica:
                 row["owner"] = replica
             self._replica = replica or self._replica

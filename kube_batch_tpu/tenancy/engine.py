@@ -30,7 +30,8 @@ from typing import Dict, Optional
 from ..metrics import metrics
 from .debug import shard_table
 from .leases import ShardLeaseManager
-from .shards import ShardChurn, ShardMap, tenancy_shards
+from .pipeline import ShardPipeline, concurrent_shards_enabled
+from .shards import ShardChurn, ShardLoad, ShardMap, tenancy_shards
 from .view import ShardView
 
 log = logging.getLogger(__name__)
@@ -55,10 +56,20 @@ class TenancyEngine:
         self.replica = replica or (lease_mgr.identity if lease_mgr
                                    else "single")
         self.leases: Optional[ShardLeaseManager] = None
-        self.churn = ShardChurn(shard_map)
+        # Per-shard load EWMA (pods + churn rate): feeds the federation's
+        # load-weighted claim targets (ROADMAP 2c) and /debug/shards.
+        self.load = ShardLoad(shard_map.num_shards)
+        self.churn = ShardChurn(shard_map, load=self.load)
         self.views = [ShardView(self.cache, shard, shard_map,
                                 replica=self.replica)
                       for shard in range(shard_map.num_shards)]
+        # Concurrent shard micro-sessions (doc/TENANCY.md "Concurrent
+        # micro-sessions"): dirty shards pipeline their host phases
+        # through each other's async dispatch windows, retiring in
+        # deterministic shard order.  KUBE_BATCH_TPU_CONCURRENT_SHARDS=0
+        # keeps the strictly sequential control arm.
+        self.pipeline: Optional[ShardPipeline] = (
+            ShardPipeline(self) if concurrent_shards_enabled() else None)
         # Per-shard crash-loop backoff (scheduler loop thread only).
         self._failures: Dict[int, int] = {}
         self._next_ok: Dict[int, float] = {}
@@ -68,6 +79,8 @@ class TenancyEngine:
         # back-to-back churn-woken iterations would otherwise never see
         # an empty dirty set.
         self._last_run: Dict[int, float] = {}
+        # Last full-cluster load refresh (scheduler loop thread only).
+        self._loads_refreshed = 0.0
         if lease_mgr is not None:
             self.attach_leases(lease_mgr)
         # Per-shard churn attribution: the cache's external ingestion
@@ -88,6 +101,12 @@ class TenancyEngine:
         self.replica = lease_mgr.identity
         if lease_mgr._on_claim is None:
             lease_mgr._on_claim = self.churn.note_shard
+        if getattr(lease_mgr, "shard_load", None) is None:
+            # Load-weighted claim targets (ROADMAP 2c): the replica
+            # mirrors the whole cluster, so its own EWMA is a usable
+            # estimate of every shard's load — claim deferral weighs
+            # load, not raw shard counts.
+            lease_mgr.shard_load = self.load.load
         for view in self.views:
             view.replica = lease_mgr.identity
             view._lease_live = lease_mgr.lease_live
@@ -124,14 +143,98 @@ class TenancyEngine:
             from ..models import incremental
             for shard in run_set:
                 incremental.request_full(self.views[shard])
+        runnable = []
         for shard in sorted(run_set):
             if self._next_ok.get(shard, 0.0) > now:
                 # Backing off: the churn that asked for this session is
                 # NOT absorbed — the shard stays dirty for the retry.
                 self.churn.note_shard(shard)
                 continue
-            self._run_shard(shard)
+            runnable.append(shard)
+        self._refresh_loads(now)
+        if self.pipeline is not None and len(runnable) > 1 \
+                and "session_once" not in self.scheduler.__dict__:
+            # Concurrent micro-sessions: successive shards' host phases
+            # overlap their predecessors' device-dispatch windows; the
+            # cluster-mutating retire halves run in this exact order.
+            # An instance-level session_once (a test double / embedder
+            # wrapper) cannot be split into halves, so it keeps the
+            # sequential walk — the run_once test-double contract,
+            # extended.
+            self.pipeline.run(runnable)
+        else:
+            for shard in runnable:
+                self._run_shard(shard)
         self._publish()
+
+    # -- stop()/drain plumbing (any thread) ---------------------------------
+
+    def request_drain(self) -> None:
+        """Scheduler.stop(): the pipeline must stop issuing new shard
+        dispatches and drain in flight before the loop joins."""
+        if self.pipeline is not None:
+            self.pipeline.request_drain()
+
+    def abandon_inflight(self):
+        """Scheduler.stop() after the join: abandon whatever a wedged
+        loop left registered.  Returns the stuck shard ids."""
+        if self.pipeline is None:
+            return []
+        return self.pipeline.abandon_inflight()
+
+    # -- per-shard outcome bookkeeping (shared by the sequential arm and
+    #    the pipeline's begin/retire halves) --------------------------------
+
+    def _note_shard_failure(self, shard: int) -> None:
+        """Failure bookkeeping — MUST run inside the except block (the
+        log path reads sys.exc_info)."""
+        failures = self._failures.get(shard, 0) + 1
+        self._failures[shard] = failures
+        period = max(self.scheduler.schedule_period, 1e-3)
+        delay = min(self.scheduler._max_backoff,
+                    period * (2.0 ** min(failures, 32)))
+        self._next_ok[shard] = time.time() + delay
+        self.churn.note_shard(shard)
+        metrics.note_shard_session(shard, "error")
+        metrics.register_schedule_attempt("error")
+        metrics.note_cycle_failure("shard")
+        metrics.set_degraded(f"shard{shard}_backoff", True)
+        self.scheduler._log_cycle_error(f"shard{shard}")
+
+    def _note_shard_ok(self, shard: int, view) -> None:
+        if self._failures.pop(shard, None):
+            metrics.set_degraded(f"shard{shard}_backoff", False)
+        self._next_ok.pop(shard, None)
+        metrics.note_shard_session(shard, "ok")
+        load = self.load.note_session(shard, view._last_pods)
+        shard_table.note_session(shard, view._last_queues,
+                                 len(view._last_jobs),
+                                 replica=self.replica, load=load)
+
+    def _refresh_loads(self, now: float) -> None:
+        """Fold EVERY shard's pod count into the load EWMA — owned or
+        not — from this replica's full-cluster mirror (ROADMAP 2c).
+        Per-session folds only cover shards this engine runs, and a
+        fair-share computed from own-shards-only estimates (everyone
+        else's shards floored at ~zero) made every replica think it was
+        hogging the fleet — the shed oscillation the soak caught.  One
+        O(jobs) walk under the cache mutex, at most once per second."""
+        if now - self._loads_refreshed < 1.0:
+            return
+        self._loads_refreshed = now
+        counts = [0] * self.map.num_shards
+        shard_of = self.map.shard_of
+        mutex = getattr(self.cache, "mutex", None)
+        jobs = getattr(self.cache, "jobs", None)
+        if jobs is None:
+            return
+        import contextlib
+        with (mutex if mutex is not None else contextlib.nullcontext()):
+            for job in jobs.values():
+                if job.queue:
+                    counts[shard_of(job.queue)] += len(job.tasks)
+        for shard, pods in enumerate(counts):
+            self.load.note_session(shard, pods)
 
     def _run_shard(self, shard: int) -> None:
         view = self.views[shard]
@@ -139,26 +242,9 @@ class TenancyEngine:
         try:
             self.scheduler.session_once(view, shard=shard)
         except Exception:  # per-shard failure isolation: the loop-survival contract, scoped
-            failures = self._failures.get(shard, 0) + 1
-            self._failures[shard] = failures
-            period = max(self.scheduler.schedule_period, 1e-3)
-            delay = min(self.scheduler._max_backoff,
-                        period * (2.0 ** min(failures, 32)))
-            self._next_ok[shard] = time.time() + delay
-            self.churn.note_shard(shard)
-            metrics.note_shard_session(shard, "error")
-            metrics.register_schedule_attempt("error")
-            metrics.note_cycle_failure("shard")
-            metrics.set_degraded(f"shard{shard}_backoff", True)
-            self.scheduler._log_cycle_error(f"shard{shard}")
+            self._note_shard_failure(shard)
         else:
-            if self._failures.pop(shard, None):
-                metrics.set_degraded(f"shard{shard}_backoff", False)
-            self._next_ok.pop(shard, None)
-            metrics.note_shard_session(shard, "ok")
-            shard_table.note_session(shard, view._last_queues,
-                                     len(view._last_jobs),
-                                     replica=self.replica)
+            self._note_shard_ok(shard, view)
 
     def _publish(self) -> None:
         if self.leases is None:

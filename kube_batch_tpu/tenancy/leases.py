@@ -73,7 +73,8 @@ class ShardLeaseManager:
                  renew_deadline: float = DEFAULT_SHARD_RENEW_DEADLINE,
                  retry_period: float = DEFAULT_SHARD_RETRY_PERIOD,
                  target_shards: Optional[int] = None,
-                 on_claim: Optional[Callable[[int], None]] = None):
+                 on_claim: Optional[Callable[[int], None]] = None,
+                 shard_load: Optional[Callable[[int], float]] = None):
         if renew_deadline >= lease_duration:
             raise ValueError(
                 "renew_deadline must be < lease_duration (a replica must "
@@ -82,11 +83,20 @@ class ShardLeaseManager:
         self.lease_duration = float(lease_duration)
         self.renew_deadline = float(renew_deadline)
         self.retry_period = float(retry_period)
-        # Soft spread target: a replica holding >= target defers claiming
-        # a freshly-expired shard for one extra lease duration so an
-        # under-loaded replica can take it first — but never forever (an
-        # orphan shard beats a balanced outage).
+        # Soft spread target: a replica at/over target defers claiming a
+        # FREE shard for one extra lease duration so an under-loaded
+        # replica can take it first — but never forever (an orphan shard
+        # beats a balanced outage).
         self.target_shards = target_shards
+        # Load-weighted claim targets (ROADMAP 2c): when a shard-load
+        # estimator is attached (the tenancy engine's pods+churn EWMA —
+        # every replica mirrors the whole cluster, so its own estimate
+        # covers every shard), the spread deferral compares owned LOAD
+        # against the fair load share target_shards implies, instead of
+        # raw shard counts — a whale tenant's shard weighs what it
+        # costs, so the whale's owner defers claiming extra shards while
+        # its peers soak up the small ones.
+        self.shard_load = shard_load
         self.num_shards = int(num_shards)
         self.locks: List[StoreLock] = [
             StoreLock(cluster, namespace, name=shard_lock_name(i))
@@ -137,7 +147,79 @@ class ShardLeaseManager:
                 self._tick_shard(shard)
             except Exception:  # lint: allow-swallow(one shard's store hiccup must not stall the other shards' renewals; the failed shard retries next tick and the renew deadline fences it meanwhile)
                 metrics.note_swallowed("shard_lease_tick")
+        try:
+            self._maybe_shed_load(time.time())
+        except Exception:  # lint: allow-swallow(load shedding is balance polish, never liveness: a failed shed retries next tick; counted)
+            metrics.note_swallowed("shard_lease_shed")
         self._publish()
+
+    def _maybe_shed_load(self, now: float) -> None:
+        """Load-weighted rebalance, shed side (ROADMAP 2c): with a load
+        estimator attached, a replica whose owned LOAD exceeds its fair
+        share even after giving up its lightest shard cleanly releases
+        that shard (at most one per tick) so an under-loaded replica
+        claims it — a whale tenant's owner converges to owning the whale
+        alone while its peers soak up the small shards.  The guard
+        ``mine - lightest >= fair`` is the oscillation fence: after the
+        shed we are still at/over fair, so our own claim deferral keeps
+        us from immediately taking the shard back, and a replica at
+        exactly fair (the uniform-load fleet) never sheds at all.
+        Count-based targets (no estimator) never shed — the PR 13
+        behavior unchanged."""
+        if self.shard_load is None or self.target_shards is None:
+            return
+        with self._lock:
+            owned = [s for s, renewed in self._renewed.items()
+                     if now - renewed < self.renew_deadline]
+        if len(owned) <= 1:
+            return  # never shed the last owned shard
+        loads = {s: max(float(self.shard_load(s)), 0.0) + 1.0
+                 for s in range(self.num_shards)}
+        total = sum(loads.values())
+        fair = total * (float(self.target_shards)
+                        / max(self.num_shards, 1))
+        mine = sum(loads[s] for s in owned)
+        victim = min(owned, key=lambda s: loads[s])
+        if mine - loads[victim] < fair:
+            return
+        # Absorption check: shed only when some OTHER live replica could
+        # take the victim without itself going over fair — read the
+        # store's current lease records and sum each holder's owned
+        # load.  Without this, a shrunken fleet (post-kill: 2 survivors
+        # over 3 shards, both necessarily over the stale static fair
+        # share) livelocks: shed -> peer defers the free shard -> the
+        # claim-anyway floor re-claims it -> shed again, and the shard
+        # spends most of its time unowned.  A peer that holds NOTHING is
+        # invisible to this scan, so we conservatively keep the shard —
+        # the free-shard claim deferral already gives idle replicas
+        # their window.
+        peer_load: dict = {}
+        for shard in range(self.num_shards):
+            try:
+                _version, record = self.locks[shard].get()
+            except Exception:  # lint: allow-swallow(an unreadable lease record just vetoes shedding this tick; counted, retried next tick)
+                metrics.note_swallowed("shard_lease_shed")
+                return
+            holder = (record or {}).get("holderIdentity") or ""
+            expires = ((record or {}).get("renewTime", 0.0)
+                       + (record or {}).get("leaseDurationSeconds",
+                                            self.lease_duration))
+            if holder and holder != self.identity and now < expires:
+                peer_load[holder] = peer_load.get(holder, 0.0) \
+                    + loads[shard]
+        if not any(pl + loads[victim] <= fair
+                   for pl in peer_load.values()):
+            return
+        from ..cli.leader_election import cas_release
+        if cas_release(self.locks[victim], self.identity,
+                       self.lease_duration):
+            with self._lock:
+                self._renewed.pop(victim, None)
+            log.info("shard %d shed by %s (owned load %.1f > fair %.1f)",
+                     victim, self.identity, mine, fair)
+            metrics.note_shard_lease(victim, "shed")
+            metrics.note_shard_rebalance("shed")
+            metrics.clear_shard_owner(victim, self.identity)
 
     def _record(self, now: float) -> dict:
         return {"holderIdentity": self.identity,
@@ -209,8 +291,8 @@ class ShardLeaseManager:
             # lease-duration failover bound outranks balance
             # (doc/TENANCY.md).
             with self._lock:
-                owned_count = len(self._renewed)
-            if owned_count >= self.target_shards:
+                owned = list(self._renewed)
+            if self._over_target(owned):
                 since = self._deferred_since.setdefault(shard, now)
                 if now - since < self.lease_duration:
                     return
@@ -231,6 +313,29 @@ class ShardLeaseManager:
         metrics.set_shard_owner(shard, self.identity)
         if self._on_claim is not None:
             self._on_claim(shard)
+
+    def _over_target(self, owned) -> bool:
+        """Whether claiming one more shard should defer for spread.
+        Count-based without a load estimator (the PR 13 behavior);
+        load-weighted with one: defer once this replica's owned load
+        reaches the fair share its target fraction implies.  A +1 floor
+        per shard keeps empty shards claimable-but-weighted (every shard
+        costs at least a session to own), and any estimator failure
+        degrades to the count rule — never to a stuck shard."""
+        if self.target_shards is None:
+            return False
+        if self.shard_load is not None:
+            try:
+                loads = [max(float(self.shard_load(s)), 0.0) + 1.0
+                         for s in range(self.num_shards)]
+                total = sum(loads)
+                mine = sum(loads[s] for s in owned)
+                fair = total * (float(self.target_shards)
+                                / max(self.num_shards, 1))
+                return mine >= fair
+            except Exception:  # lint: allow-swallow(load estimator failure degrades the deferral to the count rule; counted, and the orphan-beats-balance bound is unaffected)
+                metrics.note_swallowed("shard_load_estimate")
+        return len(owned) >= self.target_shards
 
     @staticmethod
     def _cas(lock: StoreLock, record: dict, version: int) -> bool:

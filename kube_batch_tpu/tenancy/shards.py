@@ -20,6 +20,7 @@ from __future__ import annotations
 import hashlib
 import os
 import threading
+import time
 from typing import Dict, Iterable, Optional, Set
 
 TENANCY_ENV = "KUBE_BATCH_TPU_TENANCY"
@@ -119,6 +120,74 @@ class ShardMap:
         return out
 
 
+class ShardLoad:
+    """Per-shard load EWMA of (pod count, churn rate) — the claim-target
+    weighting for replica federation (ROADMAP 2c, doc/TENANCY.md): a
+    whale tenant's shard should count for what it costs (pods to
+    snapshot/tensorize, churn events to absorb), not as one unit of N.
+
+    ``note_churn`` ticks from the cache ingestion hot path (inside the
+    ShardChurn lock, one list increment); ``note_session`` folds the
+    accumulated events into a per-second rate and EWMA-blends both
+    signals after each shard session.  ``load`` is read by the lease
+    manager's spread deferral and /debug/shards."""
+
+    ALPHA = 0.3          # EWMA blend per session
+    CHURN_WEIGHT = 5.0   # one churn event/s ~ five resident pods of load
+    MIN_RATE_WINDOW = 0.25  # s: shorter windows keep accumulating —
+    # rate = events/elapsed over a milliseconds window would turn a
+    # couple of events into a triple-digit rate spike, poisoning the
+    # claim-target fair-share math
+
+    def __init__(self, num_shards: int):
+        self._lock = threading.Lock()
+        n = int(num_shards)
+        self._pods = [0.0] * n        # EWMA pods       guarded-by: _lock
+        self._rate = [0.0] * n        # EWMA churn/s    guarded-by: _lock
+        self._events = [0] * n        # since last fold guarded-by: _lock
+        self._folded = [0.0] * n      # last fold time  guarded-by: _lock
+
+    def note_churn(self, shard: int) -> None:
+        with self._lock:
+            self._events[shard] += 1
+
+    def note_session(self, shard: int, pods: int) -> float:
+        """Fold one finished shard session's observation in; returns the
+        refreshed load estimate (also published as a gauge)."""
+        from ..metrics import metrics
+        now = time.time()
+        a = self.ALPHA
+        with self._lock:
+            last = self._folded[shard]
+            if not last:
+                # First observation: start the rate window, no fold.
+                self._events[shard] = 0
+                self._folded[shard] = now
+            elif now - last >= self.MIN_RATE_WINDOW:
+                rate = self._events[shard] / max(now - last, 1e-6)
+                self._events[shard] = 0
+                self._folded[shard] = now
+                self._rate[shard] = a * rate \
+                    + (1.0 - a) * self._rate[shard]
+            # else: window too short — keep accumulating events.
+            self._pods[shard] = a * float(pods) \
+                + (1.0 - a) * self._pods[shard]
+            load = self._load_locked(shard)
+        metrics.set_shard_load(shard, load)
+        return load
+
+    def _load_locked(self, shard: int) -> float:
+        return self._pods[shard] + self.CHURN_WEIGHT * self._rate[shard]
+
+    def load(self, shard: int) -> float:
+        with self._lock:
+            return self._load_locked(shard)
+
+    def loads(self) -> list:
+        with self._lock:
+            return [self._load_locked(s) for s in range(len(self._pods))]
+
+
 class ShardChurn:
     """Dirty-shard set fed by the cache's external ingestion paths.
 
@@ -128,8 +197,10 @@ class ShardChurn:
     all — an over-approximation is always safe (a spurious micro-session
     finds nothing to do), an under-approximation would strand work."""
 
-    def __init__(self, shard_map: ShardMap):
+    def __init__(self, shard_map: ShardMap,
+                 load: Optional["ShardLoad"] = None):
         self._map = shard_map
+        self._load = load
         self._lock = threading.Lock()
         self._dirty: Set[int] = set(range(shard_map.num_shards))  # guarded-by: _lock
 
@@ -138,7 +209,12 @@ class ShardChurn:
             if queue is None:
                 self._dirty.update(range(self._map.num_shards))
             else:
-                self._dirty.add(self._map.shard_of(queue))
+                shard = self._map.shard_of(queue)
+                self._dirty.add(shard)
+                if self._load is not None:
+                    # Queue-attributed churn only: broadcast dirtying is
+                    # bookkeeping, not per-tenant demand.
+                    self._load.note_churn(shard)
 
     def note_shard(self, shard: int) -> None:
         """Re-mark a shard dirty (engine-side: a skipped or failed

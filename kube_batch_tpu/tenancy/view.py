@@ -53,9 +53,12 @@ class ShardView:
         self._lease_live = lease_live
         # Job uids / queue names the LAST shard snapshot served: the
         # close-bookkeeping merge universe (scheduler loop thread only —
-        # shard sessions are strictly sequential within one engine).
+        # shard sessions within one engine snapshot strictly serially,
+        # even when the concurrent pipeline overlaps their device
+        # windows).  _last_pods feeds the shard-load EWMA (ROADMAP 2c).
         self._last_jobs: Set[str] = set()
         self._last_queues: tuple = ()
+        self._last_pods: int = 0
 
     def __getattr__(self, name):
         return getattr(self._cache, name)
@@ -92,6 +95,7 @@ class ShardView:
                     if job.queue in queues}
         self._last_jobs = set(out.jobs)
         self._last_queues = tuple(queues)
+        self._last_pods = sum(len(job.tasks) for job in out.jobs.values())
         return out
 
     # -- incremental-close bookkeeping, shard-scoped ------------------------

@@ -185,6 +185,33 @@ def end_session() -> None:
     recorder.record(tr)
 
 
+def suspend_session() -> Optional[SessionTrace]:
+    """Detach this thread's active session trace WITHOUT finalizing it
+    (the shard pipeline interleaves several sessions' begin/retire halves
+    on one loop thread — doc/TENANCY.md "Concurrent micro-sessions").
+    The caller re-installs it with resume_session before recording the
+    session's remaining spans; ``end_session`` still runs exactly once
+    per session.  Returns None when no session is active (kill switch or
+    plain sequential flow), and resume_session(None) is then a no-op —
+    the pair is safe to call unconditionally."""
+    tr = getattr(_tls, "trace", None)
+    _tls.trace = None
+    return tr
+
+
+def resume_session(tr: Optional[SessionTrace]) -> None:
+    """Re-install a suspended session trace on this thread.  Installing
+    over an active trace would silently drop it, so that is a bug loud
+    enough to raise on (the pipeline always suspends before switching)."""
+    if tr is None:
+        return
+    if getattr(_tls, "trace", None) is not None:
+        raise RuntimeError(
+            "resume_session over an active session trace: suspend the "
+            "current session first")
+    _tls.trace = tr
+
+
 def current_trace() -> Optional[SessionTrace]:
     return getattr(_tls, "trace", None)
 

@@ -110,6 +110,18 @@ GATED_KEYS = {
     "tenancy_shard_rebalances": {
         "path": ("tenancy", "shard_rebalances"), "direction": "down",
         "band": 0.0, "abs_slack": 0.0},
+    # Concurrent shard micro-sessions (doc/TENANCY.md "Concurrent
+    # micro-sessions"): the pipeline must keep actually overlapping —
+    # per-round overlapped host time silently collapsing toward zero,
+    # or the in-flight high water falling back to 1 (sequential), is
+    # the regression these keys watch.  Overlap is wall clock (wide
+    # band); inflight is deterministic at the gate shape (no band).
+    "tenancy_shard_overlap_ms": {
+        "path": ("tenancy", "shard_overlap_ms"), "direction": "up",
+        "band": 0.8, "abs_slack": 0.0},
+    "tenancy_shard_inflight": {
+        "path": ("tenancy", "shard_inflight"), "direction": "up",
+        "band": 0.0, "abs_slack": 0.0},
     # Full-bench keys: absent from steady-only artifacts (so they never
     # enter the bench-gate baseline) but extracted into the trajectory
     # when a full 50k-shape run is appended — the cross-PR history the

@@ -1,0 +1,104 @@
+"""CI gate for `make bench-tenancy`: read the bench artifact line from
+stdin and assert the concurrent shard-pipeline A/B's contracts
+(doc/TENANCY.md "Concurrent micro-sessions").
+
+bench.py deliberately always exits 0 (the artifact-always-emits
+contract), so the smoke's pass/fail lives here:
+
+* PARITY — the concurrent arm's binds, events, and lineage bind-sample
+  set must be bit-identical to the KUBE_BATCH_TPU_CONCURRENT_SHARDS=0
+  sequential control, at the single-chip level AND the FORCE_SHARD
+  8-device mesh leg (when the host exposes a mesh);
+* NON-VACUOUS — the concurrent arm must actually have overlapped:
+  zero overlapped begin halves, zero recorded overlap milliseconds, or
+  an in-flight high water of 1 means the A/B compared the sequential
+  path against itself and proves nothing;
+* the storm must have BOUND work (a zero-bind storm can't diverge).
+
+Exits nonzero on any violation and prints both arms' whole-round pace.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> int:
+    line = ""
+    for raw in sys.stdin:
+        raw = raw.strip()
+        if raw.startswith("{"):
+            line = raw  # last JSON-looking line wins (the artifact)
+    if not line:
+        print("check_tenancy_ab: no artifact line on stdin",
+              file=sys.stderr)
+        return 1
+    out = json.loads(line)
+    if out.get("error"):
+        print(f"check_tenancy_ab: bench reported error: {out['error']}",
+              file=sys.stderr)
+        return 1
+    ab = out.get("tenancy_ab") or {}
+    if not ab:
+        print("check_tenancy_ab: artifact carries no tenancy_ab "
+              "measurements", file=sys.stderr)
+        return 1
+    if out.get("tenancy_parity") is not True:
+        print("check_tenancy_ab: PARITY FAILURE — concurrent shard "
+              "pipeline diverged from the sequential control "
+              f"(parity={ab.get('parity')!r}, "
+              f"lineage={ab.get('lineage_parity')!r}, "
+              f"mesh={ab.get('mesh', {}).get('parity')!r})",
+              file=sys.stderr)
+        return 1
+    if ab.get("events_verified") is not True:
+        # A truncated event ring silently narrows parity to
+        # binds+lineage — the event-ORDER half (the retire/defer
+        # machinery's whole contract) would then be unverified.  That
+        # is the check_churn_ab vacuous-gate discipline: fail, don't
+        # footnote.
+        print("check_tenancy_ab: EVENTS UNVERIFIED — the event ring "
+              "overflowed and the A/B compared binds+lineage only; "
+              "size the ring to the storm", file=sys.stderr)
+        return 1
+    conc = ab.get("concurrent") or {}
+    seq = ab.get("sequential") or {}
+    pipeline = conc.get("pipeline") or {}
+    overlapped = int(pipeline.get("overlapped", 0))
+    overlap_ms = float(conc.get("overlap_ms_total") or 0.0)
+    inflight = int(conc.get("inflight") or 1)
+    if overlapped <= 0 or overlap_ms <= 0.0 or inflight < 2:
+        print("check_tenancy_ab: VACUOUS RUN — the concurrent arm "
+              f"never overlapped (overlapped={overlapped}, "
+              f"overlap_ms={overlap_ms}, inflight={inflight}); the A/B "
+              "compared the sequential path against itself",
+              file=sys.stderr)
+        return 1
+    if pipeline.get("begun", 0) <= 0:
+        print("check_tenancy_ab: VACUOUS RUN — zero pipeline stages "
+              "begun", file=sys.stderr)
+        return 1
+    print(f"concurrent shard A/B: parity OK over {ab.get('rounds')} "
+          f"rounds x {ab.get('shards')} shards "
+          f"(gang {ab.get('gang')}, events "
+          f"{'verified' if ab.get('events_verified') else 'TRUNCATED'})")
+    print(f"  concurrent  round {conc.get('round_ms'):>8} ms   "
+          f"{conc.get('sessions_per_sec')} sessions/s   "
+          f"overlap {overlap_ms:.1f} ms   inflight {inflight}")
+    print(f"  sequential  round {seq.get('round_ms'):>8} ms   "
+          f"{seq.get('sessions_per_sec')} sessions/s")
+    print(f"  whole-round speedup: {ab.get('speedup')}x"
+          f"   pipeline {pipeline}")
+    mesh = ab.get("mesh") or {}
+    if mesh.get("parity") is None:
+        print(f"  mesh leg: skipped ({mesh.get('skipped', '?')})")
+    else:
+        print(f"  mesh leg: parity OK, overlap "
+              f"{mesh.get('overlap_ms_total')} ms, "
+              f"binds {mesh.get('binds')}")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -427,6 +427,93 @@ def run_soak(*, replicas: int = 3, shards: int = 3, nodes: int = 12,
                 rep.stop()
 
 
+def run_skewed_load_check(*, shards: int = 4, lease_duration: float = 3.0,
+                          whale_pods: int = 30, rounds: int = 30) -> dict:
+    """Load-weighted claim-target pin (ROADMAP 2c, doc/TENANCY.md): two
+    replicas over one truth store, four queue-shards, queue q0 a WHALE
+    (a standing pod population dwarfing the other tenants).  Replicas
+    are driven deterministically (manual lease ticks + scheduler cycles,
+    no threads).  With the shard-load EWMA feeding claim targets, the
+    federation must converge so the whale's owner holds FEWER shards
+    than its peer (the count rule would freeze the cold-start 2/2
+    split), via at least one clean load-shed release."""
+    truth = Cluster()
+    queues = [f"q{i}" for i in range(shards)]
+    shard_map = ShardMap(shards, {q: i for i, q in enumerate(queues)})
+    for q in queues:
+        truth.create_queue(v1alpha1.Queue(
+            metadata=ObjectMeta(name=q),
+            spec=v1alpha1.QueueSpec(weight=1)))
+    alloc = {"cpu": "2", "memory": "4Gi", "pods": 110}
+    for i in range(4):
+        truth.create_node(Node(
+            metadata=ObjectMeta(name=f"sk-node-{i}", uid=f"sk-node-{i}"),
+            spec=NodeSpec(),
+            status=NodeStatus(allocatable=dict(alloc),
+                              capacity=dict(alloc))))
+    # The whale: a standing population of unplaceable pods (requests
+    # exceed any node) — pure snapshot/churn load, no binds needed.
+    truth.create_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name="whale", namespace="soak"),
+        spec=v1alpha1.PodGroupSpec(min_member=whale_pods, queue="q0")))
+    for i in range(whale_pods):
+        truth.create_pod(_mk_pod(f"whale-{i}", "whale", cpu="64"))
+    for qi in range(1, shards):
+        _submit_job(truth, f"small-{qi}", 2, queues[qi])
+
+    # The rebalance counter is process-global and the main soak runs
+    # first (its replicas can legitimately shed): the pin asserts on
+    # THIS check's delta, not the cumulative count.
+    shed0 = shard_rebalance_counts().get("shed", 0)
+    reps = []
+    for name in ("skew-a", "skew-b"):
+        cache = new_scheduler_cache(truth)
+        scheduler = Scheduler(cache, schedule_period=3600)
+        leases = ShardLeaseManager(
+            truth, "soak-skew", shards, identity=name,
+            lease_duration=lease_duration,
+            renew_deadline=lease_duration * 0.6,
+            retry_period=lease_duration / 10.0,
+            target_shards=shards // 2)
+        engine = TenancyEngine(scheduler, shard_map, lease_mgr=leases)
+        scheduler.tenancy = engine
+        cache.run()
+        cache.wait_for_cache_sync()
+        reps.append((name, scheduler, leases, engine))
+    problems = []
+    try:
+        for _ in range(rounds):
+            for _name, scheduler, leases, _engine in reps:
+                leases.tick()
+                scheduler.cycle()
+            time.sleep(lease_duration / 10.0)
+        owned = {name: sorted(leases.owned_shards())
+                 for name, _s, leases, _e in reps}
+        whale_owner = next((name for name, shard_list in owned.items()
+                            if 0 in shard_list), None)
+        sheds = shard_rebalance_counts().get("shed", 0) - shed0
+        if whale_owner is None:
+            problems.append("whale shard never owned by any replica")
+        else:
+            peer = next(n for n in owned if n != whale_owner)
+            if not len(owned[whale_owner]) < len(owned[peer]):
+                problems.append(
+                    "skewed load did not rebalance: whale owner "
+                    f"{whale_owner} holds {owned[whale_owner]} vs peer "
+                    f"{owned[peer]} (count-split frozen)")
+        if sheds < 1:
+            problems.append("no load-shed release happened (the "
+                            "load-weighted claim target never engaged)")
+        if set(sum(owned.values(), [])) != set(range(shards)):
+            problems.append(f"shards left unowned: {owned}")
+        return {"owned": owned, "sheds": sheds,
+                "whale_owner": whale_owner, "problems": problems,
+                "ok": not problems}
+    finally:
+        for _name, _scheduler, leases, _engine in reps:
+            leases.stop(release=True)
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
     parser.add_argument("--replicas", type=int, default=3)
@@ -443,6 +530,9 @@ def main(argv=None) -> int:
                              "RemoteCluster (leases ride the wire too)")
     parser.add_argument("--json", type=str, default="",
                         help="also write the artifact to this path")
+    parser.add_argument("--no-skewed-check", action="store_true",
+                        help="skip the skewed-load claim-target pin "
+                             "(run_skewed_load_check)")
     args = parser.parse_args(argv)
 
     artifact = run_soak(replicas=args.replicas, shards=args.shards,
@@ -450,6 +540,14 @@ def main(argv=None) -> int:
                         seed=args.seed, lease_duration=args.lease_duration,
                         edge=args.edge,
                         lease_chaos_rate=args.lease_chaos_rate)
+    if not args.no_skewed_check:
+        # Load-weighted claim targets (ROADMAP 2c): the skewed-tenant
+        # rebalance pin rides every soak run.
+        artifact["skewed_load"] = run_skewed_load_check()
+        if not artifact["skewed_load"]["ok"]:
+            artifact["problems"] = (artifact.get("problems") or []) + \
+                artifact["skewed_load"]["problems"]
+            artifact["ok"] = False
     line = json.dumps(artifact, sort_keys=True)
     print(line)
     if args.json:

@@ -4,7 +4,8 @@ COMPILE_CACHE ?= $(CURDIR)/.compile-cache
 
 .PHONY: lint lint-inventory test bench bench-cached bench-steady \
 	bench-evict bench-commit bench-churn bench-wire bench-shard \
-	bench-topo bench-tenancy bench-gate bench-gate-baseline \
+	bench-topo bench-tenancy bench-fused bench-gate \
+	bench-gate-baseline \
 	lineage-ab chaos chaos-smoke scenarios soak-replicas trace-demo \
 	clean-cache
 
@@ -155,6 +156,23 @@ bench-tenancy:
 		BENCH_TENANCY_AB=1 BENCH_TASKS=2000 BENCH_NODES=256 \
 		BENCH_JOBS=80 BENCH_QUEUES=4 $(PYTHON) bench.py \
 		| $(PYTHON) tools/check_tenancy_ab.py
+
+# One-dispatch session A/B smoke (doc/FUSED.md): the fused session
+# program (one device dispatch serving evict scores, allocate
+# placements, and topology origins) vs the KUBE_BATCH_TPU_FUSED=0
+# per-family control on the 4-action churn storm, the quiet
+# (no-eviction) steady leg, the FORCE_SHARD mesh leg, and the
+# three-family topology leg — asserts bit-identical victims/binds/
+# events everywhere and that each family was actually SERVED from a
+# fused dispatch (vacuous-gate guard).  The checker exits nonzero on
+# any violation (bench.py itself always exits 0), so CI fails loudly.
+bench-fused:
+	env JAX_PLATFORMS=cpu \
+		XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+		BENCH_FUSED_AB=1 BENCH_TASKS=2000 BENCH_NODES=256 \
+		BENCH_JOBS=80 BENCH_QUEUES=4 \
+		KUBE_BATCH_TPU_SCAN_MIN_NODES=0 $(PYTHON) bench.py \
+		| $(PYTHON) tools/check_fused_ab.py
 
 # Adversarial scenario sweep (doc/TOPOLOGY.md "Scenario harness"):
 # seeded generated workloads (gang deadlocks, priority inversions,

@@ -266,7 +266,9 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
     from kube_batch_tpu.metrics.metrics import (compile_cache_counts,
                                                 cycle_floor_values,
                                                 overlap_split_totals,
-                                                route_counts, ship_counts,
+                                                route_counts,
+                                                session_dispatch_counts,
+                                                ship_counts,
                                                 ship_shard_counts)
 
     with _gc_posture():
@@ -285,6 +287,7 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
         ship0 = ship_counts()
         shard0 = ship_shard_counts()
         routes0 = route_counts()
+        disp0 = session_dispatch_counts()
         for rnd in range(rounds + 1):
             if rnd == 1:
                 # Round 0 re-absorbs the cold session's mass echo (usually
@@ -293,6 +296,7 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
                 ship0 = ship_counts()
                 shard0 = ship_shard_counts()
                 routes0 = route_counts()
+                disp0 = session_dispatch_counts()
             round_start = time.perf_counter()
             new_keys, pgs = [], []
             remaining = k
@@ -382,6 +386,7 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
                                "apply", "fit_deltas"))
     shard1 = ship_shard_counts()
     routes1 = route_counts()
+    disp1 = session_dispatch_counts()
     stats = {
         # Whole-round pace: injection + session + echo back-to-back —
         # the sustained cycle rate, not just 1e3/session_ms.
@@ -399,6 +404,13 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
         "routes": ({k: v for k, v in
                     ((k, routes1.get(k, 0) - routes0.get(k, 0))
                      for k in routes1) if v} or None),
+        # Solve-family device dispatches over the same window: the
+        # one-dispatch-per-session ledger (doc/FUSED.md) — the gate
+        # pins the per-session solve count so a regression that starts
+        # re-dispatching shows up as a count, not a latency blur.
+        "dispatches": ({k: v for k, v in
+                        ((k, disp1.get(k, 0) - disp0.get(k, 0))
+                         for k in disp1) if v} or None),
         "phase_ms": phase_ms,
         # Residual per-cycle floors over the steady window (median per
         # floor): the trajectory key `make bench-gate` compares across
@@ -903,7 +915,8 @@ tiers:
 """
 
 
-def _run_topo_arm(defrag: bool, batch: bool, force_shard: bool = False):
+def _run_topo_arm(defrag: bool, batch: bool, force_shard: bool = False,
+                  fused=None):
     """One topo A/B arm: a two-cycle fragmentation-pressure run on the
     checkerboard torus (models/synthetic.make_topo_cache) —
 
@@ -920,6 +933,12 @@ def _run_topo_arm(defrag: bool, batch: bool, force_shard: bool = False):
       cycle 2: the defrag arm's cleared box is now a FREE box — the
                slice places and binds; the capacity arm stays pending.
 
+    ``fused`` (None = leave the env alone) toggles KUBE_BATCH_TPU_FUSED
+    and stamps the conf ladder on each session the way
+    Scheduler.session_once does, so the fused A/B can drive the
+    three-family (evict+solve+topo) dispatch through this scenario
+    without changing what `make bench-topo` measures.
+
     Returns (binds, evict_sequence, frag_after, slice_binds)."""
     import numpy as np
 
@@ -927,17 +946,21 @@ def _run_topo_arm(defrag: bool, batch: bool, force_shard: bool = False):
     from kube_batch_tpu.models.synthetic import make_topo_cache
     from kube_batch_tpu.models.topology import (TOPO_BATCH_ENV,
                                                 TOPO_DEFRAG_ENV, build_view)
+    from kube_batch_tpu.ops.fused_solver import FUSED_ENV
     from kube_batch_tpu.ops.solver import FORCE_SHARD_ENV, \
         refresh_shard_knobs
     from kube_batch_tpu.scheduler import load_scheduler_conf
 
     prior = {k: os.environ.get(k) for k in (TOPO_BATCH_ENV,
                                             TOPO_DEFRAG_ENV,
-                                            FORCE_SHARD_ENV)}
+                                            FORCE_SHARD_ENV,
+                                            FUSED_ENV)}
     os.environ[TOPO_BATCH_ENV] = "1" if batch else "0"
     os.environ[TOPO_DEFRAG_ENV] = "1" if defrag else "0"
     if force_shard:
         os.environ[FORCE_SHARD_ENV] = "1"
+    if fused is not None:
+        os.environ[FUSED_ENV] = "1" if fused else "0"
     refresh_shard_knobs()
     try:
         _register()
@@ -949,8 +972,15 @@ def _run_topo_arm(defrag: bool, batch: bool, force_shard: bool = False):
                 from kube_batch_tpu.api import pod_key
                 podmap[pod_key(t.pod)] = t.pod
 
+        conf_names = tuple(a.name() for a in actions)
+
         def cycle():
             ssn = open_session(cache, tiers)
+            if fused is not None:
+                # The fused dispatcher keys its ride-along legs on the
+                # conf ladder Scheduler.session_once stamps; manual
+                # drives must stamp it themselves.
+                ssn._conf_actions = conf_names
             try:
                 for a in actions:
                     a.execute(ssn)
@@ -1192,6 +1222,282 @@ def measure_action_pipeline(n_tasks, n_nodes, n_jobs, n_queues,
         "evictions_by_action": split,
         "parity": parity,
     }
+
+
+def _fused_storm_arm(fused, n_tasks, n_nodes, n_jobs, n_queues,
+                     cycles: int = 3, force_shard: bool = False):
+    """One arm of the fused-session A/B (doc/FUSED.md): the shipped
+    4-action conf on the churn storm, ``cycles`` back-to-back sessions
+    on ONE cache with the informer echo between them — cycle 1 is
+    eviction-heavy (the alloc leg is host-invalidated by the storm's own
+    evictions), later cycles are quiet (the alloc leg is consumed from
+    the fused dispatch), so a single arm exercises BOTH fused outcomes.
+    KUBE_BATCH_TPU_FUSED is toggled per arm; the manual session drive
+    stamps ``_conf_actions`` exactly as Scheduler.session_once does
+    (the fused dispatcher keys its ride-along legs on the conf ladder).
+
+    Returns the parity material (victim sequence, binds, cluster event
+    log), per-session walls, and the fused counter deltas
+    (dispatches/legs/routes) for the non-vacuity gates."""
+    import dataclasses as dc
+
+    from kube_batch_tpu.api import PodStatus, pod_key
+    from kube_batch_tpu.cache.cache import _EventDeque
+    from kube_batch_tpu.framework import close_session, open_session
+    from kube_batch_tpu.metrics.metrics import (fused_leg_counts,
+                                                route_counts,
+                                                session_dispatch_counts)
+    from kube_batch_tpu.models.synthetic import make_churn_cache
+    from kube_batch_tpu.ops.fused_solver import FUSED_ENV
+    from kube_batch_tpu.ops.solver import FORCE_SHARD_ENV, \
+        refresh_shard_knobs
+    from kube_batch_tpu.scheduler import load_scheduler_conf
+
+    _register()
+    conf_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "config", "kube-batch-conf.yaml")
+    with open(conf_path) as fh:
+        conf = fh.read().replace('"reclaim, allocate, backfill, preempt"',
+                                 '"reclaim, tpu-allocate, backfill, '
+                                 'preempt"')
+    actions, tiers = load_scheduler_conf(conf)
+    conf_names = tuple(a.name() for a in actions)
+
+    saved = {k: os.environ.get(k) for k in (FUSED_ENV, FORCE_SHARD_ENV)}
+    os.environ[FUSED_ENV] = "1" if fused else "0"
+    if force_shard:
+        os.environ[FORCE_SHARD_ENV] = "1"
+    refresh_shard_knobs()
+    try:
+        cache, binder = make_churn_cache(n_tasks, n_nodes, n_jobs,
+                                         n_queues)
+        cache.events = _EventDeque(maxlen=max(200000,
+                                              4 * n_tasks + 20000))
+        podmap = {}
+        for job in cache.jobs.values():
+            for t in job.tasks.values():
+                podmap[pod_key(t.pod)] = t.pod
+        d0 = session_dispatch_counts()
+        l0 = fused_leg_counts()
+        r0 = route_counts()
+        walls = []
+        evicts_all = []
+        with _gc_posture():
+            for _ in range(cycles):
+                t0 = time.perf_counter()
+                ssn = open_session(cache, tiers)
+                # Manual drives bypass Scheduler.session_once, so stamp
+                # the conf ladder the fused dispatcher keys on.
+                ssn._conf_actions = conf_names
+                try:
+                    for a in actions:
+                        a.execute(ssn)
+                finally:
+                    close_session(ssn)
+                walls.append((time.perf_counter() - t0) * 1e3)
+                # Informer echo: victims terminate, binds run — the
+                # next cycle faces the post-storm (quiet) cluster.
+                new_evicts = cache.evictor.evicts[len(evicts_all):]
+                evicts_all.extend(new_evicts)
+                for key in new_evicts:
+                    pod = podmap.pop(key, None)
+                    if pod is not None:
+                        cache.delete_pod(pod)
+                binds = dict(binder.binds)
+                binder.binds.clear()
+                for key, node in binds.items():
+                    old = podmap.get(key)
+                    if old is None:
+                        continue
+                    new = dc.replace(
+                        old,
+                        spec=dc.replace(old.spec, node_name=node),
+                        status=PodStatus(phase="Running"))
+                    podmap[key] = new
+                    cache.update_pod(old, new)
+
+        def _delta(before, after):
+            return {k: v for k, v in
+                    ((k, after.get(k, 0) - before.get(k, 0))
+                     for k in after) if v}
+
+        return {
+            "walls_ms": [round(w, 2) for w in walls],
+            "evicts": evicts_all,
+            "binds": {k: v for k, v in
+                      sorted((pod_key(p), p.spec.node_name)
+                             for p in podmap.values()
+                             if p.spec.node_name is not None)},
+            "events": list(cache.events),
+            "dispatches": _delta(d0, session_dispatch_counts()),
+            "legs": _delta(l0, fused_leg_counts()),
+            "routes": _delta(r0, route_counts()),
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        refresh_shard_knobs()
+
+
+def _fused_quiet_arm(fused, n_tasks, n_nodes, n_jobs, n_queues):
+    """The quiet leg of the fused A/B: ONE session on a free-capacity
+    cluster (models/synthetic.make_synthetic_cache) under the same
+    4-action conf — the scan finds no victims, so the fused dispatch's
+    alloc leg survives to tpu-allocate and is SERVED (the steady-state
+    outcome the storm arm can never show, because its own evictions
+    host-invalidate every alloc leg).  Returns (binds, legs delta)."""
+    from kube_batch_tpu.framework import close_session, open_session
+    from kube_batch_tpu.metrics.metrics import fused_leg_counts
+    from kube_batch_tpu.models.synthetic import make_synthetic_cache
+    from kube_batch_tpu.ops.fused_solver import FUSED_ENV
+    from kube_batch_tpu.scheduler import load_scheduler_conf
+
+    _register()
+    conf_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "config", "kube-batch-conf.yaml")
+    with open(conf_path) as fh:
+        conf = fh.read().replace('"reclaim, allocate, backfill, preempt"',
+                                 '"reclaim, tpu-allocate, backfill, '
+                                 'preempt"')
+    actions, tiers = load_scheduler_conf(conf)
+    prior = os.environ.get(FUSED_ENV)
+    os.environ[FUSED_ENV] = "1" if fused else "0"
+    try:
+        cache, binder = make_synthetic_cache(n_tasks, n_nodes, n_jobs,
+                                             n_queues)
+        l0 = fused_leg_counts()
+        with _gc_posture():
+            ssn = open_session(cache, tiers)
+            ssn._conf_actions = tuple(a.name() for a in actions)
+            try:
+                for a in actions:
+                    a.execute(ssn)
+            finally:
+                close_session(ssn)
+        l1 = fused_leg_counts()
+        legs = {k: v for k, v in
+                ((k, l1.get(k, 0) - l0.get(k, 0)) for k in l1) if v}
+        assert not cache.evictor.evicts, \
+            "quiet leg evicted (the scenario is supposed to be placeable)"
+        return dict(binder.binds), legs
+    finally:
+        if prior is None:
+            os.environ.pop(FUSED_ENV, None)
+        else:
+            os.environ[FUSED_ENV] = prior
+
+
+def measure_fused_ab(n_tasks, n_nodes, n_jobs, n_queues,
+                     cycles: int = 3):
+    """Counterbalanced fused-session A/B (`make bench-fused`,
+    doc/FUSED.md): the one-dispatch session program vs the
+    KUBE_BATCH_TPU_FUSED=0 per-family control on the 4-action churn
+    storm, in off/on/on/off order, plus the FORCE_SHARD mesh leg and
+    the three-family topology leg.  The parity material is the full
+    footprint — victim sequence, final binds, cluster event log —
+    which tools/check_fused_ab.py requires bit-identical across arms;
+    the counter deltas make the gate non-vacuous (>=1 fused dispatch,
+    with evict AND solve AND topo legs actually served somewhere in
+    the run, not just dispatched)."""
+    arms = {True: [], False: []}
+    # Warm both arms (jit shapes + clone pools), then counterbalance.
+    for warm in (True, False):
+        _fused_storm_arm(warm, n_tasks, n_nodes, n_jobs, n_queues,
+                         cycles=1)
+    for arm in (False, True, True, False):
+        arms[arm].append(_fused_storm_arm(arm, n_tasks, n_nodes, n_jobs,
+                                          n_queues, cycles=cycles))
+
+    def _foot(run):
+        return (run["evicts"], run["binds"], run["events"])
+
+    feet = {arm: [_foot(r) for r in runs] for arm, runs in arms.items()}
+    parity = (all(f == feet[True][0] for f in feet[True][1:]) and
+              all(f == feet[False][0] for f in feet[False]))
+    fused_runs = arms[True]
+    dispatches = {}
+    legs = {}
+    for run in fused_runs:
+        for k, v in run["dispatches"].items():
+            dispatches[k] = dispatches.get(k, 0) + v
+        for k, v in run["legs"].items():
+            legs[k] = legs.get(k, 0) + v
+
+    def _med(runs):
+        return round(statistics.median(
+            [w for r in runs for w in r["walls_ms"]]), 2)
+
+    # Mesh leg: the fused program routed through the sharded solvers
+    # must reproduce the single-chip footprint bit-for-bit.
+    sh_on = _fused_storm_arm(True, n_tasks, n_nodes, n_jobs, n_queues,
+                             cycles=cycles, force_shard=True)
+    shard_parity = _foot(sh_on) == feet[True][0]
+    for k, v in sh_on["dispatches"].items():
+        dispatches[k] = dispatches.get(k, 0) + v
+    for k, v in sh_on["legs"].items():
+        legs[k] = legs.get(k, 0) + v
+
+    # Quiet leg: a no-eviction session where the alloc leg SURVIVES to
+    # tpu-allocate (solve/served) — the steady-state outcome.  Parity
+    # on binds vs the FUSED=0 control.
+    qb_on, q_legs = _fused_quiet_arm(True, n_tasks, n_nodes, n_jobs,
+                                     n_queues)
+    qb_off, _ = _fused_quiet_arm(False, n_tasks, n_nodes, n_jobs,
+                                 n_queues)
+    quiet_parity = qb_on == qb_off
+    for k, v in q_legs.items():
+        legs[k] = legs.get(k, 0) + v
+
+    # Three-family leg: the topology conf stages a box-scan INTO the
+    # fused dispatch (evict+solve+topo in one program).  Parity vs the
+    # FUSED=0 control on the fragmentation-pressure scenario.
+    from kube_batch_tpu.metrics.metrics import (fused_leg_counts,
+                                                route_counts)
+    tl0, tr0 = fused_leg_counts(), route_counts()
+    b_on, e_on, _f, s_on = _run_topo_arm(defrag=True, batch=True,
+                                         fused=True)
+    tl1, tr1 = fused_leg_counts(), route_counts()
+    b_off, e_off, _f2, s_off = _run_topo_arm(defrag=True, batch=True,
+                                             fused=False)
+    topo_parity = (b_on == b_off and e_on == e_off)
+    topo_legs = {k: tl1.get(k, 0) - tl0.get(k, 0) for k in tl1
+                 if tl1.get(k, 0) - tl0.get(k, 0)}
+    topo_routes = {k: tr1.get(k, 0) - tr0.get(k, 0) for k in tr1
+                   if tr1.get(k, 0) - tr0.get(k, 0)}
+    for k, v in topo_legs.items():
+        legs[k] = legs.get(k, 0) + v
+
+    return {
+        "on_ms": _med(arms[True]),
+        "off_ms": _med(arms[False]),
+        "parity": parity and quiet_parity,
+        "shard_parity": shard_parity,
+        "topo_parity": topo_parity,
+        "evictions": len(feet[True][0][0]),
+        "binds": len(feet[True][0][1]),
+        "quiet_binds": len(qb_on),
+        "topo_slice_binds": len(s_on),
+        "dispatches": dispatches,
+        "legs": legs,
+        "topo_routes": topo_routes,
+    }
+
+
+def _fill_fused_ab(out, n_tasks, n_nodes, n_jobs, n_queues):
+    """BENCH_FUSED_AB=1 (`make bench-fused`): the one-dispatch session
+    A/B — storm + mesh + three-family topology legs, parity and the
+    non-vacuity counters tools/check_fused_ab.py gates CI on
+    (doc/FUSED.md)."""
+    ab = measure_fused_ab(
+        n_tasks, n_nodes, n_jobs, n_queues,
+        cycles=int(os.environ.get("BENCH_FUSED_CYCLES", "3")))
+    out["fused_ab"] = ab
+    out["fused_parity"] = ab["parity"]
+    out["fused_shard_parity"] = ab["shard_parity"]
+    out["fused_topo_parity"] = ab["topo_parity"]
 
 
 def measure_commit_ab(n_tasks, n_nodes, n_jobs, n_queues, cycles: int = 2,
@@ -2152,7 +2458,18 @@ def _run(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n, with_pipeline,
          steady_only=False, steady_rounds_n=5, evict_only=False,
          churn_only=False, shard_only=False, lineage_only=False,
          topo_only=False, wire_only=False, commit_only=False,
-         tenancy_only=False):
+         tenancy_only=False, fused_only=False):
+    if fused_only:
+        # BENCH_FUSED_AB=1 (`make bench-fused`): ONLY the one-dispatch
+        # session A/B — the fused program vs the KUBE_BATCH_TPU_FUSED=0
+        # per-family control on the 4-action churn storm, plus the
+        # FORCE_SHARD mesh leg and the three-family topology leg
+        # tools/check_fused_ab.py gates CI on (doc/FUSED.md).
+        import jax as _jax
+        out["platform"] = _jax.default_backend()
+        out["mesh_devices"] = len(_jax.devices())
+        _fill_fused_ab(out, n_tasks, n_nodes, n_jobs, n_queues)
+        return
     if tenancy_only:
         # BENCH_TENANCY_AB=1 (`make bench-tenancy`): ONLY the
         # concurrent-vs-sequential shard micro-session A/B — the
@@ -2346,6 +2663,7 @@ def _run_full(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n,
     out["ship"] = steady_stats["ship"]
     out["ship_shards"] = steady_stats.get("ship_shards")
     out["routes"] = steady_stats.get("routes")
+    out["session_dispatches"] = steady_stats.get("dispatches")
     # Flight-recorder span summaries: p50/p95 per phase over the steady
     # window — WHERE the steady milliseconds went, not just the total
     # (null when KUBE_BATCH_TPU_TRACE=0).
@@ -2471,6 +2789,18 @@ def main():
         # (doc/TENANCY.md "Concurrent micro-sessions").
         "tenancy_ab": None,
         "tenancy_parity": None,
+        # One-dispatch session A/B (BENCH_FUSED_AB=1 / `make
+        # bench-fused`): fused program vs the per-family FUSED=0
+        # control — storm/mesh/topology parity + the dispatch and
+        # leg-outcome counters (doc/FUSED.md; gated by
+        # tools/check_fused_ab.py).  `session_dispatches` is the
+        # steady-window solve-family device-dispatch ledger — the
+        # one-dispatch contract, visible in every artifact.
+        "fused_ab": None,
+        "fused_parity": None,
+        "fused_shard_parity": None,
+        "fused_topo_parity": None,
+        "session_dispatches": None,
         "topo_parity": None,
         "topo_shard_parity": None,
         "topo_slices": None,
@@ -2522,6 +2852,7 @@ def main():
         lineage_only = os.environ.get("BENCH_LINEAGE_AB") == "1"
         topo_only = os.environ.get("BENCH_TOPO_AB") == "1"
         tenancy_only = os.environ.get("BENCH_TENANCY_AB") == "1"
+        fused_only = os.environ.get("BENCH_FUSED_AB") == "1"
         steady_rounds_n = int(os.environ.get("BENCH_STEADY_ROUNDS", 5))
         out["metric"] = (f"sched-session solve latency @ {n_tasks} tasks "
                          f"x {n_nodes} nodes (gang+DRF+proportion)"
@@ -2533,7 +2864,8 @@ def main():
                          + (" [shard-ab]" if shard_only else "")
                          + (" [lineage-ab]" if lineage_only else "")
                          + (" [topo-ab]" if topo_only else "")
-                         + (" [tenancy-ab]" if tenancy_only else ""))
+                         + (" [tenancy-ab]" if tenancy_only else "")
+                         + (" [fused-ab]" if fused_only else ""))
 
         # Wall-clock backstop for hangs the signal guard cannot reach
         # (a device call blocked in an extension never returns to the
@@ -2573,7 +2905,8 @@ def main():
              evict_only=evict_only, churn_only=churn_only,
              shard_only=shard_only, lineage_only=lineage_only,
              topo_only=topo_only, wire_only=wire_only,
-             commit_only=commit_only, tenancy_only=tenancy_only)
+             commit_only=commit_only, tenancy_only=tenancy_only,
+             fused_only=fused_only)
         # Last statement INSIDE the try: a signal landing here is still
         # caught below — no handlerless gap before the emit.
         _ignore_signals()

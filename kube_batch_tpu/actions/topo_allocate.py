@@ -128,7 +128,8 @@ class TopoAllocateAction(Action):
         return free, evictable, vic_cnt, vic_cost
 
     @staticmethod
-    def _box_stats(view, free, evictable, vic_cnt, vic_cost, shape):
+    def _box_stats(view, free, evictable, vic_cnt, vic_cost, shape,
+                   ssn=None):
         """Route the scan: batched kernel (one dispatch over the padded
         bucket) or the sequential oracle under TOPO_BATCH=0.  A device
         failure degrades to the oracle — identical integers, so the
@@ -152,6 +153,15 @@ class TopoAllocateAction(Action):
 
         inp = ts.BoxInputs(coords, pad(free), pad(evictable),
                            pad(vic_cnt), pad(vic_cost))
+        if ssn is not None:
+            # One-dispatch sessions (ops/fused_solver.py): the first
+            # scan of the session stages here and rides the fused
+            # program with the eviction/allocate legs; a served leg IS
+            # this dispatch's [N, 6] rows (same kernel, same inputs).
+            from ..ops import fused_solver
+            stats = fused_solver.take_topo(ssn, inp, shape, n)
+            if stats is not None:
+                return stats
         try:
             with trace.span("topo.box_scan", shape="x".join(
                     str(s) for s in shape)):
@@ -386,7 +396,7 @@ class TopoAllocateAction(Action):
             free, evictable, vic_cnt, vic_cost = self._job_masks(
                 ssn, view, job, task0)
             stats = self._box_stats(view, free, evictable, vic_cnt,
-                                    vic_cost, shape)
+                                    vic_cost, shape, ssn=ssn)
             origin = self._pick_free(stats, vol)
             if origin is not None:
                 placed = self._place_box(ssn, view, origin, shape,

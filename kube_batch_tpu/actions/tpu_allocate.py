@@ -268,10 +268,20 @@ class TpuAllocateAction(Action):
                     # block only when the result is actually consumed
                     # (the continuation below).  The packed readback also
                     # forces completion (block_until_ready is unreliable
-                    # on the axon tunnel).
+                    # on the axon tunnel).  A fused session dispatch
+                    # (ops/fused_solver.py) may already hold this solve:
+                    # consume it iff the ship above came back CLEAN at
+                    # the fused generation with the same config and
+                    # candidate gather — else the per-family dispatch.
                     with trace.span("dispatch"):
-                        pending = dispatch_solve(inputs, snap.config,
-                                                 candidates=candidates)
+                        from ..ops import fused_solver
+                        pending = fused_solver.take_alloc(
+                            ssn, shipper, snap, route, candidates)
+                        if pending is not None:
+                            trace.annotate(fused=True)
+                        else:
+                            pending = dispatch_solve(inputs, snap.config,
+                                                     candidates=candidates)
                     metrics.note_candidate_solve(
                         candidates is not None,
                         candidates.count if candidates is not None else 0)
@@ -454,6 +464,11 @@ class TpuAllocateAction(Action):
         arm must keep its exact per-session work profile."""
         import numpy as np
         if not ssn._pipeline_active:
+            return
+        if ssn._pipeline_fence is not None:
+            # A begin-half footprint (tenancy/footprint.py) already
+            # published the whole conf's bound — it is a superset of
+            # this action's tasks-only union; keep it.
             return
         if empty:
             # No candidate tasks: the retire phase touches nodes only if

@@ -9,12 +9,149 @@ excludes nodes whose Used exceeds allocatable.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, Optional
 
 from .objects import Node, pod_key
 from .resource import Resource
 from .types import NodePhase, NodeState, TaskStatus
 from .job_info import TaskInfo
+
+LAZY_TASKS_ENV = "KUBE_BATCH_TPU_LAZY_TASKS"
+
+
+def lazy_tasks_enabled() -> bool:
+    """Lazy node-task view (default on): session node clones defer the
+    per-resident ``clone_lite`` until something actually reads task
+    values.  ``KUBE_BATCH_TPU_LAZY_TASKS=0`` restores the eager clones
+    (the bit-parity control)."""
+    return os.environ.get(LAZY_TASKS_ENV, "1") != "0"
+
+
+class LazyTaskDict(dict):
+    """``node.tasks`` for session node clones: live TaskInfo references
+    plus the status each had when it entered the dict, materialized into
+    the eager path's ``clone_lite`` copies only when task VALUES are
+    read.
+
+    The eager contract this preserves bit-for-bit: a stored entry is a
+    ``clone_lite`` whose ``status`` is frozen at insert time (batch
+    apply inserts BEFORE the deferred status-index moves; the cache
+    snapshot copies before later cache churn), while every other
+    ``clone_lite`` field is immutable-in-place framework-wide (resreq
+    vectors are replaced wholesale, pods are shared by the clone
+    anyway).  So a (live task, captured status) pair is enough to
+    reproduce the clone on demand — and the steady-state micro-session,
+    which writes placements into its node clones and then discards them
+    at close, never pays for a single clone.
+
+    Key-only operations (``in``, ``len``, iteration, ``keys``) never
+    materialize; anything that can leak a value does.  Deleting or
+    overwriting a key drops its pending record.  The native batch-apply
+    walk (native/fastpath.c) detects this type via its ``_lazy`` attr
+    and performs the same live insert + status capture in C."""
+
+    __slots__ = ("_lazy",)
+
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._lazy: Dict[str, object] = {}  # key -> insert-time status
+
+    # -- lazy writes --------------------------------------------------
+
+    def lazy_set(self, key: str, task: TaskInfo) -> None:
+        """Insert a live task, deferring its ``clone_lite``."""
+        dict.__setitem__(self, key, task)
+        self._lazy[key] = task.status
+
+    @classmethod
+    def lazy_copy(cls, src: Dict[str, TaskInfo]) -> "LazyTaskDict":
+        """Lazy twin of ``{k: t.clone_lite() for k, t in src.items()}``:
+        shares the source's (node-private, status-drift-only) clones and
+        captures their statuses now."""
+        d = cls(src)
+        lz = d._lazy
+        for key, task in src.items():
+            lz[key] = task.status
+        return d
+
+    def materialize(self) -> None:
+        """Replace every pending live entry with its ``clone_lite`` —
+        in place, so dict order is untouched (``__setitem__`` of an
+        existing key keeps its position)."""
+        lz = self._lazy
+        if not lz:
+            return
+        self._lazy = {}
+        raw_get = dict.__getitem__
+        raw_set = dict.__setitem__
+        for key, status in lz.items():
+            clone = raw_get(self, key).clone_lite()
+            if clone.status is not status:
+                clone.status = status
+            raw_set(self, key, clone)
+
+    # -- value-leaking reads materialize first ------------------------
+
+    def __getitem__(self, key):
+        self.materialize()
+        return dict.__getitem__(self, key)
+
+    def get(self, key, default=None):
+        self.materialize()
+        return dict.get(self, key, default)
+
+    def values(self):
+        self.materialize()
+        return dict.values(self)
+
+    def items(self):
+        self.materialize()
+        return dict.items(self)
+
+    def pop(self, *args):
+        self.materialize()
+        return dict.pop(self, *args)
+
+    def popitem(self):
+        self.materialize()
+        return dict.popitem(self)
+
+    def setdefault(self, key, default=None):
+        self.materialize()
+        return dict.setdefault(self, key, default)
+
+    def copy(self):
+        self.materialize()
+        return dict(self)
+
+    # -- writes drop stale pending records -----------------------------
+
+    def __setitem__(self, key, value):
+        self._lazy.pop(key, None)
+        dict.__setitem__(self, key, value)
+
+    def __delitem__(self, key):
+        self._lazy.pop(key, None)
+        dict.__delitem__(self, key)
+
+    def clear(self):
+        self._lazy.clear()
+        dict.clear(self)
+
+    def update(self, *args, **kwargs):
+        self.materialize()  # pending map now empty; plain update is safe
+        dict.update(self, *args, **kwargs)
+
+
+def lazy_insert(tasks: Dict[str, TaskInfo], key: str,
+                task: TaskInfo) -> None:
+    """Batch-apply insert: defer the clone when the node's task view is
+    lazy, else the eager ``clone_lite`` (plain cache dicts, gate off)."""
+    if type(tasks) is LazyTaskDict:
+        tasks.lazy_set(key, task)
+    else:
+        tasks[key] = task.clone_lite()
 
 
 class NodeInfo:
@@ -158,7 +295,12 @@ class NodeInfo:
         self.tasks[key] = task
 
     def pods(self):
-        return [t.pod for t in self.tasks.values()]
+        tmap = self.tasks
+        if type(tmap) is LazyTaskDict:
+            # Pods are shared by clone_lite anyway — read the live
+            # entries without forcing materialization.
+            return [t.pod for t in dict.values(tmap)]
+        return [t.pod for t in tmap.values()]
 
     def clone(self) -> "NodeInfo":
         """Deep clone (node_info.go NodeInfo.Clone contract)."""
@@ -186,12 +328,22 @@ class NodeInfo:
         # from_resource_list (set_node), and plugins only read them.
         res.allocatable = self.allocatable
         res.capability = self.capability
+        src = self.tasks
+        if type(src) is LazyTaskDict:
+            # Cloning a lazy view (session-node clone() calls, nested
+            # snapshots): settle its pending entries first so the copy
+            # below never chains live references through two layers.
+            src.materialize()
+        if lazy_tasks_enabled():
+            res.tasks = LazyTaskDict.lazy_copy(src) if src \
+                else LazyTaskDict()
+            return res
         from ..native import clone_task_map
-        if clone_task_map is not None and self.tasks:
-            res.tasks = clone_task_map(self.tasks)[0]
+        if clone_task_map is not None and src:
+            res.tasks = clone_task_map(src)[0]
         else:
             res.tasks = {key: task.clone_lite()
-                         for key, task in self.tasks.items()}
+                         for key, task in src.items()}
         return res
 
     def __repr__(self) -> str:

@@ -17,6 +17,7 @@ from typing import Callable, Dict, List, Optional
 from ..api import (ClusterInfo, FitError, JobInfo, NodeInfo, QueueInfo,
                    TaskInfo, TaskStatus, ValidateResult, allocated_status,
                    pod_key)
+from ..api.node_info import lazy_insert
 from ..api.pod_group_info import (PodGroupCondition, PodGroupPending,
                                   PodGroupRunning, PodGroupUnknown,
                                   PodGroupUnschedulableType)
@@ -619,7 +620,7 @@ class Session:
                     else:
                         pipe_moves.setdefault(task.job, []).append(task)
                 task.node_name = node.name
-                node.tasks[key] = task.clone_lite()
+                lazy_insert(node.tasks, key, task)
                 touched_jobs[task.job] = job
                 applied_append(task)
 
@@ -873,7 +874,7 @@ class Session:
                     pos += 1
                     continue
             task.node_name = node.name
-            ntasks[key] = task.clone_lite()
+            lazy_insert(ntasks, key, task)
             applied_append(task)
             pos += 1
 
@@ -1130,6 +1131,11 @@ def _close_is_silent(job: JobInfo) -> bool:
 
 
 def close_session(ssn: Session) -> None:
+    # Fused-dispatch ledger hygiene (ops/fused_solver.py): an alloc leg
+    # nobody consumed still holds an in-flight handle — retire it before
+    # the inflight gauge audit.
+    from ..ops import fused_solver
+    fused_solver.finalize_session(ssn)
     # plugin_close floor: the gang not-ready walk dominates this loop at
     # scale; the vectorized form (plugins/gang.py) must actually kill it
     # — the bench gate watches this number (doc/INCREMENTAL.md).

@@ -497,6 +497,21 @@ candidate_solve = registry.register(Counter(
     f"{SUBSYSTEM}_candidate_solve_total",
     "Allocate solves by node-axis scope (fired = candidate-row "
     "prefiltered program; full = whole node bucket)", ("result",)))
+# One-dispatch sessions (ops/fused_solver.py, doc/FUSED.md): every
+# solve-family device dispatch is counted at its chokepoint, so "one
+# dispatch per session" is a measured claim — the per-cycle ledger below
+# rides /debug/sessions meta the same way cycle floors do.
+session_dispatches = registry.register(Counter(
+    f"{SUBSYSTEM}_tpu_session_dispatches_total",
+    "Solve-family device dispatches by family (solve | evict | topo = "
+    "per-family programs; fused = the one-dispatch super-program "
+    "serving several families from a single round trip)", ("family",)))
+fused_legs = registry.register(Counter(
+    f"{SUBSYSTEM}_tpu_fused_legs_total",
+    "Fused super-program legs by consumption outcome (served = the "
+    "family's action consumed the precomputed tensors; invalidated = a "
+    "host decision moved state after the fused dispatch and the family "
+    "re-dispatched per-action)", ("family", "outcome")))
 candidate_rows = registry.register(Gauge(
     f"{SUBSYSTEM}_candidate_solve_rows",
     "Candidate node rows the last prefiltered solve actually scanned"))
@@ -990,7 +1005,7 @@ def generation_reuse_counts() -> Dict[str, int]:
 def set_cycle_floor(floor: str, seconds: float) -> None:
     """Record what the current cycle paid for one residual floor stage
     (solve_wait | snapshot | close | occupancy | decode | stage |
-    plugin_close | commit | apply)."""
+    plugin_close | commit | apply | fused)."""
     cycle_floor_ms.set(round(seconds * 1e3, 3), floor)
 
 
@@ -998,6 +1013,50 @@ def cycle_floor_values() -> Dict[str, float]:
     """{floor: ms} of the last cycle — bench churn artifact + /debug."""
     return {labels[0]: v for labels, v in cycle_floor_ms.values().items()
             if labels}
+
+
+_dispatch_cycle_lock = threading.Lock()
+_dispatch_cycle: Dict[str, int] = {}  # guarded-by: _dispatch_cycle_lock
+
+
+def note_session_dispatch(family: str) -> None:
+    """Count one solve-family device dispatch at the family's chokepoint
+    (dispatch_solve | dispatch_evict_batch_solve | dispatch_box_scan |
+    the fused super-program) — the process-total counter plus the
+    per-cycle ledger /debug/sessions reads back at close."""
+    session_dispatches.inc(1.0, family)
+    with _dispatch_cycle_lock:
+        _dispatch_cycle[family] = _dispatch_cycle.get(family, 0) + 1
+
+
+def session_dispatch_counts() -> Dict[str, int]:
+    """{family: count} so far — bench artifact + check_fused_ab."""
+    return {labels[0]: int(v)
+            for labels, v in session_dispatches.values().items()
+            if labels}
+
+
+def take_cycle_dispatches() -> Dict[str, int]:
+    """Drain the per-cycle dispatch ledger (session close -> /debug
+    sessions meta).  Pipelined shard halves interleave on one thread, so
+    like cycle floors the attribution is per retire, not per overlap."""
+    with _dispatch_cycle_lock:
+        out = dict(_dispatch_cycle)
+        _dispatch_cycle.clear()
+    return out
+
+
+def note_fused_leg(family: str, outcome: str) -> None:
+    """Count one fused-leg outcome (family solve | evict | topo;
+    outcome served | invalidated)."""
+    fused_legs.inc(1.0, family, outcome)
+
+
+def fused_leg_counts() -> Dict[str, int]:
+    """{"family/outcome": count} so far — tests + bench artifact."""
+    return {f"{labels[0]}/{labels[1]}": int(v)
+            for labels, v in fused_legs.values().items()
+            if len(labels) == 2}
 
 
 def note_candidate_solve(fired: bool, rows: int = 0) -> None:

@@ -261,11 +261,78 @@ class DeviceNodeScanner:
         # (tests + trace assertions read these).
         self.victim_rank: Optional[Dict[str, int]] = None
         self._batched = False  # True once batch_seed ran (engine active)
+        # Fused-dispatch deferral (ops/fused_solver.py): the evict leg's
+        # device tensors parked between the one-dispatch session program
+        # and the first consumer — _consume_batch materializes them.
+        self._pending_batch = None
+        self._fused_early = False  # seeded before mutating actions ran
         self.stats = {"batch_dispatches": 0, "seeded_profiles": 0,
                       "dirty_rows_patched": 0, "full_recomputes": 0,
                       "refresh_rows": 0, "refreshes": 0}
 
     # -- batched eviction engine (doc/EVICTION.md) --------------------------
+
+    @property
+    def victim_rank(self) -> Optional[Dict[str, int]]:
+        """uid -> precomputed victim-order position.  A deferred fused
+        readback materializes at first touch — consumers (preempt's
+        rank lookup) never see the parked device tensors."""
+        self._consume_batch()
+        return self._victim_rank
+
+    @victim_rank.setter
+    def victim_rank(self, value) -> None:
+        self._victim_rank = value
+
+    def _consume_batch(self) -> None:
+        """Materialize the fused evict leg (ops/fused_solver.py): ONE
+        host transfer seeding the score cache exactly as the per-family
+        batch_seed would have — keyed at the dispatch-time edit-log
+        position, so rows dirtied while the readback was parked patch
+        through the normal edit-log path.  A readback fault (chaos
+        ``fused.poison``/``fused.slow``, dead tunnel) degrades like a
+        dispatch failure: caches stay unseeded, every scores() call
+        takes the per-profile numpy path, and the shared breaker is
+        fed."""
+        pb = self._pending_batch
+        if pb is None:
+            return
+        self._pending_batch = None
+        from ..chaos.breaker import device_breaker
+        from ..metrics import metrics
+        from ..ops import fused_solver
+        from ..trace import spans as trace
+        try:
+            with trace.span("fused.evict_consume",
+                            profiles=len(pb["keys"])):
+                mat, perm = fused_solver.consume_evict(
+                    pb["scores"], pb["perm"], pb["kb"], self.dyn.shape[0])
+        except Exception as exc:
+            self._batched = False
+            self.stats["batch_dispatches"] -= 1
+            self.stats["seeded_profiles"] -= len(pb["keys"])
+            device_breaker().failure()
+            metrics.note_device_failure("fused")
+            metrics.note_fused_leg("evict", "failed")
+            trace.note_degraded(
+                f"fused evict readback failed ({type(exc).__name__}); "
+                "per-profile host scoring")
+            return
+        breaker = device_breaker()
+        if not breaker.closed():
+            # Same half-open resolution rule as the per-family dispatch:
+            # the successful readback IS the recovery evidence.
+            breaker.success()
+        for i, key in enumerate(pb["keys"]):
+            self._score_cache[key] = [mat[i], pb["pos"]]
+        if pb["stock_order"]:
+            rank_map: Dict[str, int] = {}
+            m = pb["m"]
+            for p, j in enumerate(perm.tolist()):
+                if j < m:
+                    rank_map[pb["vic_uids"][j]] = p
+            self._victim_rank = rank_map
+        metrics.note_fused_leg("evict", "served")
 
     def _profile_key(self, ti: int) -> tuple:
         return (int(self._task_sig[ti]), self._task_res[ti].tobytes(),
@@ -354,9 +421,27 @@ class DeviceNodeScanner:
             self.cfg, self.r, self.np_pad, self.ns_pad,
             self.dyn.shape[0], kb, mb, int(self.statics.sig_mask.shape[0]),
             route=route)
+        # One-dispatch sessions (ops/fused_solver.py): the fused program
+        # serves this eviction staging — plus the allocate solve and any
+        # staged topo scan — from a SINGLE device dispatch; the readback
+        # parks on _pending_batch and rides the async window to the
+        # first consumer.  None => per-family dispatch below, exactly
+        # the KUBE_BATCH_TPU_FUSED=0 control.
         from ..chaos.breaker import device_breaker
+        from ..ops import fused_solver
         with trace.span("evict.batch_solve", profiles=len(keys),
                         victims=m, nodes=len(self.snap.node_names)):
+            fused = fused_solver.take_evict(ssn, self, trows, node_p,
+                                            rank_p)
+            if fused is not None:
+                self._pending_batch = dict(
+                    scores=fused[0], perm=fused[1], kb=kb, keys=keys,
+                    vic_uids=vic_uids, m=m, stock_order=stock_order,
+                    pos=len(self._edit_log))
+                self._batched = True
+                self.stats["batch_dispatches"] += 1
+                self.stats["seeded_profiles"] += len(keys)
+                return
             try:
                 # Sharded route: the dispatch reads the resident sharded
                 # node leaves in place — staging dyn here would ship the
@@ -428,7 +513,15 @@ class DeviceNodeScanner:
             raise RuntimeError(
                 "scanner.refresh inside an open transaction (checkpoint "
                 "frames present) — attach must happen between actions")
+        self._consume_batch()
         names = sorted(n for n in ssn.mutated_nodes if n in self.node_index)
+        if names and self._fused_early:
+            # Early-seeded scanner (fused topo-first build): the victim
+            # ranking was computed BEFORE this session's mutations, so
+            # residents placed since are missing from the map.  Drop it —
+            # the walk falls back to the exact session victim queue,
+            # which is bit-identical by the batch_seed parity contract.
+            self._victim_rank = None
         self.stats["refreshes"] += 1
         if not names:
             return
@@ -541,6 +634,7 @@ class DeviceNodeScanner:
         import os
 
         safe = os.environ.get(SAFE_SCORES_ENV) == "1"
+        self._consume_batch()
         ti = self.task_index.get(task.uid)
         if ti is None:
             return None

@@ -38,7 +38,7 @@ extern int _PyObject_LookupAttr(PyObject *, PyObject *, PyObject **);
 /* Cached attribute-name objects (created once at module init). */
 static PyObject *s_job, *s_pod, *s_spec, *s_volumes, *s_node_name,
     *s_name, *s_tasks, *s_clone_lite, *s_pod_key_cache, *s_metadata,
-    *s_namespace;
+    *s_namespace, *s_lazy, *s_status;
 
 /* TaskInfo slot layout, resolved once from the first task's type: the
  * member-descriptor offsets let the clone run as 11 pointer copies
@@ -275,9 +275,24 @@ apply_placements(PyObject *self, PyObject *args)
                                     "node.tasks not a dict");
                     goto fail_inner;
                 }
-                cached = PyTuple_Pack(3, node, tasks_o, name_o);
+                /* Lazy view probe (api/node_info.LazyTaskDict): a
+                 * ``_lazy`` dict attr means inserts defer the clone —
+                 * live task + insert-time status capture instead. */
+                PyObject *pend = NULL;
+                if (LOOKUP_ATTR(tasks_o, s_lazy, &pend) < 0) {
+                    Py_DECREF(tasks_o);
+                    Py_DECREF(name_o);
+                    goto fail_inner;
+                }
+                if (pend == NULL || !PyDict_Check(pend)) {
+                    Py_XDECREF(pend);
+                    pend = Py_None;
+                    Py_INCREF(pend);
+                }
+                cached = PyTuple_Pack(4, node, tasks_o, name_o, pend);
                 Py_DECREF(tasks_o);
                 Py_DECREF(name_o);
+                Py_DECREF(pend);
                 if (cached == NULL)
                     goto fail_inner;
                 int rc = PyDict_SetItem(node_cache, hostname, cached);
@@ -360,8 +375,10 @@ apply_placements(PyObject *self, PyObject *args)
             }
         }
 
-        /* task.node_name = node.name (before the clone so it carries
-         * the assignment), then node.tasks[key] = task.clone_lite(). */
+        /* task.node_name = node.name (before the clone/capture so it
+         * carries the assignment), then node.tasks[key] =
+         * task.clone_lite() — or, on a lazy view, the live task plus
+         * its insert-time status (LazyTaskDict.lazy_set in C). */
         if (fast) {
             PyObject **slotp = (PyObject **)
                 ((char *)task + layout.offsets[SL_NODE_NAME]);
@@ -369,21 +386,34 @@ apply_placements(PyObject *self, PyObject *args)
             Py_INCREF(node_name);
             *slotp = node_name;
             Py_XDECREF(old);
-            PyObject *clone = clone_task_fast(task);
-            if (clone == NULL)
+        } else {
+            if (PyObject_SetAttr(task, s_node_name, node_name) < 0)
                 goto fail_inner;
-            int rc = PyDict_SetItem(node_tasks, key, clone);
-            Py_DECREF(clone);
+        }
+        PyObject *lazy_pend = PyTuple_GET_ITEM(cached, 3);  /* borrowed */
+        if (lazy_pend != Py_None) {
+            if (PyDict_SetItem(node_tasks, key, task) < 0)
+                goto fail_inner;
+            PyObject *status = fast ? slot_get(task, SL_STATUS) : NULL;
+            int owned = 0;
+            if (status == NULL) {
+                status = PyObject_GetAttr(task, s_status);
+                if (status == NULL)
+                    goto fail_inner;
+                owned = 1;
+            }
+            int rc = PyDict_SetItem(lazy_pend, key, status);
+            if (owned)
+                Py_DECREF(status);
             if (rc < 0)
                 goto fail_inner;
         } else {
-            int rc = PyObject_SetAttr(task, s_node_name, node_name);
-            if (rc < 0)
-                goto fail_inner;
-            PyObject *clone = PyObject_CallMethodNoArgs(task, s_clone_lite);
+            PyObject *clone = fast
+                ? clone_task_fast(task)
+                : PyObject_CallMethodNoArgs(task, s_clone_lite);
             if (clone == NULL)
                 goto fail_inner;
-            rc = PyDict_SetItem(node_tasks, key, clone);
+            int rc = PyDict_SetItem(node_tasks, key, clone);
             Py_DECREF(clone);
             if (rc < 0)
                 goto fail_inner;
@@ -684,6 +714,8 @@ PyInit__fastpath(void)
     s_pod_key_cache = PyUnicode_InternFromString("_pod_key");
     s_metadata = PyUnicode_InternFromString("metadata");
     s_namespace = PyUnicode_InternFromString("namespace");
+    s_lazy = PyUnicode_InternFromString("_lazy");
+    s_status = PyUnicode_InternFromString("status");
     s_tensor_static = PyUnicode_InternFromString("_tensor_static");
     s_containers = PyUnicode_InternFromString("containers");
     s_ports = PyUnicode_InternFromString("ports");
@@ -693,7 +725,8 @@ PyInit__fastpath(void)
     s_affinity = PyUnicode_InternFromString("affinity");
     if (!s_job || !s_pod || !s_spec || !s_volumes || !s_node_name
         || !s_name || !s_tasks || !s_clone_lite || !s_pod_key_cache
-        || !s_metadata || !s_namespace || !s_tensor_static
+        || !s_metadata || !s_namespace || !s_lazy || !s_status
+        || !s_tensor_static
         || !s_containers || !s_ports || !s_host_port || !s_node_selector
         || !s_tolerations || !s_affinity)
         return NULL;

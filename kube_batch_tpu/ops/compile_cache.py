@@ -460,7 +460,111 @@ def warm_bucket(spec: BucketSpec, cfg=None, family: Sequence[str] = ("auto",),
     from ..models.topology import topology_enabled
     if topology_enabled():
         records.append(_warm_topo(spec))
+    from .fused_solver import fused_enabled
+    if fused_enabled():
+        records.append(_warm_fused(spec, cfg, inp_np, inp,
+                                   resident=resident))
     return records
+
+
+def _warm_fused(spec: BucketSpec, cfg, inp_np, inp,
+                resident=None) -> WarmupRecord:
+    """Warm the fused one-dispatch session program (ops/fused_solver.py)
+    at this bucket: the allocate solve plus the batched eviction scan
+    (plus the topo box scan when topology is enabled) composed inside
+    ONE jit is a DIFFERENT executable from its warmed members, so the
+    first fused session would otherwise pay the composition's XLA
+    compile live.  Routed as the live dispatch would be: mesh-sharded
+    legs when the warm shipper produced a resident image, the pinned
+    single-chip route otherwise.  Other leg subsets compile on first
+    use (each is strictly smaller than this one)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from ..models.topology import topology_enabled
+    from .evict_solver import choose_evict_route
+    from .fused_solver import _fused_program, fused_solve_key
+    from .scan import ScanStatics
+    from .solver import choose_solver_mesh
+
+    r = inp_np.task_req.shape[1]
+    np_pad = inp_np.task_ports.shape[1]
+    ns_pad = inp_np.task_aff_req.shape[1]
+    n_pad = inp_np.node_idle.shape[0]
+    kb = bucket(1)
+    mb = bucket(max(spec.tasks, 1))
+    legs = ("evict", "solve", "topo") if topology_enabled() \
+        else ("evict", "solve")
+    eroute, emesh = choose_evict_route(resident)
+    if resident is not None:
+        from ..parallel.mesh import default_mesh
+        aroute, amesh = "sharded", default_mesh()
+    else:
+        aroute, amesh = choose_solver_mesh(inp_np)
+        if aroute == "sharded":
+            aroute, amesh = "xla", None
+    sx, sy, sz = (2, 2, 2) if "topo" in legs else (0, 0, 0)
+    key = fused_solve_key(legs, aroute, False, 0, (n_pad, cfg), eroute,
+                          (cfg, r, np_pad, ns_pad, kb, mb), "xla",
+                          (sx, sy, sz))
+    start = time.perf_counter()
+    try:
+        src = resident if resident is not None else inp
+        statics = ScanStatics(
+            sig_mask=jnp.asarray(src.sig_mask),
+            sig_bonus=jnp.asarray(src.sig_bonus),
+            node_alloc=jnp.asarray(src.node_alloc),
+            node_max_tasks=jnp.asarray(src.node_max_tasks),
+            node_exists=jnp.asarray(src.node_exists),
+            score_shift=jnp.asarray(src.score_shift))
+        trows = np.zeros((kb, 1 + r + np_pad + 4 * ns_pad), np.int32)
+        vic_node = np.full((mb,), n_pad, np.int32)
+        vic_rank = np.full((mb,), mb, np.int32)
+        if eroute == "sharded":
+            import jax
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rep = NamedSharding(emesh, P())
+            trows_d = jax.device_put(trows, rep)
+            node_d = jax.device_put(vic_node, rep)
+            rank_d = jax.device_put(vic_rank, rep)
+            edyn = None
+        else:
+            trows_d = jnp.asarray(trows)
+            node_d = jnp.asarray(vic_node)
+            rank_d = jnp.asarray(vic_rank)
+            edyn = jnp.asarray(np.concatenate(
+                [np.asarray(inp_np.node_used),
+                 np.asarray(inp_np.node_count)[:, None],
+                 np.asarray(inp_np.node_ports).astype(np.int32),
+                 np.asarray(inp_np.node_selcnt)],
+                axis=1).astype(np.int32))
+        box = None
+        troute, tmesh = "xla", None
+        if "topo" in legs:
+            from . import topo_solver as ts
+            box = ts.BoxInputs(
+                coords=jnp.asarray(np.full((n_pad, 8), -1, np.int32)),
+                free=jnp.zeros((n_pad,), bool),
+                evictable=jnp.zeros((n_pad,), bool),
+                vic_cnt=jnp.zeros((n_pad,), jnp.int32),
+                vic_cost=jnp.zeros((n_pad,), jnp.int32))
+        out = _fused_program(
+            legs, cfg, aroute, False, amesh, cfg, r, np_pad, ns_pad,
+            eroute, emesh, sx, sy, sz, troute, tmesh,
+            src, None, None, statics, edyn, trows_d, node_d, rank_d, box)
+        np.asarray(out["alloc"])
+        np.asarray(out["evict"][0])
+        if "topo" in legs:
+            np.asarray(out["topo"])
+    except Exception as exc:  # lint: allow-swallow(warmup must never take down boot; failure is recorded in WarmupRecord.error)
+        return WarmupRecord(
+            spec, "fused", key,
+            round((time.perf_counter() - start) * 1e3, 1),
+            f"{type(exc).__name__}: {exc}")
+    note_warmed(key)
+    return WarmupRecord(
+        spec, "fused", key,
+        round((time.perf_counter() - start) * 1e3, 1))
 
 
 def _warm_topo(spec: BucketSpec) -> WarmupRecord:

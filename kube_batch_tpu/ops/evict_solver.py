@@ -134,6 +134,7 @@ def dispatch_evict_batch_solve(cfg, r: int, np_pad: int, ns_pad: int,
             "chaos: batched eviction solve failed (injected)")
     choice, mesh = choose_evict_route(resident)
     metrics.note_route("evict", choice)
+    metrics.note_session_dispatch("evict")
     from ..trace import spans as trace
     trace.annotate(route=choice, mesh_devices=mesh.size if mesh else 1)
     if choice == "sharded":

@@ -1,0 +1,536 @@
+"""One-dispatch sessions: the action ladder's whole solve-family fused
+into a SINGLE device program (doc/FUSED.md).
+
+A steady micro-session still paid one device round trip per solver
+family — the allocate solve (ops/solver.py), the batched eviction solve
+(ops/evict_solver.py) and the topo box scan (ops/topo_solver.py) each
+dispatched their own program even though all three read the SAME
+resident node image and none depends on another's device output (the
+sequential decision tail — victim commits, placement statements — runs
+on the host against the readbacks).  This module composes the exact
+per-family jitted programs inside ONE outer jit, so the session's
+entire device work lands in one dispatch at the first consumer and the
+host replays the decision ladder against precomputed tensors:
+
+  * ``alloc`` leg — the allocate solve (full-bucket or candidate-row,
+    single-chip / Pallas / mesh-sharded: the same routing
+    ``choose_solver_mesh`` pins), packed through the SAME
+    ``_pack_result_ordered`` [4, P] readback and wrapped as a standard
+    ``PendingSolve`` — tpu-allocate's ``finish`` continuation consumes
+    it through ``fetch_solve`` unchanged.
+  * ``evict`` leg — ``evict_batch_solve``'s [K, N] profile scan + the
+    victim lexsort, consumed lazily by models/scanner.py (the readback
+    rides the async-dispatch window to the first ``scores()`` call).
+  * ``topo`` leg — ``box_scan``'s [N, 6] origin stats for the first
+    slice job, staged by actions/topo_allocate.py before the scanner
+    builds so all three families share the dispatch.
+
+Validity is generation-proved, never assumed: the alloc leg records the
+shipper generation it solved at, and tpu-allocate consumes it only when
+its own ship comes back CLEAN at that same generation with the same
+config and the same candidate gather (byte-compared remap) — the exact
+"clean ship at an unchanged generation proves byte-identical inputs"
+contract the incremental solve cache already relies on
+(models/shipping.py, models/incremental.py).  Anything else counts a
+``kube_batch_tpu_fused_legs_total{outcome="invalidated"}`` and falls
+back to the per-family dispatch — bit-parity is structural, not
+probabilistic.  ``KUBE_BATCH_TPU_FUSED=0`` is the A/B control: every
+consumer takes the per-family chokepoints exactly as before.
+
+Failure degrades, never decides: a fused dispatch or readback failure
+feeds the shared device breaker (chaos site ``fused.device_error``;
+readback faults ``fused.slow`` / ``fused.poison``), invalidates the
+resident image, and the session re-dispatches per family — then the
+per-family paths' own host oracles below that (doc/CHAOS.md).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import time
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+FUSED_ENV = "KUBE_BATCH_TPU_FUSED"
+FUSED_SOLVE_CHOICE = "fused"
+
+# Leg outcome vocabulary (kube_batch_tpu_fused_legs_total{outcome=}):
+#   served      — the precomputed tensor answered the family's question
+#   invalidated — host state moved between dispatch and consume (or the
+#                 consumer's staging differed); per-family re-dispatch
+#   unused      — dispatched but never consumed (e.g. the incremental
+#                 cache answered first, or the session aborted)
+#   failed      — the fused dispatch/readback itself errored; breaker fed
+
+
+def fused_enabled() -> bool:
+    return os.environ.get(FUSED_ENV, "1") != "0"
+
+
+class _AllocLeg(NamedTuple):
+    """The alloc leg's host-side capture: everything tpu-allocate must
+    re-derive identically for the precomputed solve to be ITS solve."""
+    inputs: object        # resident SolverInputs (the shipped image)
+    cfg: object           # SolverConfig (static)
+    route: str            # choose_solver_mesh choice at stage time
+    mesh: object          # the mesh the route validated (or None)
+    generation: int       # shipper generation the solve read
+    cand_sig: object      # candidate-gather identity (None = full bucket)
+    candidates: object    # the staged CandidateSet (remap for the fetch)
+
+
+class FusedState:
+    """Per-session fused-dispatch ledger, cached on ``ssn._fused_state``.
+
+    One fused dispatch per session maximum: the first device-needing
+    consumer stages every leg it can prove out and fires; later
+    consumers either match their capture (served) or re-dispatch per
+    family (invalidated, counted)."""
+
+    __slots__ = ("dispatched", "failed", "legs", "alloc_pending",
+                 "alloc_leg", "topo_request", "topo_out", "topo_sig",
+                 "early_scanner")
+
+    def __init__(self):
+        self.dispatched = False
+        self.failed = False
+        self.legs = ()
+        self.alloc_pending = None   # PendingSolve until consumed/discarded
+        self.alloc_leg = None       # _AllocLeg capture
+        self.topo_request = None    # (BoxInputs np, shape, sig) staging
+        self.topo_out = None        # device [N, 6] stats
+        self.topo_sig = None
+        self.early_scanner = False  # scanner seeded before mutations ran
+
+
+def state_for(ssn) -> FusedState:
+    st = getattr(ssn, "_fused_state", None)
+    if st is None:
+        st = FusedState()
+        ssn._fused_state = st
+    return st
+
+
+def _conf_names(ssn) -> tuple:
+    """The session's action ladder (scheduler stamps it at open)."""
+    return tuple(getattr(ssn, "_conf_actions", ()) or ())
+
+
+# ---------------------------------------------------------------------------
+# The fused program: per-family jitted solvers composed inside ONE outer
+# jit.  jit-of-jit inlines — the whole composition compiles to a single
+# executable and the runtime enqueues ONE device program per call.
+# Absent legs pass None for their traced arguments (an empty pytree) and
+# are skipped at trace time via the static ``legs`` tuple.
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.jit, static_argnames=(
+    "legs", "acfg", "aroute", "has_cand", "amesh",
+    "ecfg", "r", "np_pad", "ns_pad", "eroute", "emesh",
+    "sx", "sy", "sz", "troute", "tmesh"))
+def _fused_program(legs, acfg, aroute, has_cand, amesh,
+                   ecfg, r, np_pad, ns_pad, eroute, emesh,
+                   sx, sy, sz, troute, tmesh,
+                   ainp, cand_idx, cand_valid,
+                   statics, edyn, trows, vic_node, vic_rank,
+                   box):
+    out = {}
+    if "solve" in legs:
+        from .solver import (_gather_candidate_inputs, _pack_result_ordered,
+                             solve_allocate)
+        if has_cand:
+            if aroute == "sharded":
+                from ..parallel.sharded_solver import (
+                    gather_candidate_sharded, solve_allocate_sharded)
+                sub = gather_candidate_sharded(ainp, cand_idx, cand_valid,
+                                               amesh)
+                res = solve_allocate_sharded(sub, acfg, amesh)
+            else:
+                sub = _gather_candidate_inputs(ainp, cand_idx, cand_valid)
+                res = solve_allocate(sub, acfg)
+        elif aroute == "sharded":
+            from ..parallel.sharded_solver import solve_allocate_sharded
+            res = solve_allocate_sharded(ainp, acfg, amesh)
+        elif aroute == "pallas":
+            from .pallas_solver import solve_allocate_pallas
+            res = solve_allocate_pallas(ainp, acfg)
+        else:
+            res = solve_allocate(ainp, acfg)
+        out["alloc"] = _pack_result_ordered(res.assignment, res.kind,
+                                            res.order)
+    if "evict" in legs:
+        if eroute == "sharded":
+            from ..parallel.sharded_scan import evict_batch_solve_sharded
+            scores, perm = evict_batch_solve_sharded(
+                ecfg, r, np_pad, ns_pad, statics, ainp.node_used,
+                ainp.node_count, ainp.node_ports, ainp.node_selcnt,
+                trows, vic_node, vic_rank, emesh)
+        else:
+            from .evict_solver import evict_batch_solve
+            scores, perm = evict_batch_solve(
+                ecfg, r, np_pad, ns_pad, statics, edyn, trows,
+                vic_node, vic_rank)
+        out["evict"] = (scores, perm)
+    if "topo" in legs:
+        if troute == "sharded":
+            from .topo_solver import box_scan_sharded
+            out["topo"] = box_scan_sharded(box, sx, sy, sz, tmesh)
+        else:
+            from .topo_solver import box_scan
+            out["topo"] = box_scan(box, sx, sy, sz)
+    return out
+
+
+def fused_solve_key(legs, aroute, has_cand, cand_rows, a_shape,
+                    eroute, e_shape, troute, t_shape) -> tuple:
+    """Compile-cache identity of one fused executable: the static leg
+    set plus each present leg's jit-relevant degrees of freedom (the
+    per-family solve_key/evict_solve_key/topo_solve_key disciplines
+    folded into one tuple)."""
+    return (FUSED_SOLVE_CHOICE, tuple(legs), aroute, has_cand, cand_rows,
+            a_shape, eroute, e_shape, troute, t_shape)
+
+
+# ---------------------------------------------------------------------------
+# Staging: what each leg must prove on the host before riding along.
+# ---------------------------------------------------------------------------
+
+def _cand_sig(candidates) -> object:
+    """Byte identity of a candidate gather: same remap => same gathered
+    program => same placements.  None means the full-bucket program."""
+    if candidates is None:
+        return None
+    remap = candidates.remap
+    return (int(candidates.count),
+            None if remap is None else remap.tobytes())
+
+
+def _stage_alloc(ssn, snap) -> Optional[_AllocLeg]:
+    """Decide whether the allocate solve can ride the fused dispatch,
+    and stage exactly what tpu-allocate's begin half would stage: the
+    shipped resident image, the route, and the candidate gather.  Every
+    predicate mirrors actions/tpu_allocate.execute_begin so the capture
+    is the SAME dispatch that action would have issued — the consume
+    check then only has to prove nothing moved in between."""
+    if "tpu-allocate" not in _conf_names(ssn):
+        return None
+    from ..actions.tpu_allocate import PIPELINE_ENV
+    if os.environ.get(PIPELINE_ENV, "1") == "0":
+        # The sequential control consumes synchronously via
+        # best_solve_allocate; a pre-staged async handle would change
+        # its timing topology.  Keep the control untouched.
+        return None
+    from ..chaos.breaker import device_breaker
+    if not device_breaker().allow():
+        return None
+    if snap.needs_fallback or not snap.tasks:
+        return None
+    from ..models import incremental
+    from ..models.shipping import resident_shipper
+    from ..ops.solver import choose_solver_mesh
+    shipper = resident_shipper(ssn.cache)
+    inputs = shipper.ship(snap.inputs, snap.config)
+    inc_state = (incremental.state_for(ssn.cache, create=False)
+                 if incremental.incremental_enabled() else None)
+    if (inc_state is not None
+            and shipper.last_mode == "clean"
+            and inc_state.solve_gen == shipper.generation
+            and inc_state.solve_cfg == snap.config
+            and inc_state.solve_result is not None):
+        # The generation-keyed cache already holds this session's
+        # answer; tpu-allocate will reuse it without any dispatch.
+        return None
+    route, mesh = choose_solver_mesh(snap.inputs)
+    candidates = None
+    if inc_state is not None and inc_state.last_kind == "micro":
+        from .prefilter import derive_candidates
+        candidates = derive_candidates(snap, route, mesh)
+    return _AllocLeg(inputs=inputs, cfg=snap.config, route=route,
+                     mesh=mesh, generation=shipper.generation,
+                     cand_sig=_cand_sig(candidates), candidates=candidates)
+
+
+def _chaos_consume(arr: np.ndarray) -> np.ndarray:
+    """Readback fault sites for the fused legs (doc/CHAOS.md):
+    ``fused.slow`` sleeps before the transfer is consumed and
+    ``fused.poison`` truncates the trailing column — the shape every
+    consumer validates before seeding caches.  One no-op branch when
+    the chaos engine is off."""
+    from ..chaos import plan as chaos_plan
+    plan = chaos_plan.PLAN
+    if plan is None:
+        return arr
+    slow = plan.fire("fused.slow")
+    if slow is not None:
+        time.sleep(0.01 + 0.05 * slow.magnitude)
+    if plan.fire("fused.poison") and arr.ndim >= 2 and arr.shape[-1]:
+        return arr[..., :-1]
+    return arr
+
+
+def _fail(ssn, st: FusedState, exc: Exception, families) -> None:
+    """Shared degrade path: feed the breaker, invalidate the resident
+    image (the fused program may have died mid-write on a real device),
+    count the failure, and let every family re-dispatch (then degrade
+    further to its own host oracle under the breaker)."""
+    from ..chaos.breaker import device_breaker
+    from ..metrics import metrics
+    from ..models.shipping import resident_shipper
+    from ..trace import spans as trace
+    st.failed = True
+    st.alloc_pending = None
+    st.alloc_leg = None
+    st.topo_out = None
+    device_breaker().failure()
+    metrics.note_device_failure("fused")
+    for fam in families:
+        metrics.note_fused_leg(fam, "failed")
+    try:
+        resident_shipper(ssn.cache).invalidate()
+    except Exception:
+        metrics.note_swallowed("fused_invalidate")
+    trace.note_degraded(
+        f"fused dispatch failed ({type(exc).__name__}); per-family "
+        "re-dispatch")
+
+
+# ---------------------------------------------------------------------------
+# Consumers.
+# ---------------------------------------------------------------------------
+
+def take_evict(ssn, scanner, trows, node_p, rank_p):
+    """The fused dispatch point, called from scanner.batch_seed with the
+    eviction staging fully derived.  Stages every other leg the session
+    can prove out (alloc from the scanner's own snapshot; topo if
+    actions/topo_allocate.py staged a request) and fires the ONE
+    program.  Returns the evict leg's device (scores, perm) — the
+    scanner defers the readback to its first consumer — or None, in
+    which case batch_seed dispatches per family exactly as before."""
+    if not fused_enabled():
+        return None
+    st = state_for(ssn)
+    if st.dispatched or st.failed:
+        return None
+    from ..metrics import metrics
+    from ..ops import evict_solver
+    from ..ops.compile_cache import note_solve_key
+    from ..trace import spans as trace
+
+    legs = ["evict"]
+    eroute, emesh = evict_solver.choose_evict_route(scanner._resident)
+    alloc = None
+    try:
+        alloc = _stage_alloc(ssn, scanner.snap)
+    except Exception:
+        metrics.note_swallowed("fused_stage_alloc")
+        alloc = None
+    if alloc is not None:
+        legs.append("solve")
+    topo = st.topo_request
+    if topo is not None:
+        legs.append("topo")
+    legs = tuple(legs)
+
+    # Resident leaves feed the sharded evict leg; the alloc leg's image
+    # is the same buffer when both shipped (one shipper per cache).
+    ainp = alloc.inputs if alloc is not None else scanner._resident
+    if eroute == "sharded" and ainp is None:
+        return None  # nothing resident to read in place; per-family path
+
+    aroute = alloc.route if alloc is not None else "xla"
+    amesh = alloc.mesh if alloc is not None else None
+    has_cand = alloc is not None and alloc.candidates is not None
+    cand_idx = cand_valid = None
+    cand_rows = 0
+    if has_cand:
+        c = alloc.candidates
+        cand_rows = int(c.remap.shape[0] if c.remap is not None else c.count)
+        if c.sharded:
+            cand_idx = jnp.asarray(c.local_idx)
+            cand_valid = jnp.asarray(c.local_valid)
+        else:
+            cand_idx = jnp.asarray(c.idx)
+            cand_valid = jnp.asarray(c.valid)
+
+    edyn = None if eroute == "sharded" else jnp.asarray(scanner.dyn)
+    if eroute == "sharded":
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        rep = NamedSharding(emesh, P())
+        trows_d = jax.device_put(np.asarray(trows), rep)
+        node_d = jax.device_put(np.asarray(node_p), rep)
+        rank_d = jax.device_put(np.asarray(rank_p), rep)
+    else:
+        trows_d = jnp.asarray(trows)
+        node_d = jnp.asarray(node_p)
+        rank_d = jnp.asarray(rank_p)
+
+    sx = sy = sz = 0
+    troute, tmesh = "xla", None
+    box = None
+    if topo is not None:
+        from .topo_solver import BoxInputs, choose_topo_route
+        inp, shape, _sig = topo
+        sx, sy, sz = (int(v) for v in shape)
+        troute, tmesh = choose_topo_route(
+            int(np.asarray(inp.coords).shape[0]))
+        box = BoxInputs(*(jnp.asarray(a) for a in inp))
+
+    key = fused_solve_key(
+        legs, aroute, has_cand, cand_rows,
+        (None if alloc is None
+         else (int(alloc.inputs.node_idle.shape[0]), alloc.cfg)),
+        eroute,
+        (scanner.cfg, scanner.r, scanner.np_pad, scanner.ns_pad,
+         int(np.asarray(trows).shape[0]), int(np.asarray(node_p).shape[0])),
+        troute, (sx, sy, sz))
+
+    start = time.time()
+    try:
+        from ..chaos import plan as chaos_plan
+        plan = chaos_plan.PLAN
+        if plan is not None and plan.fire("fused.device_error"):
+            raise RuntimeError("chaos: fused session dispatch failed "
+                               "(injected)")
+        with trace.span("fused.dispatch", legs=",".join(legs)):
+            out = _fused_program(
+                legs, alloc.cfg if alloc is not None else None, aroute,
+                has_cand, amesh, scanner.cfg, scanner.r, scanner.np_pad,
+                scanner.ns_pad, eroute, emesh, sx, sy, sz, troute, tmesh,
+                ainp, cand_idx, cand_valid, scanner.statics, edyn,
+                trows_d, node_d, rank_d, box)
+    except Exception as exc:
+        _fail(ssn, st, exc, legs)
+        return None
+
+    st.dispatched = True
+    st.legs = legs
+    metrics.note_session_dispatch("fused")
+    metrics.note_route("fused", "+".join(sorted(legs)))
+    note_solve_key(key)
+    metrics.set_cycle_floor("fused", time.time() - start)
+    trace.annotate(fused_legs=",".join(legs))
+
+    if alloc is not None:
+        from .solver import PendingSolve, _note_dispatch
+        st.alloc_leg = alloc
+        st.alloc_pending = PendingSolve(
+            out["alloc"],
+            remap=(alloc.candidates.remap
+                   if alloc.candidates is not None else None))
+        _note_dispatch(+1)
+    if topo is not None:
+        st.topo_out = out["topo"]
+        st.topo_sig = topo[2]
+    return out["evict"]
+
+
+def consume_evict(scores, perm, kb: int, n_pad: int):
+    """Host readback of the deferred evict leg as one transfer, with the
+    fused chaos seams applied and the poisoned-shape check every seeded
+    row depends on.  Raises on any fault — the scanner degrades exactly
+    like a per-family dispatch failure."""
+    packed = _chaos_consume(np.asarray(scores))
+    if packed.shape != (kb, n_pad):
+        raise RuntimeError(
+            f"fused evict readback shape {packed.shape} != ({kb}, {n_pad})")
+    return packed.astype(np.int64), np.asarray(perm)
+
+
+def take_alloc(ssn, shipper, snap, route, candidates):
+    """tpu-allocate's consume point: the precomputed solve is THIS
+    session's solve iff the action's own ship came back CLEAN at the
+    dispatch generation with the same config, route and candidate
+    gather.  Returns the PendingSolve (the action's finish continuation
+    fetches it through the standard path) or None for the per-family
+    dispatch."""
+    st = getattr(ssn, "_fused_state", None)
+    if st is None or st.alloc_pending is None:
+        return None
+    from ..metrics import metrics
+    from .solver import discard_solve
+    pending, leg = st.alloc_pending, st.alloc_leg
+    st.alloc_pending = None
+    st.alloc_leg = None
+    ok = (shipper.last_mode == "clean"
+          and shipper.generation == leg.generation
+          and snap.config == leg.cfg
+          and route == leg.route
+          and _cand_sig(candidates) == leg.cand_sig)
+    if not ok:
+        discard_solve(pending)
+        metrics.note_fused_leg("solve", "invalidated")
+        return None
+    metrics.note_fused_leg("solve", "served")
+    return pending
+
+
+def take_topo(ssn, inp, shape, n: int):
+    """actions/topo_allocate's chokepoint, wired around dispatch_box_scan.
+
+    First call in a session STAGES the scan and — when the conf carries
+    an eviction action — triggers the shared scanner build so the fused
+    dispatch serves all three families from one program.  Returns the
+    host [n, 6] stats when the staged leg matches this exact request
+    (same arrays, same shape), else None for the per-family dispatch."""
+    if not fused_enabled():
+        return None
+    st = state_for(ssn)
+    if st.failed:
+        return None
+    from ..metrics import metrics
+    sig = (tuple(int(v) for v in shape),
+           b"".join(np.ascontiguousarray(a).tobytes() for a in inp))
+    if not st.dispatched and st.topo_request is None:
+        st.topo_request = (inp, tuple(int(v) for v in shape), sig)
+        names = _conf_names(ssn)
+        if {"reclaim", "preempt", "backfill"} & set(names):
+            from ..models.scanner import batch_evict_enabled, \
+                maybe_shared_scanner
+            if batch_evict_enabled():
+                st.early_scanner = True
+                try:
+                    sc = maybe_shared_scanner(ssn)  # batch_seed -> take_evict
+                    if sc is not None:
+                        # Seeded BEFORE this session's mutating actions:
+                        # refresh drops the victim ranking on the first
+                        # mutation so the walk replays the exact queue.
+                        sc._fused_early = True
+                except Exception:
+                    metrics.note_swallowed("fused_topo_scanner")
+        if not st.dispatched:
+            st.topo_request = None  # nothing fused it; per-family path
+            return None
+    if not st.dispatched or st.topo_out is None:
+        return None
+    if sig != st.topo_sig:
+        metrics.note_fused_leg("topo", "invalidated")
+        return None
+    try:
+        stats = _chaos_consume(np.asarray(st.topo_out))
+        if stats.ndim != 2 or stats.shape[1] != 6 or stats.shape[0] < n:
+            raise RuntimeError(
+                f"fused topo readback shape {stats.shape} (need >= "
+                f"({n}, 6))")
+    except Exception as exc:
+        _fail(ssn, st, exc, ("topo",))
+        return None
+    metrics.note_fused_leg("topo", "served")
+    return stats[:n]
+
+
+def finalize_session(ssn) -> None:
+    """Ledger hygiene at session close/abandon: an alloc leg nobody
+    consumed (incremental cache answered first, fallback path, stale
+    abort) still holds an in-flight dispatch handle — retire it."""
+    st = getattr(ssn, "_fused_state", None)
+    if st is None or st.alloc_pending is None:
+        return
+    from ..metrics import metrics
+    from .solver import discard_solve
+    pending, st.alloc_pending, st.alloc_leg = st.alloc_pending, None, None
+    discard_solve(pending)
+    metrics.note_fused_leg("solve", "unused")

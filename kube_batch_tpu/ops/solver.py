@@ -601,6 +601,8 @@ def dispatch_solve(inp: SolverInputs, cfg: SolverConfig,
             result = best_solve_allocate(inp, cfg)
             pending = PendingSolve(_pack_result_ordered(
                 result.assignment, result.kind, result.order))
+    from ..metrics import metrics
+    metrics.note_session_dispatch("solve")
     _note_dispatch(+1)
     return pending
 

@@ -229,6 +229,7 @@ def dispatch_box_scan(inp: BoxInputs, shape) -> np.ndarray:
     n_pad = int(np.asarray(inp.coords).shape[0])
     route, mesh = choose_topo_route(n_pad)
     metrics.note_route("topo", route)
+    metrics.note_session_dispatch("topo")
     trace.annotate(route=route, mesh_devices=mesh.size if mesh else 1)
     note_solve_key(topo_solve_key(route, n_pad, (sx, sy, sz)))
     staged = BoxInputs(*(jnp.asarray(a) for a in inp))

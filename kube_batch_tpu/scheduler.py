@@ -235,6 +235,9 @@ class Scheduler:
         try:
             with trace.span("open_session"):
                 ssn = open_session(cache, self.tiers)
+            # The fused session dispatch (ops/fused_solver.py) decides
+            # which legs can ride along from the conf's action ladder.
+            ssn._conf_actions = tuple(a.name() for a in self.actions)
             trace.set_uid(ssn.uid)
             trace.set_meta(jobs=len(ssn.jobs), nodes=len(ssn.nodes),
                            queues=len(ssn.queues))
@@ -254,7 +257,8 @@ class Scheduler:
                 # this cycle paid per formerly-O(N) stage, plus the
                 # O(N)-work counters (doc/INCREMENTAL.md "floors").
                 trace.set_meta(floors=metrics.cycle_floor_values(),
-                               onwork=metrics.onwork_values())
+                               onwork=metrics.onwork_values(),
+                               dispatches=metrics.take_cycle_dispatches())
         finally:
             trace.end_session()
             if gc_was_enabled:
@@ -286,6 +290,7 @@ class Scheduler:
             # this, keeping the sequential control's work profile
             # exact).
             ssn._pipeline_active = True
+            ssn._conf_actions = tuple(a.name() for a in self.actions)
             trace.set_uid(ssn.uid)
             trace.set_meta(jobs=len(ssn.jobs), nodes=len(ssn.nodes),
                            queues=len(ssn.queues))
@@ -304,6 +309,13 @@ class Scheduler:
                             cont = begin(ssn)
                         action_elapsed = time.time() - action_start
                         resume_idx = 1
+                # Confs whose leading action has no begin half still
+                # publish a bounded read fence (tenancy/footprint.py) —
+                # and under the fused session engine the eviction-led
+                # build moves the session's one device dispatch into
+                # this async window.
+                from .tenancy.footprint import publish_begin_footprint
+                publish_begin_footprint(ssn, ssn._conf_actions)
             except Exception:
                 # Mirror session_once's finally: an action exception
                 # after a successful open still closes the session
@@ -362,14 +374,18 @@ class Scheduler:
                 # its remaining actions or close (a close would emit
                 # events/status writes the rerun emits again).
                 stale_abort = True
+                from .ops import fused_solver
+                fused_solver.finalize_session(ssn)
                 trace.set_meta(pipeline_discarded="stale_fallback")
                 raise
             finally:
                 if not stale_abort:
                     with trace.span("close_session"):
                         close_session(ssn)
-                    trace.set_meta(floors=metrics.cycle_floor_values(),
-                                   onwork=metrics.onwork_values())
+                    trace.set_meta(
+                        floors=metrics.cycle_floor_values(),
+                        onwork=metrics.onwork_values(),
+                        dispatches=metrics.take_cycle_dispatches())
         finally:
             trace.end_session()
         metrics.observe_e2e_latency(time.time() - handle.start)
@@ -382,6 +398,8 @@ class Scheduler:
         rolling back."""
         trace.resume_session(handle.trace_obj)
         handle.trace_obj = None
+        from .ops import fused_solver
+        fused_solver.finalize_session(handle.ssn)
         trace.note_degraded(f"shard pipeline discarded session: {reason}")
         trace.set_meta(pipeline_discarded=reason)
         trace.end_session()

@@ -76,15 +76,16 @@ CONCURRENT_ENV = "KUBE_BATCH_TPU_CONCURRENT_SHARDS"
 INFLIGHT_ENV = "KUBE_BATCH_TPU_SHARD_INFLIGHT"
 DEFAULT_INFLIGHT = 2
 
-# Action lists whose retire-phase node reads are bounded by the
-# tpu-allocate read fence: the flagship device action (fence published
-# by its begin half) optionally followed by backfill (a no-op unless the
-# session has BestEffort pending tasks, which the fence already treats
-# as reads-all).  Anything else — eviction actions, topology placement —
-# walks arbitrary node state at retire, so every stage under such a conf
-# runs with an unbounded footprint (still correct: any predecessor
-# mutation then forces the sequential rerun).
-_BOUNDED_CONFS = (("tpu-allocate",), ("tpu-allocate", "backfill"))
+# Actions whose retire-phase node reads are bounded by a published read
+# fence: tpu-allocate publishes the sig-union from its own begin half,
+# and confs led by an eviction or topology action get theirs from
+# tenancy/footprint.py (candidate sig-union, plus the valid-coordinate
+# mask for the box scan).  A conf is bounded only when EVERY action in
+# it is on this list — one unfenced action walking arbitrary node state
+# at retire makes the whole stage's footprint unbounded (still correct:
+# any predecessor mutation then forces the sequential rerun).
+_BOUNDED_ACTIONS = frozenset({"tpu-allocate", "backfill", "reclaim",
+                              "preempt", "topo-allocate"})
 
 
 class StaleSessionAbort(Exception):
@@ -157,7 +158,8 @@ class ShardPipeline:
         self._registry_lock = threading.Lock()
         self._registry: Dict[int, _Stage] = {}  # guarded-by: _registry_lock
         names = tuple(a.name() for a in self.scheduler.actions)
-        self._bounded_conf = names in _BOUNDED_CONFS
+        self._bounded_conf = bool(names) and all(
+            n in _BOUNDED_ACTIONS for n in names)
         self._cycle_overlap = 0.0
 
     # -- stop()/drain coordination (any thread) --------------------------

@@ -134,10 +134,11 @@ class TestWarmupAndHits:
         assert w.done
         # One bucket x (one routed allocate solver + the batched
         # eviction kernel + the candidate-row gather+solve + the topo
-        # box scan, which warm alongside the family).
-        assert len(w.records) == 4
+        # box scan + the fused session program, which warm alongside
+        # the family).
+        assert len(w.records) == 5
         assert {r.solver for r in w.records} >= {"evict_batch", "candidate",
-                                                 "topo_box"}
+                                                 "topo_box", "fused"}
         assert w.errors == []
         w.stop()  # after completion: no-op, returns immediately
 

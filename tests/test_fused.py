@@ -1,0 +1,427 @@
+"""One-dispatch fused sessions (doc/FUSED.md): parity and machinery.
+
+The fused engine's contract is that ``KUBE_BATCH_TPU_FUSED=1`` (default)
+produces EXACTLY the placements, victim choices, victim ORDER, and
+session end state of the ``=0`` per-family control — one device dispatch
+emits the evict scores, allocate placements, and topology origins the
+whole action ladder consumes, with host-invalidated legs falling back to
+per-family re-dispatch without changing a single decision.  These tests
+pin that against the per-family control AND the all-flags-off sequential
+oracle, count the dispatches (the ONE-dispatch contract), exercise the
+begin-half read fences (tenancy/footprint.py), and pin the lazy
+node-task view's order/value parity (api/node_info.LazyTaskDict).
+"""
+
+import os
+
+import pytest
+
+from kube_batch_tpu.framework import close_session, open_session
+from kube_batch_tpu.scheduler import load_scheduler_conf
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _register(monkeypatch):
+    from kube_batch_tpu.actions.factory import register_default_actions
+    from kube_batch_tpu.plugins.factory import register_default_plugins
+    register_default_actions()
+    register_default_plugins()
+    monkeypatch.setenv("KUBE_BATCH_TPU_SCAN_MIN_NODES", "0")
+
+
+def _storm_conf():
+    """The shipped 4-action conf with the device action swapped in
+    (the same replacement bench.py's storm arms use)."""
+    with open(os.path.join(REPO, "config", "kube-batch-conf.yaml")) as fh:
+        conf = fh.read().replace(
+            '"reclaim, allocate, backfill, preempt"',
+            '"reclaim, tpu-allocate, backfill, preempt"')
+    return load_scheduler_conf(conf)
+
+
+TOPO_CONF = """
+actions: "topo-allocate, tpu-allocate, backfill"
+tiers:
+- plugins:
+  - name: priority
+  - name: gang
+  - name: conformance
+- plugins:
+  - name: drf
+  - name: predicates
+  - name: proportion
+  - name: nodeorder
+  - name: topology
+"""
+
+
+def _session_state(ssn):
+    return sorted((t.uid, t.status.name, t.node_name)
+                  for job in ssn.jobs.values()
+                  for t in job.tasks.values())
+
+
+def _drive(cache, actions, tiers):
+    """One manually-driven session, stamping the conf ladder the way
+    Scheduler.session_once does (the fused dispatcher keys on it)."""
+    ssn = open_session(cache, tiers)
+    ssn._conf_actions = tuple(a.name() for a in actions)
+    try:
+        for a in actions:
+            a.execute(ssn)
+        return _session_state(ssn)
+    finally:
+        close_session(ssn)
+
+
+def _dispatch_delta(fn):
+    """Run ``fn`` and return (result, session-dispatch delta,
+    fused-leg-outcome delta)."""
+    from kube_batch_tpu.metrics.metrics import (fused_leg_counts,
+                                                session_dispatch_counts)
+    d0, l0 = session_dispatch_counts(), fused_leg_counts()
+    result = fn()
+    d1, l1 = session_dispatch_counts(), fused_leg_counts()
+    disp = {k: v for k, v in ((k, d1.get(k, 0) - d0.get(k, 0))
+                              for k in d1) if v}
+    legs = {k: v for k, v in ((k, l1.get(k, 0) - l0.get(k, 0))
+                              for k in l1) if v}
+    return result, disp, legs
+
+
+STORM_SHAPES = {0: (600, 100, 30, 4), 1: (420, 64, 20, 3)}
+
+
+class TestFusedParity:
+    @pytest.mark.parametrize("seed", sorted(STORM_SHAPES))
+    def test_storm_parity_vs_control_and_oracle(self, seed, monkeypatch):
+        """Eviction-led conf family: fused == per-family control ==
+        all-flags-off sequential oracle on the churn storm — state,
+        victim sequence AND order, binds."""
+        from kube_batch_tpu.models.synthetic import make_churn_cache
+        shape = STORM_SHAPES[seed]
+        actions, tiers = _storm_conf()
+        arms = {
+            "fused": {"KUBE_BATCH_TPU_FUSED": "1"},
+            "control": {"KUBE_BATCH_TPU_FUSED": "0"},
+            "oracle": {"KUBE_BATCH_TPU_FUSED": "0",
+                       "KUBE_BATCH_TPU_BATCH_EVICT": "0",
+                       "KUBE_BATCH_TPU_PIPELINE": "0",
+                       "KUBE_BATCH_TPU_INCREMENTAL": "0"},
+        }
+        results = {}
+        for name, env in arms.items():
+            for k, v in env.items():
+                monkeypatch.setenv(k, v)
+            cache, binder = make_churn_cache(*shape)
+            state = _drive(cache, actions, tiers)
+            results[name] = (state, list(cache.evictor.evicts),
+                             dict(binder.binds))
+            for k in env:
+                monkeypatch.delenv(k, raising=False)
+        assert results["fused"][1], "storm must evict"
+        assert results["fused"] == results["control"]
+        assert results["fused"] == results["oracle"]
+
+    def test_quiet_conf_family_parity_and_served_leg(self, monkeypatch):
+        """Quiet (free-capacity) family: identical binds, zero
+        evictions, and the fused dispatch's alloc leg actually SERVES
+        tpu-allocate (the steady-state outcome)."""
+        from kube_batch_tpu.models.synthetic import make_synthetic_cache
+        actions, tiers = _storm_conf()
+        results = {}
+        legs_fused = None
+        for name, fused in (("fused", "1"), ("control", "0")):
+            monkeypatch.setenv("KUBE_BATCH_TPU_FUSED", fused)
+            cache, binder = make_synthetic_cache(300, 32, 12, 2)
+            state, disp, legs = _dispatch_delta(
+                lambda: _drive(cache, actions, tiers))
+            results[name] = (state, list(cache.evictor.evicts),
+                             dict(binder.binds))
+            if name == "fused":
+                legs_fused = legs
+                assert disp.get("fused", 0) >= 1
+        assert results["fused"][2], "quiet session must bind"
+        assert not results["fused"][1]
+        assert results["fused"] == results["control"]
+        assert legs_fused.get("solve/served", 0) >= 1
+
+    def test_mesh_leg_parity(self, monkeypatch):
+        """FORCE_SHARD: the fused program routed through the sharded
+        solvers reproduces the single-chip footprint."""
+        from kube_batch_tpu.models.synthetic import make_churn_cache
+        from kube_batch_tpu.ops.solver import (FORCE_SHARD_ENV,
+                                               refresh_shard_knobs)
+        actions, tiers = _storm_conf()
+        results = {}
+        try:
+            for name, force in (("chip", "0"), ("mesh", "1")):
+                monkeypatch.setenv("KUBE_BATCH_TPU_FUSED", "1")
+                monkeypatch.setenv(FORCE_SHARD_ENV, force)
+                refresh_shard_knobs()
+                cache, binder = make_churn_cache(420, 64, 20, 3)
+                results[name] = (_drive(cache, actions, tiers),
+                                 list(cache.evictor.evicts),
+                                 dict(binder.binds))
+        finally:
+            monkeypatch.delenv(FORCE_SHARD_ENV, raising=False)
+            refresh_shard_knobs()
+        assert results["mesh"][1], "storm must evict"
+        assert results["mesh"] == results["chip"]
+
+    def test_topology_three_family_dispatch_parity(self, monkeypatch):
+        """Topology-led conf on the fragmentation torus: ONE fused
+        dispatch carries evict+solve+topo, and the decisions match the
+        FUSED=0 control bit for bit."""
+        from kube_batch_tpu.metrics.metrics import route_counts
+        from kube_batch_tpu.models.synthetic import make_topo_cache
+        monkeypatch.setenv("KUBE_BATCH_TPU_TOPO_BATCH", "1")
+        monkeypatch.setenv("KUBE_BATCH_TPU_TOPO_DEFRAG", "1")
+        actions, tiers = load_scheduler_conf(TOPO_CONF)
+        results = {}
+        for name, fused in (("fused", "1"), ("control", "0")):
+            monkeypatch.setenv("KUBE_BATCH_TPU_FUSED", fused)
+            cache, binder = make_topo_cache()
+            r0 = route_counts()
+            state = _drive(cache, actions, tiers)
+            r1 = route_counts()
+            results[name] = (state, list(cache.evictor.evicts),
+                             dict(binder.binds))
+            if name == "fused":
+                key = "fused/evict+solve+topo"
+                assert r1.get(key, 0) - r0.get(key, 0) >= 1, \
+                    "topology conf must take the three-family dispatch"
+        assert results["fused"] == results["control"]
+
+
+class TestOneDispatch:
+    def test_quiet_session_is_exactly_one_dispatch(self, monkeypatch):
+        """The tentpole contract: a steady-state (no-eviction) session
+        under the full 4-action conf executes EXACTLY ONE solve-family
+        device dispatch — the fused program — and nothing per-family."""
+        from kube_batch_tpu.models.synthetic import make_synthetic_cache
+        monkeypatch.setenv("KUBE_BATCH_TPU_FUSED", "1")
+        actions, tiers = _storm_conf()
+        cache, binder = make_synthetic_cache(300, 32, 12, 2)
+        _state, disp, legs = _dispatch_delta(
+            lambda: _drive(cache, actions, tiers))
+        assert binder.binds, "quiet session must bind"
+        assert disp == {"fused": 1}, \
+            f"steady session must dispatch ONCE, got {disp}"
+        assert legs.get("solve/served", 0) == 1
+
+    def test_storm_invalidation_falls_back_per_family(self, monkeypatch):
+        """The storm's own evictions land between the fused dispatch
+        and tpu-allocate's ship: the alloc leg is host-invalidated
+        (counted) and the action re-dispatches per-family — decisions
+        unchanged (TestFusedParity), dispatches accounted here."""
+        from kube_batch_tpu.models.synthetic import make_churn_cache
+        monkeypatch.setenv("KUBE_BATCH_TPU_FUSED", "1")
+        actions, tiers = _storm_conf()
+        cache, _binder = make_churn_cache(420, 64, 20, 3)
+        _state, disp, legs = _dispatch_delta(
+            lambda: _drive(cache, actions, tiers))
+        assert cache.evictor.evicts, "storm must evict"
+        assert disp.get("fused", 0) >= 1
+        assert legs.get("evict/served", 0) >= 1, \
+            "the evict scores must be consumed from the fused dispatch"
+        assert legs.get("solve/invalidated", 0) >= 1
+        assert disp.get("solve", 0) >= 1, \
+            "an invalidated alloc leg must re-dispatch per-family"
+
+    def test_fused_off_restores_per_family_dispatches(self, monkeypatch):
+        """KUBE_BATCH_TPU_FUSED=0 is the bit-parity control: no fused
+        dispatch at all, the per-family programs run instead."""
+        from kube_batch_tpu.models.synthetic import make_churn_cache
+        monkeypatch.setenv("KUBE_BATCH_TPU_FUSED", "0")
+        actions, tiers = _storm_conf()
+        cache, _binder = make_churn_cache(420, 64, 20, 3)
+        _state, disp, _legs = _dispatch_delta(
+            lambda: _drive(cache, actions, tiers))
+        assert disp.get("fused", 0) == 0
+        assert disp.get("evict", 0) >= 1
+        assert disp.get("solve", 0) >= 1
+
+
+class TestBeginFences:
+    """tenancy/footprint.py: bounded begin-half read fences for confs
+    whose leading action has no begin half — the enabler that lets
+    eviction- and topology-led micro-sessions stay optimistic in the
+    shard pipeline instead of defaulting to reads-all."""
+
+    def _pipelined_session(self, cache, tiers):
+        ssn = open_session(cache, tiers)
+        ssn._pipeline_active = True
+        return ssn
+
+    def test_evict_led_conf_publishes_bounded_fence(self, monkeypatch):
+        import numpy as np
+
+        from kube_batch_tpu.models.synthetic import make_churn_cache
+        from kube_batch_tpu.tenancy.footprint import \
+            publish_begin_footprint
+        cache, _ = make_churn_cache(420, 64, 20, 3)
+        _actions, tiers = _storm_conf()
+        ssn = self._pipelined_session(cache, tiers)
+        try:
+            publish_begin_footprint(
+                ssn, ("reclaim", "tpu-allocate", "backfill", "preempt"))
+            assert not ssn._pipeline_reads_all
+            assert ssn._pipeline_fence is not None
+            names, mask = ssn._pipeline_fence
+            assert len(names) == len(mask)
+            assert np.asarray(mask).dtype == bool
+            # The storm's pending profiles can land anywhere CPU fits:
+            # the sig-union must cover at least one node, and only
+            # existing nodes.
+            assert 0 < int(np.sum(mask)) <= len(cache.nodes)
+        finally:
+            close_session(ssn)
+
+    def test_topo_led_conf_publishes_bounded_fence(self, monkeypatch):
+        import numpy as np
+
+        from kube_batch_tpu.models.synthetic import make_topo_cache
+        from kube_batch_tpu.tenancy.footprint import \
+            publish_begin_footprint
+        monkeypatch.setenv("KUBE_BATCH_TPU_TOPO_BATCH", "1")
+        monkeypatch.setenv("KUBE_BATCH_TPU_TOPO_DEFRAG", "1")
+        cache, _ = make_topo_cache()
+        _actions, tiers = load_scheduler_conf(TOPO_CONF)
+        ssn = self._pipelined_session(cache, tiers)
+        try:
+            publish_begin_footprint(
+                ssn, ("topo-allocate", "tpu-allocate", "backfill"))
+            if ssn._pipeline_fence is not None:
+                names, mask = ssn._pipeline_fence
+                assert len(names) == len(mask)
+                assert int(np.sum(np.asarray(mask))) > 0
+            else:
+                # Unprovable footprints must degrade to reads-all,
+                # never to a silent unbounded fence.
+                assert ssn._pipeline_reads_all
+        finally:
+            close_session(ssn)
+
+    def test_unknown_lead_degrades_to_reads_all(self):
+        from kube_batch_tpu.models.synthetic import make_synthetic_cache
+        from kube_batch_tpu.tenancy.footprint import \
+            publish_begin_footprint
+        cache, _ = make_synthetic_cache(60, 8, 4, 2)
+        _actions, tiers = _storm_conf()
+        ssn = self._pipelined_session(cache, tiers)
+        try:
+            publish_begin_footprint(ssn, ("some-new-action",))
+            assert ssn._pipeline_reads_all
+            assert ssn._pipeline_fence is None
+        finally:
+            close_session(ssn)
+
+    def test_existing_fence_wins(self):
+        """tpu-allocate's own begin-half publication must not be
+        overwritten (the leading action already decided)."""
+        from kube_batch_tpu.models.synthetic import make_synthetic_cache
+        from kube_batch_tpu.tenancy.footprint import \
+            publish_begin_footprint
+        cache, _ = make_synthetic_cache(60, 8, 4, 2)
+        _actions, tiers = _storm_conf()
+        ssn = self._pipelined_session(cache, tiers)
+        try:
+            sentinel = (("n0",), None)
+            ssn._pipeline_fence = sentinel
+            publish_begin_footprint(ssn, ("reclaim", "tpu-allocate"))
+            assert ssn._pipeline_fence is sentinel
+        finally:
+            close_session(ssn)
+
+
+class TestLazyTaskView:
+    """api/node_info.LazyTaskDict: the snapshot's node-task view defers
+    per-task clone_lite until a VALUE actually leaks; key-only ops see
+    live refs.  Validity hinges on (a) dict order parity with the eager
+    clone and (b) insert-time status capture."""
+
+    def _node_with_tasks(self):
+        from kube_batch_tpu.models.synthetic import make_churn_cache
+        cache, _ = make_churn_cache(120, 8, 6, 2)
+        for node in cache.nodes.values():
+            if node.tasks:
+                return node
+        raise AssertionError("storm cache has no occupied node")
+
+    def test_snapshot_clone_order_and_value_parity(self, monkeypatch):
+        from kube_batch_tpu.api.node_info import LazyTaskDict
+        node = self._node_with_tasks()
+        monkeypatch.setenv("KUBE_BATCH_TPU_LAZY_TASKS", "1")
+        lazy = node.snapshot_clone()
+        monkeypatch.setenv("KUBE_BATCH_TPU_LAZY_TASKS", "0")
+        eager = node.snapshot_clone()
+        assert type(lazy.tasks) is LazyTaskDict
+        assert type(eager.tasks) is dict
+        assert list(lazy.tasks) == list(eager.tasks)  # key-only: no clone
+        fp = lambda d: [(k, t.uid, t.status, t.node_name, t.resreq)
+                        for k, t in d.items()]       # values(): clones
+        assert fp(lazy.tasks) == fp(eager.tasks)
+        assert list(lazy.tasks) == list(eager.tasks)  # order survives
+
+    def test_key_ops_stay_lazy_value_ops_materialize(self, monkeypatch):
+        monkeypatch.setenv("KUBE_BATCH_TPU_LAZY_TASKS", "1")
+        node = self._node_with_tasks()
+        snap = node.snapshot_clone()
+        tmap = snap.tasks
+        key = next(iter(tmap))
+        assert tmap._lazy, "fresh lazy copy must have pending entries"
+        _ = key in tmap
+        _ = len(tmap)
+        _ = list(tmap)
+        assert tmap._lazy, "key-only ops must not materialize"
+        live = dict.__getitem__(tmap, key)
+        got = tmap[key]                      # value leak: clones now
+        assert not tmap._lazy
+        assert got is not live, "reads must hand out clones, not refs"
+        assert got.uid == live.uid
+
+    def test_insert_time_status_capture(self, monkeypatch):
+        """A later status flip on the LIVE task must not leak into the
+        deferred clone: the captured status is the insert-time one,
+        exactly what an eager clone would have frozen."""
+        from kube_batch_tpu.api import TaskStatus
+        monkeypatch.setenv("KUBE_BATCH_TPU_LAZY_TASKS", "1")
+        node = self._node_with_tasks()
+        snap = node.snapshot_clone()
+        key = next(iter(snap.tasks))
+        live = dict.__getitem__(snap.tasks, key)
+        captured = snap.tasks._lazy[key]
+        original = live.status
+        try:
+            live.status = TaskStatus.Releasing
+            clone = snap.tasks[key]
+        finally:
+            live.status = original
+        assert clone.status is captured
+        assert clone.status is original
+
+    def test_pods_reads_without_materializing(self, monkeypatch):
+        monkeypatch.setenv("KUBE_BATCH_TPU_LAZY_TASKS", "1")
+        node = self._node_with_tasks()
+        snap = node.snapshot_clone()
+        pods = snap.pods()
+        assert len(pods) == len(snap.tasks)
+        assert snap.tasks._lazy, "pods() must not force the clone walk"
+
+    def test_lazy_insert_matches_eager_clone(self, monkeypatch):
+        from kube_batch_tpu.api.node_info import LazyTaskDict, lazy_insert
+        node = self._node_with_tasks()
+        key = next(iter(node.tasks))
+        task = node.tasks[key]
+        lazy = LazyTaskDict()
+        eager = {}
+        lazy_insert(lazy, key, task)
+        lazy_insert(eager, key, task)
+        assert dict.__getitem__(lazy, key) is task   # live ref + pending
+        assert lazy._lazy[key] is task.status
+        assert eager[key] is not task                # plain dict: clone
+        assert lazy[key].uid == eager[key].uid       # materialized ==
+        assert lazy[key] is not task

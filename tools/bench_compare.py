@@ -122,6 +122,14 @@ GATED_KEYS = {
     "tenancy_shard_inflight": {
         "path": ("tenancy", "shard_inflight"), "direction": "up",
         "band": 0.0, "abs_slack": 0.0},
+    # One-dispatch session contract (doc/FUSED.md): solve-family device
+    # dispatches over the 8-round steady window — exactly one per
+    # session at the gate shape.  Deterministic, so NO band: a change
+    # that starts re-dispatching (a second solve per session, a
+    # fallback loop) fails the gate as a count, not a latency blur.
+    "steady_dispatches.solve": {
+        "path": ("session_dispatches", "solve"), "direction": "down",
+        "band": 0.0, "abs_slack": 0.0},
     # Full-bench keys: absent from steady-only artifacts (so they never
     # enter the bench-gate baseline) but extracted into the trajectory
     # when a full 50k-shape run is appended — the cross-PR history the

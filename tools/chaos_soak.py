@@ -74,6 +74,7 @@ tiers:
 # degradation test lives in tests/test_incremental_sessions.py.
 FAKE_SITES = ("session.snapshot", "session.tensorize", "solve.device_error",
               "solve.slow", "solve.poison", "evict_solve.device_error",
+              "fused.device_error", "fused.slow", "fused.poison",
               "bind.timeout", "bind.http5xx", "bind.ambiguous",
               "evict.error", "evict.ambiguous", "commit.flush_error",
               "topology.bad_coords")
@@ -431,6 +432,19 @@ def run_soak(seeds, *, nodes: int = 8, cycles: int = 10,
                   ("solve.slow", min(1.0, rate * 1.6)),
                   ("solve.poison", min(1.0, rate * 1.4)),
                   ("evict_solve.*", min(1.0, rate * 1.6)),
+                  # The fused session dispatch (doc/FUSED.md) fires at
+                  # most once per cycle, and its readback seams
+                  # (fused.slow / fused.poison) only on cycles where
+                  # the dispatch survived fused.device_error — boost
+                  # all three so the one-dispatch degrade ladder
+                  # (breaker feed -> resident invalidate -> per-family
+                  # re-dispatch) demonstrably exercises every sweep.
+                  # The readback seams only draw on cycles where the
+                  # dispatch survived fused.device_error, so they get
+                  # the strongest boost of the table.
+                  ("fused.device_error", min(1.0, rate * 1.2)),
+                  ("fused.slow", min(1.0, rate * 3.0)),
+                  ("fused.poison", min(1.0, rate * 2.4)),
                   # Fires only on micro-eligible cycles (see FAKE_SITES
                   # note): boost it so those cycles do get hit.
                   ("incremental.stale_generation", min(1.0, rate * 1.6)),

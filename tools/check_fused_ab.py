@@ -1,0 +1,93 @@
+"""CI gate for `make bench-fused`: read the fused-session A/B artifact
+line from stdin and assert the one-dispatch subsystem's contracts
+(doc/FUSED.md):
+
+1. PARITY — the fused single-dispatch session program is bit-identical
+   to the KUBE_BATCH_TPU_FUSED=0 per-family control: victim sequence,
+   final binds, and the cluster event log on the 4-action churn storm
+   AND the quiet (no-eviction) leg.
+2. MESH PARITY — the FORCE_SHARD leg (fused program routed through the
+   sharded solvers) reproduces the single-chip footprint.
+3. TOPO PARITY — the three-family (evict+solve+topo) dispatch on the
+   fragmentation-pressure torus matches the FUSED=0 control.
+4. NON-VACUOUS — at least one fused dispatch actually happened, each
+   of the three families was SERVED from a fused dispatch somewhere in
+   the run (a dispatched-but-never-consumed leg measures nothing), the
+   three-family route was taken, and the storm really stormed
+   (evictions >= 1) while the quiet leg really placed.
+
+bench.py deliberately always exits 0 (the artifact-always-emits
+contract), so pass/fail lives here — the check_evict_ab discipline.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def main() -> int:
+    line = ""
+    for raw in sys.stdin:
+        raw = raw.strip()
+        if raw.startswith("{"):
+            line = raw  # last JSON-looking line wins (the artifact)
+    if not line:
+        print("check_fused_ab: no artifact line on stdin", file=sys.stderr)
+        return 1
+    out = json.loads(line)
+    if out.get("error"):
+        print(f"check_fused_ab: bench reported error: {out['error']}",
+              file=sys.stderr)
+        return 1
+    for key, what in (
+            ("fused_parity", "storm/quiet footprint"),
+            ("fused_shard_parity", "FORCE_SHARD mesh leg"),
+            ("fused_topo_parity", "three-family topology leg")):
+        if out.get(key) is not True:
+            print(f"check_fused_ab: PARITY FAILURE — {what} diverged "
+                  f"from the KUBE_BATCH_TPU_FUSED=0 control "
+                  f"({key}={out.get(key)!r})", file=sys.stderr)
+            return 1
+    ab = out.get("fused_ab") or {}
+    dispatches = ab.get("dispatches") or {}
+    legs = ab.get("legs") or {}
+    if dispatches.get("fused", 0) < 1:
+        print("check_fused_ab: VACUOUS — no fused dispatch happened; "
+              "the A/B measured the per-family path twice",
+              file=sys.stderr)
+        return 1
+    for family in ("evict", "solve", "topo"):
+        if legs.get(f"{family}/served", 0) < 1:
+            print(f"check_fused_ab: VACUOUS — the {family} family was "
+                  "never SERVED from a fused dispatch "
+                  f"(legs={legs})", file=sys.stderr)
+            return 1
+    routes = ab.get("topo_routes") or {}
+    if routes.get("fused/evict+solve+topo", 0) < 1:
+        print("check_fused_ab: VACUOUS — no three-family "
+              "evict+solve+topo dispatch was recorded "
+              f"(topo_routes={routes})", file=sys.stderr)
+        return 1
+    if ab.get("evictions", 0) < 1:
+        print("check_fused_ab: VACUOUS — the storm arm evicted nothing",
+              file=sys.stderr)
+        return 1
+    if ab.get("binds", 0) < 1 or ab.get("quiet_binds", 0) < 1 \
+            or ab.get("topo_slice_binds", 0) < 1:
+        print("check_fused_ab: VACUOUS — an arm bound nothing "
+              f"(binds={ab.get('binds')}, quiet={ab.get('quiet_binds')}, "
+              f"slice={ab.get('topo_slice_binds')})", file=sys.stderr)
+        return 1
+    print("fused session A/B: parity OK (storm + quiet + mesh + topo)")
+    print(f"  fused dispatches {dispatches.get('fused'):3d}   "
+          f"storm evictions {ab.get('evictions')}   "
+          f"binds {ab.get('binds')}+{ab.get('quiet_binds')} quiet")
+    print(f"  legs {legs}")
+    print(f"  on {ab.get('on_ms')} ms / off {ab.get('off_ms')} ms "
+          f"(per-session median, same-box counterbalanced)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

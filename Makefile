@@ -3,7 +3,8 @@ PYTHON ?= python
 COMPILE_CACHE ?= $(CURDIR)/.compile-cache
 
 .PHONY: lint lint-inventory test bench bench-cached bench-steady \
-	bench-evict bench-commit bench-churn bench-wire bench-shard \
+	bench-evict bench-commit bench-churn bench-wire bench-ingest \
+	bench-shard \
 	bench-topo bench-tenancy bench-fused bench-gate \
 	bench-gate-baseline \
 	lineage-ab chaos chaos-smoke scenarios soak-replicas trace-demo \
@@ -112,6 +113,16 @@ bench-wire:
 	env JAX_PLATFORMS=cpu BENCH_WIRE_AB=1 BENCH_TASKS=240 \
 		BENCH_NODES=24 BENCH_JOBS=24 $(PYTHON) bench.py \
 		| $(PYTHON) tools/check_wire_ab.py
+
+# Shard-filtered ingest A/B smoke (doc/INGEST.md): one real ApiServer,
+# one replica scoped to shard 0 of 2 vs an unfiltered control, start
+# order counterbalanced.  Asserts the filtered replica's pods+podgroups
+# watch bytes land under 60% of the control's AND that its mirror is
+# bit-identical (encoded docs) to the control restricted to the scope
+# contract (own-pending + all-assigned + scoped podgroups).  The
+# checker is self-contained and exits nonzero on any violation.
+bench-ingest:
+	env JAX_PLATFORMS=cpu $(PYTHON) tools/check_ingest_ab.py
 
 # Sharded-vs-single-chip A/B smoke on the virtual 8-device CPU mesh
 # (doc/SHARDING.md): runs the 4-action storm with

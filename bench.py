@@ -2155,6 +2155,10 @@ def measure_wire_ab(n_tasks, n_nodes, n_jobs, rounds: int = 3,
                 # The bind ECHO must land in the mirror before churn
                 # deletes bound pods, or arms could diverge on timing.
                 def echo_visible():
+                    # Bench-side debug read: drain the lazy-mirror
+                    # pending store first (doc/INGEST.md) — a deferred
+                    # bind echo is invisible to a raw mirror poll.
+                    remote.flush_pending()
                     with remote.lock:
                         return sum(1 for p in remote.pods.values()
                                    if p.spec.node_name) >= n_tasks
@@ -2194,6 +2198,7 @@ def measure_wire_ab(n_tasks, n_nodes, n_jobs, rounds: int = 3,
                     wait_until(wave_bound, f"churn binds {rnd}")
 
                     def wave_echo():
+                        remote.flush_pending()  # deferred bind echoes
                         with remote.lock:
                             return all(
                                 remote.pods[k].spec.node_name
@@ -2286,6 +2291,79 @@ def measure_wire_ab(n_tasks, n_nodes, n_jobs, rounds: int = 3,
         else:
             os.environ[WIRE_FAST_ENV] = prior
     return ab, parity_all
+
+
+def measure_ingest_probe(n_queues: int = 4, n_pods: int = 240,
+                         n_groups: int = 24):
+    """Deterministic shard-scoped ingest probe (doc/INGEST.md): one
+    ApiServer with a fixed labeled workload spread over ``n_queues``
+    queues, one RemoteCluster scoped to HALF the shards of a 2-shard
+    map.  Emits the bench-gate's two directional-down keys:
+
+    * ``ingest_bytes`` — watch bytes the scoped replica received for
+      pods+podgroups at sync (the wire-bandwidth term shard filtering
+      attacks; goes DOWN as server-side scoping improves).
+    * ``baseline_bytes`` — retained `_wire_doc` delta-baseline bytes
+      after sync (the mirror-memory term the bounded store attacks).
+
+    The workload is fully deterministic (fixed names, sizes, and
+    timestamps), so both keys are byte-stable on one code version."""
+    from kube_batch_tpu.api import (Container, ObjectMeta, Pod, PodSpec,
+                                    PodStatus)
+    from kube_batch_tpu.apis.scheduling import v1alpha1
+    from kube_batch_tpu.cache import Cluster
+    from kube_batch_tpu.edge import ApiServer, RemoteCluster, ShardScope
+    from kube_batch_tpu.edge.wire_shard import QUEUE_LABEL
+    from kube_batch_tpu.tenancy.shards import ShardMap
+
+    _register()
+    queues = [f"q{i}" for i in range(n_queues)]
+    # Pin queue->shard explicitly: the probe's byte counts must not
+    # move when the hash default changes.
+    shard_map = ShardMap(2, overrides={
+        q: i % 2 for i, q in enumerate(queues)})
+
+    cluster = Cluster()
+    for q in queues:
+        cluster.create_queue(v1alpha1.Queue(
+            metadata=ObjectMeta(name=q),
+            spec=v1alpha1.QueueSpec(weight=1)))
+    for g in range(n_groups):
+        cluster.create_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name=f"pg-{g}", namespace="bench"),
+            spec=v1alpha1.PodGroupSpec(
+                min_member=1, queue=queues[g % n_queues])))
+    for i in range(n_pods):
+        q = queues[i % n_queues]
+        cluster.create_pod(Pod(
+            metadata=ObjectMeta(
+                name=f"pod-{i}", namespace="bench", uid=f"pod-{i}",
+                labels={QUEUE_LABEL: q},
+                creation_timestamp=float(i)),
+            spec=PodSpec(
+                # A third of the fleet is bound: the assigned
+                # occupancy stream has real traffic.
+                node_name=f"node-{i % 8}" if i % 3 == 0 else "",
+                containers=[Container(requests={
+                    "cpu": "500m", "memory": "512Mi"})]),
+            status=PodStatus(phase="Pending")))
+
+    server = ApiServer(cluster).start()
+    remote = RemoteCluster(server.url, timeout=30)
+    remote.attach_scope(ShardScope(shard_map, owned=lambda: {0}))
+    try:
+        remote.start(timeout=60)
+        ingest = remote.ingest_bytes()
+        baseline = remote.wire_baseline_bytes()
+        return {
+            "ingest_bytes": int(ingest.get("pods", 0)
+                                + ingest.get("podgroups", 0)),
+            "baseline_bytes": int(sum(baseline.values())),
+            "mirrored": remote.mirrored_objects(),
+        }
+    finally:
+        remote.stop()
+        server.stop()
 
 
 def _probe_backend(timeout_s: float):
@@ -2683,6 +2761,16 @@ def _run_full(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n,
         except Exception as exc:  # noqa: BLE001 — artifact stays honest
             out["tenancy_error"] = f"{type(exc).__name__}: {exc}"
 
+    # Shard-scoped ingest probe (doc/INGEST.md): deterministic watch-
+    # bandwidth + retained-baseline bytes for a half-scoped replica —
+    # the two directional-down keys tools/bench_compare.py gates.
+    # Optional (BENCH_INGEST=0 skips) and failure-isolated.
+    if os.environ.get("BENCH_INGEST", "1") != "0":
+        try:
+            out["ingest"] = measure_ingest_probe()
+        except Exception as exc:  # noqa: BLE001 — artifact stays honest
+            out["ingest_error"] = f"{type(exc).__name__}: {exc}"
+
     if not steady_only:
         _, steady_het_rounds, _het_stats = measure_steady_session(
             n_tasks, n_nodes, n_jobs, n_queues, n_signatures=64)
@@ -2778,6 +2866,11 @@ def main():
         # of the steady cache + the shard-rebalance counter (pinned 0
         # outside federation failover).
         "tenancy": None,
+        # Shard-scoped ingest probe (doc/INGEST.md): deterministic
+        # watch-bandwidth + retained-baseline bytes for a half-scoped
+        # replica — the ingest_bytes/baseline_bytes directional-down
+        # gate keys (tools/bench_compare.py).
+        "ingest": None,
         # Topology A/B (BENCH_TOPO_AB=1 / `make bench-topo`): defrag vs
         # capacity eviction contrast + batched/sequential/mesh parity
         # (doc/TOPOLOGY.md; gated by tools/check_topo_ab.py).

@@ -291,6 +291,17 @@ class SchedulerCache(Cache):
         # churn_event; the callable takes its own lock.
         self.shard_churn = None  # Optional[Callable[[Optional[str]], None]]
 
+        # Lazy-mirror flush chokepoint (edge/client.RemoteCluster,
+        # doc/INGEST.md): under KUBE_BATCH_TPU_LAZY_MIRROR the remote
+        # mirror defers dataclass materialization of MODIFIED frames for
+        # objects nothing has read yet.  snapshot() is the moment the
+        # scheduler observes cluster state, so it must drain that
+        # deferral first — new_scheduler_cache installs the cluster's
+        # flush_pending here when the cluster has one.  Called BEFORE
+        # taking self.mutex: the flush fires informer callbacks that
+        # re-enter cache ingestion (which takes mutex itself).
+        self.mirror_flush = None  # Optional[Callable[[], int]]
+
     # ------------------------------------------------------------------
     # epoch stamping + clone pool
 
@@ -798,6 +809,9 @@ class SchedulerCache(Cache):
         dicts and events either way — the churn parity gate pins it)."""
         from ..models.incremental import incremental_enabled
 
+        flush = self.mirror_flush
+        if flush is not None:  # before mutex: flush re-enters ingestion
+            flush()
         with self.mutex:
             st = self._snap_state
             if not incremental_enabled():

@@ -621,4 +621,11 @@ def new_scheduler_cache(cluster: Cluster, scheduler_name: str = "kube-batch",
         priority_class_enabled=priority_class_enabled,
         event_recorder=ClusterEventRecorder(cluster))
     connect_cache_to_cluster(cache, cluster)
+    if hasattr(cluster, "flush_pending"):
+        # Remote mirror (edge/client.RemoteCluster): lazy-deferred
+        # MODIFIED frames must be drained at every snapshot, and their
+        # deferral must still wake the scheduler loop — the frame IS
+        # external churn even when the dataclass is built later.
+        cache.mirror_flush = cluster.flush_pending
+        cluster.pending_churn = cache._note_churn
     return cache

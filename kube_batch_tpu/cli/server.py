@@ -359,6 +359,16 @@ class ServerRuntime:
                 renew_deadline=duration * 0.6,
                 retry_period=max(0.05, duration / 5.0))
             engine.attach_leases(self.shard_leases)
+            # Shard-filtered ingest (doc/INGEST.md): over the HTTP edge,
+            # scope the reflectors to the shards this replica owns.
+            # MUST come after attach_leases — attach_shard_scope pins
+            # the lease manager's load-based shed off (a filtered
+            # mirror undercounts foreign load) and chains its
+            # ownership-change hook.
+            from ..edge import RemoteCluster, attach_shard_scope
+            if isinstance(self.cluster, RemoteCluster):
+                attach_shard_scope(self.cluster, engine.map,
+                                   self.shard_leases)
             self.shard_leases.start()
             self.scheduler.run()
         elif self.opt.enable_leader_election:

@@ -12,5 +12,7 @@ run on a different machine than the cluster state.
 
 from .client import RemoteCluster
 from .server import ApiServer
+from .wire_shard import QUEUE_LABEL, ShardScope, attach_shard_scope
 
-__all__ = ["ApiServer", "RemoteCluster"]
+__all__ = ["ApiServer", "RemoteCluster", "QUEUE_LABEL", "ShardScope",
+           "attach_shard_scope"]

@@ -12,19 +12,25 @@ from __future__ import annotations
 
 import http.client
 import json
+import logging
 import socket
 import threading
 import time
 import urllib.error
 import urllib.request
-from typing import Dict
+from collections import OrderedDict
+from typing import Dict, Optional
+from urllib.parse import quote as _quote
 
 from ..api import objects as _objects
 from ..cache.cluster import Informer
 from ..cache.interface import AmbiguousOutcomeError
 from ..chaos import plan as chaos_plan
 from ..metrics import metrics
-from . import codec, codec_k8s
+from . import baseline as baseline_store
+from . import codec, codec_k8s, wire_shard
+
+_LOG = logging.getLogger(__name__)
 
 # Watch reconnect backoff (doc/CHAOS.md "Graceful degradation"): a
 # flapping or erroring stream backs off exponentially instead of
@@ -184,6 +190,33 @@ class RemoteCluster:
         # resource, written only by that resource's reflector thread.
         self._baseline_bytes: Dict[str, int] = {
             r: 0 for r in _WATCHED}
+        # Shard-scoped ingest (edge/wire_shard.py, doc/INGEST.md): once
+        # a ShardScope attaches, pods split into the unassigned (scoped)
+        # + assigned (occupancy) streams and podgroups filter by queue.
+        self._scope = None  # attach_scope(); read per frame, no lock
+        self._selector_warned: set = set()  # guarded-by: lock
+        # Cumulative watch bytes per reflector stream key ("pods",
+        # "pods@assigned", ...) — each stream's thread is its key's only
+        # writer; ingest_bytes() folds the streams per resource.
+        self._ingest_bytes: Dict[str, int] = {}
+        # Lazy mirror (doc/INGEST.md): deferred MODIFIED pod frames,
+        # {resource: {key: [prev_obj, doc, frame_ts, nbytes]}} — the raw
+        # doc waits here until flush_pending() materializes it at the
+        # session/debug chokepoint.  guarded-by: lock
+        self._pending: Dict[str, Dict[str, list]] = {"pods": {}}
+        # Wake hook the cache wiring installs (cache._note_churn): a
+        # deferred frame still dirties its queue's shard at receipt so
+        # the scheduler loop wakes.  None = no flush consumer is wired,
+        # so ingest stays fully eager (lazy-mirror validity rule).
+        self.pending_churn = None
+        # Baseline byte budgets (edge/baseline.py) + per-kind LRU of
+        # retained baselines, cold end first.  guarded-by: lock
+        self._baseline_budget = baseline_store.parse_budgets()
+        self._budget: Dict[str, Optional[int]] = {
+            r: baseline_store.budget_for(self._baseline_budget, r)
+            for r in _WATCHED}
+        self._baseline_lru: Dict[str, OrderedDict] = {
+            r: OrderedDict() for r in _WATCHED}
 
     # -- ingest: reflectors -------------------------------------------------
 
@@ -200,7 +233,7 @@ class RemoteCluster:
                 "priorityclasses": self.priority_class_informer,
                 "pdbs": self.pdb_informer}[resource]
 
-    def _reflect(self, resource: str) -> None:
+    def _reflect(self, resource: str, stream: Optional[str] = None) -> None:
         """One reflector: stream watch events into the mirror + informer.
         A fresh connect replays the server's current state as ADDED
         events ending in SYNC (objects deleted during a disconnect are
@@ -208,17 +241,32 @@ class RemoteCluster:
         RECONNECT resumes from the last seen resourceVersion: the server
         replays only the missed delta (RESUMED frame, no reconciliation),
         or answers ERROR 410 when the client fell past its event buffer,
-        forcing a full relist — the k8s list+watch contract."""
+        forcing a full relist — the k8s list+watch contract.
+
+        ``stream`` is the shard-scoped pod split (doc/INGEST.md): None
+        serves the whole collection (the legacy single stream; once a
+        ShardScope attaches it carries the UNASSIGNED half, scoped by
+        queue), "assigned" is the static bound-pod occupancy stream.
+        A scoped connection records the scope epoch its selector came
+        from; a lease claim/steal/shed bumps the epoch and the next
+        frame (keep-alive PINGs bound the wait) forces a reconnect
+        WITHOUT a resume version — the full scoped relist whose SYNC
+        reconciliation purges the shed shard and admits the gained
+        one."""
         store = self._store(resource)
         informer = self._informer(resource)
         key_of = _key_fn(resource)
+        skey = f"{resource}@{stream}" if stream else resource
         base = f"{self.base_url}{self._collection(resource)}?watch=1"
         last_rv = 0
         backoff = _WATCH_BACKOFF_BASE_S
         while not self._stop.is_set():
             replay_seen = set()
             replaying = True
-            url = (f"{base}&resourceVersion={last_rv}" if last_rv else base)
+            suffix, scope_epoch, domain = self._watch_params(resource,
+                                                             stream)
+            url = base + suffix + (f"&resourceVersion={last_rv}"
+                                   if last_rv else "")
             try:
                 # Read timeout >> the server's 5s keep-alive ping: a
                 # half-open connection surfaces as socket.timeout (OSError)
@@ -227,6 +275,12 @@ class RemoteCluster:
                     for raw in resp:
                         if self._stop.is_set():
                             return
+                        # Watch bandwidth ledger (make bench-ingest):
+                        # every received byte counts, dropped frames
+                        # included — this measures wire cost, not
+                        # mirror admission.  Sole writer of skey.
+                        self._ingest_bytes[skey] = (
+                            self._ingest_bytes.get(skey, 0) + len(raw))
                         # Frame-receipt stamp: the lineage ingest clock
                         # starts HERE, not after materialization — the
                         # fast path skips most of the decode and must
@@ -253,6 +307,22 @@ class RemoteCluster:
                                     "full relist (injected)")
                             if plan.fire(f"watch.truncate:{resource}"):
                                 raw = raw[:max(1, len(raw) // 2)]
+                        # Scope-epoch staleness: shard ownership changed
+                        # since this connection derived its selector.
+                        # Reconnect WITHOUT a resume version (the server
+                        # history cannot replay a gained shard's
+                        # pre-existing objects) — unless the
+                        # handover-race chaos site holds the stale
+                        # window open one frame so the in-scope drop
+                        # below is exercised deterministically.
+                        if self._scope_stale(resource, stream,
+                                             scope_epoch):
+                            if not (plan is not None and plan.fire(
+                                    f"ingest.handover_race:{resource}")):
+                                last_rv = 0
+                                metrics.note_watch_reconnect(
+                                    resource, "rescope")
+                                break
                         event = json.loads(raw)
                         etype = event["type"]
                         # NOTE: last_rv advances only AFTER a frame is
@@ -262,15 +332,29 @@ class RemoteCluster:
                         # heals it).
                         frame_rv = event.get("rv")
                         if etype == "SYNC":
+                            # Reconciliation is scoped to THIS stream's
+                            # domain: the scoped pod split partitions the
+                            # key space by assignment, and one stream's
+                            # relist must not purge the other's objects.
+                            # A shed shard's entries fall in the scoped
+                            # domain but out of the replay — purged here,
+                            # releasing their retained baselines.
                             with self.lock:
-                                for stale in [k for k in store
-                                              if k not in replay_seen]:
+                                for stale in [
+                                        k for k in store
+                                        if k not in replay_seen
+                                        and self._in_domain(
+                                            resource, domain, store[k])]:
                                     gone = store.pop(stale)
+                                    self._pending.get(resource, {}).pop(
+                                        stale, None)
+                                    self._drop_baseline_key(resource,
+                                                            stale)
                                     self._note_baseline(resource, gone,
                                                         None)
                                     informer.fire_delete(gone)
                             replaying = False
-                            self._synced[resource].set()
+                            self._synced[skey].set()
                             backoff = _WATCH_BACKOFF_BASE_S  # healthy again
                             if frame_rv is not None:
                                 last_rv = max(last_rv, int(frame_rv))
@@ -279,7 +363,7 @@ class RemoteCluster:
                             # Continuous delta stream: mirror is already
                             # current, no reconciliation needed.
                             replaying = False
-                            self._synced[resource].set()
+                            self._synced[skey].set()
                             backoff = _WATCH_BACKOFF_BASE_S  # healthy again
                             continue
                         if etype == "ERROR":
@@ -289,12 +373,73 @@ class RemoteCluster:
                         if etype == "PING":
                             continue
                         edoc = event["object"]
+                        if domain is not None:
+                            # Client-side scope check (always on under a
+                            # scope, selector or no selector): a frame
+                            # for a foreign queue — the server's
+                            # over-approximating selector still sends
+                            # unlabeled pods, and a raced lease loss
+                            # sends a just-shed shard's — must be
+                            # dropped-and-counted, never mirrored.
+                            if etype in ("ADDED", "MODIFIED") \
+                                    and not self._frame_in_scope(
+                                        resource, domain, edoc):
+                                try:
+                                    mirrored = _raw_key(resource,
+                                                        edoc) in store
+                                except (KeyError, TypeError,
+                                        AttributeError):
+                                    mirrored = False
+                                if not mirrored:
+                                    metrics.note_ingest_drop(
+                                        resource,
+                                        "handover" if self._scope_stale(
+                                            resource, stream, scope_epoch)
+                                        else "scope")
+                                    if frame_rv is not None:
+                                        last_rv = max(last_rv,
+                                                      int(frame_rv))
+                                    continue
+                                # A MIRRORED object exiting the scope is
+                                # a boundary transition, not a drop: the
+                                # server's own selector rewrites it to
+                                # DELETED, and the over-approximating
+                                # client-side check must rewrite
+                                # identically (e.g. a stream that
+                                # connected before the queue universe
+                                # synced carries no label selector).
+                                etype = "DELETED"
+                            # A DELETED on one pod stream whose carried
+                            # object now belongs to the OTHER stream is
+                            # a boundary transition (bind), not a
+                            # removal: the peer stream delivers the
+                            # matching ADDED, and the upsert below turns
+                            # it into the same fire_update the
+                            # unfiltered control emits for the MODIFIED.
+                            if etype == "DELETED" and resource == "pods":
+                                target = self._pod_domain_of(edoc)
+                                if target is not None and target != domain:
+                                    if frame_rv is not None:
+                                        last_rv = max(last_rv,
+                                                      int(frame_rv))
+                                    continue
+                        # Lazy mirror: absorb a MODIFIED pod frame into
+                        # the deferred store instead of materializing —
+                        # flush_pending() finishes the job at the
+                        # session/debug chokepoint.
+                        if etype == "MODIFIED" and self._maybe_defer(
+                                resource, edoc, raw, frame_ts):
+                            if frame_rv is not None:
+                                last_rv = max(last_rv, int(frame_rv))
+                            continue
                         # Previous mirror object for this key = the
-                        # delta baseline.  Read without the lock: this
-                        # reflector thread is the store's ONLY writer,
-                        # and dict.get is atomic under the GIL.  A doc
-                        # too malformed to key routes to the full
-                        # decode, whose error handling is unchanged.
+                        # delta baseline.  Read without the lock: writes
+                        # to a key come only from its own stream's
+                        # thread (the scoped pod split partitions keys
+                        # by assignment), and dict.get is atomic under
+                        # the GIL.  A doc too malformed to key routes to
+                        # the full decode, whose error handling is
+                        # unchanged.
                         try:
                             prev = store.get(_raw_key(resource, edoc))
                         except (KeyError, TypeError, AttributeError):
@@ -317,28 +462,32 @@ class RemoteCluster:
                             obj._wire_nbytes = len(raw)
                         key = key_of(obj)
                         with self.lock:
-                            if etype == "ADDED":
-                                if replaying:
+                            if etype in ("ADDED", "MODIFIED"):
+                                if etype == "ADDED" and replaying:
                                     replay_seen.add(key)
+                                # This frame's doc supersedes any
+                                # deferred one for the key (wire docs
+                                # are complete snapshots, not diffs).
+                                self._pending.get(resource, {}).pop(
+                                    key, None)
                                 old = store.get(key)
                                 store[key] = obj
                                 self._note_baseline(resource, old, obj)
+                                self._touch_baseline(resource, key)
                                 if old is None:
                                     informer.fire_add(obj)
-                                else:  # relist upsert of a known object
-                                    informer.fire_update(old, obj)
-                            elif etype == "MODIFIED":
-                                old = store.get(key)
-                                store[key] = obj
-                                self._note_baseline(resource, old, obj)
-                                if old is None:
-                                    informer.fire_add(obj)
-                                else:
+                                else:  # upsert of a known object
                                     informer.fire_update(old, obj)
                             elif etype == "DELETED":
+                                # Deliver any deferred update first so
+                                # the cache sees final-state-then-delete
+                                # — the unfiltered control's order.
+                                self._flush_key_locked(resource, key)
                                 old = store.pop(key, None)
+                                self._drop_baseline_key(resource, key)
                                 self._note_baseline(resource, old, None)
                                 informer.fire_delete(obj)
+                            self._enforce_budget_locked(resource)
                         if frame_rv is not None:  # applied successfully
                             last_rv = max(last_rv, int(frame_rv))
             except (OSError, http.client.HTTPException):
@@ -363,28 +512,363 @@ class RemoteCluster:
                 self._stop.wait(backoff)
                 backoff = min(backoff * 2.0, _WATCH_BACKOFF_CAP_S)
 
+    # -- shard scope + lazy mirror + baseline budget ------------------------
+
+    def attach_scope(self, scope) -> "RemoteCluster":
+        """Install a ShardScope (edge/wire_shard.py).  Before ``start()``
+        the scoped streams come up scoped; after it, running reflectors
+        notice the presence change on their next frame (keep-alive PINGs
+        bound the wait) and reconnect scoped, and the assigned-pod
+        occupancy stream is spawned here."""
+        with self.lock:
+            self._scope = scope
+        if self._threads and "pods@assigned" not in self._synced:
+            self._spawn("pods", "assigned")
+        return self
+
+    def _watch_params(self, resource: str, stream: Optional[str]):
+        """(url-suffix, connect-epoch, domain) for one reflector
+        connection.  domain None = unscoped legacy stream; "unassigned"
+        / "assigned" = the scoped pod split; "scoped" = scoped
+        podgroups.  connect-epoch None marks a connection whose selector
+        does not depend on the owned-shard set (unscoped, or the static
+        assigned stream)."""
+        scope = self._scope
+        if scope is None or resource not in ("pods", "podgroups"):
+            return "", None, None
+        if resource == "pods" and stream == "assigned":
+            # Every bound pod, any queue: node-occupancy accounting
+            # must see foreign pods or the replica double-books nodes.
+            return ("&fieldSelector=" + _quote("spec.nodeName!="),
+                    None, "assigned")
+        epoch = scope.epoch
+        # The queue stream is unfiltered, so its mirror is the
+        # selector's queue-name universe — wait for its initial sync
+        # (bounded) so the first scoped connection filters server-side
+        # instead of degrading to the client-side check.  Queues created
+        # AFTER this connect stay foreign-unfiltered until the next
+        # rescope; the client-side check covers the gap.
+        sync = self._synced.get("queues")
+        if sync is not None:
+            sync.wait(5.0)
+        with self.lock:
+            universe = list(self.queues)
+        if resource == "podgroups":
+            try:
+                sel = scope.podgroup_field_selector(universe)
+            except ValueError:
+                self._warn_selector(resource)
+                sel = None
+            return (("&fieldSelector=" + _quote(sel)) if sel else "",
+                    epoch, "scoped")
+        parts = ["fieldSelector=" + _quote("spec.nodeName=")]
+        try:
+            sel = scope.pod_label_selector(universe)
+        except ValueError:
+            # Malformed shard selector: degrade THIS stream to the
+            # unfiltered unassigned watch (the client-side scope check
+            # still keeps the mirror scoped) — never kill the daemon.
+            self._warn_selector(resource)
+            sel = None
+        if sel:
+            parts.append("labelSelector=" + _quote(sel))
+        return "&" + "&".join(parts), epoch, "unassigned"
+
+    def _warn_selector(self, resource: str) -> None:
+        metrics.note_wire_fast_fallback("selector")
+        with self.lock:
+            if resource in self._selector_warned:
+                return
+            self._selector_warned.add(resource)
+        _LOG.warning(
+            "shard selector for %r failed to compile (a queue name "
+            "outside the selector charset?); degrading to an unfiltered "
+            "%s watch — bandwidth scoping is OFF for this stream, the "
+            "client-side scope check still applies", resource, resource)
+
+    def _scope_stale(self, resource: str, stream: Optional[str],
+                     scope_epoch) -> bool:
+        """Did the owned-shard set change under this connection's
+        selector?  Presence changes count (a scope attached mid-stream
+        must rescope the legacy connection); the static assigned stream
+        never goes stale."""
+        if resource not in ("pods", "podgroups") or stream == "assigned":
+            return False
+        scope = self._scope
+        if scope is None:
+            return scope_epoch is not None
+        if scope_epoch is None:
+            return True  # connected unscoped, scope attached since
+        return scope.epoch != scope_epoch
+
+    def _frame_in_scope(self, resource: str, domain: str, edoc) -> bool:
+        """Client-side shard admission for one ADDED/MODIFIED frame.
+        Unresolvable queues pass (over-approximation: never drop what we
+        cannot attribute); assigned-domain pods always pass
+        (occupancy)."""
+        scope = self._scope
+        if scope is None:
+            return True
+        try:
+            if resource == "pods":
+                if domain != "unassigned" \
+                        or wire_shard.node_of_pod_doc(edoc, self.wire):
+                    return True
+                with self.lock:
+                    q = wire_shard.queue_of_pod_doc(
+                        edoc, self.pod_groups, self.wire)
+            else:
+                q = wire_shard.queue_of_podgroup_doc(edoc, self.wire)
+        except (AttributeError, TypeError):
+            return True  # malformed doc: the decode path owns the error
+        return q is None or scope.allows(q)
+
+    def _pod_domain_of(self, edoc) -> Optional[str]:
+        """Which scoped pod stream owns this doc NOW — "assigned",
+        "unassigned", or None when it is out of scope entirely (foreign
+        unassigned pod: a removal is a real removal)."""
+        try:
+            if wire_shard.node_of_pod_doc(edoc, self.wire):
+                return "assigned"
+            with self.lock:
+                q = wire_shard.queue_of_pod_doc(
+                    edoc, self.pod_groups, self.wire)
+        except (AttributeError, TypeError):
+            return None
+        scope = self._scope
+        if q is not None and scope is not None and not scope.allows(q):
+            return None
+        return "unassigned"
+
+    def _in_domain(self, resource: str, domain: Optional[str],
+                   obj) -> bool:
+        """Does a MIRRORED object fall in this stream's relist-purge
+        domain?  Unscoped streams (and scoped single-stream resources)
+        own every key; the scoped pod split partitions by assignment."""
+        if domain is None or resource != "pods" or domain == "scoped":
+            return True
+        assigned = bool(getattr(obj.spec, "node_name", "") or "")
+        return assigned == (domain == "assigned")
+
+    def _maybe_defer(self, resource: str, edoc, raw, frame_ts) -> bool:
+        """Lazy mirror: queue a MODIFIED pod frame's raw doc instead of
+        materializing a fresh dataclass nobody will read before the next
+        frame.  Active only with a wired flush consumer (pending_churn,
+        installed by the cache wiring), the fast path on, and a known
+        previous object (first sight must fire_add eagerly).  Returns
+        True when the frame was absorbed; the deferred doc still dirties
+        its queue's shard so the scheduler wakes."""
+        if resource != "pods" or self.pending_churn is None \
+                or not wire_shard.lazy_mirror_enabled() \
+                or not codec.wire_fast_enabled():
+            return False
+        try:
+            key = _raw_key(resource, edoc)
+        except (KeyError, TypeError, AttributeError):
+            return False
+        with self.lock:
+            cur = self.pods.get(key)
+            if cur is None:
+                return False
+            pend = self._pending[resource]
+            entry = pend.get(key)
+            if entry is None:
+                pend[key] = [cur, edoc, frame_ts, len(raw)]
+                metrics.note_lazy_mirror("deferred")
+            else:
+                # Coalesce: keep the prev the informer last delivered
+                # (entry[0]); only the latest doc + receipt stamp
+                # matter — wire docs are complete snapshots.
+                entry[1] = edoc
+                entry[2] = frame_ts
+                entry[3] = len(raw)
+                metrics.note_lazy_mirror("coalesced")
+            queue = wire_shard.queue_of_pod_doc(edoc, self.pod_groups,
+                                                self.wire)
+        churn = self.pending_churn
+        if churn is not None:  # outside the lock: churn takes cache.mutex
+            churn(queue)
+        return True
+
+    def _flush_key_locked(self, resource: str, key: str) -> None:
+        entry = self._pending.get(resource, {}).pop(key, None)
+        if entry is not None:
+            self._materialize_locked(resource, key, entry)
+
+    def _materialize_locked(self, resource: str, key: str,
+                            entry: list) -> None:
+        """Decode one deferred frame against its retained baseline and
+        deliver the coalesced informer update.  ``_ingest_ts`` carries
+        the stored frame-receipt stamp, so the lineage SLO clock is the
+        one the eager path would have stamped."""
+        store = self._store(resource)
+        old = store.get(key)
+        _prev, doc, frame_ts, nbytes = entry
+        try:
+            t_dec = time.perf_counter()
+            obj = self._decode(doc, prev=old, ingest_ts=frame_ts)
+            metrics.note_decode_seconds(time.perf_counter() - t_dec)
+        except Exception:  # lint: allow-swallow(a malformed deferred doc must not poison the session chokepoint; the mirror keeps the prior materialization, the next frame or relist heals it, and the drop is counted)
+            metrics.note_lazy_mirror("error")
+            return
+        if codec.wire_fast_enabled():
+            obj._wire_nbytes = nbytes
+        store[key] = obj
+        self._note_baseline(resource, old, obj)
+        self._touch_baseline(resource, key)
+        metrics.note_lazy_mirror("flushed")
+        informer = self._informer(resource)
+        if old is None:
+            informer.fire_add(obj)
+        else:
+            informer.fire_update(old, obj)
+
+    def flush_pending(self) -> int:
+        """Materialize every deferred MODIFIED frame into the mirror and
+        informer fan-out — the lazy-mirror chokepoint.  Wired as
+        ``cache.mirror_flush`` so ``snapshot()``/the session open and
+        the debug surfaces see a current mirror; also safe to call
+        directly.  Returns the number of frames materialized."""
+        n = 0
+        with self.lock:
+            for resource in list(self._pending):
+                pend = self._pending[resource]
+                while pend:
+                    key, entry = pend.popitem()
+                    self._materialize_locked(resource, key, entry)
+                    n += 1
+                if n:
+                    self._enforce_budget_locked(resource)
+        return n
+
+    def pending_count(self) -> int:
+        with self.lock:
+            return sum(len(p) for p in self._pending.values())
+
+    def _touch_baseline(self, resource: str, key: str) -> None:
+        """Mark ``key`` hottest in its kind's baseline LRU (enforcement
+        compresses/evicts from the cold end).  Lock held."""
+        if self._budget.get(resource) is None:
+            return
+        lru = self._baseline_lru[resource]
+        lru.pop(key, None)
+        lru[key] = True
+
+    def _drop_baseline_key(self, resource: str, key: str) -> None:
+        lru = self._baseline_lru.get(resource)
+        if lru:
+            lru.pop(key, None)
+
+    def _enforce_budget_locked(self, resource: str) -> None:
+        """Hold the kind's retained baseline bytes to its budget:
+        compress cold baselines in place first, evict (counted) only
+        when compression cannot get there.  Runs under the lock so the
+        ledger and the objects move together; the gauge publishes every
+        step, so ``kube_batch_wire_baseline_bytes`` only goes DOWN at a
+        fixed workload once the budget binds."""
+        budget = self._budget.get(resource)
+        if budget is None \
+                or self._baseline_bytes.get(resource, 0) <= budget:
+            return
+        store = self._store(resource)
+        lru = self._baseline_lru[resource]
+        for op in ("compress", "evict"):
+            for key in list(lru):
+                if self._baseline_bytes[resource] <= budget:
+                    return
+                obj = store.get(key)
+                if obj is None:
+                    lru.pop(key, None)
+                    continue
+                old_n = getattr(obj, "_wire_nbytes", 0)
+                if op == "compress":
+                    if old_n < 128:
+                        continue  # zlib overhead would inflate it
+                    new_n = baseline_store.compress(obj)
+                    if new_n is None:
+                        continue  # already cold / nothing retained
+                else:
+                    popped = baseline_store.evict(obj)
+                    lru.pop(key, None)
+                    if not popped:
+                        continue
+                    new_n = 0
+                try:
+                    obj._wire_nbytes = new_n
+                except AttributeError:  # lint: allow-swallow(slotted/foreign object: it never carried retained bytes, the ledger is untouched)
+                    continue
+                delta = new_n - old_n
+                if delta:
+                    total = self._baseline_bytes.get(resource, 0) + delta
+                    self._baseline_bytes[resource] = total
+                    metrics.set_wire_baseline(resource, total)
+                metrics.note_baseline_budget(resource, op)
+
+    def audit_baseline_bytes(self) -> Dict[str, int]:
+        """{kind: ledger - actual}: zero everywhere iff the
+        ``_baseline_bytes`` ledger reconciles with the ``_wire_nbytes``
+        actually retained on mirror objects — the relist/DELETE release
+        invariant (tests/test_baseline_budget.py)."""
+        out = {}
+        with self.lock:
+            for resource in _WATCHED:
+                actual = sum(getattr(o, "_wire_nbytes", 0)
+                             for o in self._store(resource).values())
+                out[resource] = (self._baseline_bytes.get(resource, 0)
+                                 - actual)
+        return out
+
+    def ingest_bytes(self) -> Dict[str, int]:
+        """Cumulative watch bytes received per resource (the scoped pod
+        streams folded together) — `make bench-ingest`'s directional
+        key."""
+        out: Dict[str, int] = {}
+        for skey, v in self._ingest_bytes.items():
+            base = skey.split("@", 1)[0]
+            out[base] = out.get(base, 0) + v
+        return out
+
+    def mirrored_objects(self) -> Dict[str, int]:
+        """{resource: mirror entry count} — the soak's O(own shards)
+        scoping assertions."""
+        with self.lock:
+            return {r: len(self._store(r)) for r in _WATCHED}
+
+    def _spawn(self, resource: str, stream: Optional[str] = None) -> None:
+        skey = f"{resource}@{stream}" if stream else resource
+        # setdefault: start() pre-registers every stream's sync event
+        # before ANY reflector thread runs, so the scoped pod/podgroup
+        # connections can wait on the queue stream's sync no matter the
+        # spawn order.
+        self._synced.setdefault(skey, threading.Event())
+        self._ingest_bytes.setdefault(skey, 0)
+        t = threading.Thread(target=self._reflect,
+                             args=(resource, stream), daemon=True,
+                             name=f"reflector-{skey}")
+        t.start()
+        self._threads.append(t)
+
     def start(self, timeout: float = 30.0) -> "RemoteCluster":
         for resource in _WATCHED:
-            self._synced[resource] = threading.Event()
-            t = threading.Thread(target=self._reflect, args=(resource,),
-                                 daemon=True,
-                                 name=f"reflector-{resource}")
-            t.start()
-            self._threads.append(t)
+            self._synced.setdefault(resource, threading.Event())
         for resource in _WATCHED:
-            if not self._synced[resource].wait(timeout):
-                # Don't leak six reflector threads into a caller that
-                # will retry or give up: each holds a socket and keeps
+            self._spawn(resource)
+        if self._scope is not None:
+            self._spawn("pods", "assigned")
+        for skey in list(self._synced):
+            if not self._synced[skey].wait(timeout):
+                # Don't leak reflector threads into a caller that will
+                # retry or give up: each holds a socket and keeps
                 # mutating the mirrors.  Stop and join them before
-                # surfacing WHICH resources never synced.
-                unsynced = [r for r in _WATCHED
-                            if not self._synced[r].is_set()]
+                # surfacing WHICH streams never synced.
+                unsynced = [s for s in self._synced
+                            if not self._synced[s].is_set()]
                 self._stop.set()
                 for t in self._threads:
                     t.join(timeout=2.0)
                 alive = [t.name for t in self._threads if t.is_alive()]
                 raise TimeoutError(
-                    f"watch sync timeout after {timeout:.1f}s; resources "
+                    f"watch sync timeout after {timeout:.1f}s; streams "
                     f"never synced: {', '.join(unsynced)}"
                     + (f" (reflectors still draining a blocked read: "
                        f"{', '.join(alive)})" if alive else ""))
@@ -462,7 +946,8 @@ class RemoteCluster:
                 # "baseline" so the label set stays bounded.
                 reason = str(exc)
                 metrics.note_wire_fast_fallback(
-                    reason if reason == "kind" else "baseline")
+                    reason if reason in ("kind", "evicted")
+                    else "baseline")
             except ValueError:
                 # The full decode would reject this doc too: let the
                 # reflector's malformed-frame relist handle it.
@@ -780,8 +1265,11 @@ class RemoteCluster:
 
     def get_mirror_pod(self, namespace: str, name: str):
         """The local mirror's view (may lag truth): the zero-round-trip
-        read for callers that only need informer-consistent state."""
+        read for callers that only need informer-consistent state.  A
+        debug/resync read is a materialization touch — any deferred
+        frame for the key flushes first (lazy-mirror validity rule)."""
         with self.lock:
+            self._flush_key_locked("pods", f"{namespace}/{name}")
             return self.pods.get(f"{namespace}/{name}")
 
     # mutation verbs (typed clientsets / workload submission clients):

@@ -206,6 +206,26 @@ def remember_wire_doc(obj, doc: Dict[str, Any]) -> None:
         pass
 
 
+def wire_baseline(prev) -> Dict[str, Any]:
+    """The retained raw-doc delta baseline for ``prev``: the hot
+    ``_wire_doc`` dict, or transparently decompressed from the budget
+    store's cold form (``_wire_zdoc``, edge/baseline.py).  Raises
+    LookupError("evicted") when the baseline budget evicted it (the
+    client counts the full-decode fallback under that reason) and
+    LookupError("baseline") when nothing was ever retained."""
+    data = getattr(prev, _WIRE_DOC_ATTR, None)
+    if isinstance(data, dict):
+        return data
+    z = getattr(prev, "_wire_zdoc", None)
+    if z is not None:
+        import json
+        import zlib
+        return json.loads(zlib.decompress(z))
+    raise LookupError(
+        "evicted" if getattr(prev, "_wire_evicted", False)
+        else "baseline")
+
+
 def decode_delta(doc: Dict[str, Any], prev):
     """Decode a native-wire doc against the previously decoded ``prev``,
     re-decoding only changed fields.  Raises ValueError on anything the
@@ -217,8 +237,10 @@ def decode_delta(doc: Dict[str, Any], prev):
     cls = _BY_KIND.get(kind)
     if cls is None:
         raise ValueError(f"unknown wire kind {kind!r}")
-    prev_data = getattr(prev, _WIRE_DOC_ATTR, None)
-    if type(prev) is not cls or not isinstance(prev_data, dict):
+    if type(prev) is not cls:
+        raise LookupError("no delta baseline")
+    prev_data = wire_baseline(prev)
+    if not isinstance(prev_data, dict):
         raise LookupError("no delta baseline")
     data = {k: v for k, v in doc.items() if k != "__kind__"}
     obj = _decode_dataclass_delta(cls, data, prev, prev_data)

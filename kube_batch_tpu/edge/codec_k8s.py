@@ -584,8 +584,11 @@ def from_k8s_delta(doc: Dict[str, Any], prev):
         # counted under its own fallback reason so operators can tell
         # "unsupported kind" from "missing baseline".
         raise LookupError("kind")
-    prev_data = getattr(prev, "_wire_doc", None)
-    if type(prev) is not cls or not isinstance(prev_data, dict):
+    if type(prev) is not cls:
+        raise LookupError("baseline")
+    from .codec import wire_baseline
+    prev_data = wire_baseline(prev)  # LookupError: baseline | evicted
+    if not isinstance(prev_data, dict):
         raise LookupError("baseline")
     kwargs = {}
     for doc_key, field, section_in in sections:

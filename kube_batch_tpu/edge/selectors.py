@@ -101,6 +101,10 @@ _COMMON_FIELDS = ("metadata.name", "metadata.namespace")
 _FIELD_PATHS = {
     "pods": _COMMON_FIELDS + ("spec.nodeName", "spec.schedulerName",
                               "status.phase"),
+    # Queue is the tenancy shard key: a chain of ``spec.queue!=<q>``
+    # requirements is how a shard-scoped reflector excludes foreign
+    # queues' podgroups server-side (doc/INGEST.md).
+    "podgroups": _COMMON_FIELDS + ("spec.queue",),
 }
 
 
@@ -113,11 +117,19 @@ def _field_value(resource: str, obj, path: str) -> str:
             return md.namespace
     if resource == "pods":
         if path == "spec.nodeName":
-            return obj.spec.node_name
+            # Coerce a null nodeName to "": `spec.nodeName=` (empty
+            # value) must select every unassigned pod regardless of how
+            # the doc spelled "no node" (doc/INGEST.md stream split).
+            return obj.spec.node_name or ""
         if path == "spec.schedulerName":
             return obj.spec.scheduler_name
         if path == "status.phase":
             return obj.status.phase
+    if resource == "podgroups" and path == "spec.queue":
+        # Both PodGroup API versions carry spec.queue; an unset queue
+        # reads as "" so `spec.queue!=<name>` keeps default-queue groups
+        # (over-approximation: the client attributes those itself).
+        return getattr(obj.spec, "queue", "") or ""
     raise ValueError(f"field label not supported: {path}")
 
 

@@ -58,6 +58,13 @@ def _merge_patch(target, patch):
 # replace, as in RFC 7386.
 _MERGE_KEYS = {("status", "conditions"): "type"}
 
+# Idle-watch keep-alive cadence.  Also the upper bound on how long a
+# shard-scoped reflector can sit on a STALE selector after a lease
+# claim/shed (the client checks its scope epoch per frame, PINGs
+# included — doc/INGEST.md "Handover relist").  Module-level so tests
+# can shrink the rescope latency.
+_PING_INTERVAL_S = 5.0
+
 
 def _strategic_merge(target, patch, path=()):
     """Kubernetes strategic merge patch (the fragment the edge needs):
@@ -518,7 +525,7 @@ class _Handler(BaseHTTPRequestHandler):
                 emit("SYNC", None, rv=list_rv)
             while True:
                 try:
-                    etype, obj, rv = events.get(timeout=5.0)
+                    etype, obj, rv = events.get(timeout=_PING_INTERVAL_S)
                 except queue.Empty:
                     emit("PING", None)  # keep-alive; detects dead peers
                     continue

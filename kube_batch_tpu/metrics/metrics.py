@@ -457,8 +457,36 @@ wire_fast_fallback = registry.register(Counter(
     "kube_batch_wire_fast_fallback_total",
     "Delta-decode attempts that degraded to a full decode, by reason "
     "(error = delta raised unexpectedly; baseline = no/mismatched "
-    "cached doc; kind = resource kind outside the delta plans)",
-    ("reason",)))
+    "cached doc; kind = resource kind outside the delta plans; "
+    "evicted = baseline dropped by the byte budget; selector = a "
+    "shard selector failed to compile and the stream degraded to an "
+    "unfiltered watch)", ("reason",)))
+# Shard-scoped ingest (edge/wire_shard.py, doc/INGEST.md): watch frames
+# the client-side scope check refused to mirror — scope = a frame for a
+# foreign queue the server's over-approximating selector still sent;
+# handover = a frame that raced a lease loss (the `ingest.handover_race`
+# chaos site pins this window open deterministically).
+ingest_dropped = registry.register(Counter(
+    "kube_batch_ingest_dropped_total",
+    "Watch frames dropped by the client-side shard-scope check, by "
+    "resource and reason (scope | handover)", ("resource", "reason")))
+# Lazy mirror materialization (edge/client.flush_pending): MODIFIED pod
+# frames deferred at receipt (deferred), follow-up frames folded into an
+# existing deferral (coalesced), deferred frames materialized at the
+# session/debug chokepoint (flushed), and deferred docs the flush could
+# not decode (error — the mirror keeps the prior materialization until
+# the next frame or relist heals it).
+lazy_mirror = registry.register(Counter(
+    "kube_batch_lazy_mirror_total",
+    "Lazy-mirror deferral events (deferred | coalesced | flushed | "
+    "error)", ("event",)))
+# Baseline byte-budget enforcement (edge/baseline.py): cold baselines
+# compressed in place, then evicted when compression alone cannot meet
+# the budget.
+baseline_budget_ops = registry.register(Counter(
+    "kube_batch_wire_baseline_budget_total",
+    "Baseline-budget enforcement actions by kind (compress | evict)",
+    ("kind", "op")))
 solve_deadline_exceeded = registry.register(Counter(
     f"{SUBSYSTEM}_solve_deadline_exceeded_total",
     "Session solves that overran the per-session deadline (counted as "
@@ -945,6 +973,45 @@ def wire_fast_counts() -> Dict[str, int]:
         if labels:
             out[f"fallback_{labels[0]}"] = int(v)
     return out
+
+
+def note_ingest_drop(resource: str, reason: str) -> None:
+    """Count one watch frame the shard-scope check refused to mirror
+    (scope = steady over-approximation; handover = raced a lease
+    loss)."""
+    ingest_dropped.inc(1.0, resource, reason)
+
+
+def ingest_drop_counts() -> Dict[str, int]:
+    """{"resource/reason": count} — soak + handover-race assertions."""
+    return {f"{labels[0]}/{labels[1]}": int(v)
+            for labels, v in ingest_dropped.values().items()
+            if len(labels) == 2}
+
+
+def note_lazy_mirror(event: str) -> None:
+    """Count one lazy-mirror deferral event (deferred | coalesced |
+    flushed | error)."""
+    lazy_mirror.inc(1.0, event)
+
+
+def lazy_mirror_counts() -> Dict[str, int]:
+    """{event: count} — the lazy-parity tests' non-vacuity guard."""
+    return {labels[0]: int(v)
+            for labels, v in lazy_mirror.values().items() if labels}
+
+
+def note_baseline_budget(kind: str, op: str) -> None:
+    """Count one baseline-budget enforcement action (compress |
+    evict)."""
+    baseline_budget_ops.inc(1.0, kind, op)
+
+
+def baseline_budget_counts() -> Dict[str, int]:
+    """{"kind/op": count} — eviction-recovery test assertions."""
+    return {f"{labels[0]}/{labels[1]}": int(v)
+            for labels, v in baseline_budget_ops.values().items()
+            if len(labels) == 2}
 
 
 # Wall time the reflector threads spent decoding watch frames since the

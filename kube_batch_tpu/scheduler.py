@@ -416,6 +416,13 @@ class Scheduler:
         cycles run the incremental path, full cycles revalidate it."""
         ok = True
         try:
+            # Drain lazily-deferred remote mirror frames before the
+            # cycle observes the cache: the tenancy engine's shard walk
+            # reads mirror state outside snapshot(), so the flush must
+            # happen at the cycle top, not just inside snapshot().
+            flush = getattr(self.cache, "mirror_flush", None)
+            if flush is not None:
+                flush()
             if force_full:
                 from .models import incremental
                 incremental.request_full(self.cache)

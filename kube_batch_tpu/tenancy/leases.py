@@ -102,6 +102,14 @@ class ShardLeaseManager:
             StoreLock(cluster, namespace, name=shard_lock_name(i))
             for i in range(num_shards)]
         self._on_claim = on_claim
+        # Ownership-change hook, fired on EVERY transition — claim,
+        # steal, shed, loss — with (shard, kind).  The shard-scoped
+        # reflector wiring (edge/wire_shard.attach_shard_scope) installs
+        # its scope-epoch bump here so a filtered watch rescopes the
+        # moment the owned set moves; _on_claim above stays claim-only
+        # (the engine's churn wake).  Assignable any time; called from
+        # the lease thread.
+        self.on_change: Optional[Callable[[int, str], None]] = None
         self._lock = threading.Lock()
         self._renewed: Dict[int, float] = {}   # shard -> last renew  guarded-by: _lock
         # Spread-target deferral bookkeeping (lease thread only): when
@@ -220,6 +228,16 @@ class ShardLeaseManager:
             metrics.note_shard_lease(victim, "shed")
             metrics.note_shard_rebalance("shed")
             metrics.clear_shard_owner(victim, self.identity)
+            self._notify_change(victim, "shed")
+
+    def _notify_change(self, shard: int, kind: str) -> None:
+        hook = self.on_change
+        if hook is None:
+            return
+        try:
+            hook(shard, kind)
+        except Exception:  # lint: allow-swallow(an observer must never kill the lease loop mid-transition; the miss is counted and the next tick re-notifies nothing worse than a late rescope)
+            metrics.note_swallowed("lease_on_change")
 
     def _record(self, now: float) -> dict:
         return {"holderIdentity": self.identity,
@@ -235,6 +253,7 @@ class ShardLeaseManager:
             metrics.note_shard_lease(shard, kind)
             metrics.note_shard_rebalance("lost")
             metrics.clear_shard_owner(shard, self.identity)
+            self._notify_change(shard, kind)
 
     def _tick_shard(self, shard: int) -> None:
         plan = chaos_plan.PLAN
@@ -313,6 +332,7 @@ class ShardLeaseManager:
         metrics.set_shard_owner(shard, self.identity)
         if self._on_claim is not None:
             self._on_claim(shard)
+        self._notify_change(shard, kind)
 
     def _over_target(self, owned) -> bool:
         """Whether claiming one more shard should defer for spread.

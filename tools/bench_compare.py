@@ -130,6 +130,19 @@ GATED_KEYS = {
     "steady_dispatches.solve": {
         "path": ("session_dispatches", "solve"), "direction": "down",
         "band": 0.0, "abs_slack": 0.0},
+    # Shard-scoped ingest probe (doc/INGEST.md): deterministic watch
+    # bytes and retained baseline bytes for a half-scoped replica at
+    # the fixed probe shape.  Both are directional DOWN — the whole
+    # point of shard-filtered reflectors and the bounded baseline
+    # store is that these shrink and stay shrunk.  Byte counts are
+    # deterministic modulo JSON framing, so the bands are tight with a
+    # small absolute slack for framing drift.
+    "ingest_bytes": {
+        "path": ("ingest", "ingest_bytes"), "direction": "down",
+        "band": 0.05, "abs_slack": 2048.0},
+    "baseline_bytes": {
+        "path": ("ingest", "baseline_bytes"), "direction": "down",
+        "band": 0.05, "abs_slack": 2048.0},
     # Full-bench keys: absent from steady-only artifacts (so they never
     # enter the bench-gate baseline) but extracted into the trajectory
     # when a full 50k-shape run is appended — the cross-PR history the

@@ -1,0 +1,196 @@
+"""CI gate for `make bench-ingest`: counterbalanced two-replica ingest
+A/B for the shard-filtered reflectors (doc/INGEST.md).
+
+One cluster, one real ApiServer, two RemoteClusters: a FILTERED replica
+scoped to shard 0 of a 2-shard map and an UNFILTERED control.  The gate
+asserts the two acceptance signals from the ingest tentpole:
+
+* **Bandwidth** — the filtered replica's pods+podgroups watch bytes come
+  in under 60% of the control's at 2 shards (server-side selectors must
+  actually drop foreign traffic on the server, not client-side).
+* **Bit-parity at truth** — the filtered mirror equals the control
+  mirror restricted to exactly the scope contract: every podgroup whose
+  queue hashes to an owned shard, every bound pod (assigned stream is
+  unscoped by design — occupancy needs the whole fleet), and every
+  unassigned pod that is unlabeled or labeled with an owned queue.
+  Compared on ENCODED docs, so a drifted field fails loudly.
+
+The A/B is counterbalanced: two passes with the replica start order
+swapped, so connection-order artifacts (resume windows, RV drift)
+cannot manufacture or mask a byte delta.  Vacuity guards reject runs
+where the scope never bound (filtered == control mirror), the control
+saw no traffic, or scoping is disabled via env.
+
+Always prints one JSON artifact line; exits nonzero on any failure.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+import sys
+import time
+
+sys.path.insert(0, str(pathlib.Path(__file__).resolve().parents[1]))
+
+from kube_batch_tpu.api import (Container, ObjectMeta, Pod,  # noqa: E402
+                                PodSpec, PodStatus)
+from kube_batch_tpu.apis.scheduling import v1alpha1  # noqa: E402
+from kube_batch_tpu.cache import Cluster  # noqa: E402
+from kube_batch_tpu.edge import (ApiServer, RemoteCluster,  # noqa: E402
+                                 ShardScope)
+from kube_batch_tpu.edge.codec import encode  # noqa: E402
+from kube_batch_tpu.edge.wire_shard import (QUEUE_LABEL,  # noqa: E402
+                                            wire_shard_enabled)
+from kube_batch_tpu.tenancy.shards import ShardMap  # noqa: E402
+
+N_QUEUES = 4
+N_PODS = 240
+N_GROUPS = 24
+BOUND_EVERY = 8          # 1/8 bound: assigned stream has real traffic
+BANDWIDTH_CEILING = 0.60  # filtered bytes must be < 60% of control
+
+
+def _build_cluster(queues):
+    cluster = Cluster()
+    for q in queues:
+        cluster.create_queue(v1alpha1.Queue(
+            metadata=ObjectMeta(name=q),
+            spec=v1alpha1.QueueSpec(weight=1)))
+    for g in range(N_GROUPS):
+        cluster.create_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name=f"pg-{g}", namespace="ab"),
+            spec=v1alpha1.PodGroupSpec(
+                min_member=1, queue=queues[g % N_QUEUES])))
+    for i in range(N_PODS):
+        q = queues[i % N_QUEUES]
+        cluster.create_pod(Pod(
+            metadata=ObjectMeta(
+                name=f"pod-{i}", namespace="ab", uid=f"pod-{i}",
+                labels={QUEUE_LABEL: q},
+                creation_timestamp=float(i)),
+            spec=PodSpec(
+                node_name=(f"node-{i % 4}"
+                           if i % BOUND_EVERY == 0 else ""),
+                containers=[Container(requests={
+                    "cpu": "500m", "memory": "512Mi"})]),
+            status=PodStatus(phase="Pending")))
+    return cluster
+
+
+def _snapshot(remote):
+    """Encoded-doc view of one replica's pod/podgroup mirrors."""
+    remote.flush_pending()
+    with remote.lock:
+        pods = {k: encode(p) for k, p in remote.pods.items()}
+        groups = {k: encode(g) for k, g in remote.pod_groups.items()}
+    ingest = remote.ingest_bytes()
+    return pods, groups, int(ingest.get("pods", 0)
+                             + ingest.get("podgroups", 0))
+
+
+def _expected_subset(ctrl_pods, ctrl_groups, shard_map, owned):
+    """Restrict the control mirror to the filtered replica's contract."""
+    exp_groups = {k: d for k, d in ctrl_groups.items()
+                  if shard_map.shard_of(d["spec"]["queue"]) in owned}
+    exp_pods = {}
+    for k, d in ctrl_pods.items():
+        if d["spec"].get("nodeName"):
+            exp_pods[k] = d          # assigned stream: whole fleet
+            continue
+        q = (d["metadata"].get("labels") or {}).get(QUEUE_LABEL)
+        if q is None or shard_map.shard_of(q) in owned:
+            exp_pods[k] = d          # unlabeled or own-queue pending
+    return exp_pods, exp_groups
+
+
+def _run_pass(filtered_first):
+    queues = [f"q{i}" for i in range(N_QUEUES)]
+    shard_map = ShardMap(2, overrides={
+        q: i % 2 for i, q in enumerate(queues)})
+    owned = {0}
+    cluster = _build_cluster(queues)
+    server = ApiServer(cluster).start()
+    filtered = RemoteCluster(server.url, timeout=30)
+    filtered.attach_scope(ShardScope(shard_map, owned=lambda: owned))
+    control = RemoteCluster(server.url, timeout=30)
+    order = ((filtered, control) if filtered_first
+             else (control, filtered))
+    try:
+        for r in order:
+            r.start(timeout=60)
+        # Both replicas are past initial sync (start blocks on it); give
+        # any straggler watch frame a beat, then settle on counts.
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            with control.lock:
+                n = len(control.pods)
+            if n == N_PODS:
+                break
+            time.sleep(0.02)
+        f_pods, f_groups, f_bytes = _snapshot(filtered)
+        c_pods, c_groups, c_bytes = _snapshot(control)
+        exp_pods, exp_groups = _expected_subset(
+            c_pods, c_groups, shard_map, owned)
+        return {
+            "order": "filtered-first" if filtered_first
+                     else "control-first",
+            "filtered_bytes": f_bytes,
+            "control_bytes": c_bytes,
+            "ratio": round(f_bytes / c_bytes, 4) if c_bytes else None,
+            "filtered_pods": len(f_pods),
+            "control_pods": len(c_pods),
+            "parity": (f_pods == exp_pods and f_groups == exp_groups),
+            "expected_pods": len(exp_pods),
+        }
+    finally:
+        filtered.stop()
+        control.stop()
+        server.stop()
+
+
+def main() -> int:
+    out = {"shards": 2, "ceiling": BANDWIDTH_CEILING, "passes": []}
+    failures = []
+    if not wire_shard_enabled():
+        failures.append("KUBE_BATCH_TPU_WIRE_SHARD=0: scoping disabled, "
+                        "the A/B would compare unfiltered to unfiltered")
+    else:
+        for filtered_first in (True, False):
+            try:
+                out["passes"].append(_run_pass(filtered_first))
+            except Exception as exc:  # noqa: BLE001 — artifact stays honest
+                failures.append(f"pass crashed: {type(exc).__name__}: {exc}")
+                break
+    for p in out["passes"]:
+        tag = p["order"]
+        if p["control_bytes"] <= 0 or p["control_pods"] != N_PODS:
+            failures.append(f"{tag}: VACUOUS — control saw "
+                            f"{p['control_pods']}/{N_PODS} pods, "
+                            f"{p['control_bytes']} bytes")
+        if p["filtered_pods"] >= p["control_pods"]:
+            failures.append(f"{tag}: VACUOUS — scope never bound "
+                            f"(filtered mirror {p['filtered_pods']} >= "
+                            f"control {p['control_pods']})")
+        if not p["parity"]:
+            failures.append(f"{tag}: PARITY FAILURE — filtered mirror "
+                            "!= control mirror restricted to the scope "
+                            "contract")
+        if p["ratio"] is None or p["ratio"] >= BANDWIDTH_CEILING:
+            failures.append(f"{tag}: BANDWIDTH — filtered/control byte "
+                            f"ratio {p['ratio']} >= {BANDWIDTH_CEILING}")
+    out["ok"] = not failures
+    out["failures"] = failures
+    print(json.dumps(out))
+    if failures:
+        for f in failures:
+            print(f"check_ingest_ab: {f}", file=sys.stderr)
+        return 1
+    ratios = [p["ratio"] for p in out["passes"]]
+    print(f"ingest A/B: parity OK in both orders; byte ratios {ratios} "
+          f"< {BANDWIDTH_CEILING} at 2 shards")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

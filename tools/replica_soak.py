@@ -54,15 +54,20 @@ from kube_batch_tpu.metrics.metrics import (compile_cache_counts,  # noqa: E402
                                             shard_bind_counts,
                                             shard_rebalance_counts,
                                             shard_session_counts)
+from kube_batch_tpu.edge.wire_shard import QUEUE_LABEL  # noqa: E402
 from kube_batch_tpu.scheduler import Scheduler  # noqa: E402
 from kube_batch_tpu.tenancy import (ShardLeaseManager, ShardMap,  # noqa: E402
                                     TenancyEngine)
 
 
-def _mk_pod(name, group, ns="soak", cpu="1", mem="1Gi"):
+def _mk_pod(name, group, ns="soak", cpu="1", mem="1Gi", queue=""):
+    # The queue label makes the pod shard-attributable SERVER-SIDE, so
+    # a scoped edge replica's unassigned stream can drop foreign-shard
+    # pods on the server instead of shipping them (doc/INGEST.md).
+    labels = {QUEUE_LABEL: queue} if queue else None
     return Pod(
         metadata=ObjectMeta(
-            name=name, namespace=ns,
+            name=name, namespace=ns, labels=labels,
             annotations={v1alpha1.GroupNameAnnotationKey: group}),
         spec=PodSpec(node_name="",
                      containers=[Container(
@@ -75,7 +80,8 @@ def _submit_job(cluster, name, replicas, queue, ns="soak"):
         metadata=ObjectMeta(name=name, namespace=ns),
         spec=v1alpha1.PodGroupSpec(min_member=replicas, queue=queue)))
     for i in range(replicas):
-        cluster.create_pod(_mk_pod(f"{name}-{i}", name, ns=ns))
+        cluster.create_pod(_mk_pod(f"{name}-{i}", name, ns=ns,
+                                   queue=queue))
 
 
 class TruthMonitor:
@@ -119,11 +125,15 @@ class Replica:
                  edge: bool = False, period: float = 0.15):
         self.name = name
         self.period = period
+        self.shard_map = shard_map
         self._server = self._remote = None
         if edge:
             from kube_batch_tpu.edge import ApiServer, RemoteCluster
             self._server = ApiServer(truth).start()
-            self._remote = RemoteCluster(self._server.url).start()
+            # Created UNSTARTED: the shard scope must be attached before
+            # the reflectors connect so the very first watch carries the
+            # shard-filtered selectors (doc/INGEST.md).
+            self._remote = RemoteCluster(self._server.url)
             store = self._remote
         else:
             store = truth
@@ -138,6 +148,15 @@ class Replica:
         self.engine = TenancyEngine(self.scheduler, shard_map,
                                     lease_mgr=self.leases)
         self.scheduler.tenancy = self.engine
+        if self._remote is not None:
+            # AFTER attach_leases (the engine constructor ran it): the
+            # helper pins the count-based claim rule and chains the
+            # lease on_change hook into scope bumps, so every claim/
+            # steal/shed triggers a scoped relist on this replica.
+            from kube_batch_tpu.edge.wire_shard import attach_shard_scope
+            self.scope = attach_shard_scope(self._remote, shard_map,
+                                            self.leases)
+            self._remote.start()
         self._stop = threading.Event()
         self._thread = threading.Thread(target=self._loop, daemon=True,
                                         name=f"replica-{name}")
@@ -154,6 +173,30 @@ class Replica:
 
     def owned(self):
         return self.leases.owned_shards()
+
+    def stale_mirror_entries(self):
+        """Scoped-mirror hygiene probe: entries this replica's CURRENT
+        shard ownership does not justify — podgroups of unowned queues
+        and queue-labeled UNASSIGNED pods of unowned queues (bound pods
+        are whole-fleet by design: occupancy needs them).  Nonempty
+        after a handover settles means a shed/steal left stale state
+        behind (doc/INGEST.md "Handover")."""
+        if self._remote is None or getattr(self, "scope", None) is None:
+            return []
+        owned = set(self.leases.owned_shards())
+        stale = []
+        with self._remote.lock:
+            for key, group in self._remote.pod_groups.items():
+                if self.shard_map.shard_of(group.spec.queue) not in owned:
+                    stale.append(f"podgroup:{key}")
+            for key, pod in self._remote.pods.items():
+                if pod.spec.node_name:
+                    continue
+                q = (pod.metadata.labels or {}).get(QUEUE_LABEL)
+                if q is not None \
+                        and self.shard_map.shard_of(q) not in owned:
+                    stale.append(f"pod:{key}")
+        return stale
 
     def kill(self) -> None:
         """Crash semantics: the loop dies, the leases are NOT released —
@@ -391,6 +434,29 @@ def run_soak(*, replicas: int = 3, shards: int = 3, nodes: int = 12,
                     f"queue {q}: {bound_by_queue.get(q, 0)} bound vs "
                     f"{want} expected (per-tenant demand not met)")
 
+        # Shard-scoped ingest hygiene (doc/INGEST.md): after the mid-
+        # soak steal settles, a scoped edge replica's mirror must hold
+        # ZERO stale-shard entries — no podgroup and no unassigned pod
+        # of a queue whose shard it does not own (shed purges + scoped
+        # relists both worked).  Deadline loop: the post-steal relist
+        # is asynchronous.
+        edge_stale = None
+        for rep in fleet:
+            if rep._remote is None or rep is killed \
+                    or getattr(rep, "scope", None) is None:
+                continue
+            deadline = time.time() + 15
+            stale = rep.stale_mirror_entries()
+            while stale and time.time() < deadline:
+                time.sleep(0.05)
+                stale = rep.stale_mirror_entries()
+            edge_stale = len(stale)
+            if stale:
+                problems.append(
+                    f"replica {rep.name}: {len(stale)} stale-shard "
+                    f"mirror entries after the steal settled: "
+                    f"{sorted(stale)[:6]}")
+
         problems.extend(monitor.violations)
         stamped = shard_bind_counts()
         if not stamped:
@@ -406,6 +472,7 @@ def run_soak(*, replicas: int = 3, shards: int = 3, nodes: int = 12,
             "binds": len(monitor.binds),
             "rejected_rebinds": len(monitor.rejected_rebinds),
             "orphaned_shards": sorted(orphaned or ()),
+            "edge_stale_entries": edge_stale,
             "reclaim_s": (round(reclaim_s, 3)
                           if reclaim_s is not None else None),
             "bound_by_queue": bound_by_queue,
@@ -457,7 +524,8 @@ def run_skewed_load_check(*, shards: int = 4, lease_duration: float = 3.0,
         metadata=ObjectMeta(name="whale", namespace="soak"),
         spec=v1alpha1.PodGroupSpec(min_member=whale_pods, queue="q0")))
     for i in range(whale_pods):
-        truth.create_pod(_mk_pod(f"whale-{i}", "whale", cpu="64"))
+        truth.create_pod(_mk_pod(f"whale-{i}", "whale", cpu="64",
+                                 queue="q0"))
     for qi in range(1, shards):
         _submit_job(truth, f"small-{qi}", 2, queues[qi])
 

@@ -19,14 +19,21 @@ GATE_ENV = env JAX_PLATFORMS=cpu BENCH_STEADY_ONLY=1 BENCH_STEADY_ROUNDS=8 \
 
 # graftlint: the repo's contract-enforcing static analysis (doc/LINT.md)
 # — lock discipline, donation safety, tracer hygiene, ship/no-mutate
-# contracts, exception policy.  Zero runtime deps (stdlib ast only), so
-# it runs before — and much faster than — the test suite.
+# contracts, exception policy, plus the whole-program registries (knobs,
+# metrics, chaos sites, thread lifecycle).  Zero runtime deps (stdlib
+# ast only), so it runs before — and much faster than — the test suite.
+# A typo'd target path exits 2 (never lints zero files and passes);
+# --max-seconds keeps the linter cheap enough to gate every push.
+LINT_TARGETS = kube_batch_tpu bench.py tools tests
 lint:
-	$(PYTHON) -m tools.graftlint kube_batch_tpu bench.py
+	$(PYTHON) -m tools.graftlint $(LINT_TARGETS) --max-seconds 15
 
-# Greppable audit trail of every annotation/suppression marker.
+# Greppable audit trail of every annotation/suppression marker, plus
+# the regenerated knob table in doc/INVENTORY.md (the registry in
+# kube_batch_tpu/knobs.py is the source of truth; CI diffs the result).
 lint-inventory:
-	$(PYTHON) -m tools.graftlint kube_batch_tpu bench.py --inventory
+	$(PYTHON) -m tools.graftlint $(LINT_TARGETS) --inventory \
+		--write-knob-inventory doc/INVENTORY.md
 
 # Tier-1 verify: lint first (cheap, catches contract breaks in seconds),
 # then the exact pytest line ROADMAP.md pins (CPU-pinned, slow markers

@@ -17,9 +17,9 @@ from __future__ import annotations
 
 import contextlib
 import logging
-import os
 import time
 
+from .. import knobs
 from ..framework import Action
 from ..metrics import metrics
 from ..trace import spans as trace
@@ -28,16 +28,16 @@ log = logging.getLogger(__name__)
 
 # Set to a directory path to capture an XLA profiler trace of each session
 # solve (the sidecar profiling hook, SURVEY.md §5).
-PROFILE_ENV = "KUBE_BATCH_TPU_PROFILE"
+PROFILE_ENV = knobs.PROFILE.env
 # =0 runs the pre-pipeline sequential path (solve barrier, then apply
 # preparation): the A/B control and parity oracle for the pipelined
 # engine (doc/PIPELINE.md; tests/test_pipeline.py proves both paths
 # produce identical placements, events, and binds).
-PIPELINE_ENV = "KUBE_BATCH_TPU_PIPELINE"
+PIPELINE_ENV = knobs.PIPELINE.env
 
 
 def _maybe_profile():
-    profile_dir = os.environ.get(PROFILE_ENV)
+    profile_dir = knobs.PROFILE.raw()
     if not profile_dir:
         return contextlib.nullcontext()
     import jax
@@ -234,7 +234,7 @@ class TpuAllocateAction(Action):
                     and inc_state.solve_cfg == snap.config
                     and inc_state.solve_result is not None):
                 cached_solve = inc_state.solve_result
-            pipelined = os.environ.get(PIPELINE_ENV, "1") != "0"
+            pipelined = knobs.PIPELINE.enabled()
             # Candidate-row solve prefilter (ops/prefilter.py,
             # doc/INCREMENTAL.md "floors"): on a micro build the host
             # derives the provably-sufficient candidate node set from

@@ -9,15 +9,15 @@ excludes nodes whose Used exceeds allocatable.
 
 from __future__ import annotations
 
-import os
 from typing import Dict, Optional
 
+from .. import knobs
 from .objects import Node, pod_key
 from .resource import Resource
 from .types import NodePhase, NodeState, TaskStatus
 from .job_info import TaskInfo
 
-LAZY_TASKS_ENV = "KUBE_BATCH_TPU_LAZY_TASKS"
+LAZY_TASKS_ENV = knobs.LAZY_TASKS.env
 
 
 def lazy_tasks_enabled() -> bool:
@@ -25,7 +25,7 @@ def lazy_tasks_enabled() -> bool:
     per-resident ``clone_lite`` until something actually reads task
     values.  ``KUBE_BATCH_TPU_LAZY_TASKS=0`` restores the eager clones
     (the bit-parity control)."""
-    return os.environ.get(LAZY_TASKS_ENV, "1") != "0"
+    return knobs.LAZY_TASKS.enabled()
 
 
 class LazyTaskDict(dict):

@@ -10,12 +10,12 @@ shadow groups.
 
 from __future__ import annotations
 
-import os
 import random
 import threading
 import time
 from typing import Dict, List, Optional
 
+from .. import knobs
 from ..api import (ClusterInfo, JobInfo, NodeInfo, Pod, PodGroup, QueueInfo,
                    TaskInfo, TaskStatus, get_job_id, job_terminated,
                    pod_key)
@@ -34,20 +34,14 @@ from .shadow import create_shadow_pod_group, shadow_group_key, shadow_pod_group
 # bounded exponential backoff + full jitter; ambiguous outcomes (the POST
 # was delivered, the outcome unproven) are never retried — a duplicate
 # Binding POST is not idempotent — and route through resync instead.
-BIND_RETRIES_ENV = "KUBE_BATCH_TPU_BIND_RETRIES"
-_DEF_BIND_RETRIES = 2
+BIND_RETRIES_ENV = knobs.BIND_RETRIES.env
+_DEF_BIND_RETRIES = knobs.BIND_RETRIES.default
 _BIND_BACKOFF_BASE_S = 0.05
 _BIND_BACKOFF_CAP_S = 0.5
 
 
 def _bind_retries() -> int:
-    raw = os.environ.get(BIND_RETRIES_ENV)
-    if raw:
-        try:
-            return max(0, int(raw))
-        except ValueError:
-            pass
-    return _DEF_BIND_RETRIES
+    return knobs.BIND_RETRIES.value()
 
 
 def _backoff_sleep(delay: float) -> float:

@@ -31,16 +31,17 @@ devices degrade to the host path exactly like erroring ones.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from typing import Callable, Optional
 
-THRESHOLD_ENV = "KUBE_BATCH_TPU_BREAKER_THRESHOLD"
-COOLDOWN_ENV = "KUBE_BATCH_TPU_BREAKER_COOLDOWN_S"
-SOLVE_DEADLINE_ENV = "KUBE_BATCH_TPU_SOLVE_DEADLINE_MS"
-_DEF_THRESHOLD = 3
-_DEF_COOLDOWN_S = 30.0
+from .. import knobs
+
+THRESHOLD_ENV = knobs.BREAKER_THRESHOLD.env
+COOLDOWN_ENV = knobs.BREAKER_COOLDOWN_S.env
+SOLVE_DEADLINE_ENV = knobs.SOLVE_DEADLINE_MS.env
+_DEF_THRESHOLD = knobs.BREAKER_THRESHOLD.default
+_DEF_COOLDOWN_S = knobs.BREAKER_COOLDOWN_S.default
 
 CLOSED = "closed"
 HALF_OPEN = "half-open"
@@ -48,21 +49,9 @@ OPEN = "open"
 _STATE_CODE = {CLOSED: 0.0, HALF_OPEN: 1.0, OPEN: 2.0}
 
 
-def _env_number(name: str, default: float, cast=float) -> float:
-    """Tuning-knob parse that cannot take down a degradation chokepoint:
-    a malformed value falls back to the default instead of raising."""
-    raw = os.environ.get(name)
-    if not raw:
-        return default
-    try:
-        return cast(raw)
-    except ValueError:
-        return default
-
-
 def solve_deadline_s() -> float:
     """The per-session solve deadline in seconds; 0.0 = disabled."""
-    return max(0.0, _env_number(SOLVE_DEADLINE_ENV, 0.0) / 1e3)
+    return max(0.0, knobs.SOLVE_DEADLINE_MS.value() / 1e3)
 
 
 class CircuitBreaker:
@@ -72,10 +61,9 @@ class CircuitBreaker:
                  clock: Callable[[], float] = time.monotonic):
         self.name = name
         self.threshold = (threshold if threshold is not None
-                          else int(_env_number(THRESHOLD_ENV,
-                                               _DEF_THRESHOLD, int)))
+                          else knobs.BREAKER_THRESHOLD.value())
         self.cooldown = (cooldown if cooldown is not None
-                         else _env_number(COOLDOWN_ENV, _DEF_COOLDOWN_S))
+                         else knobs.BREAKER_COOLDOWN_S.value())
         self._clock = clock
         self._lock = threading.Lock()
         self._state = CLOSED     # guarded-by: _lock

@@ -42,11 +42,12 @@ from __future__ import annotations
 
 import fnmatch
 import hashlib
-import os
 import threading
 from typing import Dict, NamedTuple, Optional, Tuple
 
-CHAOS_ENV = "KUBE_BATCH_TPU_CHAOS"
+from .. import knobs
+
+CHAOS_ENV = knobs.CHAOS.env
 
 _DEFAULT_RATE = 0.2
 
@@ -213,7 +214,7 @@ def plan_from_spec(spec: Optional[str]) -> Optional[FaultPlan]:
 # (``chaos.PLAN``), never from-imported, so install/disable take effect
 # immediately.  Parsed once at import: a chaos run sets the env before
 # the process starts; in-process harnesses use install()/disable().
-PLAN: Optional[FaultPlan] = plan_from_spec(os.environ.get(CHAOS_ENV))
+PLAN: Optional[FaultPlan] = plan_from_spec(knobs.CHAOS.raw())
 
 
 def active() -> Optional[FaultPlan]:
@@ -234,5 +235,5 @@ def disable() -> None:
 
 def reload_from_env() -> Optional[FaultPlan]:
     global PLAN
-    PLAN = plan_from_spec(os.environ.get(CHAOS_ENV))
+    PLAN = plan_from_spec(knobs.CHAOS.raw())
     return PLAN

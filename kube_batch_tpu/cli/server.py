@@ -199,6 +199,14 @@ def start_metrics_server(listen_address: str) -> ThreadingHTTPServer:
     return server
 
 
+def stop_metrics_server(server: ThreadingHTTPServer) -> None:
+    """Tear down a start_metrics_server() server: stops serve_forever
+    (the serving thread exits with it) and closes the listening socket —
+    shutdown() alone leaks the bound port for the life of the process."""
+    server.shutdown()
+    server.server_close()
+
+
 def load_cluster_state(cluster: Cluster, path: str) -> None:
     """Populate the simulator from a JSON snapshot file (the standalone
     analog of pointing --master at an API server)."""
@@ -454,4 +462,4 @@ class ServerRuntime:
         if recorder is not None and hasattr(recorder, "stop"):
             recorder.stop()
         if self.metrics_server is not None:
-            self.metrics_server.shutdown()
+            stop_metrics_server(self.metrics_server)

@@ -25,11 +25,12 @@ Unset or empty = unbounded (the pre-budget behavior).
 from __future__ import annotations
 
 import json
-import os
 import zlib
 from typing import Dict, Optional
 
-BASELINE_BUDGET_ENV = "KUBE_BATCH_TPU_BASELINE_BUDGET"
+from .. import knobs
+
+BASELINE_BUDGET_ENV = knobs.BASELINE_BUDGET.env
 
 _SUFFIX = {"k": 1024, "m": 1024 ** 2, "g": 1024 ** 3}
 
@@ -53,7 +54,7 @@ def parse_budgets(spec: Optional[str] = None) -> Dict[str, int]:
     ValueError at construction — a budget typo must fail loudly at
     boot, not silently disable the cap."""
     if spec is None:
-        spec = os.environ.get(BASELINE_BUDGET_ENV, "")
+        spec = knobs.BASELINE_BUDGET.raw() or ""
     spec = spec.strip()
     if not spec:
         return {}

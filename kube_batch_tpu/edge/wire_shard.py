@@ -36,15 +36,15 @@ MODIFIED-frame deferral (edge/client.flush_pending) eager.
 
 from __future__ import annotations
 
-import os
 import threading
 from typing import Callable, Dict, Iterable, List, Optional
 
+from .. import knobs
 from ..apis.scheduling.v1alpha1 import GroupNameAnnotationKey
 from . import selectors as _selectors
 
-WIRE_SHARD_ENV = "KUBE_BATCH_TPU_WIRE_SHARD"
-LAZY_MIRROR_ENV = "KUBE_BATCH_TPU_LAZY_MIRROR"
+WIRE_SHARD_ENV = knobs.WIRE_SHARD.env
+LAZY_MIRROR_ENV = knobs.LAZY_MIRROR.env
 
 # Pods carry their queue as a label so the SERVER can shard-filter the
 # watch (annotations are not selectable — the k8s contract).  Pods
@@ -56,11 +56,11 @@ QUEUE_LABEL = "queue.kube-batch.tpu/name"
 
 
 def wire_shard_enabled() -> bool:
-    return os.environ.get(WIRE_SHARD_ENV, "1") != "0"
+    return knobs.WIRE_SHARD.enabled()
 
 
 def lazy_mirror_enabled() -> bool:
-    return os.environ.get(LAZY_MIRROR_ENV, "1") != "0"
+    return knobs.LAZY_MIRROR.enabled()
 
 
 def queue_of_pod_doc(doc, pod_groups, wire: str) -> Optional[str]:

@@ -39,15 +39,16 @@ dropped or double-applied.  Ambiguous outcomes are never re-driven
 from __future__ import annotations
 
 import contextlib
-import os
 import time
 from typing import List, Tuple
 
-BATCH_COMMIT_ENV = "KUBE_BATCH_TPU_BATCH_COMMIT"
+from .. import knobs
+
+BATCH_COMMIT_ENV = knobs.BATCH_COMMIT.env
 
 
 def batch_commit_enabled() -> bool:
-    return os.environ.get(BATCH_COMMIT_ENV, "1") != "0"
+    return knobs.BATCH_COMMIT.enabled()
 
 
 class CommitSink:

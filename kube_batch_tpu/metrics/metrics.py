@@ -11,10 +11,11 @@ counts, job_retry_counts.  Exposition-format text is served by
 from __future__ import annotations
 
 import logging
-import os
 import threading
 from collections import defaultdict
 from typing import Dict, List, Tuple
+
+from .. import knobs
 
 SUBSYSTEM = "kube_batch"
 
@@ -30,8 +31,8 @@ log = logging.getLogger(__name__)
 # is validated like ops/solver.shard_knobs: a malformed value warns
 # loudly exactly once and pins the default.
 
-SERIES_CAP_ENV = "KUBE_BATCH_TPU_METRIC_SERIES_CAP"
-DEFAULT_SERIES_CAP = 64
+SERIES_CAP_ENV = knobs.METRIC_SERIES_CAP.env
+DEFAULT_SERIES_CAP = knobs.METRIC_SERIES_CAP.default
 
 _series_lock = threading.Lock()
 _series_seen: Dict[str, set] = {}       # guarded-by: _series_lock
@@ -40,21 +41,7 @@ OTHER_LABEL = "other"
 
 
 def _resolve_series_cap() -> int:
-    raw = os.environ.get(SERIES_CAP_ENV)
-    if not raw:
-        return DEFAULT_SERIES_CAP
-    try:
-        cap = int(raw)
-        if cap < 1:
-            raise ValueError(raw)
-        return cap
-    except ValueError:
-        log.warning(
-            "%s=%r is not a positive integer; pinning the default %d for "
-            "the life of this process (fix the env and restart, or call "
-            "metrics.refresh_series_cap())", SERIES_CAP_ENV, raw,
-            DEFAULT_SERIES_CAP)
-        return DEFAULT_SERIES_CAP
+    return knobs.METRIC_SERIES_CAP.value()
 
 
 def refresh_series_cap() -> int:

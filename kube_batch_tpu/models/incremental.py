@@ -46,27 +46,27 @@ so the fallback-to-full-rebuild path stays exercised (doc/CHAOS.md).
 
 from __future__ import annotations
 
-import os
 from typing import Dict, List, Optional, Tuple
 
+from .. import knobs
 from ..chaos import plan as chaos_plan
 from ..metrics import metrics
 from ..trace import spans as trace
 
 # =0 restores the sequential control: full tensorize scans, uncached
 # plugin opens, a fresh solve every cycle, fixed-period scheduling.
-INCREMENTAL_ENV = "KUBE_BATCH_TPU_INCREMENTAL"
+INCREMENTAL_ENV = knobs.INCREMENTAL.env
 # Wire-to-tensor fast path (doc/INCREMENTAL.md "Wire fast path"): =0 is
 # the sequential control for the L1 columnar watch-delta decode
 # (edge/codec), the persistent candidate-row staging buffers
 # (tensor_snapshot), and the vectorized drf/job-valid/gang-close walks
 # below — `make bench-wire` pins binds+events bit-identical across it.
-WIRE_FAST_ENV = "KUBE_BATCH_TPU_WIRE_FAST"
+WIRE_FAST_ENV = knobs.WIRE_FAST.env
 # Periodic full-session floor (scheduler.py): every K cycles the loop
 # requests a full rebuild so incremental drift cannot accumulate
 # silently.  0 disables the floor.
-FULL_EVERY_ENV = "KUBE_BATCH_TPU_FULL_EVERY"
-DEFAULT_FULL_EVERY = 16
+FULL_EVERY_ENV = knobs.FULL_EVERY.env
+DEFAULT_FULL_EVERY = knobs.FULL_EVERY.default
 
 # Above this dirty fraction the micro patch moves more rows than a full
 # rebuild saves — mirror of the delta shipper's _DELTA_MAX_FRACTION.
@@ -79,21 +79,15 @@ _EXACT_LIMIT = float(2 ** 50)
 
 
 def incremental_enabled() -> bool:
-    return os.environ.get(INCREMENTAL_ENV, "1") != "0"
+    return knobs.INCREMENTAL.enabled()
 
 
 def wire_fast_enabled() -> bool:
-    return os.environ.get(WIRE_FAST_ENV, "1") != "0"
+    return knobs.WIRE_FAST.enabled()
 
 
 def full_session_every() -> int:
-    raw = os.environ.get(FULL_EVERY_ENV)
-    if raw:
-        try:
-            return max(0, int(raw))
-        except ValueError:
-            pass
-    return DEFAULT_FULL_EVERY
+    return knobs.FULL_EVERY.value()
 
 
 def resource_exact(res) -> bool:

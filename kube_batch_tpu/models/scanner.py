@@ -20,6 +20,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from .. import knobs
 from ..api import TaskInfo
 from ..ops.resources import quantize_value
 from ..ops.scan import ScanStatics, best_scan_nodes
@@ -27,13 +28,13 @@ from ..ops.scoring import SCORE_NEG_INF
 
 # Node counts below this are cheaper as the plain per-node object walk
 # than tensorizing at all; tests set 0 to force the scanner.
-SCAN_MIN_NODES_ENV = "KUBE_BATCH_TPU_SCAN_MIN_NODES"
-DEFAULT_SCAN_MIN_NODES = 64
+SCAN_MIN_NODES_ENV = knobs.SCAN_MIN_NODES.env
+DEFAULT_SCAN_MIN_NODES = knobs.SCAN_MIN_NODES.default
 # The scan math is exact int32 either way; numpy wins whenever host<->device
 # transfer latency exceeds the ~N*40 integer ops (always true on the
 # tunneled dev chip), the jitted kernel when node state is huge or the TPU
 # is local.  Set =1 to run the scan on device.
-SCAN_DEVICE_ENV = "KUBE_BATCH_TPU_SCAN_DEVICE"
+SCAN_DEVICE_ENV = knobs.SCAN_DEVICE.env
 
 # Distinct task profiles whose score vectors stay warm at once; a storm
 # interleaves preemptors of a handful of profiles, far under this.
@@ -43,18 +44,18 @@ _SCORE_CACHE_CAP = 64
 # at the cost of one [N] copy per call.  Default off — the fast path's
 # no-retain/no-mutate contract is machine-checked by graftlint's
 # frozen-after rule instead — and on in tests (tests/conftest.py).
-SAFE_SCORES_ENV = "KUBE_BATCH_TPU_SAFE_SCORES"
+SAFE_SCORES_ENV = knobs.SAFE_SCORES.env
 # Batched eviction engine (doc/EVICTION.md): =0 restores the sequential
 # control — one scanner per action, one score solve per preemptor, host
 # victim sorts — with bit-identical placements and victim choices.
-BATCH_EVICT_ENV = "KUBE_BATCH_TPU_BATCH_EVICT"
+BATCH_EVICT_ENV = knobs.BATCH_EVICT.env
 # Whether the batched engine stages its device statics through the
 # DeviceResidentShipper (delta against the resident SolverInputs buffer).
 # Default auto: on for real accelerators (the tunnel charges fixed
 # latency per transfer, so reusing the resident buffer beats six leaf
 # transfers), off on CPU where a ship is just a large memcpy that the
 # plain per-leaf asarray path undercuts.  =1/=0 force.
-EVICT_SHIP_ENV = "KUBE_BATCH_TPU_EVICT_SHIP"
+EVICT_SHIP_ENV = knobs.EVICT_SHIP.env
 # Dirty-row patches at or under this many rows take the scalar Python
 # scorer (_score_rows_py) instead of numpy: the per-call numpy overhead
 # (slicing eight statics, ~20 tiny-array ops) dominates 1-4 row patches,
@@ -63,17 +64,14 @@ _PY_PATCH_MAX = 8
 
 
 def batch_evict_enabled() -> bool:
-    import os
-    return os.environ.get(BATCH_EVICT_ENV, "1") != "0"
+    return knobs.BATCH_EVICT.enabled()
 
 
 def _shipper_wanted(route: str = "xla") -> bool:
-    import os
-    forced = os.environ.get(EVICT_SHIP_ENV)
+    forced = knobs.EVICT_SHIP.tristate()
     if forced is not None:
-        return forced == "1"
-    from .shipping import DELTA_SHIP_ENV
-    if route == "sharded" and os.environ.get(DELTA_SHIP_ENV, "1") != "0":
+        return forced
+    if route == "sharded" and knobs.DELTA_SHIP.enabled():
         # The mesh-routed eviction engine reads the shipper's resident
         # sharded node leaves in place (doc/SHARDING.md): without the
         # shipper the batched dispatch would fall back to single-chip
@@ -88,12 +86,9 @@ def _shipper_wanted(route: str = "xla") -> bool:
 
 def _build_scanner(ssn, use_shipper: bool = False
                    ) -> Optional["DeviceNodeScanner"]:
-    import os
-
     from ..chaos.breaker import device_breaker
     from .tensor_snapshot import tensorize_session
-    min_nodes = int(os.environ.get(SCAN_MIN_NODES_ENV,
-                                   DEFAULT_SCAN_MIN_NODES))
+    min_nodes = knobs.SCAN_MIN_NODES.value()
     if len(ssn.nodes) < min_nodes:
         return None
     breaker = device_breaker()
@@ -631,9 +626,7 @@ class DeviceNodeScanner:
         ``.scores(...)`` call (doc/LINT.md rule 4), and
         ``KUBE_BATCH_TPU_SAFE_SCORES=1`` (tests' default) returns a
         defensive copy so a contract hole corrupts nothing there."""
-        import os
-
-        safe = os.environ.get(SAFE_SCORES_ENV) == "1"
+        safe = knobs.SAFE_SCORES.enabled()
         self._consume_batch()
         ti = self.task_index.get(task.uid)
         if ti is None:
@@ -641,7 +634,7 @@ class DeviceNodeScanner:
         key = self._profile_key(ti)
         log = self._edit_log
         entry = self._score_cache.get(key)
-        if entry is None and os.environ.get(SCAN_DEVICE_ENV) == "1":
+        if entry is None and knobs.SCAN_DEVICE.enabled():
             # Per-row device scan (opt-in env).  A batch-seeded profile
             # skips this: its row already came back from the ONE batched
             # dispatch and only dirty rows need the numpy patch —

@@ -42,13 +42,13 @@ solve-result reuse works on the mesh as-is
 from __future__ import annotations
 
 import functools
-import os
 import warnings
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from .. import knobs
 from ..ops.compile_cache import bucket
 from ..ops.solver import SolverInputs
 
@@ -62,7 +62,7 @@ _BLOCK = 512
 _DELTA_MAX_FRACTION = 0.5
 # Escape hatch for A/B measurement and field debugging: =0 disables the
 # device-resident path entirely (every session full-ships, no state kept).
-DELTA_SHIP_ENV = "KUBE_BATCH_TPU_DELTA_SHIP"
+DELTA_SHIP_ENV = knobs.DELTA_SHIP.env
 
 
 def _kind_of(dtype: np.dtype) -> str:
@@ -402,7 +402,7 @@ class DeviceResidentShipper:
 
         if float_dtype is None:
             float_dtype = _default_float_dtype()
-        if os.environ.get(DELTA_SHIP_ENV, "1") == "0":
+        if not knobs.DELTA_SHIP.enabled():
             self._state = None  # clean A/B: no stale image survives
             self.generation += 1
             spec, flat, treedef = _pack_host(inp, float_dtype)
@@ -707,7 +707,7 @@ def dirty_shard_probe(inp: SolverInputs, cfg=None) -> dict:
     probe = {"route": route, "mesh_devices": mesh.size if mesh else 1}
     if route != "sharded":
         return probe
-    if os.environ.get(DELTA_SHIP_ENV, "1") == "0":
+    if not knobs.DELTA_SHIP.enabled():
         # Residency disabled (the A/B escape hatch): there is no resident
         # image to delta against — report the misconfiguration instead
         # of crashing on the stateless ship.

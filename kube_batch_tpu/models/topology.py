@@ -36,25 +36,26 @@ tests/test_topology.py).
 from __future__ import annotations
 
 import logging
-import os
 import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from .. import knobs
+
 log = logging.getLogger(__name__)
 
-TOPOLOGY_ENV = "KUBE_BATCH_TPU_TOPOLOGY"
+TOPOLOGY_ENV = knobs.TOPOLOGY.env
 # Batched-vs-sequential control: =0 computes every box scan through the
 # pure-numpy sequential oracle (bit-identical stats by the parity suite).
-TOPO_BATCH_ENV = "KUBE_BATCH_TPU_TOPO_BATCH"
+TOPO_BATCH_ENV = knobs.TOPO_BATCH.env
 # Defrag-aware eviction: =0 degrades the no-free-box path to the
 # capacity-only evictor (the A/B control `make bench-topo` contrasts).
-TOPO_DEFRAG_ENV = "KUBE_BATCH_TPU_TOPO_DEFRAG"
+TOPO_DEFRAG_ENV = knobs.TOPO_DEFRAG.env
 # Beyond this many coordinate-labeled nodes the O(N^2) box scan is not
 # dispatched and slice jobs stay pending (counted, documented).
-TOPO_MAX_NODES_ENV = "KUBE_BATCH_TPU_TOPO_MAX_NODES"
-DEFAULT_TOPO_MAX_NODES = 4096
+TOPO_MAX_NODES_ENV = knobs.TOPO_MAX_NODES.env
+DEFAULT_TOPO_MAX_NODES = knobs.TOPO_MAX_NODES.default
 
 LABEL_PREFIX = "topology.kube-batch.tpu/"
 POD_LABEL = LABEL_PREFIX + "pod"
@@ -76,20 +77,19 @@ COORD_WIDTH = 8
 
 
 def topology_enabled() -> bool:
-    return os.environ.get(TOPOLOGY_ENV, "1") != "0"
+    return knobs.TOPOLOGY.enabled()
 
 
 def topo_batch_enabled() -> bool:
-    return os.environ.get(TOPO_BATCH_ENV, "1") != "0"
+    return knobs.TOPO_BATCH.enabled()
 
 
 def topo_defrag_enabled() -> bool:
-    return os.environ.get(TOPO_DEFRAG_ENV, "1") != "0"
+    return knobs.TOPO_DEFRAG.enabled()
 
 
 def topo_max_nodes() -> int:
-    from ..trace.lineage import validated_ring_env
-    return validated_ring_env(TOPO_MAX_NODES_ENV, DEFAULT_TOPO_MAX_NODES)
+    return knobs.TOPO_MAX_NODES.value()
 
 
 def parse_coord_labels(labels: Dict[str, str]) -> Optional[tuple]:

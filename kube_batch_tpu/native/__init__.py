@@ -20,6 +20,8 @@ import subprocess
 import sys
 import sysconfig
 
+from .. import knobs
+
 _DIR = os.path.dirname(__file__)
 _SRC = os.path.join(_DIR, "fastpath.c")
 _SO = os.path.join(
@@ -39,7 +41,7 @@ def _build() -> bool:
 
 
 def _load():
-    if os.environ.get("KUBE_BATCH_TPU_NO_NATIVE"):
+    if knobs.NO_NATIVE.enabled():
         return None
     if (not os.path.exists(_SO)
             or os.path.getmtime(_SO) < os.path.getmtime(_SRC)):

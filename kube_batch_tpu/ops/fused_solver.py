@@ -47,7 +47,6 @@ per-family paths' own host oracles below that (doc/CHAOS.md).
 from __future__ import annotations
 
 import functools
-import os
 import time
 from typing import NamedTuple, Optional
 
@@ -55,7 +54,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-FUSED_ENV = "KUBE_BATCH_TPU_FUSED"
+from .. import knobs
+
+FUSED_ENV = knobs.FUSED.env
 FUSED_SOLVE_CHOICE = "fused"
 
 # Leg outcome vocabulary (kube_batch_tpu_fused_legs_total{outcome=}):
@@ -68,7 +69,7 @@ FUSED_SOLVE_CHOICE = "fused"
 
 
 def fused_enabled() -> bool:
-    return os.environ.get(FUSED_ENV, "1") != "0"
+    return knobs.FUSED.enabled()
 
 
 class _AllocLeg(NamedTuple):
@@ -218,8 +219,7 @@ def _stage_alloc(ssn, snap) -> Optional[_AllocLeg]:
     check then only has to prove nothing moved in between."""
     if "tpu-allocate" not in _conf_names(ssn):
         return None
-    from ..actions.tpu_allocate import PIPELINE_ENV
-    if os.environ.get(PIPELINE_ENV, "1") == "0":
+    if not knobs.PIPELINE.enabled():
         # The sequential control consumes synchronously via
         # best_solve_allocate; a pre-staged async handle would change
         # its timing topology.  Keep the control untouched.

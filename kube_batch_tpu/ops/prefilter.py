@@ -54,24 +54,24 @@ program produced it.
 
 from __future__ import annotations
 
-import os
 from typing import Optional
 
 import numpy as np
 
+from .. import knobs
 from .compile_cache import bucket
 from .resources import EPS_QUANTA, SCORE_GRID_K
 
 # Escape hatch for A/B measurement and field debugging: =0 always runs
 # the full-node-bucket program (placement-identical by construction).
-CANDIDATE_SOLVE_ENV = "KUBE_BATCH_TPU_CANDIDATE_SOLVE"
+CANDIDATE_SOLVE_ENV = knobs.CANDIDATE_SOLVE.env
 # Above this many distinct pending (sig, req, res) profiles the host
 # ranking pass costs more than the device scan it would save.
 _MAX_PROFILES = 64
 
 
 def candidate_solve_enabled() -> bool:
-    return os.environ.get(CANDIDATE_SOLVE_ENV, "1") != "0"
+    return knobs.CANDIDATE_SOLVE.enabled()
 
 
 class CandidateSet:

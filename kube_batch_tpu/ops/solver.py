@@ -35,6 +35,7 @@ from typing import NamedTuple
 import jax
 import jax.numpy as jnp
 
+from .. import knobs
 from ..chaos import plan as chaos_plan
 from .fairness import queue_shares, safe_share
 from .resources import less_equal_vec
@@ -646,11 +647,11 @@ def fetch_solve(pending: PendingSolve):
 # sits mid-conservative at 16384.  A bytes cap still triggers sharding
 # when node-major state would pressure one chip's HBM regardless of
 # latency.  Overridable for ops tuning; FORCE_SHARD for tests/drills.
-SHARD_NODES_ENV = "KUBE_BATCH_TPU_SHARD_NODES"
-SHARD_BYTES_ENV = "KUBE_BATCH_TPU_SHARD_BYTES"
-FORCE_SHARD_ENV = "KUBE_BATCH_TPU_FORCE_SHARD"
-DEFAULT_SHARD_NODES = 16384
-DEFAULT_SHARD_BYTES = 256 * 1024 * 1024
+SHARD_NODES_ENV = knobs.SHARD_NODES.env
+SHARD_BYTES_ENV = knobs.SHARD_BYTES.env
+FORCE_SHARD_ENV = knobs.FORCE_SHARD.env
+DEFAULT_SHARD_NODES = knobs.SHARD_NODES.default
+DEFAULT_SHARD_BYTES = knobs.SHARD_BYTES.default
 
 
 def _node_state_bytes(inp: SolverInputs) -> int:
@@ -682,33 +683,10 @@ _SHARD_KNOBS = None  # resolved lazily once; refresh_shard_knobs re-reads
 
 
 def _resolve_shard_knobs() -> ShardKnobs:
-    import logging
-    import os
-
-    log = logging.getLogger(__name__)
-
-    def _int_knob(name: str, default: int) -> int:
-        raw = os.environ.get(name)
-        if not raw:
-            return default
-        try:
-            return int(raw)
-        except ValueError:
-            log.warning(
-                "%s=%r is not an integer; pinning the default %d for the "
-                "life of this process (fix the env and restart, or call "
-                "ops.solver.refresh_shard_knobs())", name, raw, default)
-            return default
-
-    raw_force = os.environ.get(FORCE_SHARD_ENV)
-    if raw_force not in (None, "", "0", "1"):
-        log.warning(
-            "%s=%r is neither 0 nor 1; pinning off for the life of this "
-            "process", FORCE_SHARD_ENV, raw_force)
     return ShardKnobs(
-        nodes=_int_knob(SHARD_NODES_ENV, DEFAULT_SHARD_NODES),
-        bytes=_int_knob(SHARD_BYTES_ENV, DEFAULT_SHARD_BYTES),
-        force=(raw_force == "1"))
+        nodes=knobs.SHARD_NODES.value(),
+        bytes=knobs.SHARD_BYTES.value(),
+        force=knobs.FORCE_SHARD.enabled())
 
 
 def shard_knobs() -> ShardKnobs:

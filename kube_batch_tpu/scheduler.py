@@ -8,13 +8,13 @@ schedule-period) and util.go (YAML conf loading with the default
 from __future__ import annotations
 
 import logging
-import os
 import threading
 import time
 from typing import List, Optional, Tuple
 
 log = logging.getLogger(__name__)
 
+from . import knobs
 from .chaos import plan as chaos_plan
 from .conf import (SchedulerConfiguration, Tier, apply_plugin_conf_defaults,
                    configuration_from_dict)
@@ -25,14 +25,14 @@ from .trace import spans as trace
 # Crash-loop backoff cap (seconds): consecutive failing cycles double the
 # loop delay up to this bound, so a persistently bad cycle (dead
 # apiserver, wedged device tunnel) cannot hot-loop at schedule_period.
-MAX_CYCLE_BACKOFF_ENV = "KUBE_BATCH_TPU_MAX_CYCLE_BACKOFF_S"
-_DEF_MAX_CYCLE_BACKOFF_S = 30.0
+MAX_CYCLE_BACKOFF_ENV = knobs.MAX_CYCLE_BACKOFF_S.env
+_DEF_MAX_CYCLE_BACKOFF_S = knobs.MAX_CYCLE_BACKOFF_S.default
 
 # Event-driven micro-sessions (doc/INCREMENTAL.md): cache churn wakes the
 # loop early; a woken loop sleeps this coalescing window first so one
 # informer burst becomes one micro-session instead of N.  Milliseconds.
-COALESCE_MS_ENV = "KUBE_BATCH_TPU_COALESCE_MS"
-_DEF_COALESCE_MS = 10.0
+COALESCE_MS_ENV = knobs.COALESCE_MS.env
+_DEF_COALESCE_MS = knobs.COALESCE_MS.default
 
 # The shipped default pipeline puts the flagship device action first:
 # tpu-allocate solves the allocate loop on TPU and falls back to the host
@@ -159,16 +159,8 @@ class Scheduler:
         # accumulate unrevalidated (models/incremental.py).
         self._cycles_since_full = 0
         self._force_full_pending = False  # consumed by the tenancy engine
-        try:
-            self._max_backoff = float(os.environ.get(
-                MAX_CYCLE_BACKOFF_ENV, _DEF_MAX_CYCLE_BACKOFF_S))
-        except ValueError:
-            self._max_backoff = _DEF_MAX_CYCLE_BACKOFF_S
-        try:
-            self._coalesce_s = float(os.environ.get(
-                COALESCE_MS_ENV, _DEF_COALESCE_MS)) / 1e3
-        except ValueError:
-            self._coalesce_s = _DEF_COALESCE_MS / 1e3
+        self._max_backoff = knobs.MAX_CYCLE_BACKOFF_S.value()
+        self._coalesce_s = knobs.COALESCE_MS.value() / 1e3
         # Log<->trace correlation: every loop record carries [s=<id>]
         # while a traced session is active (doc/OBSERVABILITY.md).
         trace.install_log_correlation()

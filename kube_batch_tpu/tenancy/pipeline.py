@@ -62,19 +62,19 @@ and ``make bench-tenancy``):
 from __future__ import annotations
 
 import logging
-import os
 import threading
 import time
 from bisect import bisect_left
 from typing import Dict, List, Optional
 
+from .. import knobs
 from ..metrics import metrics
 
 log = logging.getLogger(__name__)
 
-CONCURRENT_ENV = "KUBE_BATCH_TPU_CONCURRENT_SHARDS"
-INFLIGHT_ENV = "KUBE_BATCH_TPU_SHARD_INFLIGHT"
-DEFAULT_INFLIGHT = 2
+CONCURRENT_ENV = knobs.CONCURRENT_SHARDS.env
+INFLIGHT_ENV = knobs.SHARD_INFLIGHT.env
+DEFAULT_INFLIGHT = knobs.SHARD_INFLIGHT.default
 
 # Actions whose retire-phase node reads are bounded by a published read
 # fence: tpu-allocate publishes the sig-union from its own begin half,
@@ -101,25 +101,13 @@ class StaleSessionAbort(Exception):
 
 
 def concurrent_shards_enabled() -> bool:
-    return os.environ.get(CONCURRENT_ENV, "1") != "0"
+    return knobs.CONCURRENT_SHARDS.enabled()
 
 
 def shard_inflight_depth() -> int:
     """Pipeline depth from the environment — validated the shard_knobs
     way: a malformed value warns loudly and pins the default."""
-    raw = os.environ.get(INFLIGHT_ENV)
-    if not raw:
-        return DEFAULT_INFLIGHT
-    try:
-        depth = int(raw)
-        if depth < 1:
-            raise ValueError(raw)
-        return depth
-    except ValueError:
-        log.warning(
-            "%s=%r is not a positive integer; pinning the default %d",
-            INFLIGHT_ENV, raw, DEFAULT_INFLIGHT)
-        return DEFAULT_INFLIGHT
+    return knobs.SHARD_INFLIGHT.value()
 
 
 class _Stage:

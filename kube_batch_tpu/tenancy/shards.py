@@ -18,13 +18,14 @@ decide which micro-sessions the next loop iteration runs.
 from __future__ import annotations
 
 import hashlib
-import os
 import threading
 import time
 from typing import Dict, Iterable, Optional, Set
 
-TENANCY_ENV = "KUBE_BATCH_TPU_TENANCY"
-SHARD_MAP_ENV = "KUBE_BATCH_TPU_SHARD_MAP"
+from .. import knobs
+
+TENANCY_ENV = knobs.TENANCY.env
+SHARD_MAP_ENV = knobs.SHARD_MAP.env
 
 
 def tenancy_shards() -> int:
@@ -32,7 +33,7 @@ def tenancy_shards() -> int:
     control arm).  A malformed value raises: running a silently
     different tenancy topology than configured is the conf-parsing
     failure mode scheduler._mini_yaml refuses too."""
-    raw = (os.environ.get(TENANCY_ENV) or "").strip()
+    raw = (knobs.TENANCY.raw() or "").strip()
     if not raw or raw.lower() in ("0", "off", "false"):
         return 0
     shards = int(raw)
@@ -94,7 +95,7 @@ class ShardMap:
     @classmethod
     def from_env(cls, num_shards: int) -> "ShardMap":
         return cls(num_shards, parse_shard_overrides(
-            os.environ.get(SHARD_MAP_ENV), num_shards))
+            knobs.SHARD_MAP.raw(), num_shards))
 
     def shard_of(self, queue: str) -> int:
         shard = self._memo.get(queue)

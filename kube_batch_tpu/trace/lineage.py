@@ -51,50 +51,40 @@ from __future__ import annotations
 
 import bisect
 import logging
-import os
 import threading
 import time
 from collections import OrderedDict
 from typing import List, NamedTuple, Optional, Tuple
 
+from .. import knobs
 from ..metrics import metrics
 
 log = logging.getLogger(__name__)
 
-LINEAGE_ENV = "KUBE_BATCH_TPU_LINEAGE"
-LINEAGE_RING_ENV = "KUBE_BATCH_TPU_LINEAGE_RING"
-DEFAULT_RING = 2048
+LINEAGE_ENV = knobs.LINEAGE.env
+LINEAGE_RING_ENV = knobs.LINEAGE_RING.env
+DEFAULT_RING = knobs.LINEAGE_RING.default
 # Session-open ledger depth: a pod that waits longer than this many
 # sessions loses its derivable first-consider (counted, not guessed).
 _SESSION_LEDGER = 4096
 
-_warned_envs: set = set()
+# Legacy alias: the once-per-process warned-set now lives in the knob
+# registry (knobs.reset_warnings clears it in place, so this stays live).
+_warned_envs = knobs._warned
 
 
 def warn_once_bad_env(name: str, raw, default) -> None:
     """Loud, once-per-process warning for a malformed env knob (the
     ops/solver.shard_knobs discipline, shared with trace/recorder.py)."""
-    if name in _warned_envs:
-        return
-    _warned_envs.add(name)
-    log.warning(
-        "%s=%r is not a positive integer; pinning the default %r for the "
-        "life of this process (fix the env and restart)", name, raw,
-        default)
+    knobs.warn_once(name, raw, default, "is not a positive integer",
+                    owner=__name__)
 
 
 def validated_ring_env(name: str, default: int) -> int:
-    raw = os.environ.get(name)
-    if not raw:
-        return default
-    try:
-        value = int(raw)
-        if value < 1:
-            raise ValueError(raw)
-        return value
-    except ValueError:
-        warn_once_bad_env(name, raw, default)
-        return default
+    """Validated positive-int read, routed through the knob registry
+    (which holds the authoritative default; ``default`` is kept for
+    signature compatibility with pre-registry callers)."""
+    return knobs.by_env(name).value()
 
 
 class _Cfg(NamedTuple):
@@ -103,11 +93,8 @@ class _Cfg(NamedTuple):
 
 
 def _resolve_cfg() -> _Cfg:
-    raw = os.environ.get(LINEAGE_ENV, "1")
-    if raw not in ("0", "1", ""):
-        warn_once_bad_env(LINEAGE_ENV, raw, "1 (enabled)")
-    return _Cfg(enabled=(raw != "0"),
-                capacity=validated_ring_env(LINEAGE_RING_ENV, DEFAULT_RING))
+    return _Cfg(enabled=knobs.LINEAGE.enabled(),
+                capacity=knobs.LINEAGE_RING.value())
 
 
 # Wall<->monotonic anchor for DISPLAY only (/debug/lineage's

@@ -18,8 +18,10 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional
 
-_RING_ENV = "KUBE_BATCH_TPU_TRACE_RING"
-_DEFAULT_RING = 64
+from .. import knobs
+
+_RING_ENV = knobs.TRACE_RING.env
+_DEFAULT_RING = knobs.TRACE_RING.default
 
 
 class FlightRecorder:

@@ -23,13 +23,14 @@ from __future__ import annotations
 
 import itertools
 import logging
-import os
 import threading
 import time
 from typing import Dict, List, Optional
 
+from .. import knobs
+
 # =0 disables tracing entirely (checked once per session, not per span).
-TRACE_ENV = "KUBE_BATCH_TPU_TRACE"
+TRACE_ENV = knobs.TRACE.env
 
 # Why-pending state is bounded per session: a pathological cluster with
 # hundreds of thousands of stuck jobs must not grow a trace without
@@ -41,7 +42,7 @@ _tls = threading.local()
 
 
 def enabled() -> bool:
-    return os.environ.get(TRACE_ENV, "1") != "0"
+    return knobs.TRACE.enabled()
 
 
 class SpanRecord:

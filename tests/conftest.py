@@ -62,3 +62,17 @@ def _unpin_lineage_cfg():
     if metrics_mod is not None:
         with metrics_mod._series_lock:
             metrics_mod._series_cap = None
+
+
+@pytest.fixture(autouse=True)
+def _reset_knob_warnings():
+    """The knob registry warns once per env var per PROCESS (knobs.py
+    warn_once) — correct in production, but across tests it would let an
+    earlier test's garbage value swallow a later test's expected
+    warning.  Clearing the warned set per test keeps every test's
+    warn-once assertion independent."""
+    yield
+    import sys
+    knobs_mod = sys.modules.get("kube_batch_tpu.knobs")
+    if knobs_mod is not None:
+        knobs_mod.reset_warnings()

@@ -636,6 +636,7 @@ class TestScanEngines:
             monkeypatch.setenv("KUBE_BATCH_TPU_SAFE_SCORES", "1")
             s = scanner.scores(task)
             pristine = s.copy()
+            # lint: disable=frozen-after (deliberate caller-side abuse: the test proves the cache is isolated from it)
             s[:] = -12345  # caller-side abuse: must not reach the cache
             again = scanner.scores(task)
             assert np.array_equal(again, pristine)
@@ -652,6 +653,7 @@ class TestScanEngines:
             monkeypatch.setenv("KUBE_BATCH_TPU_SCAN_DEVICE", "1")
             dev = scanner.scores(task)
             assert np.array_equal(dev, pristine)
+            # lint: disable=frozen-after (deliberate write: proves safe mode returned a defensive copy, not the cache)
             dev[:] = -1  # must be writable (defensive copy)
         finally:
             close_session(ssn)

@@ -166,6 +166,7 @@ class TestLeaderElection:
         assert elector.is_leader
         assert events == ["started"]
         elector.stop()
+        t.join(timeout=2.0)
 
     def test_second_candidate_blocked_until_lease_expires(self, tmp_path):
         lock = str(tmp_path / "lock.json")

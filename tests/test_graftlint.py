@@ -719,3 +719,583 @@ class TestCli:
         from tools.graftlint.__main__ import main
         assert main([str(tmp_path / "no_such_pkg")]) == 2
         assert "no_such_pkg" in capsys.readouterr().err
+
+# ---------------------------------------------------------------------------
+# Interprocedural lock propagation (lock-discipline without per-hop markers)
+# ---------------------------------------------------------------------------
+
+class TestInterproceduralLocks:
+    def test_private_helper_all_callers_hold_passes(self):
+        # No holds-lock marker anywhere: the lock-held state flows into
+        # the helper because EVERY in-class call site holds it.
+        findings = lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                    self.items = []  # guarded-by: lock
+
+                def add(self, x):
+                    with self.lock:
+                        self._store(x)
+
+                def drop(self, x):
+                    with self.lock:
+                        self._store(x)
+
+                def _store(self, x):
+                    self.items.append(x)
+        """)
+        assert findings == []
+
+    def test_helper_chain_fixpoint_passes(self):
+        # helper -> helper: the intersection fixpoint must carry the lock
+        # through the chain, not just one hop.
+        findings = lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                    self.items = []  # guarded-by: lock
+
+                def add(self, x):
+                    with self.lock:
+                        self._a(x)
+
+                def _a(self, x):
+                    self._b(x)
+
+                def _b(self, x):
+                    self.items.append(x)
+        """)
+        assert findings == []
+
+    def test_one_unlocked_caller_flags_with_note(self):
+        findings = lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                    self.items = []  # guarded-by: lock
+
+                def add(self, x):
+                    with self.lock:
+                        self._store(x)
+
+                def sneak(self, x):
+                    self._store(x)
+
+                def _store(self, x):
+                    self.items.append(x)
+        """)
+        assert rules_of(findings) == {"lock-discipline"}
+        assert any("interprocedural" in f.message and "sneak" in f.message
+                   for f in findings)
+
+    def test_value_escape_disables_inference(self):
+        # ``self.cb = self._store`` — the helper escapes as a value and
+        # may be called from anywhere, so inference must stay silent even
+        # though the only direct call site holds the lock.
+        findings = lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                    self.items = []  # guarded-by: lock
+                    self.cb = None
+
+                def register(self):
+                    self.cb = self._store
+
+                def add(self, x):
+                    with self.lock:
+                        self._store(x)
+
+                def _store(self, x):
+                    self.items.append(x)
+        """)
+        assert "lock-discipline" in rules_of(findings)
+
+    def test_public_helper_gets_no_inference(self):
+        # A public method can be called from outside the module, so the
+        # all-callers-hold argument does not apply.
+        findings = lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                    self.items = []  # guarded-by: lock
+
+                def add(self, x):
+                    with self.lock:
+                        self.store(x)
+
+                def store(self, x):
+                    self.items.append(x)
+        """)
+        assert "lock-discipline" in rules_of(findings)
+
+    def test_closure_call_site_does_not_propagate(self):
+        # The closure escapes run(): by the time it fires, run()'s lock
+        # may be long released — its call site contributes nothing.
+        findings = lint("""
+            import threading
+
+            class C:
+                def __init__(self):
+                    self.lock = threading.Lock()
+                    self.items = []  # guarded-by: lock
+
+                def run(self, defer):
+                    with self.lock:
+                        def cb():
+                            self._store(1)
+                        defer(cb)
+
+                def _store(self, x):
+                    self.items.append(x)
+        """)
+        assert "lock-discipline" in rules_of(findings)
+
+    def test_module_helper_inference(self):
+        # Module-level private functions propagate the same way; the
+        # unlocked caller breaks the intersection and the helper's write
+        # is reported with the call-site hint.
+        findings = lint("""
+            import threading
+
+            _lock = threading.Lock()
+            _seen = set()  # guarded-by: _lock
+
+            def good(k):
+                with _lock:
+                    _mark(k)
+
+            def bad(k):
+                _mark(k)
+
+            def _mark(k):
+                _seen.add(k)
+        """)
+        assert rules_of(findings) == {"lock-discipline"}
+        assert any("bad" in f.message for f in findings)
+
+    def test_module_holds_lock_checked_from_methods(self):
+        # A method calling a holds-lock module function outside the lock
+        # is flagged (v1 only checked module-function callers).
+        findings = lint("""
+            import threading
+
+            _lock = threading.Lock()
+            _seen = set()  # guarded-by: _lock
+
+            def _mutate(k):  # holds-lock: _lock
+                _seen.add(k)
+
+            class C:
+                def good(self, k):
+                    with _lock:
+                        _mutate(k)
+
+                def bad(self, k):
+                    _mutate(k)
+        """)
+        assert len(findings) == 1
+        assert "_mutate" in findings[0].message
+        assert "bad" in findings[0].message
+
+
+# ---------------------------------------------------------------------------
+# (8) knob-registry
+# ---------------------------------------------------------------------------
+
+def lint_files(files, root=None):
+    sfs = [SourceFile(path, textwrap.dedent(src)) for path, src in files]
+    findings, _markers = run_files(sfs, root=root)
+    return findings
+
+
+KNOBS_DECL = """
+    import os
+
+    def _knob(env, default):
+        return (env, default)
+
+    FOO = _knob("KUBE_BATCH_TPU_FOO", 1)
+"""
+
+
+class TestKnobRegistry:
+    def test_raw_getenv_in_package_flagged(self):
+        findings = lint_files([("kube_batch_tpu/fake.py", """
+            import os
+            x = os.getenv("KUBE_BATCH_TPU_X", "0")
+        """)])
+        assert "knob-registry" in rules_of(findings)
+        assert "os.getenv" in findings[0].message
+
+    def test_raw_subscript_and_membership_flagged(self):
+        findings = lint_files([("kube_batch_tpu/fake.py", """
+            import os
+            y = os.environ["KUBE_BATCH_TPU_Y"]
+            z = "KUBE_BATCH_TPU_Z" in os.environ
+        """)])
+        hits = [f for f in findings if f.rule == "knob-registry"]
+        assert len(hits) == 2
+
+    def test_environ_get_flagged_but_writes_exempt(self):
+        findings = lint_files([("kube_batch_tpu/fake.py", """
+            import os
+            a = os.environ.get("KUBE_BATCH_TPU_A")
+            os.environ["KUBE_BATCH_TPU_B"] = "1"      # republish idiom
+            os.environ.pop("KUBE_BATCH_TPU_C", None)
+            del os.environ["KUBE_BATCH_TPU_D"]
+        """)])
+        hits = [f for f in findings if f.rule == "knob-registry"]
+        assert len(hits) == 1
+        assert "environ.get" in hits[0].message
+
+    def test_reads_outside_package_not_flagged(self):
+        # tests monkeypatching and bench.py's save/restore harness are
+        # out of scope by design.
+        findings = lint_files([("bench.py", """
+            import os
+            x = os.getenv("KUBE_BATCH_TPU_X")
+        """)])
+        assert "knob-registry" not in rules_of(findings)
+
+    def test_dead_flag_flagged(self):
+        findings = lint_files([("kube_batch_tpu/knobs.py", KNOBS_DECL)])
+        hits = [f for f in findings if f.rule == "knob-registry"]
+        assert len(hits) == 1
+        assert "dead flag" in hits[0].message
+
+    def test_referenced_flag_passes(self):
+        findings = lint_files([
+            ("kube_batch_tpu/knobs.py", KNOBS_DECL),
+            ("kube_batch_tpu/user.py", """
+                from kube_batch_tpu import knobs
+                LIMIT = knobs.FOO
+            """)])
+        assert "knob-registry" not in rules_of(findings)
+
+    def test_env_string_reference_counts(self):
+        # by_env("KUBE_BATCH_TPU_FOO") leaves a string-constant trace.
+        findings = lint_files([
+            ("kube_batch_tpu/knobs.py", KNOBS_DECL),
+            ("kube_batch_tpu/user.py", """
+                from kube_batch_tpu.knobs import by_env
+                LIMIT = by_env("KUBE_BATCH_TPU_FOO")
+            """)])
+        assert "knob-registry" not in rules_of(findings)
+
+    def test_inventory_membership(self, tmp_path):
+        (tmp_path / "doc").mkdir()
+        ref = ("kube_batch_tpu/user.py",
+               "from kube_batch_tpu import knobs\nLIMIT = knobs.FOO\n")
+        decl = ("kube_batch_tpu/knobs.py", KNOBS_DECL)
+        (tmp_path / "doc" / "INVENTORY.md").write_text(
+            "| `KUBE_BATCH_TPU_FOO` | int | 1 |\n")
+        assert "knob-registry" not in rules_of(
+            lint_files([decl, ref], root=str(tmp_path)))
+        (tmp_path / "doc" / "INVENTORY.md").write_text("nothing here\n")
+        findings = lint_files([decl, ref], root=str(tmp_path))
+        assert any("INVENTORY" in f.message for f in findings
+                   if f.rule == "knob-registry")
+
+    def test_unreadable_inventory_is_loud(self, tmp_path):
+        findings = lint_files(
+            [("kube_batch_tpu/knobs.py", KNOBS_DECL),
+             ("kube_batch_tpu/user.py",
+              "from kube_batch_tpu import knobs\nLIMIT = knobs.FOO\n")],
+            root=str(tmp_path))   # no doc/INVENTORY.md here
+        assert any("cannot read" in f.message for f in findings
+                   if f.rule == "knob-registry")
+
+
+# ---------------------------------------------------------------------------
+# (9) metric-discipline
+# ---------------------------------------------------------------------------
+
+METRICS_DECL = """
+    SUBSYSTEM = "kbt"
+
+    class _R:
+        pass
+
+    registry = _R()
+    M_THINGS = registry.register(
+        Counter(f"{SUBSYSTEM}_things", "how many things", ("shard",)))
+"""
+
+
+class TestMetricDiscipline:
+    def test_never_emitted_metric_flagged(self):
+        findings = lint_files(
+            [("kube_batch_tpu/metrics/metrics.py", METRICS_DECL)])
+        hits = [f for f in findings if f.rule == "metric-discipline"]
+        assert len(hits) == 1
+        assert "never emitted" in hits[0].message
+        assert "kbt_things" in hits[0].message
+
+    def test_duplicate_declaration_flagged(self):
+        findings = lint_files([("kube_batch_tpu/metrics/metrics.py",
+                                METRICS_DECL + """
+    M_DUP = registry.register(
+        Counter(f"{SUBSYSTEM}_things", "again", ("shard",)))
+    """)])
+        assert any("more than once" in f.message for f in findings
+                   if f.rule == "metric-discipline")
+
+    def test_consistent_emission_passes(self):
+        findings = lint_files([
+            ("kube_batch_tpu/metrics/metrics.py", METRICS_DECL),
+            ("kube_batch_tpu/emit.py", """
+                from kube_batch_tpu.metrics.metrics import M_THINGS
+
+                def bump(shard):
+                    M_THINGS.inc(1, shard)
+            """)])
+        assert "metric-discipline" not in rules_of(findings)
+
+    def test_label_arity_mismatch_flagged(self):
+        findings = lint_files([
+            ("kube_batch_tpu/metrics/metrics.py", METRICS_DECL),
+            ("kube_batch_tpu/emit.py", """
+                from kube_batch_tpu.metrics.metrics import M_THINGS
+
+                def bump():
+                    M_THINGS.inc(1)
+            """)])
+        hits = [f for f in findings if f.rule == "metric-discipline"
+                and "label" in f.message]
+        assert len(hits) == 1
+        assert "0 label(s)" in hits[0].message
+
+    def test_indirect_reference_counts_as_emitted(self):
+        # The symbol escapes into a dict and is driven dynamically
+        # (trace/lineage's SLO ledger idiom): conservative, not flagged.
+        findings = lint_files([
+            ("kube_batch_tpu/metrics/metrics.py", METRICS_DECL),
+            ("kube_batch_tpu/ledger.py", """
+                from kube_batch_tpu.metrics.metrics import M_THINGS
+
+                SINKS = {"things": M_THINGS}
+            """)])
+        assert "metric-discipline" not in rules_of(findings)
+
+    def test_tests_tree_neither_credits_nor_flags(self):
+        # A test driving the metric must not mask a production metric
+        # nothing emits; its own arity is its fixture's business.
+        findings = lint_files([
+            ("kube_batch_tpu/metrics/metrics.py", METRICS_DECL),
+            ("tests/test_fake.py", """
+                from kube_batch_tpu.metrics.metrics import M_THINGS
+
+                def test_bump():
+                    M_THINGS.inc(1)
+            """)])
+        hits = [f for f in findings if f.rule == "metric-discipline"]
+        assert len(hits) == 1
+        assert "never emitted" in hits[0].message
+
+
+# ---------------------------------------------------------------------------
+# (10) chaos-registry
+# ---------------------------------------------------------------------------
+
+CHAOS_PLAN = """
+    def fire_all(plan, resource):
+        plan.fire("watch.drop")
+        plan.fire(f"watch.stale:{resource}")
+"""
+
+CHAOS_DOC = """\
+# Chaos
+
+## Keys
+
+| `unrelated.key` | not a site |
+
+## Injection-site catalogue
+
+| site | meaning |
+|---|---|
+| `watch.drop` | drop one watch event |
+| `watch.stale:<resource>` | serve a stale snapshot |
+"""
+
+CHAOS_SOAK = """\
+FAKE_SITES = ("watch.drop",)
+EDGE_SITES = FAKE_SITES + ("watch.stale:pods",)
+"""
+
+
+def _chaos_root(tmp_path, doc=CHAOS_DOC, soak=CHAOS_SOAK):
+    (tmp_path / "doc").mkdir(exist_ok=True)
+    (tmp_path / "tools").mkdir(exist_ok=True)
+    (tmp_path / "doc" / "CHAOS.md").write_text(doc)
+    (tmp_path / "tools" / "chaos_soak.py").write_text(soak)
+    return str(tmp_path)
+
+
+class TestChaosRegistry:
+    def test_in_sync_registries_pass(self, tmp_path):
+        findings = lint_files(
+            [("kube_batch_tpu/chaos/plan.py", CHAOS_PLAN)],
+            root=_chaos_root(tmp_path))
+        assert "chaos-registry" not in rules_of(findings)
+
+    def test_undocumented_code_site_flagged(self, tmp_path):
+        findings = lint_files(
+            [("kube_batch_tpu/chaos/plan.py", CHAOS_PLAN + """
+    def extra(plan):
+        plan.fire("lease.steal")
+    """)],
+            root=_chaos_root(tmp_path))
+        assert any("missing from doc/CHAOS.md" in f.message
+                   for f in findings if f.rule == "chaos-registry")
+
+    def test_documented_site_with_no_code_flagged(self, tmp_path):
+        doc = CHAOS_DOC + "| `ghost.site` | never implemented |\n"
+        findings = lint_files(
+            [("kube_batch_tpu/chaos/plan.py", CHAOS_PLAN)],
+            root=_chaos_root(tmp_path, doc=doc))
+        assert any("'ghost.site'" in f.message and "no plan.fire" in f.message
+                   for f in findings if f.rule == "chaos-registry")
+
+    def test_soak_required_site_with_no_code_flagged(self, tmp_path):
+        soak = CHAOS_SOAK + "EDGE_SITES = EDGE_SITES + (\"phantom.x\",)\n"
+        findings = lint_files(
+            [("kube_batch_tpu/chaos/plan.py", CHAOS_PLAN)],
+            root=_chaos_root(tmp_path, soak=soak))
+        hits = [f for f in findings if f.rule == "chaos-registry"
+                and "'phantom.x'" in f.message]
+        # unsatisfiable soak requirement AND undocumented requirement
+        assert len(hits) == 2
+
+    def test_sites_outside_package_ignored(self, tmp_path):
+        # tools/replay.py fires through plan objects too, but only
+        # package call sites define the registry (the doc documents the
+        # scheduler's surface, not the harness's).
+        findings = lint_files(
+            [("kube_batch_tpu/chaos/plan.py", CHAOS_PLAN),
+             ("tools/fake_harness.py",
+              "def drive(plan):\n    plan.fire(\"harness.only\")\n")],
+            root=_chaos_root(tmp_path))
+        assert "chaos-registry" not in rules_of(findings)
+
+    def test_missing_doc_is_loud(self, tmp_path):
+        (tmp_path / "tools").mkdir()
+        (tmp_path / "tools" / "chaos_soak.py").write_text(CHAOS_SOAK)
+        findings = lint_files(
+            [("kube_batch_tpu/chaos/plan.py", CHAOS_PLAN)],
+            root=str(tmp_path))
+        assert any("cannot read" in f.message for f in findings
+                   if f.rule == "chaos-registry")
+
+
+# ---------------------------------------------------------------------------
+# (11) thread-lifecycle
+# ---------------------------------------------------------------------------
+
+class TestThreadLifecycle:
+    def test_nondaemon_unjoined_flagged(self):
+        findings = lint("""
+            import threading
+
+            def spawn(worker):
+                t = threading.Thread(target=worker)
+                t.start()
+                return t
+        """)
+        hits = [f for f in findings if f.rule == "thread-lifecycle"]
+        assert len(hits) == 1
+        assert "neither joined" in hits[0].message
+
+    def test_joined_thread_passes(self):
+        findings = lint("""
+            import threading
+
+            def run(worker):
+                t = threading.Thread(target=worker)
+                t.start()
+                t.join(timeout=5.0)
+        """)
+        assert "thread-lifecycle" not in rules_of(findings)
+
+    def test_daemon_without_stop_path_flagged(self):
+        findings = lint("""
+            import threading
+
+            class Pump:
+                def start(self):
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._t.start()
+        """)
+        hits = [f for f in findings if f.rule == "thread-lifecycle"]
+        assert len(hits) == 1
+        assert "no stop path" in hits[0].message
+
+    def test_daemon_with_class_stop_path_passes(self):
+        findings = lint("""
+            import threading
+
+            class Pump:
+                def start(self):
+                    self._stop = threading.Event()
+                    self._t = threading.Thread(target=self._run,
+                                               daemon=True)
+                    self._t.start()
+
+                def stop(self):
+                    self._stop.set()
+                    self._t.join(timeout=2.0)
+        """)
+        assert "thread-lifecycle" not in rules_of(findings)
+
+    def test_two_statement_daemon_with_module_stop_passes(self):
+        # ``t.daemon = True`` spelling + a module-level shutdown().
+        findings = lint("""
+            import threading
+
+            _stop = threading.Event()
+
+            def start(worker):
+                t = threading.Thread(target=worker)
+                t.daemon = True
+                t.start()
+                return t
+
+            def shutdown():
+                _stop.set()
+        """)
+        assert "thread-lifecycle" not in rules_of(findings)
+
+    def test_str_join_is_not_a_thread_join(self):
+        findings = lint("""
+            import threading
+
+            def spawn(parts, worker):
+                label = "".join(parts)
+                t = threading.Thread(target=worker, name=label)
+                t.start()
+        """)
+        assert "thread-lifecycle" in rules_of(findings)
+
+    def test_suppression_marker_works(self):
+        findings = lint("""
+            import threading
+
+            def spawn(worker):
+                # lint: disable=thread-lifecycle (fire-and-forget probe, process-lifetime)
+                t = threading.Thread(target=worker, daemon=True)
+                t.start()
+        """)
+        assert "thread-lifecycle" not in rules_of(findings)

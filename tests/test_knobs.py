@@ -1,0 +1,209 @@
+"""The knob registry (kube_batch_tpu/knobs.py): warn-once-pin-default on
+garbage, fresh per-call reads, and the boot-with-garbage regression —
+every non-spec flag set to junk must leave the scheduler bootable and
+deciding exactly as if every flag were unset (warn-once is the ONLY
+side effect a malformed value may have).
+"""
+
+import logging
+
+import pytest
+
+from kube_batch_tpu import knobs
+
+
+def _garbage_env(monkeypatch):
+    """Set every warn-and-pin knob to junk its parser must reject.
+    spec/str knobs are excluded: their owning modules deliberately raise
+    on malformed specs (a typo'd fault plan must be loud), and a str
+    path knob has no invalid spellings."""
+    polluted = []
+    for env, knob in sorted(knobs.REGISTRY.items()):
+        if knob.kind in ("spec", "str"):
+            continue
+        if knob.kind == "flag-set":
+            continue   # any non-empty value is a valid "set"
+        if knob.clamp_min is not None and knob.minimum is None:
+            # clamp knobs floor silently on numbers; garbage text still
+            # warn-pins, so they stay in the sweep.
+            pass
+        monkeypatch.setenv(env, "banana?!")
+        polluted.append(env)
+    return polluted
+
+
+class TestAccessors:
+
+    def test_numeric_garbage_warns_once_and_pins_default(
+            self, monkeypatch, caplog):
+        monkeypatch.setenv("KUBE_BATCH_TPU_SHARD_NODES", "not-a-number")
+        knob = knobs.by_env("KUBE_BATCH_TPU_SHARD_NODES")
+        with caplog.at_level(logging.WARNING, logger=knob.owner):
+            assert knob.value() == knob.default
+            assert knob.value() == knob.default    # second read: no new warn
+        warnings = [r for r in caplog.records if "not-a-number" in r.message]
+        assert len(warnings) == 1
+        assert knob.env in warnings[0].message
+
+    def test_minimum_violation_pins_default(self, monkeypatch, caplog):
+        knob = knobs.by_env("KUBE_BATCH_TPU_SHARD_INFLIGHT")
+        assert knob.minimum == 1
+        monkeypatch.setenv(knob.env, "0")
+        with caplog.at_level(logging.WARNING, logger=knob.owner):
+            assert knob.value() == knob.default
+        assert any(knob.env in r.message for r in caplog.records)
+
+    def test_clamp_min_floors_silently(self, monkeypatch, caplog):
+        knob = knobs.by_env("KUBE_BATCH_TPU_FULL_EVERY")
+        assert knob.clamp_min == 0
+        monkeypatch.setenv(knob.env, "-5")
+        with caplog.at_level(logging.WARNING, logger=knob.owner):
+            assert knob.value() == 0
+        assert not caplog.records    # documented "negative means zero"
+
+    def test_flag_on_garbage_warns_but_stays_enabled(self, monkeypatch,
+                                                     caplog):
+        knob = knobs.by_env("KUBE_BATCH_TPU_INCREMENTAL")
+        monkeypatch.setenv(knob.env, "maybe")
+        with caplog.at_level(logging.WARNING, logger=knob.owner):
+            assert knob.enabled() is True    # only "0" disables
+        assert any("maybe" in r.message for r in caplog.records)
+
+    def test_reads_are_fresh_per_call(self, monkeypatch):
+        knob = knobs.by_env("KUBE_BATCH_TPU_FULL_EVERY")
+        monkeypatch.setenv(knob.env, "3")
+        assert knob.value() == 3
+        monkeypatch.setenv(knob.env, "9")
+        assert knob.value() == 9
+        monkeypatch.delenv(knob.env)
+        assert knob.value() == knob.default
+
+    def test_tristate_unset_empty_and_garbage(self, monkeypatch, caplog):
+        knob = knobs.by_env("KUBE_BATCH_TPU_EVICT_SHIP")
+        monkeypatch.delenv(knob.env, raising=False)
+        assert knob.tristate() is None
+        monkeypatch.setenv(knob.env, "")
+        assert knob.tristate() is False      # empty forces off
+        monkeypatch.setenv(knob.env, "1")
+        assert knob.tristate() is True
+        monkeypatch.setenv(knob.env, "wat")
+        with caplog.at_level(logging.WARNING, logger=knob.owner):
+            assert knob.tristate() is False
+        assert any("wat" in r.message for r in caplog.records)
+
+    def test_kind_mismatch_raises(self):
+        with pytest.raises(TypeError):
+            knobs.by_env("KUBE_BATCH_TPU_SHARD_NODES").enabled()
+        with pytest.raises(TypeError):
+            knobs.by_env("KUBE_BATCH_TPU_INCREMENTAL").value()
+        with pytest.raises(TypeError):
+            knobs.by_env("KUBE_BATCH_TPU_INCREMENTAL").tristate()
+
+    def test_by_env_unknown_flag_raises(self):
+        with pytest.raises(KeyError):
+            knobs.by_env("KUBE_BATCH_TPU_NO_SUCH_FLAG")
+
+
+class TestRegistrySurface:
+
+    def test_every_knob_has_doc_and_help(self):
+        for env, knob in knobs.REGISTRY.items():
+            assert knob.doc.endswith(".md"), env
+            assert knob.help, env
+            assert env.startswith("KUBE_BATCH_TPU_"), env
+
+    def test_inventory_rows_cover_registry(self):
+        rows = knobs.inventory_rows()
+        assert len(rows) == len(knobs.REGISTRY)
+        text = "\n".join(rows)
+        for env in knobs.REGISTRY:
+            assert f"`{env}`" in text
+
+    def test_parity_knobs_marked(self):
+        # The A/B-verified engine gates must carry the parity bit — the
+        # scenario harness derives its sequential-control env from it.
+        for env in ("KUBE_BATCH_TPU_FUSED", "KUBE_BATCH_TPU_PIPELINE",
+                    "KUBE_BATCH_TPU_INCREMENTAL",
+                    "KUBE_BATCH_TPU_BATCH_COMMIT",
+                    "KUBE_BATCH_TPU_BATCH_EVICT",
+                    "KUBE_BATCH_TPU_DELTA_SHIP",
+                    "KUBE_BATCH_TPU_WIRE_FAST"):
+            assert knobs.by_env(env).parity, env
+
+
+class TestGarbageBoot:
+    """The satellite regression: a cluster whose operator fat-fingered
+    EVERY tunable still boots, schedules, and decides exactly like the
+    defaults."""
+
+    def test_all_accessors_pin_defaults_under_garbage(self, monkeypatch,
+                                                      caplog):
+        polluted = _garbage_env(monkeypatch)
+        assert len(polluted) >= 30
+        with caplog.at_level(logging.WARNING):
+            for env in polluted:
+                knob = knobs.by_env(env)
+                if knob.kind in ("flag-on", "flag-opt-in"):
+                    # flag-on: garbage != "0" stays enabled (fail-open
+                    # to the default engine); opt-in: garbage != "1"
+                    # stays disabled.  Both equal the unset behavior.
+                    assert knob.enabled() == (knob.kind == "flag-on"), env
+                elif knob.kind == "tristate":
+                    assert knob.tristate() is False, env
+                else:
+                    assert knob.value() == knob.default, env
+        # One warning per knob, no more (warn-once), none swallowed.
+        warned = {env for env in polluted
+                  if any(f"{env}=" in r.message for r in caplog.records)}
+        assert warned == set(polluted)
+        per_env = {env: sum(f"{env}=" in r.message for r in caplog.records)
+                   for env in polluted}
+        assert all(n == 1 for n in per_env.values()), per_env
+
+    def test_scheduler_boots_and_cycles_under_garbage(self, monkeypatch):
+        polluted = _garbage_env(monkeypatch)
+        # EVICT_SHIP garbage forces the "off" route; clear it so the
+        # session takes the same shipping route as the default config
+        # (tristate garbage is warned, not default-preserving: forced
+        # off IS its documented non-None contract).
+        monkeypatch.delenv("KUBE_BATCH_TPU_EVICT_SHIP")
+        from kube_batch_tpu.api import objects as O
+        from kube_batch_tpu.api.objects import (Container, Node, NodeSpec,
+                                                NodeStatus, ObjectMeta, Pod,
+                                                PodSpec, PodStatus)
+        from kube_batch_tpu.apis.scheduling import v1alpha1
+        from kube_batch_tpu.cache import Cluster, new_scheduler_cache
+        from kube_batch_tpu.scheduler import Scheduler
+
+        cluster = Cluster()
+        cluster.create_queue(v1alpha1.Queue(
+            metadata=ObjectMeta(name="default"),
+            spec=v1alpha1.QueueSpec(weight=1)))
+        for i in range(2):
+            cluster.create_node(Node(
+                metadata=ObjectMeta(name=f"n{i}", uid=f"n{i}"),
+                spec=NodeSpec(),
+                status=NodeStatus(
+                    allocatable={"cpu": "4", "memory": "8Gi",
+                                 "pods": "110"},
+                    capacity={"cpu": "4", "memory": "8Gi",
+                              "pods": "110"})))
+        cluster.create_pod_group(v1alpha1.PodGroup(
+            metadata=ObjectMeta(name="pg", namespace="t"),
+            spec=v1alpha1.PodGroupSpec(min_member=2, queue="default")))
+        for i in range(2):
+            cluster.create_pod(Pod(
+                metadata=ObjectMeta(
+                    name=f"p{i}", namespace="t", uid=f"p{i}",
+                    annotations={
+                        v1alpha1.GroupNameAnnotationKey: "pg"}),
+                spec=PodSpec(containers=[Container(
+                    requests={"cpu": "1", "memory": "1Gi"})]),
+                status=PodStatus(phase="Pending")))
+        cache = new_scheduler_cache(cluster)
+        scheduler = Scheduler(cache, schedule_period=3600)
+        assert scheduler.cycle()
+        bound = [p for p in cluster.pods.values() if p.spec.node_name]
+        assert len(bound) == 2, [p.metadata.name
+                                 for p in cluster.pods.values()]
+        assert polluted    # the cycle above really ran under garbage

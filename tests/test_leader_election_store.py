@@ -62,6 +62,8 @@ class TestStoreLock:
             time.sleep(0.02)
         assert b.is_leader
         b.stop()
+        ta.join(timeout=2.0)
+        tb.join(timeout=2.0)
         assert events[0] == "a-up" and "b-up" in events
 
 

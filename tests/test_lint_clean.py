@@ -14,13 +14,19 @@ ROOT = pathlib.Path(__file__).resolve().parents[1]
 if str(ROOT) not in sys.path:
     sys.path.insert(0, str(ROOT))
 
-from tools.graftlint.core import load_files, run_files  # noqa: E402
+from tools.graftlint.core import RULES, load_files, run_files  # noqa: E402
 
-LINT_TARGETS = [str(ROOT / "kube_batch_tpu"), str(ROOT / "bench.py")]
+# The same target set as `make lint`: the package, the bench harness,
+# the tools/ tree (soaks, replay, graftlint itself) and tests/.
+LINT_TARGETS = [str(ROOT / "kube_batch_tpu"), str(ROOT / "bench.py"),
+                str(ROOT / "tools"), str(ROOT / "tests")]
 
 
 def _run():
-    return run_files(load_files(LINT_TARGETS))
+    # root=ROOT so the registry cross-checks (doc/INVENTORY.md,
+    # doc/CHAOS.md, tools/chaos_soak.py) run regardless of the pytest
+    # invocation directory.
+    return run_files(load_files(LINT_TARGETS), root=str(ROOT))
 
 
 def test_package_is_lint_clean():
@@ -108,3 +114,47 @@ def test_contract_annotations_cover_the_known_invariants():
         f"{[str(m) for m in lineage_guarded]}")
     # The except-audit markers stay greppable.
     assert len(by_kind.get("allow-swallow", [])) >= 10
+
+
+def test_registry_rules_are_wired():
+    """The v2 rules exist and the whole tree is clean under each — a
+    rule that silently fell out of RULES would pass the blanket gate
+    while checking nothing."""
+    assert {"knob-registry", "metric-discipline", "chaos-registry",
+            "thread-lifecycle"} <= set(RULES), sorted(RULES)
+    findings, _markers = _run()
+    for rule in ("knob-registry", "metric-discipline", "chaos-registry",
+                 "thread-lifecycle"):
+        hits = [f for f in findings if f.rule == rule]
+        assert not hits, "\n".join(str(f) for f in hits)
+
+
+def test_knob_registry_coverage_pinned():
+    """Every env flag goes through kube_batch_tpu/knobs.py — the count
+    is pinned so a knob added without a declaration (or a declaration
+    dropped without removing the flag) fails here, not in review."""
+    from kube_batch_tpu import knobs
+    assert len(knobs.REGISTRY) == 42, sorted(knobs.REGISTRY)
+    rows = knobs.inventory_rows()
+    assert len(rows) == 42
+    inventory = (ROOT / "doc" / "INVENTORY.md").read_text(encoding="utf-8")
+    for env in knobs.REGISTRY:
+        assert env in inventory, f"{env} missing from doc/INVENTORY.md"
+
+
+def test_registries_collected_nonempty():
+    """The cross-file registries must actually see the contract files:
+    an import-path or anchor-path regression that empties a registry
+    would make its rule vacuously green."""
+    from tools.graftlint.core import Context
+    from tools.graftlint import knobs as knob_rule
+    from tools.graftlint import registry as registry_rule
+    ctx = Context()
+    ctx.root = str(ROOT)
+    files = load_files(LINT_TARGETS)
+    for sf in files:
+        knob_rule.collect(sf, ctx)
+        registry_rule.collect(sf, ctx)
+    assert len(ctx.knob_decls) == 42
+    assert len(ctx.metric_decls) >= 80, len(ctx.metric_decls)
+    assert len(ctx.chaos_sites) >= 16, sorted(ctx.chaos_sites)

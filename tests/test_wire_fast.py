@@ -282,6 +282,7 @@ def test_fuzz_delta_never_diverges_or_invents_failures(seed):
             try:
                 full = codec_k8s.decode_any(mutated)
             except Exception as exc:  # noqa: BLE001 — classifying
+                # lint: allow-swallow(classifying, not ignoring: the captured exception is asserted against the delta path's below)
                 full_exc = exc
             delta_exc = out = None
             try:
@@ -290,6 +291,7 @@ def test_fuzz_delta_never_diverges_or_invents_failures(seed):
                 except LookupError:
                     out = codec_k8s.decode_any(mutated)  # the fallback
             except Exception as exc:  # noqa: BLE001 — classifying
+                # lint: allow-swallow(classifying, not ignoring: both paths' exceptions are compared — fuzz parity is the assertion)
                 delta_exc = exc
             if full_exc is None:
                 assert delta_exc is None, (mutated, delta_exc)

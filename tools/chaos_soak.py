@@ -257,6 +257,7 @@ def run_arm(plans, *, nodes: int, cycles: int, drain_cap: int = 30,
                 if not scheduler.cycle():
                     failed_cycles += 1
             except Exception as exc:  # the loop-survival contract broke
+                # lint: allow-swallow(recorded in loop_deaths and reported as a soak failure — the soak measures survival, it must not die with the loop)
                 loop_deaths.append(f"{type(exc).__name__}: {exc}")
             if edge:
                 time.sleep(edge_settle_s)  # let the watch echo land

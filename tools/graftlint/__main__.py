@@ -3,15 +3,49 @@
 Exit 0 = no unsuppressed findings; exit 1 = findings (printed one per
 line as ``path:line: [rule] message``).  ``--inventory`` prints every
 contract/suppression marker instead (greppable audit trail) and always
-exits 0.
+exits 0.  ``--max-seconds N`` fails the run (exit 3) when the whole
+lint pass takes longer — the CI wall-clock budget that keeps the linter
+cheap enough to gate every push.  ``--write-knob-inventory PATH``
+regenerates the knob table between the ``<!-- knobs:begin/end -->``
+sentinels of PATH (normally doc/INVENTORY.md) from the live registry,
+then lints as usual.
 """
 
 from __future__ import annotations
 
 import argparse
+import importlib.util
+import os
 import sys
+import time
 
 from .core import run_paths
+
+_BEGIN = "<!-- knobs:begin -->"
+_END = "<!-- knobs:end -->"
+
+
+def write_knob_inventory(target: str) -> None:
+    """Rewrite the sentinel-delimited knob table of ``target`` from
+    kube_batch_tpu/knobs.py.  The registry module is loaded standalone
+    (no package import) so this works without jax/numpy installed."""
+    knobs_path = os.path.join("kube_batch_tpu", "knobs.py")
+    spec = importlib.util.spec_from_file_location("_graftlint_knobs",
+                                                  knobs_path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    table = "\n".join(mod.inventory_rows())
+
+    with open(target, encoding="utf-8") as f:
+        text = f.read()
+    if _BEGIN not in text or _END not in text:
+        raise FileNotFoundError(
+            f"{target} carries no {_BEGIN} .. {_END} sentinels to "
+            f"rewrite — refusing to guess where the knob table goes")
+    head, rest = text.split(_BEGIN, 1)
+    _old, tail = rest.split(_END, 1)
+    with open(target, "w", encoding="utf-8") as f:
+        f.write(f"{head}{_BEGIN}\n{table}\n{_END}{tail}")
 
 
 def main(argv=None) -> int:
@@ -24,9 +58,24 @@ def main(argv=None) -> int:
     parser.add_argument("--inventory", action="store_true",
                         help="list every annotation/suppression marker "
                              "instead of linting")
+    parser.add_argument("--max-seconds", type=float, default=None,
+                        help="fail (exit 3) when the lint pass exceeds "
+                             "this wall-clock budget")
+    parser.add_argument("--write-knob-inventory", metavar="PATH",
+                        default=None,
+                        help="regenerate the knob table between the "
+                             "knobs:begin/end sentinels of PATH from "
+                             "kube_batch_tpu/knobs.py, then lint")
     args = parser.parse_args(argv)
     paths = args.paths or ["kube_batch_tpu"]
 
+    start = time.monotonic()
+    if args.write_knob_inventory:
+        try:
+            write_knob_inventory(args.write_knob_inventory)
+        except (OSError, FileNotFoundError) as exc:
+            print(f"graftlint: {exc}", file=sys.stderr)
+            return 2
     try:
         findings, markers = run_paths(paths)
     except FileNotFoundError as exc:
@@ -47,6 +96,13 @@ def main(argv=None) -> int:
     if findings:
         print(f"-- {len(findings)} finding(s)", file=sys.stderr)
         return 1
+    elapsed = time.monotonic() - start
+    if args.max_seconds is not None and elapsed > args.max_seconds:
+        print(f"graftlint: clean, but took {elapsed:.1f}s — over the "
+              f"--max-seconds {args.max_seconds:g} budget; the linter "
+              f"must stay cheap enough to gate every push",
+              file=sys.stderr)
+        return 3
     return 0
 
 

@@ -46,6 +46,10 @@ RULES = (
     "frozen-after",
     "exception-policy",
     "suppression",
+    "knob-registry",
+    "metric-discipline",
+    "chaos-registry",
+    "thread-lifecycle",
 )
 
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
@@ -171,6 +175,22 @@ class Context:
         self.frozen_funcs: Dict[str, str] = {}   # func name -> event
         # lock-order: (outer, inner) -> first (path, line) observed.
         self.lock_pairs: Dict[Tuple[str, str], Tuple[str, int]] = {}
+        # Repo root for the registry cross-checks that read NON-linted
+        # inputs (doc/INVENTORY.md, doc/CHAOS.md, tools/chaos_soak.py).
+        # None (unit fixtures without a root) disables those checks.
+        self.root: Optional[str] = None
+        # knob-registry: env var -> (path, line, registry symbol name),
+        # plus every symbol referenced outside the registry module
+        # (dead-flag detection).
+        self.knob_decls: Dict[str, Tuple[str, int, str]] = {}
+        self.knob_refs: set = set()
+        # metric-discipline: metric name -> [(path, line, labels)];
+        # registry symbol -> metric name; symbols referenced as values.
+        self.metric_decls: Dict[str, List[Tuple[str, int, tuple]]] = {}
+        self.metric_vars: Dict[str, str] = {}
+        self.metric_refs: set = set()
+        # chaos-registry: site base name -> first (path, line) observed.
+        self.chaos_sites: Dict[str, Tuple[str, int]] = {}
 
 
 @dataclass
@@ -317,13 +337,19 @@ def load_files(paths: Iterable[str]) -> List[SourceFile]:
     return [SourceFile(p) for p in iter_py_files(paths)]
 
 
-def run_files(files: List[SourceFile]):
+def run_files(files: List[SourceFile], root: Optional[str] = None):
     """(unsuppressed findings, markers).  Two phases: every checker first
-    collects cross-file registries, then checks each file against them."""
-    from . import donation, exceptions, frozen, locks, tracer
+    collects cross-file registries, then checks each file against them.
+    ``root`` is the repo root for checks that read non-linted inputs
+    (doc/INVENTORY.md, doc/CHAOS.md, tools/chaos_soak.py); None skips
+    them (unit fixtures)."""
+    from . import donation, exceptions, frozen, knobs, locks, registry, \
+        threads, tracer
 
-    checkers = (locks, donation, tracer, frozen, exceptions)
+    checkers = (locks, donation, tracer, frozen, exceptions, knobs,
+                registry, threads)
     ctx = Context()
+    ctx.root = root
     for module in checkers:
         for sf in files:
             module.collect(sf, ctx)
@@ -347,8 +373,10 @@ def run_files(files: List[SourceFile]):
     return kept, markers
 
 
-def run_paths(paths: Iterable[str]):
-    return run_files(load_files(paths))
+def run_paths(paths: Iterable[str], root: Optional[str] = None):
+    if root is None:
+        root = os.getcwd()
+    return run_files(load_files(paths), root=root)
 
 
 def _suppressed(sf: SourceFile, finding: Finding) -> bool:

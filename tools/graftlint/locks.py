@@ -1,4 +1,4 @@
-"""Rule (1) lock-discipline + lock-order.
+"""Rule (1) lock-discipline + lock-order, with interprocedural propagation.
 
 ``# guarded-by: <lock>`` on an attribute assignment (usually in
 ``__init__``) declares that the field's contents are protected by
@@ -13,7 +13,28 @@ init).  Module-level globals annotate the same way and check against
 ``# holds-lock: <lock>`` on a ``def`` declares a caller-holds-the-lock
 precondition: the body is analyzed with the lock held, and every call of
 the method from the same class outside the lock is flagged — the
-annotation is sound in both directions.
+annotation is sound in both directions.  Module-level functions carry
+the same contract, and calls to them are checked from module functions
+AND from methods.
+
+Interprocedural propagation: an *unannotated private* helper no longer
+needs ``# holds-lock:`` on every hop.  Lock-held state flows through a
+module-local call graph — a helper's body is analyzed with the
+intersection of what every reachable call site holds (to a fixpoint, so
+helper-calls-helper chains resolve).  The inference is deliberately
+conservative; a helper gets NO assumed locks when any of these holds:
+
+* its name is public (no ``_`` prefix) — external callers are invisible;
+* it is decorated — the decorator may change call semantics entirely;
+* it is ever referenced as a value (``cb = self._helper``) — the escape
+  may be called from anywhere;
+* it has zero in-module call sites;
+* a call site reaches it from a closure — the closure escapes its
+  caller, so the CALLER'S locks (declared or assumed) do not apply
+  (the closure's own ``with`` blocks still count).
+
+Constructor call sites count as holding every lock (construction
+happens-before sharing), matching the ctor-store exemption.
 
 lock-order: every textually nested acquisition records an (outer, inner)
 pair keyed by ``Class.lockname``; observing both (A, B) and (B, A)
@@ -44,26 +65,54 @@ def check(sf: SourceFile, ctx: Context) -> List[Finding]:
     findings: List[Finding] = []
     module_guarded = _module_guarded_fields(sf)
     # Module-level functions support holds-lock the same way methods do:
-    # the body checks as locked, and bare calls from other module-level
-    # code are flagged.
+    # the body checks as locked, and bare calls outside the lock are
+    # flagged (from module functions and from methods alike).
     module_holds: Dict[str, str] = {}
+    module_fns: Dict[str, ast.AST] = {}
     for node in sf.tree.body:
         if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            module_fns[node.name] = node
             lock = sf.annotation_near(sf.holds_lock, node.lineno)
             if lock:
                 module_holds[node.name] = lock
+
+    # Call sites reaching module-level helpers, collected from module
+    # functions AND class methods: callee -> [(held, propagate_assumed,
+    # caller_name)].  propagate_assumed is False for closure call sites.
+    module_calls: Dict[str, List[Tuple[frozenset, bool, str]]] = {}
+    module_universe = set(module_guarded.values()) | set(module_holds.values())
+
+    class_jobs = []
     for node in sf.tree.body:
         if isinstance(node, ast.ClassDef):
-            findings.extend(_check_class(sf, ctx, node, module_guarded))
-        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            initial = set()
-            lock = module_holds.get(node.name)
-            if lock:
-                initial.add(lock)
-            findings.extend(_check_function(
-                sf, ctx, node, fields={}, module_fields=module_guarded,
-                holds=initial, scope=f"{_modname(sf)}",
-                module_holds=module_holds))
+            class_jobs.append(_prepare_class(
+                sf, ctx, node, module_guarded, module_holds, module_fns,
+                module_calls))
+
+    fn_events: Dict[str, list] = {}
+    for name, fn in module_fns.items():
+        initial = {module_holds[name]} if name in module_holds else set()
+        known = module_universe | initial
+        events = _walk_held(sf, ctx, fn, known, _modname(sf), initial)
+        fn_events[name] = events
+        _record_calls(events, module_fns, module_calls, name,
+                      receiver=None)
+
+    module_assumed = _infer(
+        candidates=_module_candidates(sf, module_fns, module_holds),
+        call_sites=module_calls, universe=module_universe)
+
+    for name, fn in module_fns.items():
+        assumed = module_assumed.get(name, frozenset())
+        findings.extend(_check_events(
+            sf, fn, fn_events[name], fields={},
+            module_fields=module_guarded, holds_methods={},
+            module_holds=module_holds, scope=_modname(sf),
+            assumed=assumed,
+            note=_note(name, assumed, module_calls)))
+
+    for job in class_jobs:
+        findings.extend(job(module_assumed))
     return findings
 
 
@@ -148,26 +197,6 @@ def _holds_methods(sf: SourceFile, cls: ast.ClassDef) -> Dict[str, str]:
     return out
 
 
-def _check_class(sf: SourceFile, ctx: Context, cls: ast.ClassDef,
-                 module_fields: Dict[str, str]) -> List[Finding]:
-    fields = _class_guarded_fields(sf, cls)
-    holds = _holds_methods(sf, cls)
-    findings: List[Finding] = []
-    for node in cls.body:
-        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        if node.name in _CTOR_NAMES:
-            continue
-        initial = set()
-        lock = sf.annotation_near(sf.holds_lock, node.lineno)
-        if lock:
-            initial.add(lock)
-        findings.extend(_check_function(
-            sf, ctx, node, fields=fields, module_fields=module_fields,
-            holds=initial, scope=cls.name, holds_methods=holds))
-    return findings
-
-
 def _lock_of(expr: ast.AST) -> Optional[str]:
     """'mutex' for ``with self.mutex:``, '_seen_lock' for module locks,
     'cluster.lock' for foreign-object locks (order tracking only)."""
@@ -186,25 +215,252 @@ def _looks_like_lock(name: Optional[str]) -> bool:
     return bool(name) and ("lock" in name.lower() or "mutex" in name.lower())
 
 
-def _check_function(sf: SourceFile, ctx: Context, fn, fields, module_fields,
-                    holds: Set[str], scope: str,
-                    holds_methods: Optional[Dict[str, str]] = None,
-                    module_holds: Optional[Dict[str, str]] = None
-                    ) -> List[Finding]:
+# ---------------------------------------------------------------------------
+# Held-set walker: one traversal per function yields every expression
+# subtree with the lock set active there, tracking ``with`` acquisitions
+# (and recording lock-order pairs as a side effect).  Both the call-site
+# collector and the access checker consume this one event stream, so
+# their notion of "held" can never drift apart.
+# ---------------------------------------------------------------------------
+
+def _walk_held(sf: SourceFile, ctx: Context, fn, known_guards: Set[str],
+               scope: str, initial_held: Set[str]):
+    """[(expr_root, held frozenset, in_closure)] for fn's body."""
+    events: List[Tuple[ast.AST, frozenset, bool]] = []
+
+    def scan_block(stmts, held: Set[str], in_closure: bool) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, (ast.With, ast.AsyncWith)):
+                acquired: List[str] = []
+                for item in stmt.items:
+                    events.append((item.context_expr, frozenset(held),
+                                   in_closure))
+                    name = _lock_of(item.context_expr)
+                    if name and (name in known_guards
+                                 or _looks_like_lock(name)):
+                        acquired.append(name)
+                new_held = set(held)
+                for name in acquired:
+                    inner = _qualify(scope, name)
+                    for outer_name in new_held:
+                        outer = _qualify(scope, outer_name)
+                        if outer != inner:
+                            ctx.lock_pairs.setdefault(
+                                (outer, inner), (sf.path, stmt.lineno))
+                    new_held.add(name)
+                scan_block(stmt.body, new_held, in_closure)
+            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                # A closure may escape and run later, off-lock: analyze
+                # its body with nothing held (conservative).
+                scan_block(stmt.body, set(), True)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                events.append((stmt.test, frozenset(held), in_closure))
+                scan_block(stmt.body, held, in_closure)
+                scan_block(stmt.orelse, held, in_closure)
+            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                events.append((stmt.target, frozenset(held), in_closure))
+                events.append((stmt.iter, frozenset(held), in_closure))
+                scan_block(stmt.body, held, in_closure)
+                scan_block(stmt.orelse, held, in_closure)
+            elif isinstance(stmt, ast.Try):
+                scan_block(stmt.body, held, in_closure)
+                for handler in stmt.handlers:
+                    scan_block(handler.body, held, in_closure)
+                scan_block(stmt.orelse, held, in_closure)
+                scan_block(stmt.finalbody, held, in_closure)
+            elif isinstance(stmt, ast.ClassDef):
+                continue
+            else:
+                events.append((stmt, frozenset(held), in_closure))
+
+    scan_block(fn.body, set(initial_held), False)
+    return events
+
+
+# ---------------------------------------------------------------------------
+# Interprocedural inference
+# ---------------------------------------------------------------------------
+
+def _record_calls(events, callees: Dict[str, ast.AST],
+                  call_sites: Dict[str, List[Tuple[frozenset, bool, str]]],
+                  caller: str, receiver: Optional[str]) -> None:
+    """Record calls from one function's event stream.  receiver None
+    matches bare-name calls (module functions); receiver 'self' matches
+    ``self.X()`` method calls."""
+    for root, held, in_closure in events:
+        for sub in ast.walk(root):
+            if not isinstance(sub, ast.Call):
+                continue
+            if receiver is None:
+                if (isinstance(sub.func, ast.Name)
+                        and sub.func.id in callees):
+                    call_sites.setdefault(sub.func.id, []).append(
+                        (held, not in_closure, caller))
+            else:
+                if (isinstance(sub.func, ast.Attribute)
+                        and isinstance(sub.func.value, ast.Name)
+                        and sub.func.value.id == receiver
+                        and sub.func.attr in callees):
+                    call_sites.setdefault(sub.func.attr, []).append(
+                        (held, not in_closure, caller))
+
+
+def _module_candidates(sf: SourceFile, module_fns: Dict[str, ast.AST],
+                       module_holds: Dict[str, str]) -> Set[str]:
+    candidates = {name for name, fn in module_fns.items()
+                  if name.startswith("_")
+                  and name not in module_holds
+                  and not getattr(fn, "decorator_list", None)}
+    if not candidates:
+        return candidates
+    parents = parent_map(sf.tree)
+    for node in ast.walk(sf.tree):
+        if (isinstance(node, ast.Name) and node.id in candidates
+                and isinstance(getattr(node, "ctx", None), ast.Load)):
+            parent = parents.get(node)
+            if not (isinstance(parent, ast.Call) and parent.func is node):
+                candidates.discard(node.id)   # value escape: no inference
+    return candidates
+
+
+def _class_candidates(cls: ast.ClassDef, methods: Dict[str, ast.AST],
+                      holds: Dict[str, str]) -> Set[str]:
+    candidates = {name for name, fn in methods.items()
+                  if name.startswith("_")
+                  and name not in _CTOR_NAMES
+                  and name not in holds
+                  and not getattr(fn, "decorator_list", None)}
+    if not candidates:
+        return candidates
+    parents = parent_map(cls)
+    for node in ast.walk(cls):
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self" and node.attr in candidates):
+            parent = parents.get(node)
+            if not (isinstance(parent, ast.Call) and parent.func is node):
+                candidates.discard(node.attr)  # value escape: no inference
+    return candidates
+
+
+def _infer(candidates: Set[str],
+           call_sites: Dict[str, List[Tuple[frozenset, bool, str]]],
+           universe: Set[str]) -> Dict[str, frozenset]:
+    """Fixpoint: assumed[m] = ∩ over call sites of (held at site, plus the
+    caller's own assumed set unless the site is in a closure).  Starts
+    from the full guard universe so helper->helper cycles converge from
+    above; a candidate with no call sites assumes nothing."""
+    assumed: Dict[str, frozenset] = {}
+    for name in candidates:
+        assumed[name] = (frozenset(universe) if call_sites.get(name)
+                         else frozenset())
+    changed = True
+    while changed:
+        changed = False
+        for name in candidates:
+            sites = call_sites.get(name)
+            if not sites:
+                continue
+            new: Optional[Set[str]] = None
+            for held, propagate, caller in sites:
+                eff = set(held)
+                if propagate and caller in assumed:
+                    eff |= assumed[caller]
+                new = eff if new is None else (new & eff)
+            new_frozen = frozenset(new or ())
+            if new_frozen != assumed[name]:
+                assumed[name] = new_frozen
+                changed = True
+    return assumed
+
+
+def _note(name: str,
+          assumed: frozenset,
+          call_sites: Dict[str, List[Tuple[frozenset, bool, str]]]) -> str:
+    """Finding-message hint when inference ran but could not prove the
+    lock held on every path into the helper."""
+    sites = call_sites.get(name)
+    if sites and not assumed:
+        callers = sorted({c for _h, _p, c in sites})
+        return (" — interprocedural: not every call site holds it "
+                f"(called from {', '.join(callers)})")
+    return ""
+
+
+# ---------------------------------------------------------------------------
+# Per-class driver
+# ---------------------------------------------------------------------------
+
+def _prepare_class(sf: SourceFile, ctx: Context, cls: ast.ClassDef,
+                   module_fields: Dict[str, str],
+                   module_holds: Dict[str, str],
+                   module_fns: Dict[str, ast.AST],
+                   module_calls: Dict[str, List[Tuple[frozenset, bool, str]]]):
+    """Walk the class's methods once (recording lock order and module
+    call sites as side effects), then return a closure that — given the
+    module-level inference results — finishes the class's own inference
+    and produces findings."""
+    fields = _class_guarded_fields(sf, cls)
+    holds = _holds_methods(sf, cls)
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    universe = (set(fields.values()) | set(module_fields.values())
+                | set(holds.values()) | set(module_holds.values()))
+    qname = f"{_modname(sf)}.{cls.name}"
+
+    events_by_method: Dict[str, list] = {}
+    class_calls: Dict[str, List[Tuple[frozenset, bool, str]]] = {}
+    for name, fn in methods.items():
+        if name in _CTOR_NAMES:
+            # Construction happens-before sharing: a ctor call site
+            # counts as holding everything (it constrains nothing), and
+            # the ctor body itself is never access-checked.
+            initial = set(universe)
+        else:
+            initial = {holds[name]} if name in holds else set()
+        known = universe | initial
+        events = _walk_held(sf, ctx, fn, known, cls.name, initial)
+        events_by_method[name] = events
+        _record_calls(events, methods, class_calls, name, receiver="self")
+        _record_calls(events, module_fns, module_calls, f"{qname}.{name}",
+                      receiver=None)
+
+    candidates = _class_candidates(cls, methods, holds)
+
+    def finish(module_assumed: Dict[str, frozenset]) -> List[Finding]:
+        assumed = _infer(candidates, class_calls, universe)
+        findings: List[Finding] = []
+        for name, fn in methods.items():
+            if name in _CTOR_NAMES:
+                continue
+            findings.extend(_check_events(
+                sf, fn, events_by_method[name], fields=fields,
+                module_fields=module_fields, holds_methods=holds,
+                module_holds=module_holds, scope=cls.name,
+                assumed=assumed.get(name, frozenset()),
+                note=_note(name, assumed.get(name, frozenset()),
+                           class_calls)))
+        return findings
+
+    return finish
+
+
+# ---------------------------------------------------------------------------
+# Access checking over an event stream
+# ---------------------------------------------------------------------------
+
+def _check_events(sf: SourceFile, fn, events, fields, module_fields,
+                  holds_methods: Dict[str, str],
+                  module_holds: Dict[str, str], scope: str,
+                  assumed: frozenset, note: str) -> List[Finding]:
     findings: List[Finding] = []
     parents = parent_map(fn)
-    holds_methods = holds_methods or {}
-    module_holds = module_holds or {}
-    # Names known to BE guards from annotations: a `with` on one of these
-    # counts as holding it even when the name itself doesn't look
-    # lock-ish (e.g. `_lk`); the name heuristic only extends coverage to
-    # unannotated foreign locks for order tracking.
-    known_guards = (set(fields.values()) | set(module_fields.values())
-                    | set(holds_methods.values())
-                    | set(module_holds.values()) | set(holds))
 
-    def check_expr_tree(node: ast.AST, held: Set[str]) -> None:
-        for sub in ast.walk(node):
+    for root, held_frozen, in_closure in events:
+        held = set(held_frozen)
+        if not in_closure:
+            held |= assumed     # inferred locks never apply inside closures
+        for sub in ast.walk(root):
             if (isinstance(sub, ast.Attribute)
                     and isinstance(sub.value, ast.Name)
                     and sub.value.id == "self" and sub.attr in fields):
@@ -217,7 +473,7 @@ def _check_function(sf: SourceFile, ctx: Context, fn, fields, module_fields,
                         RULE, sf.path, sub.lineno,
                         f"{scope}.{sub.attr} is guarded-by {lock} but "
                         f"this {_kind_word(kind)} runs outside "
-                        f"`with self.{lock}:` (in {fn.name})"))
+                        f"`with self.{lock}:` (in {fn.name}){note}"))
             elif isinstance(sub, ast.Name) and sub.id in module_fields:
                 lock = module_fields[sub.id]
                 if lock in held:
@@ -228,7 +484,7 @@ def _check_function(sf: SourceFile, ctx: Context, fn, fields, module_fields,
                         RULE, sf.path, sub.lineno,
                         f"module global {sub.id} is guarded-by {lock} but "
                         f"this {_kind_word(kind)} runs outside "
-                        f"`with {lock}:` (in {fn.name})"))
+                        f"`with {lock}:` (in {fn.name}){note}"))
             elif (isinstance(sub, ast.Call)
                   and isinstance(sub.func, ast.Attribute)
                   and isinstance(sub.func.value, ast.Name)
@@ -251,52 +507,6 @@ def _check_function(sf: SourceFile, ctx: Context, fn, fields, module_fields,
                         f"{sub.func.id}() declares holds-lock: {lock} "
                         f"but is called outside `with {lock}:` "
                         f"(in {fn.name})"))
-
-    def scan_block(stmts, held: Set[str]) -> None:
-        for stmt in stmts:
-            if isinstance(stmt, (ast.With, ast.AsyncWith)):
-                acquired: List[str] = []
-                for item in stmt.items:
-                    check_expr_tree(item.context_expr, held)
-                    name = _lock_of(item.context_expr)
-                    if name and (name in known_guards
-                                 or _looks_like_lock(name)):
-                        acquired.append(name)
-                new_held = set(held)
-                for name in acquired:
-                    inner = _qualify(scope, name)
-                    for outer_name in new_held:
-                        outer = _qualify(scope, outer_name)
-                        if outer != inner:
-                            ctx.lock_pairs.setdefault(
-                                (outer, inner), (sf.path, stmt.lineno))
-                    new_held.add(name)
-                scan_block(stmt.body, new_held)
-            elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                # A closure may escape and run later, off-lock: analyze
-                # its body with nothing held (conservative).
-                scan_block(stmt.body, set())
-            elif isinstance(stmt, (ast.If, ast.While)):
-                check_expr_tree(stmt.test, held)
-                scan_block(stmt.body, held)
-                scan_block(stmt.orelse, held)
-            elif isinstance(stmt, (ast.For, ast.AsyncFor)):
-                check_expr_tree(stmt.target, held)
-                check_expr_tree(stmt.iter, held)
-                scan_block(stmt.body, held)
-                scan_block(stmt.orelse, held)
-            elif isinstance(stmt, ast.Try):
-                scan_block(stmt.body, held)
-                for handler in stmt.handlers:
-                    scan_block(handler.body, held)
-                scan_block(stmt.orelse, held)
-                scan_block(stmt.finalbody, held)
-            elif isinstance(stmt, ast.ClassDef):
-                continue
-            else:
-                check_expr_tree(stmt, held)
-
-    scan_block(fn.body, set(holds))
     return findings
 
 

@@ -1,0 +1,377 @@
+"""Rules (9) metric-discipline and (10) chaos-registry: cross-file
+contract registries.
+
+metric-discipline — the Prometheus surface is a contract (doc/
+OBSERVABILITY.md): dashboards and the soak harness grep by metric name
+and label set.  Declarations are the ``SYMBOL = registry.register(
+Histogram|Counter|Gauge(f"{SUBSYSTEM}_..."))`` assignments in
+``kube_batch_tpu/metrics/metrics.py``; this rule checks that
+
+* every metric name is declared exactly once (two registrations of the
+  same name shadow each other in the exposition),
+* every direct emission (``symbol.inc/.set/.observe/.observe_many``)
+  passes exactly as many positional labels as the declaration names
+  (a missing label silently merges series; an extra one raises at
+  runtime — on an error path, usually), and
+* every declared metric is emitted somewhere: a symbol never referenced
+  outside its declaration is dashboard surface that can never move.
+  Indirect emission (the symbol escapes into a local/dict and is driven
+  dynamically, e.g. trace/lineage's SLO ledger) counts as emitted — the
+  rule is conservative, not clairvoyant.
+
+chaos-registry — doc/CHAOS.md's "Injection-site catalogue" table, the
+``plan.fire("site")`` call sites in the package, and the required-site
+lists in tools/chaos_soak.py (``FAKE_SITES``/``EDGE_SITES``) must agree:
+an undocumented site is invisible to operators, a documented site with
+no code is a lie, and a soak-required site with no injection point makes
+``make chaos-soak`` unsatisfiable.  Sites compare by base name (the part
+before ``:``, matching the plan's pattern semantics); f-string sites
+like ``f"watch.stale:{resource}"`` resolve through their static prefix.
+
+Both registries are collected from the linted file set and checked once,
+anchored on the file that owns the contract (metrics.py / chaos/plan.py)
+so linting a test directory alone cannot produce registry findings.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .core import Context, Finding, SourceFile
+
+METRIC_RULE = "metric-discipline"
+CHAOS_RULE = "chaos-registry"
+
+_EMIT_METHODS = ("inc", "set", "observe", "observe_many")
+_CTOR_NAMES = ("Histogram", "Counter", "Gauge")
+_DECL_SUFFIX = os.path.join("kube_batch_tpu", "metrics", "metrics.py")
+_CHAOS_ANCHOR = os.path.join("kube_batch_tpu", "chaos", "plan.py")
+
+
+def _is_metrics_file(sf: SourceFile) -> bool:
+    return os.path.normpath(sf.path).endswith(_DECL_SUFFIX)
+
+
+def _is_chaos_anchor(sf: SourceFile) -> bool:
+    return os.path.normpath(sf.path).endswith(_CHAOS_ANCHOR)
+
+
+def _in_package(sf: SourceFile) -> bool:
+    return "kube_batch_tpu" in os.path.normpath(sf.path).split(os.sep)
+
+
+def collect(sf: SourceFile, ctx: Context) -> None:
+    if _is_metrics_file(sf):
+        _collect_decls(sf, ctx)
+    if _in_package(sf):
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Call):
+                site = _fire_site(node)
+                if site is not None:
+                    ctx.chaos_sites.setdefault(
+                        site, (sf.path, node.lineno))
+    # Emission credit: any reference of a registered symbol outside the
+    # tests tree (tests drive metrics through their own Registry
+    # fixtures; crediting them would mask a production metric nothing
+    # emits).
+    if "tests" not in os.path.normpath(sf.path).split(os.sep):
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Attribute):
+                ctx.metric_refs.add(node.attr)
+            elif (isinstance(node, ast.Name)
+                  and isinstance(getattr(node, "ctx", None), ast.Load)):
+                ctx.metric_refs.add(node.id)
+
+
+def _collect_decls(sf: SourceFile, ctx: Context) -> None:
+    consts: Dict[str, str] = {}
+    for node in sf.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            consts[node.targets[0].id] = node.value.value
+    for node in sf.tree.body:
+        if not (isinstance(node, ast.Assign)
+                and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            continue
+        reg = node.value
+        if not (isinstance(reg.func, ast.Attribute)
+                and reg.func.attr == "register"
+                and isinstance(reg.func.value, ast.Name)
+                and reg.func.value.id == "registry"
+                and reg.args and isinstance(reg.args[0], ast.Call)):
+            continue
+        ctor = reg.args[0]
+        ctor_name = ctor.func.id if isinstance(ctor.func, ast.Name) else None
+        if ctor_name not in _CTOR_NAMES:
+            continue
+        name = _static_str(ctor.args[0], consts) if ctor.args else None
+        if name is None:
+            continue
+        labels = _label_names(ctor, ctor_name, consts)
+        symbol = node.targets[0].id
+        ctx.metric_decls.setdefault(name, []).append(
+            (sf.path, node.lineno, labels))
+        ctx.metric_vars[symbol] = name
+
+
+def _static_str(node: ast.AST, consts: Dict[str, str]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    if isinstance(node, ast.JoinedStr):
+        parts: List[str] = []
+        for value in node.values:
+            if isinstance(value, ast.Constant):
+                parts.append(str(value.value))
+            elif isinstance(value, ast.FormattedValue):
+                resolved = _static_str(value.value, consts)
+                if resolved is None:
+                    return None
+                parts.append(resolved)
+            else:
+                return None
+        return "".join(parts)
+    return None
+
+
+def _label_names(ctor: ast.Call, ctor_name: str,
+                 consts: Dict[str, str]) -> Optional[tuple]:
+    """Declared label tuple; None when not statically resolvable."""
+    # Histogram(name, help, buckets, label_names=()); Counter/Gauge
+    # (name, help, label_names=()).
+    pos_index = 3 if ctor_name == "Histogram" else 2
+    node = None
+    if len(ctor.args) > pos_index:
+        node = ctor.args[pos_index]
+    for kw in ctor.keywords:
+        if kw.arg == "label_names":
+            node = kw.value
+    if node is None:
+        return ()
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                out.append(elt.value)
+            else:
+                return None
+        return tuple(out)
+    return None
+
+
+def _fire_site(call: ast.Call) -> Optional[str]:
+    """Base site name for a ``<plan>.fire(...)`` call, else None."""
+    if not (isinstance(call.func, ast.Attribute)
+            and call.func.attr == "fire" and call.args):
+        return None
+    arg = call.args[0]
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return arg.value.split(":", 1)[0]
+    if (isinstance(arg, ast.JoinedStr) and arg.values
+            and isinstance(arg.values[0], ast.Constant)):
+        return str(arg.values[0].value).split(":", 1)[0]
+    return None
+
+
+def check(sf: SourceFile, ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    if _is_metrics_file(sf):
+        findings.extend(_metric_registry_findings(ctx))
+    if _is_chaos_anchor(sf):
+        findings.extend(_chaos_registry_findings(sf, ctx))
+    if ("tests" not in os.path.normpath(sf.path).split(os.sep)
+            and ctx.metric_vars):
+        findings.extend(_emission_findings(sf, ctx))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# metric-discipline
+# ---------------------------------------------------------------------------
+
+def _metric_registry_findings(ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for name, decls in sorted(ctx.metric_decls.items()):
+        if len(decls) > 1:
+            first_path, first_line, _ = decls[0]
+            for path, line, _labels in decls[1:]:
+                findings.append(Finding(
+                    METRIC_RULE, path, line,
+                    f"metric {name} is declared more than once (first at "
+                    f"{first_path}:{first_line}) — the exposition would "
+                    f"carry colliding series"))
+    for symbol, name in sorted(ctx.metric_vars.items()):
+        if symbol not in ctx.metric_refs:
+            path, line, _labels = ctx.metric_decls[name][0]
+            findings.append(Finding(
+                METRIC_RULE, path, line,
+                f"metric {name} ({symbol}) is declared but never emitted "
+                f"or referenced — dead dashboard surface; delete it or "
+                f"wire up the emission"))
+    return findings
+
+
+def _emission_findings(sf: SourceFile, ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _EMIT_METHODS):
+            continue
+        receiver = node.func.value
+        symbol = None
+        if isinstance(receiver, ast.Name):
+            symbol = receiver.id
+        elif isinstance(receiver, ast.Attribute):
+            symbol = receiver.attr
+        name = ctx.metric_vars.get(symbol or "")
+        if name is None:
+            continue
+        declared = ctx.metric_decls[name][0][2]
+        if declared is None:
+            continue   # label tuple not statically known: stay silent
+        if any(isinstance(a, ast.Starred) for a in node.args):
+            continue   # dynamic arity (observe_many(values, *labels))
+        passed = max(0, len(node.args) - 1)
+        if node.func.attr == "inc" and not node.args:
+            passed = 0     # inc() — amount defaults, no labels
+        if passed != len(declared):
+            findings.append(Finding(
+                METRIC_RULE, sf.path, node.lineno,
+                f"{symbol}.{node.func.attr}(...) passes {passed} label(s) "
+                f"but {name} declares {len(declared)} "
+                f"({', '.join(declared) or 'none'}) — mismatched labels "
+                f"merge or explode series at runtime"))
+    return findings
+
+
+# ---------------------------------------------------------------------------
+# chaos-registry
+# ---------------------------------------------------------------------------
+
+def _chaos_registry_findings(sf: SourceFile, ctx: Context) -> List[Finding]:
+    if ctx.root is None:
+        return []
+    findings: List[Finding] = []
+    doc_path = os.path.join(ctx.root, "doc", "CHAOS.md")
+    soak_path = os.path.join(ctx.root, "tools", "chaos_soak.py")
+
+    doc_sites = _doc_sites(doc_path)
+    if doc_sites is None:
+        findings.append(Finding(
+            CHAOS_RULE, sf.path, 1,
+            f"cannot read the injection-site catalogue from {doc_path} — "
+            f"run from the repo root (or restore the doc)"))
+        doc_sites = {}
+    required = _soak_sites(soak_path)
+    if required is None:
+        findings.append(Finding(
+            CHAOS_RULE, sf.path, 1,
+            f"cannot read FAKE_SITES/EDGE_SITES from {soak_path} — the "
+            f"soak's required-site list is the third leg of the "
+            f"registry"))
+        required = {}
+
+    code = ctx.chaos_sites
+    for site in sorted(set(code) - set(doc_sites)):
+        path, line = code[site]
+        findings.append(Finding(
+            CHAOS_RULE, path, line,
+            f"chaos site {site!r} is injected here but missing from "
+            f"doc/CHAOS.md's injection-site catalogue"))
+    for site, line in sorted(doc_sites.items()):
+        if site not in code:
+            findings.append(Finding(
+                CHAOS_RULE, sf.path, 1,
+                f"doc/CHAOS.md line {line} catalogues chaos site {site!r} "
+                f"but no plan.fire({site!r}...) exists in the package"))
+    for site, line in sorted(required.items()):
+        if site not in code:
+            findings.append(Finding(
+                CHAOS_RULE, sf.path, 1,
+                f"tools/chaos_soak.py line {line} requires chaos site "
+                f"{site!r} to fire but no plan.fire({site!r}...) exists "
+                f"in the package"))
+        if doc_sites and site not in doc_sites:
+            findings.append(Finding(
+                CHAOS_RULE, sf.path, 1,
+                f"tools/chaos_soak.py line {line} requires chaos site "
+                f"{site!r} but doc/CHAOS.md does not catalogue it"))
+    return findings
+
+
+def _doc_sites(path: str) -> Optional[Dict[str, int]]:
+    """site base -> line, from the '## Injection-site catalogue' table."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return None
+    sites: Dict[str, int] = {}
+    in_section = False
+    for i, line in enumerate(lines, start=1):
+        if line.startswith("## "):
+            in_section = "injection-site catalogue" in line.lower()
+            continue
+        if not in_section:
+            continue
+        stripped = line.strip()
+        if not stripped.startswith("| `"):
+            continue
+        name = stripped[3:].split("`", 1)[0]
+        base = name.split(":", 1)[0]
+        if base and base not in ("site",):
+            sites.setdefault(base, i)
+    return sites
+
+
+def _soak_sites(path: str) -> Optional[Dict[str, int]]:
+    """site base -> line, from FAKE_SITES / EDGE_SITES (EDGE_SITES is
+    ``FAKE_SITES + (<literal tuple>)`` — resolved statically)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return None
+    tuples: Dict[str, List[Tuple[str, int]]] = {}
+
+    def literal_elts(node: ast.AST) -> Optional[List[Tuple[str, int]]]:
+        if isinstance(node, (ast.Tuple, ast.List)):
+            out = []
+            for elt in node.elts:
+                if (isinstance(elt, ast.Constant)
+                        and isinstance(elt.value, str)):
+                    out.append((elt.value, elt.lineno))
+                else:
+                    return None
+            return out
+        if isinstance(node, ast.Name):
+            return tuples.get(node.id)
+        if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+            left = literal_elts(node.left)
+            right = literal_elts(node.right)
+            if left is None or right is None:
+                return None
+            return left + right
+        return None
+
+    for node in tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            elts = literal_elts(node.value)
+            if elts is not None:
+                tuples[node.targets[0].id] = elts
+    if "FAKE_SITES" not in tuples and "EDGE_SITES" not in tuples:
+        return None
+    out: Dict[str, int] = {}
+    for key in ("FAKE_SITES", "EDGE_SITES"):
+        for value, line in tuples.get(key, ()):
+            out.setdefault(value.split(":", 1)[0], line)
+    return out

@@ -1,0 +1,139 @@
+"""Rule (11) thread-lifecycle: every thread is joined or stoppable.
+
+A ``threading.Thread`` spawn must satisfy one of two disciplines:
+
+* **joined**: the enclosing function (or one of its closures) calls
+  ``.join(...)`` — the short-lived worker-pool idiom, where the spawner
+  owns the whole lifetime; or
+* **daemon + stop path**: the thread is marked ``daemon=True`` (the
+  ctor keyword or a ``t.daemon = True`` assignment in the same
+  function), AND the enclosing class — or the module, for free
+  functions — exposes a stop-ish method (name containing ``stop``,
+  ``close`` or ``shutdown``) whose body signals something (an event
+  ``.set()``, a ``.join()``, a ``.shutdown()``/``.stop()``/``.close()``
+  call).  Daemon alone is not a lifecycle: a daemon thread with no stop
+  path dies mid-operation at interpreter exit and cannot be drained by
+  tests or by ``Scheduler.stop()``-style teardown.
+
+A non-daemon spawn with no join blocks interpreter exit forever if the
+target loops; a daemon spawn with no stop path is unkillable between
+tests.  Both are flagged.  The rule checks tools/ and the package; test
+files are in scope too (leaked test threads poison later tests).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from .core import Context, Finding, SourceFile, attr_path, parent_map
+
+RULE = "thread-lifecycle"
+
+_STOPPISH_FRAGMENTS = ("stop", "close", "shutdown")
+_SIGNAL_METHODS = {"set", "join", "shutdown", "stop", "close", "cancel",
+                   "terminate", "kill"}
+
+
+def collect(sf: SourceFile, ctx: Context) -> None:
+    pass  # per-file rule: spawn, join and stop path live in one module
+
+
+def check(sf: SourceFile, ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    parents = parent_map(sf.tree)
+    module_has_stop = None   # computed lazily, most files spawn nothing
+    for node in ast.walk(sf.tree):
+        if not _is_thread_ctor(node):
+            continue
+        fn = _enclosing(node, parents,
+                        (ast.FunctionDef, ast.AsyncFunctionDef))
+        scope_name = fn.name if fn is not None else "<module>"
+        if fn is not None and _has_join(fn):
+            continue
+        if not _is_daemon(node, fn, parents):
+            findings.append(Finding(
+                RULE, sf.path, node.lineno,
+                f"thread spawned in {scope_name} is neither joined there "
+                f"nor daemon=True — a non-daemon thread with no join "
+                f"blocks interpreter exit"))
+            continue
+        cls = _enclosing(node, parents, (ast.ClassDef,))
+        if cls is not None:
+            has_stop = _has_stoppish(cls.body)
+            where = f"class {cls.name}"
+        else:
+            if module_has_stop is None:
+                module_has_stop = _has_stoppish(sf.tree.body)
+            has_stop = module_has_stop
+            where = "this module"
+        if not has_stop:
+            findings.append(Finding(
+                RULE, sf.path, node.lineno,
+                f"daemon thread spawned in {scope_name} has no stop path "
+                f"— {where} defines no stop()/close()/shutdown() that "
+                f"signals it (daemon alone dies mid-operation at exit "
+                f"and cannot be drained between tests)"))
+    return findings
+
+
+def _is_thread_ctor(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    path = attr_path(node.func)
+    return path in ("threading.Thread", "Thread")
+
+
+def _enclosing(node: ast.AST, parents, kinds) -> Optional[ast.AST]:
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, kinds):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def _has_join(fn: ast.AST) -> bool:
+    for sub in ast.walk(fn):
+        if (isinstance(sub, ast.Call)
+                and isinstance(sub.func, ast.Attribute)
+                and sub.func.attr == "join"
+                and not isinstance(sub.func.value, ast.Constant)):
+            # str.join literals ("".join(...)) are not thread joins
+            return True
+    return False
+
+
+def _is_daemon(call: ast.Call, fn: Optional[ast.AST], parents) -> bool:
+    for kw in call.keywords:
+        if kw.arg == "daemon":
+            return (isinstance(kw.value, ast.Constant)
+                    and bool(kw.value.value))
+    # ``t.daemon = True`` / ``self._thread.daemon = True`` in the same
+    # function — the two-statement spelling of the same discipline.
+    scope = fn if fn is not None else None
+    if scope is None:
+        return False
+    for sub in ast.walk(scope):
+        if (isinstance(sub, ast.Assign)
+                and isinstance(sub.value, ast.Constant) and sub.value.value
+                and any(isinstance(t, ast.Attribute) and t.attr == "daemon"
+                        for t in sub.targets)):
+            return True
+    return False
+
+
+def _has_stoppish(body) -> bool:
+    """A stop-ish def whose body signals a thread (event.set/.join/...)."""
+    for node in body:
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if not any(frag in node.name.lower()
+                   for frag in _STOPPISH_FRAGMENTS):
+            continue
+        for sub in ast.walk(node):
+            if (isinstance(sub, ast.Call)
+                    and isinstance(sub.func, ast.Attribute)
+                    and sub.func.attr in _SIGNAL_METHODS):
+                return True
+    return False

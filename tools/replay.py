@@ -349,6 +349,7 @@ def replay(trace: dict, drain_cap: int = 40) -> dict:
         try:
             scheduler.cycle()
         except Exception as exc:  # the loop-survival contract broke
+            # lint: allow-swallow(recorded in loop_deaths and reported as a replay divergence — the harness outlives the cycle to diff the wreckage)
             loop_deaths.append(f"{type(exc).__name__}: {exc}")
 
     def apply_boundary(s) -> None:

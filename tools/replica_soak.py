@@ -351,7 +351,8 @@ def run_soak(*, replicas: int = 3, shards: int = 3, nodes: int = 12,
                 reclaim_box: dict = {}
 
                 def _watch_reclaim():
-                    while time.time() - kill_t < 60.0:
+                    while ("stop" not in reclaim_box
+                           and time.time() - kill_t < 60.0):
                         holders = set()
                         for rep in survivors:
                             holders.update(rep.owned())
@@ -378,6 +379,8 @@ def run_soak(*, replicas: int = 3, shards: int = 3, nodes: int = 12,
             deadline = kill_t + lease_duration + slack
             while "s" not in reclaim_box and time.time() < deadline:
                 time.sleep(0.02)
+            reclaim_box["stop"] = True   # drain the sampler: its result
+            watcher.join(timeout=2.0)    # (if any) is in the box already
             reclaim_s = reclaim_box.get("s")
             if reclaim_s is None:
                 holders = set()

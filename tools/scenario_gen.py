@@ -430,6 +430,7 @@ def run_arm(spec: dict, *, sequential: bool, cycles_per_wave: int = 4,
             try:
                 scheduler.cycle()
             except Exception as exc:  # the loop-survival contract broke
+                # lint: allow-swallow(recorded in loop_deaths and failed loudly at the end — the generator keeps driving waves to expose later breakage too)
                 loop_deaths.append(f"{type(exc).__name__}: {exc}")
 
         for ops in spec["waves"]:

@@ -4,7 +4,7 @@ COMPILE_CACHE ?= $(CURDIR)/.compile-cache
 
 .PHONY: lint lint-inventory test bench bench-cached bench-steady \
 	bench-evict bench-commit bench-churn bench-wire bench-ingest \
-	bench-shard \
+	bench-mem bench-shard \
 	bench-topo bench-tenancy bench-fused bench-gate \
 	bench-gate-baseline \
 	lineage-ab chaos chaos-smoke scenarios soak-replicas trace-demo \
@@ -130,6 +130,14 @@ bench-wire:
 # checker is self-contained and exits nonzero on any violation.
 bench-ingest:
 	env JAX_PLATFORMS=cpu $(PYTHON) tools/check_ingest_ab.py
+
+# Fleet memory ledger gate (doc/OBSERVABILITY.md "Memory ledger"):
+# steady churn rounds with a per-round <1% ledger-vs-store audit and a
+# monotone-growth leak gate, plus a live-edge burst/drain leg that must
+# release every mirror/pending/baseline byte.  The checker is
+# self-contained and exits nonzero on any violation.
+bench-mem:
+	env JAX_PLATFORMS=cpu $(PYTHON) tools/check_mem_ab.py
 
 # Sharded-vs-single-chip A/B smoke on the virtual 8-device CPU mesh
 # (doc/SHARDING.md): runs the 4-action storm with

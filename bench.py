@@ -105,7 +105,7 @@ def _tiers():
     return load_scheduler_conf(DEFAULT_SCHEDULER_CONF)[1]
 
 
-def _session_ms(cache, tiers, action, binder) -> float:
+def _session_ms(cache, tiers, action, binder, unbind=None) -> float:
     from kube_batch_tpu.framework import close_session, open_session
     start = time.perf_counter()
     ssn = open_session(cache, tiers)
@@ -115,6 +115,8 @@ def _session_ms(cache, tiers, action, binder) -> float:
         close_session(ssn)
     elapsed = (time.perf_counter() - start) * 1e3
     assert binder.binds, "session bound nothing"
+    if unbind is not None:
+        unbind(binder.binds)
     binder.binds.clear()
     return elapsed
 
@@ -143,6 +145,7 @@ def measure_full_session(n_tasks, n_nodes, n_jobs, n_queues,
     discarded: it both compiles any new jit shapes and is a cold, which
     measure_cold_sessions reports separately)."""
     from kube_batch_tpu.actions.tpu_allocate import TpuAllocateAction
+    from kube_batch_tpu.api import pod_key
     from kube_batch_tpu.models.synthetic import make_synthetic_cache
 
     _register()
@@ -150,9 +153,24 @@ def measure_full_session(n_tasks, n_nodes, n_jobs, n_queues,
                                          n_signatures=n_signatures)
     tiers = _tiers()
     action = TpuAllocateAction()
+    podmap = {pod_key(t.pod): t.pod for job in cache.jobs.values()
+              for t in job.tasks.values()}
+
+    def unbind(binds):
+        # Echo every bound pod back UNCHANGED (the informer update path):
+        # the assumed-bound task reverts to Pending, so each warm repeat
+        # measures the same backlog.  Without this, a shape small enough
+        # to place fully in one session (the test_bench_guard TINY run)
+        # leaves session 2+ with nothing to bind.  Outside the timed
+        # window by construction (_session_ms stops the clock first).
+        for key in binds:
+            pod = podmap.get(key)
+            if pod is not None:
+                cache.update_pod(pod, pod)
+
     with _gc_posture():
-        _session_ms(cache, binder=binder, tiers=tiers, action=action)
-        runs = [_session_ms(cache, tiers, action, binder)
+        _session_ms(cache, tiers, action, binder, unbind=unbind)
+        runs = [_session_ms(cache, tiers, action, binder, unbind=unbind)
                 for _ in range(repeat)]
     return _stats(runs)
 
@@ -263,6 +281,7 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
             updater.pod_groups.clear()
         return len(binds)
 
+    from kube_batch_tpu.metrics import memledger
     from kube_batch_tpu.metrics.metrics import (compile_cache_counts,
                                                 cycle_floor_values,
                                                 overlap_split_totals,
@@ -283,6 +302,7 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
         host_overlap = []
         device_wait = []
         floors_rounds = []
+        mem_rounds = []
         recompiled = []
         ship0 = ship_counts()
         shard0 = ship_shard_counts()
@@ -348,6 +368,7 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
             # (doc/OBSERVABILITY.md "The bench gate").
             recompiled.append(miss1 > miss0)
             floors_rounds.append(cycle_floor_values())
+            mem_rounds.append(memledger.totals())
             echo()
             retire.append((pgs, new_keys))
             host_overlap.append(h1 - h0)
@@ -421,6 +442,16 @@ def measure_steady_session(n_tasks, n_nodes, n_jobs, n_queues,
                        for floor in floors_rounds[-1]}
                       if len(floors_rounds) > 1 and floors_rounds[-1]
                       else None),
+        # Fleet memory ledger over the same steady window: per-ledger
+        # median of the per-round totals plus the process-lifetime peak
+        # (watermark) — the bench-gate keys that catch a mirror/baseline
+        # /stage memory regression (doc/OBSERVABILITY.md "Memory
+        # ledger").
+        "mem": ({name: {"median": int(statistics.median(
+                            [r[name] for r in mem_rounds[1:]])),
+                        "peak": memledger.watermarks()[name]}
+                 for name in mem_rounds[-1]}
+                if len(mem_rounds) > 1 else None),
         # Rounds of the [1:] steady window that contained a fresh XLA
         # compile: their wall clock measures the recompile, not the
         # steady state, so the median/p90 summary drops them (falling
@@ -1106,17 +1137,26 @@ def measure_session_stages(n_tasks, n_nodes, n_jobs, n_queues,
     artifact itself shows WHERE the session budget goes and the next
     bottleneck is visible in the record (tools/session_bench.py is the
     standalone form)."""
+    from kube_batch_tpu.api import pod_key
     from kube_batch_tpu.models.synthetic import make_synthetic_cache
 
     _register()
     cache, binder = make_synthetic_cache(n_tasks, n_nodes, n_jobs, n_queues)
     tiers = _tiers()
+    podmap = {pod_key(t.pod): t.pod for job in cache.jobs.values()
+              for t in job.tasks.values()}
     per_stage: dict = {}
     with _gc_posture():
         for cycle in range(repeat + 1):
             stages, placed = run_session_stages(cache, tiers)
             assert placed > 0, "stage session placed nothing"
             assert binder.binds, "stage session bound nothing"
+            # Same unbind echo as measure_full_session: a fully-placed
+            # shape must re-offer the identical backlog each cycle.
+            for key in binder.binds:
+                pod = podmap.get(key)
+                if pod is not None:
+                    cache.update_pod(pod, pod)
             binder.binds.clear()
             if cycle == 0:
                 continue  # compile/cold warm-up
@@ -2749,6 +2789,10 @@ def _run_full(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n,
     # Residual-floor medians over the same window: the attributable keys
     # tools/bench_compare.py gates (doc/OBSERVABILITY.md).
     out["floors_ms"] = steady_stats.get("floors_ms")
+    # Per-ledger steady-window byte medians + process-lifetime peaks
+    # (doc/OBSERVABILITY.md "Memory ledger"): the gate's directional-
+    # down memory keys.
+    out["mem"] = steady_stats.get("mem")
 
     # Queue-shard tenancy pacing (doc/TENANCY.md): per-tenant micro-
     # session rates under an asymmetric noisy/quiet churn split, plus

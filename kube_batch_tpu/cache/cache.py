@@ -23,7 +23,7 @@ from ..api.job_info import TaskInfo as _TaskInfo
 from ..api.queue_info import Queue, queue_from_versioned
 from ..api.pod_group_info import from_versioned
 from ..chaos import plan as chaos_plan
-from ..metrics import metrics
+from ..metrics import memledger, metrics
 from ..trace.lineage import lineage as pod_lineage
 from .interface import (AmbiguousOutcomeError, Binder, Cache, Evictor,
                         StatusUpdater, VolumeBinder)
@@ -70,6 +70,23 @@ def _retryable_bind_error(exc: Exception) -> bool:
 
 from collections import deque as _deque
 
+# Flat per-entry estimates for the cache's growable diagnostics/reuse
+# stores (one event 3-tuple; one pooled job/node clone).  Hooks and the
+# memledger auditors price entries identically, so audit_mem_ledgers
+# checks hook coverage, not estimate quality.
+_EVENT_EST = 96
+_CLONE_EST = 640
+
+
+def _event_ring_actual_nbytes(d: "_EventDeque") -> int:
+    return _EVENT_EST * len(d)
+
+
+def _pool_actual_nbytes(cache: "SchedulerCache") -> int:
+    with cache.mutex:
+        return _CLONE_EST * (len(cache._pooled_jobs)
+                             + len(cache._pooled_nodes))
+
 
 class _EventDeque(_deque):
     """The cache's local event deque, tee'd into the cluster event
@@ -83,13 +100,18 @@ class _EventDeque(_deque):
     appends FROM THE CALLING THREAD ONLY into a buffer the pipeline
     flushes at that shard's retire slot, so the event sequence stays
     bit-identical to the sequential arm.  Reflector threads keep
-    appending straight through a window."""
+    appending straight through a window.
+
+    # mem-ledger: event_ring
+    """
 
     def __init__(self, maxlen=10000, recorder=None):
         super().__init__(maxlen=maxlen)
         self._recorder = recorder
         self._defer_tid = None   # thread id owning the defer window
         self._deferred = None
+        self._mem_key = memledger.ledger("event_ring").track(
+            self, sizer=_event_ring_actual_nbytes)
 
     def begin_defer(self) -> None:
         import threading as _threading
@@ -111,6 +133,8 @@ class _EventDeque(_deque):
                 self._deferred.append(item)
                 return
         super().append(item)
+        memledger.ledger("event_ring").set(self._mem_key,
+                                           _EVENT_EST * len(self))
         if self._recorder is not None:
             try:
                 self._recorder.record(*item)
@@ -123,6 +147,8 @@ class _EventDeque(_deque):
     def extend(self, items):
         if self._recorder is None and self._defer_tid is None:
             super().extend(items)
+            memledger.ledger("event_ring").set(self._mem_key,
+                                               _EVENT_EST * len(self))
             return
         for item in items:
             self.append(item)
@@ -194,7 +220,10 @@ class _SnapState:
 
 
 class SchedulerCache(Cache):
-    """In-memory cluster mirror (cache.go:73-105)."""
+    """In-memory cluster mirror (cache.go:73-105).
+
+    # mem-ledger: snapshot_pool
+    """
 
     def __init__(self, scheduler_name: str = "kube-batch",
                  default_queue: str = "default",
@@ -248,6 +277,8 @@ class SchedulerCache(Cache):
         # uid -> (epoch, clone) / name -> (epoch, clone)
         self._pooled_jobs: Dict[str, tuple] = {}   # guarded-by: mutex
         self._pooled_nodes: Dict[str, tuple] = {}  # guarded-by: mutex
+        self._mem_pool = memledger.ledger("snapshot_pool").track(
+            self, sizer=_pool_actual_nbytes)
         # Incremental snapshot (doc/INCREMENTAL.md "floors"): dict-order
         # seq counter + the generation-keyed snapshot map; None while the
         # control arm (KUBE_BATCH_TPU_INCREMENTAL=0) runs, so the full
@@ -344,6 +375,13 @@ class SchedulerCache(Cache):
         with self.mutex:
             self._snap_full_invalidate()
 
+    def _mem_pool_refresh_locked(self) -> None:  # holds-lock: mutex
+        """Re-price the clone pool after a mutation.  The ledger lock is
+        a leaf, so nesting it under the mutex is safe."""
+        memledger.ledger("snapshot_pool").set(
+            self._mem_pool, _CLONE_EST * (len(self._pooled_jobs)
+                                          + len(self._pooled_nodes)))
+
     def discard_pooled_job(self, uid: str) -> None:
         """Called by a Session the moment it mutates a job clone: the clone
         is no longer a faithful copy of cache truth and must not be reused
@@ -353,6 +391,7 @@ class SchedulerCache(Cache):
         graftlint's guarded-by check)."""
         with self.mutex:
             self._pooled_jobs.pop(uid, None)
+            self._mem_pool_refresh_locked()
             st = self._snap_state
             if st is not None:
                 st.dirty_jobs.add(uid)
@@ -360,6 +399,7 @@ class SchedulerCache(Cache):
     def discard_pooled_node(self, name: str) -> None:
         with self.mutex:
             self._pooled_nodes.pop(name, None)
+            self._mem_pool_refresh_locked()
             st = self._snap_state
             if st is not None:
                 st.dirty_nodes.add(name)
@@ -482,6 +522,7 @@ class SchedulerCache(Cache):
             if job_terminated(job):
                 del self.jobs[job.uid]
                 self._pooled_jobs.pop(job.uid, None)
+                self._mem_pool_refresh_locked()
         if ti.node_name and ti.node_name in self.nodes:
             self._touch_node(self.nodes[ti.node_name])
             try:
@@ -640,6 +681,7 @@ class SchedulerCache(Cache):
             self.epoch += 1
             self.nodes.pop(node.name, None)
             self._pooled_nodes.pop(node.name, None)
+            self._mem_pool_refresh_locked()
             st = self._snap_state
             if st is not None:
                 st.dirty_nodes.add(node.name)
@@ -703,6 +745,7 @@ class SchedulerCache(Cache):
             if job_terminated(job):
                 del self.jobs[key]
                 self._pooled_jobs.pop(key, None)
+                self._mem_pool_refresh_locked()
             else:
                 self.deleted_jobs.append(job)
         self._note_churn(queue)
@@ -755,6 +798,7 @@ class SchedulerCache(Cache):
             if job_terminated(job):
                 del self.jobs[key]
                 self._pooled_jobs.pop(key, None)
+                self._mem_pool_refresh_locked()
             else:
                 self.deleted_jobs.append(job)
         self._note_churn(queue)
@@ -812,12 +856,18 @@ class SchedulerCache(Cache):
                 # Control arm: drop any map so a later re-enable starts
                 # from a fresh full walk instead of a stale baseline.
                 self._snap_state = None
-                return self._snapshot_full_locked(None)
-            if st is None:
-                st = self._snap_state = _SnapState()
-            if not st.valid or st.full:
-                return self._snapshot_full_locked(st)
-            return self._snapshot_incremental_locked(st)
+                info = self._snapshot_full_locked(None)
+            elif st is None or not st.valid or st.full:
+                if st is None:
+                    st = self._snap_state = _SnapState()
+                info = self._snapshot_full_locked(st)
+            else:
+                info = self._snapshot_incremental_locked(st)
+            # The walk above is the pool's only GROWTH chokepoint
+            # (_clone_job_locked and the node loops insert); re-price
+            # once per snapshot instead of per insert.
+            self._mem_pool_refresh_locked()
+        return info
 
     def _clone_job_locked(self, uid: str, job: JobInfo) -> JobInfo:  # holds-lock: mutex
         """One job's session clone: pooled when epoch-clean, else a fresh

@@ -21,6 +21,10 @@ endpoints (doc/OBSERVABILITY.md) ride the same server:
   /debug/topology            per-pool fragmentation (free nodes,
                              largest contiguous free block, frag
                              ratio) + slice placement outcomes
+  /debug/memory              fleet memory ledger: per-subsystem bytes,
+                             watermarks (with the session that set
+                             them), process RSS, optional tracemalloc
+                             top-K (KUBE_BATCH_TPU_MEMTRACE=1)
 """
 
 from __future__ import annotations
@@ -88,6 +92,9 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         "/debug/topology": "per-pool fragmentation: free nodes, largest "
                            "contiguous free block, frag ratio, slice "
                            "placement outcomes",
+        "/debug/memory": "fleet memory ledger: per-subsystem bytes, "
+                         "watermarks with owning session, process RSS, "
+                         "tracemalloc top-K (KUBE_BATCH_TPU_MEMTRACE=1)",
     }
 
     def _debug(self, path: str, query: dict) -> None:
@@ -128,6 +135,9 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             doc = topo_table.snapshot()
             doc["slices"] = metrics.topo_slice_counts()
             self._send_json(doc)
+        elif path == "/debug/memory":
+            from ..metrics import memledger
+            self._send_json(memledger.debug_doc())
         elif path == "/debug/sessions":
             self._send_json({"sessions": flight_recorder.summaries(),
                              "capacity": flight_recorder.capacity,

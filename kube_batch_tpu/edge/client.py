@@ -26,7 +26,7 @@ from ..api import objects as _objects
 from ..cache.cluster import Informer
 from ..cache.interface import AmbiguousOutcomeError
 from ..chaos import plan as chaos_plan
-from ..metrics import metrics
+from ..metrics import memledger, metrics
 from . import baseline as baseline_store
 from . import codec, codec_k8s, wire_shard
 
@@ -143,10 +143,45 @@ def _raw_key(resource: str, doc) -> str:
     return md["name"]
 
 
+#: Flat per-object shell estimate for a mirrored dataclass (pod shell +
+#: metadata strings, excluding the separately-ledgered `_wire_doc`
+#: baseline).  The mirror ledger's hook AND its auditor both price
+#: objects at this constant, so the audit checks hook coverage, not
+#: estimate quality (doc/OBSERVABILITY.md "Memory ledger").
+_MIRROR_OBJ_EST = 512
+
+
+def _mirror_actual_nbytes(c: "RemoteCluster") -> int:
+    """Audit sizer: recompute the mirror ledger from the live stores."""
+    with c.lock:
+        return sum(len(c._store(r)) for r in _WATCHED) * _MIRROR_OBJ_EST
+
+
+def _pending_actual_nbytes(c: "RemoteCluster") -> int:
+    """Audit sizer: raw bytes of every deferred lazy-mirror frame."""
+    with c.lock:
+        return sum(entry[3] for pend in c._pending.values()
+                   for entry in pend.values())
+
+
+def _baseline_actual_nbytes(c: "RemoteCluster") -> int:
+    """Audit sizer: `_wire_nbytes` actually retained on mirror objects
+    — the same truth `audit_baseline_bytes` reconciles per kind."""
+    with c.lock:
+        return sum(getattr(o, "_wire_nbytes", 0)
+                   for r in _WATCHED for o in c._store(r).values())
+
+
 class RemoteCluster:
     """Duck-types the Cluster surface the scheduler wiring consumes:
     ``*_informer`` fan-outs + mirror stores (ingest) and the effector
-    verbs (egress), all over HTTP."""
+    verbs (egress), all over HTTP.
+
+    Memory accounting (metrics/memledger.py):
+    # mem-ledger: mirror
+    # mem-ledger: pending
+    # mem-ledger: baseline
+    """
 
     def __init__(self, base_url: str, timeout: float = 10.0,
                  wire: str = "native"):
@@ -217,6 +252,18 @@ class RemoteCluster:
             for r in _WATCHED}
         self._baseline_lru: Dict[str, OrderedDict] = {
             r: OrderedDict() for r in _WATCHED}
+        # Fleet memory ledger components (metrics/memledger.py), keyed
+        # to this client's lifetime: mirror prices dataclass shells at
+        # a flat estimate, pending carries the deferred frames' raw
+        # bytes, baseline absorbs the per-kind ``_baseline_bytes``
+        # totals behind kube_batch_wire_baseline_bytes.  The auditors
+        # recompute each from the stores under ``lock``.
+        self._mem_mirror = memledger.ledger("mirror").track(
+            self, sizer=_mirror_actual_nbytes)
+        self._mem_pending = memledger.ledger("pending").track(
+            self, sizer=_pending_actual_nbytes)
+        self._mem_baseline = memledger.ledger("baseline").track(
+            self, sizer=_baseline_actual_nbytes)
 
     # -- ingest: reflectors -------------------------------------------------
 
@@ -346,8 +393,12 @@ class RemoteCluster:
                                         and self._in_domain(
                                             resource, domain, store[k])]:
                                     gone = store.pop(stale)
-                                    self._pending.get(resource, {}).pop(
-                                        stale, None)
+                                    gone_pend = self._pending.get(
+                                        resource, {}).pop(stale, None)
+                                    if gone_pend is not None:
+                                        memledger.ledger("pending").add(
+                                            self._mem_pending,
+                                            -gone_pend[3])
                                     self._drop_baseline_key(resource,
                                                             stale)
                                     self._note_baseline(resource, gone,
@@ -468,8 +519,12 @@ class RemoteCluster:
                                 # This frame's doc supersedes any
                                 # deferred one for the key (wire docs
                                 # are complete snapshots, not diffs).
-                                self._pending.get(resource, {}).pop(
-                                    key, None)
+                                superseded = self._pending.get(
+                                    resource, {}).pop(key, None)
+                                if superseded is not None:
+                                    memledger.ledger("pending").add(
+                                        self._mem_pending,
+                                        -superseded[3])
                                 old = store.get(key)
                                 store[key] = obj
                                 self._note_baseline(resource, old, obj)
@@ -674,11 +729,15 @@ class RemoteCluster:
             entry = pend.get(key)
             if entry is None:
                 pend[key] = [cur, edoc, frame_ts, len(raw)]
+                memledger.ledger("pending").add(self._mem_pending,
+                                                len(raw))
                 metrics.note_lazy_mirror("deferred")
             else:
                 # Coalesce: keep the prev the informer last delivered
                 # (entry[0]); only the latest doc + receipt stamp
                 # matter — wire docs are complete snapshots.
+                memledger.ledger("pending").add(self._mem_pending,
+                                                len(raw) - entry[3])
                 entry[1] = edoc
                 entry[2] = frame_ts
                 entry[3] = len(raw)
@@ -693,6 +752,7 @@ class RemoteCluster:
     def _flush_key_locked(self, resource: str, key: str) -> None:
         entry = self._pending.get(resource, {}).pop(key, None)
         if entry is not None:
+            memledger.ledger("pending").add(self._mem_pending, -entry[3])
             self._materialize_locked(resource, key, entry)
 
     def _materialize_locked(self, resource: str, key: str,
@@ -735,6 +795,8 @@ class RemoteCluster:
                 pend = self._pending[resource]
                 while pend:
                     key, entry = pend.popitem()
+                    memledger.ledger("pending").add(self._mem_pending,
+                                                    -entry[3])
                     self._materialize_locked(resource, key, entry)
                     n += 1
                 if n:
@@ -802,6 +864,11 @@ class RemoteCluster:
                     total = self._baseline_bytes.get(resource, 0) + delta
                     self._baseline_bytes[resource] = total
                     metrics.set_wire_baseline(resource, total)
+                    # Budget enforcement mutates `_wire_nbytes` in
+                    # place, outside _note_baseline — the ledger must
+                    # follow or audit_mem_ledgers drifts here.
+                    memledger.ledger("baseline").add(
+                        self._mem_baseline, delta)
                 metrics.note_baseline_budget(resource, op)
 
     def audit_baseline_bytes(self) -> Dict[str, int]:
@@ -886,6 +953,14 @@ class RemoteCluster:
             total = self._baseline_bytes.get(resource, 0) + delta
             self._baseline_bytes[resource] = total
             metrics.set_wire_baseline(resource, total)
+            memledger.ledger("baseline").add(self._mem_baseline, delta)
+        # Every mirror-store entry change routes through here (upsert,
+        # DELETED, SYNC purge, lazy-mirror materialize), so the mirror
+        # ledger's count delta piggybacks on the same call.
+        count_delta = (new is not None) - (old is not None)
+        if count_delta:
+            memledger.ledger("mirror").add(
+                self._mem_mirror, count_delta * _MIRROR_OBJ_EST)
 
     def wire_baseline_bytes(self) -> Dict[str, int]:
         """{kind: retained raw-doc baseline bytes} — the mirror-memory

@@ -22,7 +22,7 @@ from ..api.pod_group_info import (PodGroupCondition, PodGroupPending,
                                   PodGroupRunning, PodGroupUnknown,
                                   PodGroupUnschedulableType)
 from ..chaos import plan as chaos_plan
-from ..metrics import metrics
+from ..metrics import memledger, metrics
 from ..native import apply_placements as native_apply
 from ..trace import spans as trace
 from ..trace.lineage import lineage as pod_lineage
@@ -994,6 +994,9 @@ def open_session(cache, tiers: List[Tier],
     from .registry import get_plugin_builder
 
     ssn = Session(cache)
+    # Memory-ledger baseline for the session's mem_delta trace
+    # annotation (close_session; doc/OBSERVABILITY.md "Memory ledger").
+    ssn._mem_open = memledger.totals()
     with trace.span("snapshot"):
         # Chaos site: a session-open snapshot failure is the whole cycle
         # dying at its first step — the loop must swallow it and back off
@@ -1218,6 +1221,17 @@ def close_session(ssn: Session) -> None:
     from ..models import incremental
     incremental.note_session_mutations(ssn.cache, len(ssn.mutated_jobs),
                                        len(ssn.mutated_nodes))
+
+    # Per-session memory footprint: which ledgers this session grew or
+    # shrank, annotated onto the trace ("which session peaked the stage
+    # buffers" is then a /debug/sessions read, not a bisection).
+    mem_open = getattr(ssn, "_mem_open", None)
+    if mem_open is not None:
+        mem_delta = {name: nbytes - mem_open.get(name, 0)
+                     for name, nbytes in memledger.totals().items()
+                     if nbytes != mem_open.get(name, 0)}
+        if mem_delta:
+            trace.set_meta(mem_delta=mem_delta)
 
     ssn.jobs = {}
     ssn.nodes = {}

@@ -226,6 +226,14 @@ METRIC_SERIES_CAP = _knob(
     "KUBE_BATCH_TPU_METRIC_SERIES_CAP", "int", 64, "doc/OBSERVABILITY.md",
     "Per-metric label-series cardinality cap before the 'other' bucket",
     minimum=1, owner="kube_batch_tpu.metrics.metrics")
+MEMTRACE = _knob(
+    "KUBE_BATCH_TPU_MEMTRACE", "flag-opt-in", False, "doc/OBSERVABILITY.md",
+    "tracemalloc capture behind /debug/memory (1 enables; off = zero "
+    "overhead)", owner="kube_batch_tpu.metrics.memledger")
+MEM_AUDIT_EVERY = _knob(
+    "KUBE_BATCH_TPU_MEM_AUDIT_EVERY", "int", 0, "doc/OBSERVABILITY.md",
+    "Run audit_mem_ledgers() every N scheduler cycles (0 disables)",
+    clamp_min=0, owner="kube_batch_tpu.scheduler")
 
 # -- scheduler loop ---------------------------------------------------
 MAX_CYCLE_BACKOFF_S = _knob(

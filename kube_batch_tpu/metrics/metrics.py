@@ -679,6 +679,21 @@ wire_baseline = registry.register(Gauge(
     "kube_batch_wire_baseline_bytes",
     "Approximate bytes of raw wire-doc delta baselines retained by the "
     "mirror stores, per resource kind", ("kind",)))
+# Fleet memory ledger (metrics/memledger.py, doc/OBSERVABILITY.md
+# "Memory ledger"): per-subsystem byte accounting for every growable
+# store, with a high-watermark series attributing the peak to the
+# session that set it.  Written ONLY through memledger's publish path
+# (lint rule 11, ledger-discipline).
+mem_bytes = registry.register(Gauge(
+    "kube_batch_tpu_mem_bytes",
+    "Current accounted bytes per memory ledger (mirror, pending, "
+    "baseline, tensor_cache, stage, resident, incremental, "
+    "compile_cache, trace_ring, lineage_ring, event_ring, "
+    "snapshot_pool)", ("ledger",)))
+mem_watermark = registry.register(Gauge(
+    "kube_batch_tpu_mem_watermark_bytes",
+    "High-watermark of accounted bytes per memory ledger since process "
+    "start (or the last ledger reset)", ("ledger",)))
 # Topology / fragmentation SLO (models/topology.py, doc/TOPOLOGY.md):
 # per-pool fragmentation computed in the topo action's occupancy walk
 # and surfaced on /debug/topology + the bench-topo artifact.
@@ -1337,6 +1352,16 @@ def wire_baseline_totals() -> Dict[str, int]:
     bench wire artifact (ROADMAP item 1's memory-budget target)."""
     return {labels[0]: int(v)
             for labels, v in wire_baseline.values().items() if labels}
+
+
+def set_mem_bytes(ledger: str, nbytes: int) -> None:
+    """memledger's ONLY gauge sink (lint rule 11): publish one ledger's
+    current accounted bytes."""
+    mem_bytes.set(float(max(0, nbytes)), ledger)
+
+
+def set_mem_watermark(ledger: str, nbytes: int) -> None:
+    mem_watermark.set(float(max(0, nbytes)), ledger)
 
 
 _topo_pools_seen: set = set()  # single writer: the scheduling thread's topo action

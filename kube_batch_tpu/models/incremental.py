@@ -50,7 +50,7 @@ from typing import Dict, List, Optional, Tuple
 
 from .. import knobs
 from ..chaos import plan as chaos_plan
-from ..metrics import metrics
+from ..metrics import memledger, metrics
 from ..trace import spans as trace
 
 # =0 restores the sequential control: full tensorize scans, uncached
@@ -109,10 +109,29 @@ def resource_exact(res) -> bool:
     return True
 
 
+def _inc_state_nbytes(st: "IncrementalState") -> int:
+    """Array bytes retained across sessions: the persistent signature
+    mask/bonus and the per-job aggregate columns.  Shared by the
+    finish_tensorize set-hook and the memledger auditor."""
+    n = 0
+    for a in (st.sig_mask, st.sig_bonus):
+        n += int(getattr(a, "nbytes", 0) or 0)
+    agg = st.job_agg
+    if agg is not None:
+        for name in ("epochs", "min_avail", "ready", "valid", "alloc",
+                     "shares"):
+            n += int(getattr(getattr(agg, name, None), "nbytes", 0) or 0)
+    return n
+
+
 class IncrementalState:
     """Cross-session incremental bookkeeping, attached to an
     epoch-stamped SchedulerCache (mirror of tensor_snapshot's
-    TensorCache persistence gate).  Scheduling-thread only."""
+    TensorCache persistence gate).  Scheduling-thread only.
+
+    Memory accounting (metrics/memledger.py):
+    # mem-ledger: incremental
+    """
 
     def __init__(self):
         # Monotonic build counter: bumps once per COMPLETED tensorize
@@ -172,6 +191,14 @@ class IncrementalState:
         # as numpy column ops by plugins/drf.py's share computation, the
         # open_session job_valid gate, and plugins/gang.py's close walk.
         self.job_agg: Optional["JobAggregates"] = None
+        self._mem_key = memledger.ledger("incremental").track(
+            self, sizer=_inc_state_nbytes)
+
+    def _mem_refresh(self) -> None:
+        """Set-hook: re-price the incremental ledger (finish_tensorize
+        — the chokepoint where the persistent arrays are rebound)."""
+        memledger.ledger("incremental").set(self._mem_key,
+                                            _inc_state_nbytes(self))
 
     def invalidate_solve(self) -> None:
         self.solve_gen = -1
@@ -351,6 +378,7 @@ def begin_tensorize(ssn, tc, node_names, node_objs,
         st.sig_mask = None
         st.sig_bonus = None
         st.invalidate_solve()
+        st._mem_refresh()  # the dropped arrays must leave the books too
     st.build_open = True
 
     struct_key = _struct_key(struct)
@@ -674,6 +702,12 @@ def job_aggregates_open(ssn) -> Optional[JobAggregates]:
         _fill_job_row(agg, i, job)
         agg.epochs[i] = ep if ep is not None else -1
         agg.clones[i] = job
+    # job_agg rebinds OUTSIDE the tensorize chokepoint (open-session
+    # plugin path: _grow reallocations and the compaction rebuild above)
+    # — re-price here, or a session that opens and then dies before any
+    # tensorize (chaos faults) leaves the ledger under-counting for the
+    # life of this state object.
+    st._mem_refresh()
     return agg
 
 
@@ -806,6 +840,7 @@ def finish_tensorize(plan: Optional[SessionPlan], ssn, axis,
     st.last_kind = plan.kind
     st.last_reason = plan.reason
     st.stats[plan.kind] = st.stats.get(plan.kind, 0) + 1
+    st._mem_refresh()
     metrics.set_incremental_dirty(plan.dirty_nodes, plan.dirty_jobs)
     # One count per SESSION (the scanner and the allocate action may
     # both tensorize within one cycle; the first build classifies it).

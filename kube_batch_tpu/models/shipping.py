@@ -49,6 +49,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from .. import knobs
+from ..metrics import memledger
 from ..ops.compile_cache import bucket
 from ..ops.solver import SolverInputs
 
@@ -348,8 +349,38 @@ class _ShardShipState:
                  "rep_flat", "shard_arrays", "inputs")
 
 
+def _buf_nbytes(x) -> int:
+    """Bytes of an array (numpy or jax) or a container of arrays; 0 for
+    anything else.  Shared by the resident ledger's set-hook and its
+    memledger auditor."""
+    n = getattr(x, "nbytes", None)
+    if n is not None:
+        return int(n)
+    if isinstance(x, dict):
+        return sum(_buf_nbytes(v) for v in x.values())
+    if isinstance(x, (list, tuple)):
+        return sum(_buf_nbytes(v) for v in x)
+    return 0
+
+
+def _resident_nbytes(sh: "DeviceResidentShipper") -> int:
+    """Host + device bytes pinned by the resident image (full or
+    sharded) plus the recycled host pack scratch."""
+    n = _buf_nbytes(sh._scratch)
+    st = sh._state
+    if isinstance(st, _ShipState):
+        n += _buf_nbytes(st.host_flat) + _buf_nbytes(st.device_flat)
+    elif isinstance(st, _ShardShipState):
+        n += (_buf_nbytes(st.host_rep) + _buf_nbytes(st.host_shard)
+              + _buf_nbytes(st.rep_flat) + _buf_nbytes(st.shard_arrays))
+    return n
+
+
 class DeviceResidentShipper:
     """Delta shipping against a device-resident SolverInputs buffer.
+
+    Memory accounting (metrics/memledger.py):
+    # mem-ledger: resident
 
     Contract (doc/PIPELINE.md "dirty-row invalidation"): the host stages
     the session's tensors exactly as a full ship would (the TensorCache's
@@ -384,6 +415,15 @@ class DeviceResidentShipper:
         # aliasing guard); None for throwaway/direct-constructed
         # shippers, which are never shared.
         self._owner_id = None
+        self._mem_key = memledger.ledger("resident").track(
+            self, sizer=_resident_nbytes)
+
+    def _mem_refresh(self) -> None:
+        """Set-hook: re-price the resident ledger (every ship() return
+        and invalidate() — the chokepoints where the resident image or
+        the pack scratch is rebound)."""
+        memledger.ledger("resident").set(self._mem_key,
+                                         _resident_nbytes(self))
 
     def invalidate(self) -> None:
         """Drop the resident image so the next ship is a full one.  The
@@ -394,9 +434,16 @@ class DeviceResidentShipper:
         generation: nothing keyed to the dropped image may be reused."""
         self._state = None
         self.generation += 1
+        self._mem_refresh()
 
     def ship(self, inp: SolverInputs, cfg=None,
              float_dtype=None) -> SolverInputs:
+        out = self._ship(inp, cfg, float_dtype)
+        self._mem_refresh()
+        return out
+
+    def _ship(self, inp: SolverInputs, cfg=None,
+              float_dtype=None) -> SolverInputs:
         from ..metrics import metrics
         from ..trace import spans as trace
 

@@ -19,6 +19,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ..api import TaskStatus, allocated_status
+from ..metrics import memledger
 # The bucket ladder lives with the compile-ahead subsystem (it is the
 # compile-cache key space); re-exported here for the existing callers.
 from ..ops.compile_cache import bucket  # noqa: F401
@@ -311,13 +312,55 @@ class _NodePack:
                  "count", "maxt", "hi_rows", "coords_raw", "coords_any")
 
 
+def _arr_nbytes(a) -> int:
+    """numpy array bytes; 0 for None / non-array fields (ints, lists,
+    tuples) — the shared pricing both the set-hooks and the memledger
+    auditors use, so the audit checks hook coverage only."""
+    return int(getattr(a, "nbytes", 0) or 0)
+
+
+def _tensor_cache_nbytes(tc: "TensorCache") -> int:
+    """Array bytes held by the persistent tensor state: per-job blocks,
+    the node pack, and the occupancy matrices."""
+    n = 0
+    for blk in tc.jobs.values():
+        for name in _JobBlock.__slots__:
+            n += _arr_nbytes(getattr(blk, name, None))
+    pack = tc.pack
+    if pack is not None:
+        for name in _NodePack.__slots__:
+            n += _arr_nbytes(getattr(pack, name, None))
+    for a in (tc.occ_epochs, tc.occ_ports, tc.occ_selcnt):
+        n += _arr_nbytes(a)
+    return n
+
+
+def _stage_nbytes(tc: "TensorCache") -> int:
+    """Array bytes held by the persistent candidate staging buffers
+    (the TaskInfo list is priced at pointer cost — the objects belong
+    to the cache, not the stage)."""
+    n = 0
+    for a in (tc.stage_res_f, tc.stage_req_q, tc.stage_res_q,
+              tc.stage_sig, tc.stage_tasks_arr):
+        n += _arr_nbytes(a)
+    if tc.stage_tasks is not None:
+        n += 8 * len(tc.stage_tasks)
+    return n
+
+
 class TensorCache:
     """Cross-session tensorization state, attached to an epoch-stamped
     SchedulerCache: append-only global id tables for signatures /
     host-port keys / affinity selectors (compacted to session-local ids
     at assembly), per-job tensor blocks, and the node pack (SURVEY.md §7
     'incremental snapshot deltas'; cache.go:627-683 is the per-cycle walk
-    this removes)."""
+    this removes).
+
+    Memory accounting (metrics/memledger.py), refreshed by the
+    ``_mem_refresh`` set-hook at tensorize/drop_stage chokepoints:
+    # mem-ledger: tensor_cache
+    # mem-ledger: stage
+    """
 
     def __init__(self):
         self.sig_gid: Dict[tuple, int] = {}
@@ -379,6 +422,19 @@ class TensorCache:
         # O(tasks) object array per session.
         self.stage_tasks_arr = None  # frozen-after: stage
         self.persistent = False
+        self._mem_tensor = memledger.ledger("tensor_cache").track(
+            self, sizer=_tensor_cache_nbytes)
+        self._mem_stage = memledger.ledger("stage").track(
+            self, sizer=_stage_nbytes)
+
+    def _mem_refresh(self) -> None:
+        """Set-hook: re-price the tensor + stage ledgers from this
+        instance (tensorize end, drop_stage — the chokepoints where
+        the persistent arrays are rebound)."""
+        memledger.ledger("tensor_cache").set(
+            self._mem_tensor, _tensor_cache_nbytes(self))
+        memledger.ledger("stage").set(self._mem_stage,
+                                      _stage_nbytes(self))
 
     def drop_stage(self) -> None:
         """Invalidate the persistent candidate staging (axis flush, the
@@ -393,6 +449,7 @@ class TensorCache:
         self.stage_res_q = None
         self.stage_sig = None
         self.stage_tasks_arr = None
+        self._mem_refresh()
 
     def sig_id(self, sig: tuple) -> int:
         gid = self.sig_gid.get(sig)
@@ -1061,6 +1118,27 @@ def solver_config_from_tiers(tiers):
 def tensorize_session(ssn) -> TensorSnapshot:
     """Flatten the session into SolverInputs (cpu-staged numpy; device put
     happens in the action)."""
+    try:
+        return _tensorize_session_impl(ssn)
+    finally:
+        # An aborted build — a fallback early-return or an exception
+        # (injected chaos faults included) between begin_tensorize and
+        # finish_tensorize — leaves the persistent arrays and job
+        # blocks rebound with the finish-time re-price never reached,
+        # so the incremental / tensor_cache ledgers would under-count
+        # until the next COMPLETED build on this cache (or forever, for
+        # an abandoned cache).  Settle both on every exit; on the
+        # completed path these repeat the finish hooks idempotently.
+        from . import incremental as _inc
+        st = _inc.state_for(ssn.cache, create=False)
+        if st is not None:
+            st._mem_refresh()
+        tc = getattr(ssn.cache, "_tensor_cache", None)
+        if tc is not None:
+            tc._mem_refresh()
+
+
+def _tensorize_session_impl(ssn) -> TensorSnapshot:
     # Chaos site: tensorize is the device pipeline's first failure surface
     # (doc/CHAOS.md site ``session.tensorize``); its consumers degrade to
     # the host path and feed the device breaker.  No-op branch when off.
@@ -1832,4 +1910,5 @@ def tensorize_session(ssn) -> TensorSnapshot:
         has_pod_affinity_score=bool(paff_rows or panti_rows),
         weights=weights)
     _inc.finish_tensorize(plan, ssn, snap.resource_names, n_real, j_real)
+    tc._mem_refresh()
     return snap

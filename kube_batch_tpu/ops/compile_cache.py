@@ -230,6 +230,31 @@ def record_warmed(cache_dir: str, entries: dict) -> None:
 _seen_lock = threading.Lock()
 _seen: set = set()  # guarded-by: _seen_lock
 
+#: Flat per-solve-key estimate (a ~10-slot tuple of ints/strs + the set
+#: slot).  Hook and auditor share it, so audit_mem_ledgers checks hook
+#: coverage, not estimate quality (doc/OBSERVABILITY.md "Memory ledger").
+_KEY_EST = 160
+
+
+def _seen_actual_nbytes(seen: set) -> int:
+    with _seen_lock:
+        return len(seen) * _KEY_EST
+
+
+def _track_seen():
+    from ..metrics import memledger
+    with _seen_lock:  # registration keys off the set's identity
+        return memledger.ledger("compile_cache").track(
+            _seen, sizer=_seen_actual_nbytes)
+
+
+_mem_seen = _track_seen()
+
+
+def _mem_seen_add(n: int) -> None:
+    from ..metrics import memledger
+    memledger.ledger("compile_cache").add(_mem_seen, n)
+
 
 def solve_key(choice: str, inp, cfg) -> tuple:
     """In-process identity of one compiled solver executable: routing
@@ -259,6 +284,8 @@ def note_solve(choice: str, inp, cfg) -> bool:
     with _seen_lock:
         hit = key in _seen
         _seen.add(key)
+    if not hit:
+        _mem_seen_add(_KEY_EST)
     metrics.note_compile_cache(hit)
     return hit
 
@@ -272,6 +299,8 @@ def note_solve_key(key: tuple) -> bool:
     with _seen_lock:
         hit = key in _seen
         _seen.add(key)
+    if not hit:
+        _mem_seen_add(_KEY_EST)
     metrics.note_compile_cache(hit)
     return hit
 
@@ -280,13 +309,18 @@ def note_warmed(key: tuple) -> None:
     """Mark a signature as compiled (warmup path) WITHOUT counting it as
     a live hit or miss — warmup is setup, not traffic."""
     with _seen_lock:
+        added = key not in _seen
         _seen.add(key)
+    if added:
+        _mem_seen_add(_KEY_EST)
 
 
 def reset_seen() -> None:
     """Test hook: forget every in-process signature."""
+    from ..metrics import memledger
     with _seen_lock:
         _seen.clear()
+    memledger.ledger("compile_cache").set(_mem_seen, 0)
 
 
 # ---------------------------------------------------------------------------

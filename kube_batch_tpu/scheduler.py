@@ -161,6 +161,12 @@ class Scheduler:
         self._force_full_pending = False  # consumed by the tenancy engine
         self._max_backoff = knobs.MAX_CYCLE_BACKOFF_S.value()
         self._coalesce_s = knobs.COALESCE_MS.value() / 1e3
+        # Periodic memory-ledger audit (doc/OBSERVABILITY.md "Memory
+        # ledger"): every N cycles reconcile the byte ledgers against
+        # their stores — tolerant (log, don't raise): the audit races
+        # reflector threads, and a leak must not kill the loop.
+        self._mem_audit_every = knobs.MEM_AUDIT_EVERY.value()
+        self._cycles_since_mem_audit = 0
         # Log<->trace correlation: every loop record carries [s=<id>]
         # while a traced session is active (doc/OBSERVABILITY.md).
         trace.install_log_correlation()
@@ -449,6 +455,16 @@ class Scheduler:
             # The soak's survival ledger: this cycle completed (healthy
             # or degraded) with a fault plan active.
             metrics.note_chaos_survived()
+        if self._mem_audit_every > 0:
+            self._cycles_since_mem_audit += 1
+            if self._cycles_since_mem_audit >= self._mem_audit_every:
+                self._cycles_since_mem_audit = 0
+                from .metrics import memledger
+                report = memledger.audit_mem_ledgers(raise_on_drift=False)
+                drift = report.get("_drift")
+                if drift:
+                    log.error("memory ledger drift: %s",
+                              "; ".join(drift["failures"]))
         return ok
 
     def _cycle_delay(self, elapsed: float) -> float:

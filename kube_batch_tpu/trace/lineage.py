@@ -57,7 +57,7 @@ from collections import OrderedDict
 from typing import List, NamedTuple, Optional, Tuple
 
 from .. import knobs
-from ..metrics import metrics
+from ..metrics import memledger, metrics
 
 log = logging.getLogger(__name__)
 
@@ -95,6 +95,25 @@ class _Cfg(NamedTuple):
 def _resolve_cfg() -> _Cfg:
     return _Cfg(enabled=knobs.LINEAGE.enabled(),
                 capacity=knobs.LINEAGE_RING.value())
+
+
+# Flat per-structure estimates for the lineage ring (one _PodLineage
+# with its event list, one session-ledger entry = one int + one float).
+# Hooks and the memledger auditor price entries identically, so
+# audit_mem_ledgers checks hook coverage, not estimate quality.
+_POD_EST = 1024
+_SESSION_ENTRY_EST = 16
+
+
+def _lineage_nbytes_locked(rec: "LineageRecorder") -> int:
+    return (_POD_EST * len(rec._pods)
+            + _SESSION_ENTRY_EST * (len(rec._session_seqs)
+                                    + len(rec._session_opens)))
+
+
+def _lineage_actual_nbytes(rec: "LineageRecorder") -> int:
+    with rec._lock:
+        return _lineage_nbytes_locked(rec)
 
 
 # Wall<->monotonic anchor for DISPLAY only (/debug/lineage's
@@ -139,7 +158,10 @@ class _PodLineage:
 
 class LineageRecorder:
     """Lock-guarded bounded ring of per-pod timelines plus the
-    session-open ledger the derived ``considered`` stage reads."""
+    session-open ledger the derived ``considered`` stage reads.
+
+    # mem-ledger: lineage_ring
+    """
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -161,6 +183,14 @@ class LineageRecorder:
         # written only by the scheduling thread between set/clear, read
         # by the same thread's note_placed — no lock needed.
         self.cycle_context: str = ""
+        self._mem_key = memledger.ledger("lineage_ring").track(
+            self, sizer=_lineage_actual_nbytes)
+
+    def _mem_refresh_locked(self) -> None:
+        """Re-price the ring after a mutation.  Caller holds ``_lock``;
+        the ledger lock is a leaf, so nesting it here is safe."""
+        memledger.ledger("lineage_ring").set(
+            self._mem_key, _lineage_nbytes_locked(self))
 
     # ------------------------------------------------------------------
     # configuration
@@ -188,6 +218,7 @@ class LineageRecorder:
             self._sessions_dropped = 0
             self._pods_dropped = 0
             self._next_session = 1
+            self._mem_refresh_locked()
         self.cycle_context = ""
         return self.cfg()
 
@@ -198,6 +229,7 @@ class LineageRecorder:
             self._session_opens.clear()
             self._sessions_dropped = 0
             self._pods_dropped = 0
+            self._mem_refresh_locked()
 
     # ------------------------------------------------------------------
     # recording hooks (every one no-ops on the kill switch)
@@ -217,6 +249,7 @@ class LineageRecorder:
                 del self._session_seqs[:drop]
                 del self._session_opens[:drop]
                 self._sessions_dropped += drop
+            self._mem_refresh_locked()
 
     def note_ingest(self, key: str, ingest_mono: Optional[float],
                     queue: str = "") -> None:
@@ -247,6 +280,7 @@ class LineageRecorder:
                 self._pods_dropped += 1
                 if not old.bound and not old.closed:
                     evicted_unbound += 1
+            self._mem_refresh_locked()
         # A still-pending pod aged out of the ring loses its eventual
         # time-to-bind sample — counted here (the only place the loss
         # is knowable), never guessed at bind time where the pod is

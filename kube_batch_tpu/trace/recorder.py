@@ -19,12 +19,34 @@ import threading
 from typing import Dict, List, Optional
 
 from .. import knobs
+from ..metrics import memledger
 
 _RING_ENV = knobs.TRACE_RING.env
 _DEFAULT_RING = knobs.TRACE_RING.default
 
+# Flat per-structure estimates for a recorded SessionTrace (span
+# records, verdict/tally rows, counter triples).  The record() hook and
+# the memledger auditor price traces identically, so audit_mem_ledgers
+# checks hook coverage, not estimate quality.
+_TRACE_BASE_EST = 512
+_SPAN_EST = 160
+_ENTRY_EST = 256
+_COUNTER_EST = 48
+
+
+def _trace_nbytes(tr) -> int:
+    return (_TRACE_BASE_EST + _SPAN_EST * len(tr.spans)
+            + _ENTRY_EST * (len(tr.verdicts) + len(tr.tallies))
+            + _COUNTER_EST * len(tr.counters))
+
+
+def _ring_actual_nbytes(rec: "FlightRecorder") -> int:
+    with rec._lock:
+        return sum(_trace_nbytes(t) for t in rec._traces)
+
 
 class FlightRecorder:
+    """# mem-ledger: trace_ring"""
 
     def __init__(self, capacity: Optional[int] = None):
         if capacity is None:
@@ -37,6 +59,8 @@ class FlightRecorder:
         self._lock = threading.Lock()
         self._traces: List = []            # guarded-by: _lock  (oldest first)
         self._by_sid: Dict[int, object] = {}  # guarded-by: _lock
+        self._mem_key = memledger.ledger("trace_ring").track(
+            self, sizer=_ring_actual_nbytes)
 
     def record(self, trace) -> None:
         """Append a completed trace, evicting the oldest beyond capacity.
@@ -60,6 +84,8 @@ class FlightRecorder:
             while len(self._traces) > self.capacity:
                 old = self._traces.pop(0)
                 self._by_sid.pop(old.sid, None)
+            ring_nbytes = sum(_trace_nbytes(t) for t in self._traces)
+        memledger.ledger("trace_ring").set(self._mem_key, ring_nbytes)
 
     def get(self, sid: int):
         with self._lock:
@@ -78,6 +104,7 @@ class FlightRecorder:
         with self._lock:
             self._traces.clear()
             self._by_sid.clear()
+        memledger.ledger("trace_ring").set(self._mem_key, 0)
 
     # ------------------------------------------------------------------
     # read API for the /debug endpoints

@@ -1299,3 +1299,104 @@ class TestThreadLifecycle:
                 t.start()
         """)
         assert "thread-lifecycle" not in rules_of(findings)
+
+
+# ---------------------------------------------------------------------------
+# (12) ledger-discipline
+# ---------------------------------------------------------------------------
+
+MEMLEDGER_DECL = """
+    LEDGER_CATALOGUE = (
+        ("mirror", "dataclass mirror objects"),
+        ("stage", "staging buffers"),
+    )
+"""
+
+
+class TestLedgerDiscipline:
+    def test_marked_and_registered_passes(self):
+        findings = lint_files([
+            ("kube_batch_tpu/metrics/memledger.py", MEMLEDGER_DECL),
+            ("kube_batch_tpu/store.py", """
+                from .metrics import memledger
+
+                class Store:
+                    '''# mem-ledger: mirror'''
+
+                    def __init__(self):
+                        self._mem = memledger.ledger("mirror").track(self)
+            """)])
+        assert "ledger-discipline" not in rules_of(findings)
+
+    def test_marker_without_registration_flagged(self):
+        findings = lint_files([
+            ("kube_batch_tpu/metrics/memledger.py", MEMLEDGER_DECL),
+            ("kube_batch_tpu/store.py", """
+                class Store:
+                    '''# mem-ledger: mirror'''
+            """)])
+        hits = [f for f in findings if f.rule == "ledger-discipline"]
+        assert len(hits) == 1
+        assert "never calls memledger.ledger('mirror')" in hits[0].message
+
+    def test_marker_outside_catalogue_flagged(self):
+        findings = lint_files([
+            ("kube_batch_tpu/metrics/memledger.py", MEMLEDGER_DECL),
+            ("kube_batch_tpu/store.py", """
+                from .metrics import memledger
+
+                class Store:
+                    '''# mem-ledger: shadow'''
+
+                    def __init__(self):
+                        self._mem = memledger.ledger("shadow").track(self)
+            """)])
+        hits = [f for f in findings if f.rule == "ledger-discipline"]
+        assert len(hits) == 1
+        assert "LEDGER_CATALOGUE" in hits[0].message
+
+    def test_raw_gauge_write_flagged(self):
+        findings = lint_files([("kube_batch_tpu/rogue.py", """
+            from .metrics import metrics
+
+            def leak(n):
+                metrics.mem_bytes.set(float(n), "mirror")
+        """)])
+        hits = [f for f in findings if f.rule == "ledger-discipline"]
+        assert len(hits) == 1
+        assert "raw mem_bytes.set" in hits[0].message
+
+    def test_sink_call_outside_memledger_flagged(self):
+        findings = lint_files([("kube_batch_tpu/rogue.py", """
+            from .metrics.metrics import set_mem_bytes
+
+            def leak(n):
+                set_mem_bytes("mirror", n)
+        """)])
+        hits = [f for f in findings if f.rule == "ledger-discipline"]
+        assert len(hits) == 1
+        assert "private gauge sink" in hits[0].message
+
+    def test_memledger_itself_may_drive_the_sink(self):
+        findings = lint_files([
+            ("kube_batch_tpu/metrics/memledger.py", """
+    from . import metrics
+
+    LEDGER_CATALOGUE = (
+        ("mirror", "dataclass mirror objects"),
+    )
+
+    def publish(name, total):
+        metrics.set_mem_bytes(name, total)
+""")])
+        assert "ledger-discipline" not in rules_of(findings)
+
+    def test_suppression_marker_works(self):
+        findings = lint_files([("kube_batch_tpu/rogue.py", """
+            from .metrics import metrics
+
+            def leak(n):
+                # lint: disable=ledger-discipline (exposition self-test fixture)
+                metrics.mem_bytes.set(float(n), "mirror")
+        """)])
+        assert "ledger-discipline" not in rules_of(findings)

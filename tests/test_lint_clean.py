@@ -121,10 +121,11 @@ def test_registry_rules_are_wired():
     rule that silently fell out of RULES would pass the blanket gate
     while checking nothing."""
     assert {"knob-registry", "metric-discipline", "chaos-registry",
-            "thread-lifecycle"} <= set(RULES), sorted(RULES)
+            "thread-lifecycle", "ledger-discipline"} <= set(RULES), \
+        sorted(RULES)
     findings, _markers = _run()
     for rule in ("knob-registry", "metric-discipline", "chaos-registry",
-                 "thread-lifecycle"):
+                 "thread-lifecycle", "ledger-discipline"):
         hits = [f for f in findings if f.rule == rule]
         assert not hits, "\n".join(str(f) for f in hits)
 
@@ -134,9 +135,9 @@ def test_knob_registry_coverage_pinned():
     is pinned so a knob added without a declaration (or a declaration
     dropped without removing the flag) fails here, not in review."""
     from kube_batch_tpu import knobs
-    assert len(knobs.REGISTRY) == 42, sorted(knobs.REGISTRY)
+    assert len(knobs.REGISTRY) == 44, sorted(knobs.REGISTRY)
     rows = knobs.inventory_rows()
-    assert len(rows) == 42
+    assert len(rows) == 44
     inventory = (ROOT / "doc" / "INVENTORY.md").read_text(encoding="utf-8")
     for env in knobs.REGISTRY:
         assert env in inventory, f"{env} missing from doc/INVENTORY.md"
@@ -148,6 +149,7 @@ def test_registries_collected_nonempty():
     would make its rule vacuously green."""
     from tools.graftlint.core import Context
     from tools.graftlint import knobs as knob_rule
+    from tools.graftlint import ledger as ledger_rule
     from tools.graftlint import registry as registry_rule
     ctx = Context()
     ctx.root = str(ROOT)
@@ -155,6 +157,16 @@ def test_registries_collected_nonempty():
     for sf in files:
         knob_rule.collect(sf, ctx)
         registry_rule.collect(sf, ctx)
-    assert len(ctx.knob_decls) == 42
+        ledger_rule.collect(sf, ctx)
+    assert len(ctx.knob_decls) == 44
     assert len(ctx.metric_decls) >= 80, len(ctx.metric_decls)
     assert len(ctx.chaos_sites) >= 16, sorted(ctx.chaos_sites)
+    # ledger-discipline: the catalogue, every marked store, and the
+    # registration calls must all be visible to the rule (an anchor-path
+    # regression would make it vacuously green).
+    assert len(ctx.ledger_catalogue) == 12, sorted(ctx.ledger_catalogue)
+    marked = {name for _p, _l, _c, name in ctx.ledger_markers}
+    # compile_cache's store is a module-level set (no class to mark);
+    # every other catalogued ledger has a marked owning class.
+    assert set(ctx.ledger_catalogue) - marked == {"compile_cache"}, \
+        sorted(set(ctx.ledger_catalogue) - marked)

@@ -1,0 +1,353 @@
+"""Fleet memory ledger (kube_batch_tpu/metrics/memledger.py,
+doc/OBSERVABILITY.md "Memory ledger"): component lifecycle and watermark
+provenance, delta-hook vs audit reconciliation across real churn (the
+in-process scheduler and the HTTP edge), the /debug/memory endpoint over
+a live server, the MEMTRACE=0 zero-overhead pin, and gauge parity with
+the ledger's internal totals."""
+
+import gc
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kube_batch_tpu.metrics import memledger, metrics
+from kube_batch_tpu.metrics.memledger import Ledger, MemAuditError
+
+
+def _wait(predicate, timeout=10.0, msg="condition"):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if predicate():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out waiting for {msg}")
+
+
+def _get(url):
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, json.loads(resp.read().decode())
+    except urllib.error.HTTPError as exc:
+        return exc.code, json.loads(exc.read().decode())
+
+
+class _Store:
+    """A weakref-able stand-in for a growable store."""
+
+    def __init__(self):
+        self.nbytes = 0
+
+
+# ----------------------------------------------------------------------
+# Ledger mechanics
+
+
+class TestLedgerMechanics:
+    def test_track_add_set_drop(self):
+        led = Ledger("unit_mech")
+        store = _Store()
+        key = led.track(store, sizer=lambda s: s.nbytes)
+        led.add(key, 100)
+        assert led.total() == 100
+        led.add(key, -30)
+        assert led.total() == 70
+        led.set(key, 40)
+        assert led.total() == 40
+        led.drop(key)
+        assert led.total() == 0 and led.component_count() == 0
+
+    def test_components_are_independent(self):
+        led = Ledger("unit_multi")
+        a, b = _Store(), _Store()
+        ka = led.track(a)
+        kb = led.track(b)
+        led.set(ka, 10)
+        led.set(kb, 5)
+        assert led.total() == 15
+        led.drop(ka)
+        assert led.total() == 5
+
+    def test_watermark_growth_only_and_session_attribution(self,
+                                                          monkeypatch):
+        monkeypatch.setattr(memledger, "_sid_fn", lambda: 7)
+        led = Ledger("unit_wm")
+        store = _Store()              # keep the owner alive past track()
+        key = led.track(store)
+        led.set(key, 100)
+        assert led.watermark() == (100, 7)
+        monkeypatch.setattr(memledger, "_sid_fn", lambda: 8)
+        led.set(key, 60)          # shrink: watermark (and its sid) hold
+        assert led.watermark() == (100, 7)
+        led.set(key, 200)         # new peak: re-attributed
+        assert led.watermark() == (200, 8)
+
+    def test_component_dies_with_owner(self):
+        led = Ledger("unit_gc")
+        store = _Store()
+        key = led.track(store, sizer=lambda s: s.nbytes)
+        led.set(key, 512)
+        assert led.total() == 512
+        del store
+        gc.collect()
+        assert led.total() == 0 and led.component_count() == 0
+        assert led.audit() is None   # no live auditor left
+
+    def test_ledger_audit_pairs_hook_against_sizer(self):
+        led = Ledger("unit_audit")
+        store = _Store()
+        key = led.track(store, sizer=lambda s: s.nbytes)
+        store.nbytes = 300
+        led.set(key, 300)
+        assert led.audit() == (300, 300)
+        store.nbytes = 900            # store grew, hook forgotten
+        assert led.audit() == (300, 900)
+
+    def test_catalogue_names_are_the_only_ledgers(self):
+        assert len(memledger.LEDGER_CATALOGUE) == 12
+        with pytest.raises(KeyError):
+            memledger.ledger("not-a-ledger")
+
+    def test_audit_mem_ledgers_raises_on_drift(self):
+        """A component priced far off its store fails the fleet audit —
+        the forgotten-hook detector."""
+        store = _Store()
+        led = memledger.ledger("mirror")
+        key = led.track(store, sizer=lambda s: s.nbytes)
+        try:
+            led.set(key, 10_000_000)     # store actually holds 0
+            with pytest.raises(MemAuditError, match="mirror"):
+                memledger.audit_mem_ledgers()
+            report = memledger.audit_mem_ledgers(raise_on_drift=False)
+            assert any("mirror" in f
+                       for f in report["_drift"]["failures"])
+        finally:
+            led.drop(key)
+        assert memledger.audit_mem_ledgers(raise_on_drift=False).get(
+            "_drift") is None
+
+
+# ----------------------------------------------------------------------
+# in-process scheduler churn
+
+
+class TestSchedulerChurn:
+    def test_cycles_fill_ledgers_and_audit_reconciles(self):
+        from tests.test_e2e import CONF_TPU, Harness
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2)
+        h.create_job("j", 2, 2)
+        h.cycle()
+        assert len(h.bound("j")) == 2
+        totals = memledger.totals()
+        # The cache-side stores the harness exercises are accounted.
+        assert totals["tensor_cache"] > 0
+        assert totals["stage"] > 0
+        assert totals["compile_cache"] > 0
+        # Every hook agrees with its store at this quiescent point.
+        memledger.audit_mem_ledgers()
+        # More churn, then reconcile again (steal/rescope paths ride the
+        # same chokepoints).  A bind-free trailing cycle leaves the clone
+        # pool warm (binds bump epochs, which invalidates pooled clones).
+        h.create_job("k", 2, 2)
+        h.cycle(2)
+        assert memledger.ledger("snapshot_pool").total() > 0
+        memledger.audit_mem_ledgers()
+        for name, led in zip(memledger.totals(), memledger.ledgers()):
+            wm, _sid = led.watermark()
+            assert wm >= led.total(), name
+
+    def test_aborted_tensorize_settles_the_books(self, monkeypatch):
+        # A build that dies between begin_tensorize and finish_tensorize
+        # (chaos faults, tensorizer fallbacks) rebinds the persistent
+        # incremental arrays and TensorCache job blocks WITHOUT reaching
+        # the finish-time re-price — tensorize_session's finally must
+        # settle both ledgers anyway, or every later audit in the
+        # process inherits the drift (caught live by chaos-soak seeds).
+        from kube_batch_tpu.models import incremental as inc
+        from tests.test_e2e import CONF_TPU, Harness
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2)
+        h.create_job("j", 2, 2)
+        real_finish = inc.finish_tensorize
+
+        def exploding_finish(plan, *a, **kw):
+            raise RuntimeError("injected mid-build abort")
+
+        monkeypatch.setattr(inc, "finish_tensorize", exploding_finish)
+        h.cycle()  # the session degrades; the scheduler survives
+        # Exact hook-vs-sizer parity on this cache's own component —
+        # the global audit's 4 KiB tolerance would hide the drift at
+        # this 2-node shape, so the assertion must be byte-exact.
+        st = inc.state_for(h.cache, create=False)
+        assert st is not None and st.build_open  # the abort really hit
+        led = memledger.ledger("incremental")
+        assert led._components[st._mem_key] == inc._inc_state_nbytes(st)
+        memledger.audit_mem_ledgers()
+        monkeypatch.setattr(inc, "finish_tensorize", real_finish)
+        h.cycle()  # recovery: the next build completes and re-prices
+        assert len(h.bound("j")) == 2
+        assert not st.build_open
+        assert led._components[st._mem_key] == inc._inc_state_nbytes(st)
+        memledger.audit_mem_ledgers()
+
+    def test_session_mem_delta_annotated_on_trace(self):
+        from kube_batch_tpu.trace import flight_recorder
+        from tests.test_e2e import CONF_TPU, Harness
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2)
+        h.create_job("j", 2, 2)
+        h.cycle()
+        tr = flight_recorder.latest()
+        assert tr is not None
+        delta = tr.meta.get("mem_delta")
+        # The first session grows the snapshot pool / tensor cache from
+        # empty, so the annotation must exist and be non-trivial.
+        assert isinstance(delta, dict) and delta
+        assert all(isinstance(v, int) and v != 0 for v in delta.values())
+
+
+# ----------------------------------------------------------------------
+# the HTTP edge: mirror / pending / baseline components
+
+
+@pytest.fixture()
+def live_edge():
+    from kube_batch_tpu.api import ObjectMeta
+    from kube_batch_tpu.apis.scheduling import v1alpha1
+    from kube_batch_tpu.cache import Cluster
+    from kube_batch_tpu.edge import ApiServer, RemoteCluster
+    cluster = Cluster()
+    cluster.create_queue(v1alpha1.Queue(
+        metadata=ObjectMeta(name="default"),
+        spec=v1alpha1.QueueSpec(weight=1)))
+    cluster.create_pod_group(v1alpha1.PodGroup(
+        metadata=ObjectMeta(name="pg1", namespace="ns"),
+        spec=v1alpha1.PodGroupSpec(min_member=1, queue="default")))
+    server = ApiServer(cluster).start()
+    remote = RemoteCluster(server.url).start()
+    yield cluster, remote
+    remote.stop()
+    server.stop()
+
+
+def _mk_pod(name):
+    from tests.test_utils import build_pod, build_resource_list
+    labels = {f"pad.example.com/key-{i}": f"value-{i:032d}"
+              for i in range(20)}
+    return build_pod("ns", name, "", "Pending",
+                     build_resource_list("1", "1Gi"), "pg1", labels=labels)
+
+
+class TestEdgeLedgers:
+    def test_mirror_and_baseline_account_and_release(self, live_edge):
+        cluster, remote = live_edge
+        from kube_batch_tpu.edge.client import _MIRROR_OBJ_EST
+        mirror = memledger.ledger("mirror")
+        baseline = memledger.ledger("baseline")
+        base_m = mirror.total()
+        base_b = baseline.total()
+        for i in range(6):
+            cluster.create_pod(_mk_pod(f"p{i}"))
+        _wait(lambda: len(remote.pods) == 6, msg="pods mirrored")
+        # The queue + podgroup were mirrored at start(); the six pods are
+        # the only growth since base_m was read.
+        grown_m = mirror.total() - base_m
+        assert grown_m == 6 * _MIRROR_OBJ_EST, grown_m
+        assert baseline.total() > base_b
+        # This remote's baseline component equals its own per-kind
+        # ledger — the accounting is per-store, not a global smear.
+        with baseline._lock:
+            component = baseline._components[remote._mem_baseline]
+        assert component == sum(remote.wire_baseline_bytes().values())
+        memledger.audit_mem_ledgers()
+        # Drain: deletes release mirror shells and retained baselines.
+        for i in range(6):
+            cluster.delete_pod("ns", f"p{i}")
+        _wait(lambda: len(remote.pods) == 0, msg="mirror drained")
+        assert mirror.total() == base_m
+        memledger.audit_mem_ledgers()
+
+    def test_baseline_gauge_parity(self, live_edge):
+        """kube_batch_tpu_mem_bytes{ledger="baseline"} tracks the ledger
+        exactly (publish granularity 0), alongside the pre-existing
+        kube_batch_wire_baseline_bytes surface it generalizes."""
+        cluster, remote = live_edge
+        for i in range(4):
+            cluster.create_pod(_mk_pod(f"g{i}"))
+        _wait(lambda: len(remote.pods) == 4, msg="pods mirrored")
+        led_total = memledger.ledger("baseline").total()
+        gauge = metrics.mem_bytes.values().get(("baseline",))
+        assert gauge is not None and int(gauge) == led_total
+        wm, _sid = memledger.ledger("baseline").watermark()
+        wm_gauge = metrics.mem_watermark.values().get(("baseline",))
+        assert wm_gauge is not None and int(wm_gauge) == wm
+
+
+# ----------------------------------------------------------------------
+# /debug/memory over a live server
+
+
+class TestDebugMemoryEndpoint:
+    def test_endpoint_and_index(self, live_edge):
+        from kube_batch_tpu.cli.server import start_metrics_server
+        from tests.test_e2e import CONF_TPU, Harness
+        cluster, remote = live_edge
+        cluster.create_pod(_mk_pod("dbg0"))
+        _wait(lambda: len(remote.pods) == 1, msg="pod mirrored")
+        h = Harness(conf=CONF_TPU)
+        h.add_nodes(2)
+        h.create_job("j", 2, 2)
+        h.cycle()
+        server = start_metrics_server("127.0.0.1:0")
+        try:
+            base = f"http://127.0.0.1:{server.server_address[1]}"
+            status, index = _get(f"{base}/debug")
+            assert status == 200
+            assert "/debug/memory" in index["endpoints"]
+            status, doc = _get(f"{base}/debug/memory")
+            assert status == 200
+            table = doc["ledgers"]
+            assert set(table) == {n for n, _ in
+                                  memledger.LEDGER_CATALOGUE}
+            # The acceptance floor: at least 10 ledgers have a live
+            # registered component once an edge and a scheduler ran.
+            registered = [n for n, row in table.items()
+                          if row["components"] > 0]
+            assert len(registered) >= 10, sorted(registered)
+            for row in table.values():
+                assert row["watermark_bytes"] >= row["bytes"] >= 0
+                assert row["what"]
+            assert doc["total_bytes"] == sum(
+                row["bytes"] for row in table.values())
+            assert doc["rss_bytes"] and doc["rss_bytes"] > 0
+            assert doc["tracemalloc"] is None   # MEMTRACE unset
+        finally:
+            server.shutdown()
+
+
+# ----------------------------------------------------------------------
+# MEMTRACE opt-in (zero overhead when off)
+
+
+class TestMemtrace:
+    def test_off_by_default_never_starts_tracemalloc(self):
+        import tracemalloc
+        assert memledger.debug_doc()["tracemalloc"] is None
+        assert not tracemalloc.is_tracing()
+
+    def test_opt_in_absolute_then_diff(self, monkeypatch):
+        import tracemalloc
+        monkeypatch.setenv("KUBE_BATCH_TPU_MEMTRACE", "1")
+        try:
+            doc = memledger._tracemalloc_doc(top_k=5)
+            assert doc["mode"] == "absolute"
+            assert doc["traced_bytes"] >= 0 and len(doc["top"]) <= 5
+            doc2 = memledger._tracemalloc_doc(top_k=5)
+            assert doc2["mode"] == "diff"
+        finally:
+            tracemalloc.stop()
+            with memledger._memtrace_lock:
+                memledger._memtrace_prev = None

@@ -143,6 +143,25 @@ GATED_KEYS = {
     "baseline_bytes": {
         "path": ("ingest", "baseline_bytes"), "direction": "down",
         "band": 0.05, "abs_slack": 2048.0},
+    # Fleet memory ledger over the steady window (doc/OBSERVABILITY.md
+    # "Memory ledger"): directional DOWN — memory only gets cheaper.
+    # The stage/tensor keys are sized by the deterministic gate shape
+    # (tight band, slack for array-padding drift); the mirror/baseline
+    # peaks are ZERO on the synthetic steady shape (no edge attached),
+    # so they act as leak canaries — any growth past the slack means a
+    # bench leg started retaining edge objects it never did before.
+    "mem.stage.median": {
+        "path": ("mem", "stage", "median"), "direction": "down",
+        "band": 0.25, "abs_slack": 65536.0},
+    "mem.tensor_cache.peak": {
+        "path": ("mem", "tensor_cache", "peak"), "direction": "down",
+        "band": 0.25, "abs_slack": 65536.0},
+    "mem.mirror.peak": {
+        "path": ("mem", "mirror", "peak"), "direction": "down",
+        "band": 0.0, "abs_slack": 4096.0},
+    "mem.baseline.peak": {
+        "path": ("mem", "baseline", "peak"), "direction": "down",
+        "band": 0.0, "abs_slack": 4096.0},
     # Full-bench keys: absent from steady-only artifacts (so they never
     # enter the bench-gate baseline) but extracted into the trajectory
     # when a full 50k-shape run is appended — the cross-PR history the

@@ -48,6 +48,7 @@ from kube_batch_tpu.api.objects import (Container, Node, NodeSpec,  # noqa: E402
 from kube_batch_tpu.apis.scheduling import v1alpha1  # noqa: E402
 from kube_batch_tpu.cache import Cluster, new_scheduler_cache  # noqa: E402
 from kube_batch_tpu.chaos import plan as chaos_plan  # noqa: E402
+from kube_batch_tpu.metrics import memledger  # noqa: E402
 from kube_batch_tpu.chaos.breaker import device_breaker  # noqa: E402
 from kube_batch_tpu.scheduler import Scheduler  # noqa: E402
 
@@ -313,8 +314,15 @@ def run_arm(plans, *, nodes: int, cycles: int, drain_cap: int = 30,
             return drain_and_converge()
 
         drain_a = storm_phase(plans[0], None)
+        mem_a = memledger.totals()   # post-drain reference sample
         phase_a_map = _bind_map(cluster)
         drain_b = storm_phase(plans[1], submit_wave)
+        # Post-drain memory hygiene (doc/OBSERVABILITY.md "Memory
+        # ledger"): quiescent, so every hook must reconcile with its
+        # store even after a fault storm drove the degrade/retry paths.
+        mem_b = memledger.totals()
+        mem_drift = memledger.audit_mem_ledgers(
+            raise_on_drift=False).get("_drift")
 
         injected: dict = {}
         for plan in plans:
@@ -333,6 +341,8 @@ def run_arm(plans, *, nodes: int, cycles: int, drain_cap: int = 30,
             "drain_cycles": (drain_a, drain_b),
             "converged_quiescent": drain_a > 0 and drain_b > 0,
             "injected": injected,
+            "mem_post_drain": (mem_a, mem_b),
+            "mem_drift": (mem_drift["failures"] if mem_drift else []),
         }
     finally:
         chaos_plan.disable()
@@ -478,6 +488,21 @@ def run_soak(seeds, *, nodes: int = 8, cycles: int = 10,
         errs = list(arm["violations"]) + list(arm["loop_deaths"])
         if not arm["converged_quiescent"]:
             errs.append("chaos arm never quiesced after drain")
+        # Post-drain leak gates: the audit reconciled, and the drainable
+        # ledgers did not ratchet between the two drains (monotone-by-
+        # design stores — rings, compile cache, tensor blocks — are
+        # bounded by their caps and exempt).
+        errs.extend(f"memory ledger drift after drain: {d}"
+                    for d in arm["mem_drift"])
+        mem_a, mem_b = arm["mem_post_drain"]
+        for name in ("mirror", "pending", "baseline", "stage",
+                     "snapshot_pool"):
+            ceiling = mem_a.get(name, 0) * 1.75 + 64 * 1024
+            if mem_b.get(name, 0) > ceiling:
+                errs.append(
+                    f"memory leak: ledger {name} at {mem_b[name]} bytes "
+                    f"after the second drain vs {mem_a.get(name, 0)} after "
+                    f"the first (ceiling {int(ceiling)})")
         errs.extend(_compare_to_oracle(arm, oracle, edge=edge))
         for site in arm["injected"]:
             sites_union.add(site.split(":", 1)[0])

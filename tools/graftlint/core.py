@@ -50,6 +50,7 @@ RULES = (
     "metric-discipline",
     "chaos-registry",
     "thread-lifecycle",
+    "ledger-discipline",
 )
 
 _GUARDED_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_][\w.]*)")
@@ -191,6 +192,13 @@ class Context:
         self.metric_refs: set = set()
         # chaos-registry: site base name -> first (path, line) observed.
         self.chaos_sites: Dict[str, Tuple[str, int]] = {}
+        # ledger-discipline: catalogue name -> (path, line) from
+        # memledger.LEDGER_CATALOGUE; docstring markers
+        # (path, line, class, ledger name); (path, name) registration
+        # calls observed.
+        self.ledger_catalogue: Dict[str, Tuple[str, int]] = {}
+        self.ledger_markers: List[Tuple[str, int, str, str]] = []
+        self.ledger_regs: set = set()
 
 
 @dataclass
@@ -343,11 +351,11 @@ def run_files(files: List[SourceFile], root: Optional[str] = None):
     ``root`` is the repo root for checks that read non-linted inputs
     (doc/INVENTORY.md, doc/CHAOS.md, tools/chaos_soak.py); None skips
     them (unit fixtures)."""
-    from . import donation, exceptions, frozen, knobs, locks, registry, \
-        threads, tracer
+    from . import donation, exceptions, frozen, knobs, ledger, locks, \
+        registry, threads, tracer
 
     checkers = (locks, donation, tracer, frozen, exceptions, knobs,
-                registry, threads)
+                registry, threads, ledger)
     ctx = Context()
     ctx.root = root
     for module in checkers:

@@ -1,0 +1,167 @@
+"""Rule (12) ledger-discipline: the fleet memory ledger is a contract
+(doc/OBSERVABILITY.md "Memory ledger").
+
+Every growable store that accounts its bytes carries a ``# mem-ledger:
+<name>`` marker in the owning class's docstring; this rule pins each
+marker to reality:
+
+* the marked name must appear in ``memledger.LEDGER_CATALOGUE`` (an
+  unmarked ledger is invisible to /debug/memory), and
+* the owning file must actually register the component — a
+  ``memledger.ledger("<name>")`` call — so a marker cannot outlive a
+  deleted registration.
+
+The gauges themselves (``kube_batch_tpu_mem_bytes`` /
+``kube_batch_tpu_mem_watermark_bytes``) are written ONLY through
+memledger's publication path: a raw ``mem_bytes.set(...)`` outside
+metrics.py, or a ``set_mem_bytes(...)`` call outside memledger.py,
+bypasses the watermark/audit bookkeeping and is a finding.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import List, Optional
+
+from .core import Context, Finding, SourceFile
+
+LEDGER_RULE = "ledger-discipline"
+
+_MARKER_RE = re.compile(r"#\s*mem-ledger:\s*([\w-]+)")
+_LEDGER_SUFFIX = os.path.join("kube_batch_tpu", "metrics", "memledger.py")
+_METRICS_SUFFIX = os.path.join("kube_batch_tpu", "metrics", "metrics.py")
+#: The two gauge registry symbols only memledger may drive.
+_GAUGE_SYMBOLS = ("mem_bytes", "mem_watermark")
+#: metrics.py's sink helpers, callable only from memledger.py.
+_SINK_FUNCS = ("set_mem_bytes", "set_mem_watermark")
+
+
+def _is_memledger_file(sf: SourceFile) -> bool:
+    return os.path.normpath(sf.path).endswith(_LEDGER_SUFFIX)
+
+
+def _is_metrics_file(sf: SourceFile) -> bool:
+    return os.path.normpath(sf.path).endswith(_METRICS_SUFFIX)
+
+
+def collect(sf: SourceFile, ctx: Context) -> None:
+    if _is_memledger_file(sf):
+        _collect_catalogue(sf, ctx)
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef):
+            doc = ast.get_docstring(node, clean=False) or ""
+            for marker in _MARKER_RE.findall(doc):
+                ctx.ledger_markers.append(
+                    (sf.path, node.lineno, node.name, marker))
+        elif isinstance(node, ast.Call):
+            name = _ledger_call_name(node)
+            if name is not None:
+                ctx.ledger_regs.add((sf.path, name))
+
+
+def _collect_catalogue(sf: SourceFile, ctx: Context) -> None:
+    """Ledger names from memledger.LEDGER_CATALOGUE (tuples of
+    (name, help) literals)."""
+    for node in sf.tree.body:
+        if not (isinstance(node, (ast.Assign, ast.AnnAssign))):
+            continue
+        targets = (node.targets if isinstance(node, ast.Assign)
+                   else [node.target])
+        if not (len(targets) == 1 and isinstance(targets[0], ast.Name)
+                and targets[0].id == "LEDGER_CATALOGUE"):
+            continue
+        value = node.value
+        if not isinstance(value, (ast.Tuple, ast.List)):
+            continue
+        for elt in value.elts:
+            if (isinstance(elt, ast.Tuple) and elt.elts
+                    and isinstance(elt.elts[0], ast.Constant)
+                    and isinstance(elt.elts[0].value, str)):
+                ctx.ledger_catalogue[elt.elts[0].value] = (
+                    sf.path, elt.lineno)
+
+
+def _ledger_call_name(call: ast.Call) -> Optional[str]:
+    """The static ledger name for a ``memledger.ledger("...")`` (or bare
+    ``ledger("...")``) call, else None."""
+    func = call.func
+    is_ledger = ((isinstance(func, ast.Attribute) and func.attr == "ledger")
+                 or (isinstance(func, ast.Name) and func.id == "ledger"))
+    if not (is_ledger and call.args
+            and isinstance(call.args[0], ast.Constant)
+            and isinstance(call.args[0].value, str)):
+        return None
+    return call.args[0].value
+
+
+def check(sf: SourceFile, ctx: Context) -> List[Finding]:
+    findings: List[Finding] = []
+    for path, line, cls, marker in ctx.ledger_markers:
+        if path != sf.path:
+            continue
+        if ctx.ledger_catalogue and marker not in ctx.ledger_catalogue:
+            findings.append(Finding(
+                LEDGER_RULE, path, line,
+                f"class {cls} is marked `# mem-ledger: {marker}` but "
+                f"{marker!r} is not in memledger.LEDGER_CATALOGUE — an "
+                f"undeclared ledger is invisible to /debug/memory"))
+        if (path, marker) not in ctx.ledger_regs:
+            findings.append(Finding(
+                LEDGER_RULE, path, line,
+                f"class {cls} is marked `# mem-ledger: {marker}` but this "
+                f"file never calls memledger.ledger({marker!r}) — the "
+                f"marker outlived its registration (or the hook was "
+                f"never written)"))
+    if not _is_metrics_file(sf):
+        findings.extend(_raw_gauge_findings(sf))
+    if not _is_memledger_file(sf):
+        findings.extend(_sink_call_findings(sf))
+    return findings
+
+
+def _raw_gauge_findings(sf: SourceFile) -> List[Finding]:
+    """``mem_bytes.set(...)`` / ``metrics.mem_watermark.set(...)``
+    anywhere outside metrics.py bypasses memledger's watermark and
+    audit bookkeeping."""
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "set"):
+            continue
+        receiver = node.func.value
+        symbol = None
+        if isinstance(receiver, ast.Name):
+            symbol = receiver.id
+        elif isinstance(receiver, ast.Attribute):
+            symbol = receiver.attr
+        if symbol in _GAUGE_SYMBOLS:
+            findings.append(Finding(
+                LEDGER_RULE, sf.path, node.lineno,
+                f"raw {symbol}.set(...) outside memledger's publication "
+                f"path — register a component and use "
+                f"memledger.ledger(...).set/add instead (gauge writes "
+                f"bypass the watermark and the audit)"))
+    return findings
+
+
+def _sink_call_findings(sf: SourceFile) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(sf.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        func = node.func
+        name = None
+        if isinstance(func, ast.Name):
+            name = func.id
+        elif isinstance(func, ast.Attribute):
+            name = func.attr
+        if name in _SINK_FUNCS:
+            findings.append(Finding(
+                LEDGER_RULE, sf.path, node.lineno,
+                f"{name}(...) is memledger's private gauge sink — "
+                f"register a component and use "
+                f"memledger.ledger(...).set/add instead"))
+    return findings

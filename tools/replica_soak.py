@@ -50,6 +50,7 @@ from kube_batch_tpu.api.objects import (Container, Node, NodeSpec,  # noqa: E402
 from kube_batch_tpu.apis.scheduling import v1alpha1  # noqa: E402
 from kube_batch_tpu.cache import Cluster, new_scheduler_cache  # noqa: E402
 from kube_batch_tpu.chaos import plan as chaos_plan  # noqa: E402
+from kube_batch_tpu.metrics import memledger  # noqa: E402
 from kube_batch_tpu.metrics.metrics import (compile_cache_counts,  # noqa: E402
                                             shard_bind_counts,
                                             shard_rebalance_counts,
@@ -285,6 +286,12 @@ def run_soak(*, replicas: int = 3, shards: int = 3, nodes: int = 12,
         if unbound():
             problems.append("base demand never bound during warm-up")
 
+        # Fleet memory ledger: the pre-storm reference sample.  The
+        # churn is balanced (each gang retires two rounds later), so
+        # the drainable ledgers must come back near this level after
+        # convergence — the post-drain leak gate below.
+        mem_pre = memledger.totals()
+
         # Seeded churn, optionally under seeded lease faults: create a
         # gang in a random queue each round, retire an old churn gang
         # two rounds later (its pods are deleted at truth).
@@ -403,6 +410,29 @@ def run_soak(*, replicas: int = 3, shards: int = 3, nodes: int = 12,
                 f"wait (cross-replica fairness broke): "
                 f"{sorted(leftovers)[:6]}")
 
+        # Post-drain leak gate (doc/OBSERVABILITY.md "Memory ledger"):
+        # with the churn retired and demand converged, every hook must
+        # still reconcile with its store, and the drainable ledgers
+        # must sit near the pre-storm level.  The monotone-by-design
+        # stores (rings, compile cache, tensor blocks) are exempt —
+        # their caps bound them; a drainable ledger that ratcheted is a
+        # leak.  Bands are generous (live reflector threads, the last
+        # two un-retired gangs) but a real leak blows through them.
+        mem_post = memledger.totals()
+        mem_report = memledger.audit_mem_ledgers(raise_on_drift=False)
+        mem_drift = mem_report.get("_drift")
+        if mem_drift:
+            problems.append("memory ledger drift after drain: "
+                            + "; ".join(mem_drift["failures"]))
+        for name in ("mirror", "pending", "baseline", "stage",
+                     "snapshot_pool"):
+            ceiling = mem_pre.get(name, 0) * 1.75 + 64 * 1024
+            if mem_post.get(name, 0) > ceiling:
+                problems.append(
+                    f"memory leak: ledger {name} at {mem_post[name]} bytes "
+                    f"after drain vs {mem_pre.get(name, 0)} pre-storm "
+                    f"(ceiling {int(ceiling)})")
+
         # Warm-failover contract: the adoption window paid ZERO fresh
         # XLA compiles and the hit counter moved (the adopted shard's
         # first sessions ran against already-compiled executables).
@@ -487,6 +517,9 @@ def run_soak(*, replicas: int = 3, shards: int = 3, nodes: int = 12,
                               "misses_before_kill": miss_before_kill,
                               "hits_after": hits_after,
                               "misses_after": miss_after},
+            "mem_pre": mem_pre,
+            "mem_post": mem_post,
+            "mem_watermarks": memledger.watermarks(),
             "problems": problems,
             "ok": not problems,
         }

@@ -1430,6 +1430,90 @@ def _fused_quiet_arm(fused, n_tasks, n_nodes, n_jobs, n_queues):
             os.environ[FUSED_ENV] = prior
 
 
+def _fused_served_storm_arm(storm, force_shard: bool = False, shape=None):
+    """Served-storm leg of the fused A/B (doc/FUSED.md "Storm half"):
+    ONE session on the crafted reclaim scenario
+    (models/synthetic.make_storm_served_cache) where the device's
+    post-eviction prediction bit-matches the host's committed victim
+    order — the postevict leg is SERVED and the eviction-heavy cycle
+    converges to exactly ONE solve-family dispatch, with the commit
+    flush riding the dispatch window.  ``storm`` toggles
+    KUBE_BATCH_TPU_FUSED_STORM (the =0 arm re-dispatches per family
+    after the evictions — the bit-parity control); ``shape`` overrides
+    the builder's scenario size (the steady probe scales it to the
+    gate shape, where the eliminated re-dispatch is a real solve).
+    Returns the parity footprint, the session wall, and the dispatch /
+    leg deltas."""
+    from kube_batch_tpu import knobs
+    from kube_batch_tpu.cache.cache import _EventDeque
+    from kube_batch_tpu.framework import close_session, open_session
+    from kube_batch_tpu.metrics.metrics import (fused_leg_counts,
+                                                session_dispatch_counts)
+    from kube_batch_tpu.models.synthetic import make_storm_served_cache
+    from kube_batch_tpu.ops.fused_solver import FUSED_ENV
+    from kube_batch_tpu.ops.solver import FORCE_SHARD_ENV, \
+        refresh_shard_knobs
+    from kube_batch_tpu.scheduler import load_scheduler_conf
+
+    _register()
+    conf_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "config", "kube-batch-conf.yaml")
+    with open(conf_path) as fh:
+        conf = fh.read().replace('"reclaim, allocate, backfill, preempt"',
+                                 '"reclaim, tpu-allocate, backfill, '
+                                 'preempt"')
+    actions, tiers = load_scheduler_conf(conf)
+
+    storm_env = knobs.FUSED_STORM.env
+    scan_env = knobs.SCAN_MIN_NODES.env
+    saved = {k: os.environ.get(k)
+             for k in (FUSED_ENV, storm_env, FORCE_SHARD_ENV, scan_env)}
+    os.environ[FUSED_ENV] = "1"
+    os.environ[storm_env] = "1" if storm else "0"
+    # The crafted scenario is deliberately small (8 nodes); drop the
+    # device-scan node floor so the eviction scan actually dispatches.
+    os.environ[scan_env] = "0"
+    if force_shard:
+        os.environ[FORCE_SHARD_ENV] = "1"
+    refresh_shard_knobs()
+    try:
+        cache, binder = make_storm_served_cache(**(shape or {}))
+        cache.events = _EventDeque(maxlen=200000)
+        d0 = session_dispatch_counts()
+        l0 = fused_leg_counts()
+        with _gc_posture():
+            t0 = time.perf_counter()
+            ssn = open_session(cache, tiers)
+            ssn._conf_actions = tuple(a.name() for a in actions)
+            try:
+                for a in actions:
+                    a.execute(ssn)
+            finally:
+                close_session(ssn)
+            wall = (time.perf_counter() - t0) * 1e3
+
+        def _delta(before, after):
+            return {k: v for k, v in
+                    ((k, after.get(k, 0) - before.get(k, 0))
+                     for k in after) if v}
+
+        return {
+            "wall_ms": round(wall, 2),
+            "evicts": list(cache.evictor.evicts),
+            "binds": dict(sorted(binder.binds.items())),
+            "events": list(cache.events),
+            "dispatches": _delta(d0, session_dispatch_counts()),
+            "legs": _delta(l0, fused_leg_counts()),
+        }
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        refresh_shard_knobs()
+
+
 def measure_fused_ab(n_tasks, n_nodes, n_jobs, n_queues,
                      cycles: int = 3):
     """Counterbalanced fused-session A/B (`make bench-fused`,
@@ -1491,6 +1575,30 @@ def measure_fused_ab(n_tasks, n_nodes, n_jobs, n_queues,
     for k, v in q_legs.items():
         legs[k] = legs.get(k, 0) + v
 
+    # Served-storm leg (doc/FUSED.md "Storm half"): the crafted reclaim
+    # scenario where the postevict leg is SERVED — the eviction-heavy
+    # cycle converges to exactly ONE solve-family dispatch.  Parity vs
+    # the KUBE_BATCH_TPU_FUSED_STORM=0 per-family control and the
+    # FORCE_SHARD mesh leg; the dispatch total is the gated
+    # ``storm_dispatches.solve`` count (tools/bench_compare.py).
+    _fused_served_storm_arm(True)   # warm (jit shapes + clone pools)
+    _fused_served_storm_arm(False)
+    ss_off = _fused_served_storm_arm(False)
+    ss_on = _fused_served_storm_arm(True)
+    ss_sh = _fused_served_storm_arm(True, force_shard=True)
+
+    def _sfoot(run):
+        return (run["evicts"], run["binds"], run["events"])
+
+    storm_parity = (_sfoot(ss_on) == _sfoot(ss_off) and
+                    _sfoot(ss_sh) == _sfoot(ss_on))
+    storm_dispatches = {"solve": sum(ss_on["dispatches"].values())}
+    storm_legs = dict(ss_on["legs"])
+    for k, v in ss_sh["legs"].items():
+        storm_legs[k] = storm_legs.get(k, 0) + v
+    for k, v in storm_legs.items():
+        legs[k] = legs.get(k, 0) + v
+
     # Three-family leg: the topology conf stages a box-scan INTO the
     # fused dispatch (evict+solve+topo in one program).  Parity vs the
     # FUSED=0 control on the fragmentation-pressure scenario.
@@ -1516,10 +1624,17 @@ def measure_fused_ab(n_tasks, n_nodes, n_jobs, n_queues,
         "parity": parity and quiet_parity,
         "shard_parity": shard_parity,
         "topo_parity": topo_parity,
+        "storm_parity": storm_parity,
         "evictions": len(feet[True][0][0]),
         "binds": len(feet[True][0][1]),
         "quiet_binds": len(qb_on),
         "topo_slice_binds": len(s_on),
+        "storm_evictions": len(ss_on["evicts"]),
+        "storm_binds": len(ss_on["binds"]),
+        "storm_on_ms": ss_on["wall_ms"],
+        "storm_off_ms": ss_off["wall_ms"],
+        "storm_dispatches": storm_dispatches,
+        "storm_legs": storm_legs,
         "dispatches": dispatches,
         "legs": legs,
         "topo_routes": topo_routes,
@@ -1538,6 +1653,12 @@ def _fill_fused_ab(out, n_tasks, n_nodes, n_jobs, n_queues):
     out["fused_parity"] = ab["parity"]
     out["fused_shard_parity"] = ab["shard_parity"]
     out["fused_topo_parity"] = ab["topo_parity"]
+    out["fused_storm_parity"] = ab["storm_parity"]
+    # The served-storm one-dispatch ledger (doc/FUSED.md "Storm half"):
+    # total solve-family device dispatches for the eviction-heavy cycle
+    # — exactly 1 when the postevict leg serves; gated with no band as
+    # storm_dispatches.solve (tools/bench_compare.py).
+    out["storm_dispatches"] = ab["storm_dispatches"]
 
 
 def measure_commit_ab(n_tasks, n_nodes, n_jobs, n_queues, cycles: int = 2,
@@ -2815,6 +2936,38 @@ def _run_full(out, n_tasks, n_nodes, n_jobs, n_queues, cold_n,
         except Exception as exc:  # noqa: BLE001 — artifact stays honest
             out["ingest_error"] = f"{type(exc).__name__}: {exc}"
 
+    # Served-storm one-dispatch probe (doc/FUSED.md "Storm half"): ONE
+    # session on the crafted reclaim scenario where the postevict leg
+    # serves — the solve-family dispatch total for an eviction-heavy
+    # cycle, gated with no band as storm_dispatches.solve.  The small
+    # fixed scenario (8 nodes) keeps the probe deterministic and cheap;
+    # a warm-up run absorbs the jit compile.  Optional (BENCH_STORM=0
+    # skips) and failure-isolated like the ingest probe.
+    if os.environ.get("BENCH_STORM", "1") != "0":
+        try:
+            # Scale the crafted scenario to the gate's node count so
+            # the re-dispatch the storm half eliminates is a real solve
+            # (at toy shapes the extra on-device adjust outweighs the
+            # saved dispatch on the CPU fake).
+            shape = {"n_nodes": max(8, min(256, n_nodes)), "per_node": 8,
+                     "victims": 8, "extra_tasks": 32}
+            _fused_served_storm_arm(True, shape=shape)   # warm
+            _fused_served_storm_arm(False, shape=shape)  # warm control
+            # Interleave 3 measured reps per arm and take medians —
+            # single-sample walls are too noisy to gate.
+            seq_runs, storm_runs = [], []
+            for _ in range(3):
+                seq_runs.append(_fused_served_storm_arm(False, shape=shape))
+                storm_runs.append(_fused_served_storm_arm(True, shape=shape))
+            out["storm_dispatches"] = {
+                "solve": sum(storm_runs[-1]["dispatches"].values())}
+            out["storm_ms"] = statistics.median(
+                r["wall_ms"] for r in storm_runs)
+            out["storm_seq_ms"] = statistics.median(
+                r["wall_ms"] for r in seq_runs)
+        except Exception as exc:  # noqa: BLE001 — artifact stays honest
+            out["storm_error"] = f"{type(exc).__name__}: {exc}"
+
     if not steady_only:
         _, steady_het_rounds, _het_stats = measure_steady_session(
             n_tasks, n_nodes, n_jobs, n_queues, n_signatures=64)
@@ -2937,6 +3090,10 @@ def main():
         "fused_parity": None,
         "fused_shard_parity": None,
         "fused_topo_parity": None,
+        "fused_storm_parity": None,
+        "storm_dispatches": None,
+        "storm_ms": None,
+        "storm_seq_ms": None,
         "session_dispatches": None,
         "topo_parity": None,
         "topo_shard_parity": None,

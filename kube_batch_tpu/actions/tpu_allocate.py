@@ -55,6 +55,12 @@ class TpuAllocateAction(Action):
     def _run_host_fallback(self, ssn) -> None:
         """The host allocate oracle: placement-identical to the device
         path by the parity suite, only the engine differs."""
+        # A commit flush deferred into this action's dispatch window
+        # (framework/commit.py) must land BEFORE the fallback mutates and
+        # binds — evict events precede binds on every path, degraded
+        # included (doc/FUSED.md "Storm half").
+        from ..ops import fused_solver
+        fused_solver.flush_deferred(ssn)
         if self._fallback_action is None:
             from .allocate import AllocateAction
             self._fallback_action = AllocateAction()
@@ -175,6 +181,12 @@ class TpuAllocateAction(Action):
             ssn.prescan["has_best_effort"] = False
 
         if not snap.tasks:
+            # No finish continuation will run: flush any commit sink
+            # deferred into this action's window now (an earlier action
+            # may have pipelined away every pending task), so later
+            # actions' binds cannot precede the deferred evict events.
+            from ..ops import fused_solver
+            fused_solver.flush_deferred(ssn)
             self._publish_read_fence(ssn, snap, empty=True)
             return None
 
@@ -323,6 +335,13 @@ class TpuAllocateAction(Action):
         def finish():
             nonlocal scaffold, assignment, kind, order, ordered
             from ..chaos.breaker import solve_deadline_s
+            # Storm half (doc/FUSED.md): a commit flush deferred from an
+            # earlier action rides this window — egress the evicts FIRST
+            # so the cluster call overlaps the device wait below, and the
+            # event stream keeps evicts before this session's binds on
+            # the served, invalidated, and fallback paths alike.
+            from ..ops import fused_solver
+            fused_solver.flush_deferred(ssn)
             try:
                 if pending is not None:
                     wait_start = time.perf_counter()

@@ -130,6 +130,34 @@ class CommitSink:
         ssn._floor_commit += time.perf_counter() - start
 
 
+def _defer_to_dispatch_window(ssn, action: str) -> bool:
+    """Whether this action's sink flush rides the fused dispatch window
+    (doc/FUSED.md "Storm half"): a fused program with a live alloc leg
+    is in flight and tpu-allocate still runs LATER in this session's
+    ladder — its finish flushes the deferred sink right before touching
+    the device result, so the cluster egress overlaps the device wait
+    and an eviction-heavy cycle converges to one dispatch + one fused
+    flush.  Event order is preserved by construction: the evicts still
+    flush before the session's binds (batch_apply egresses binds after
+    finish starts), and the sequential control
+    (KUBE_BATCH_TPU_BATCH_COMMIT=0) never builds a sink at all."""
+    from .. import knobs as _knobs
+    if not (_knobs.FUSED.enabled() and _knobs.FUSED_STORM.enabled()):
+        return False
+    st = getattr(ssn, "_fused_state", None)
+    if st is None or not st.dispatched or st.failed:
+        return False
+    if st.alloc_pending is None:
+        # No alloc leg in flight: tpu-allocate may early-out without a
+        # finish continuation, and a later action could bind before the
+        # close-time safety flush — keep the at-exit flush.
+        return False
+    names = tuple(getattr(ssn, "_conf_actions", ()) or ())
+    if action not in names or "tpu-allocate" not in names:
+        return False
+    return names.index(action) < names.index("tpu-allocate")
+
+
 @contextlib.contextmanager
 def action_commit(ssn, action: str):
     """Install a CommitSink on ``ssn`` for the duration of one action's
@@ -138,7 +166,12 @@ def action_commit(ssn, action: str):
     and session diverge until resync).  A no-op handing back the outer
     sink when one is already active (nested actions accumulate into
     their caller's flush), and a no-op entirely under the sequential
-    control arm."""
+    control arm.
+
+    Storm half (ops/fused_solver.flush_deferred): when a fused dispatch
+    with a live alloc leg is in flight and tpu-allocate runs later in
+    the ladder, the at-exit flush defers into that action's device-wait
+    window instead — same sink, same effect order, one fused flush."""
     if not batch_commit_enabled():
         yield None
         return
@@ -152,4 +185,11 @@ def action_commit(ssn, action: str):
         yield sink
     finally:
         ssn._commit_sink = None
-        sink.flush()
+        if sink.evicts and _defer_to_dispatch_window(ssn, action):
+            deferred = getattr(ssn, "_deferred_flush", None)
+            if deferred is None:
+                deferred = []
+                ssn._deferred_flush = deferred
+            deferred.append(sink)
+        else:
+            sink.flush()

@@ -457,6 +457,9 @@ class Session:
         self._dirty_node(hostname)
         node.add_task(task)
         self._fire_allocate(task)
+        log = getattr(self, "_fused_mutlog", None)
+        if log is not None:
+            log.append(("pipeline", task.uid, hostname))
 
     def allocate(self, task: TaskInfo, hostname: str) -> None:
         """Assign idle resources; dispatch the whole gang once JobReady
@@ -477,6 +480,9 @@ class Session:
         self._dirty_node(hostname)
         node.add_task(task)
         self._fire_allocate(task)
+        log = getattr(self, "_fused_mutlog", None)
+        if log is not None:
+            log.append(("allocate", task.uid, hostname))
 
         if self.job_ready(job):
             # Gang barrier: dispatch every Allocated task of the job at once.
@@ -945,6 +951,12 @@ class Session:
                 # it discovers the missing job: keep the effect (the
                 # flush will evict) and surface the same error.
                 sink.add_evict(reclaimee, reason)
+            log = getattr(self, "_fused_mutlog", None)
+            if log is not None:
+                # Cluster effect without the session mirror: no storm
+                # leg can model this — a kind the proof never matches.
+                log.append(("evict_error", reclaimee.uid,
+                            reclaimee.node_name))
             raise KeyError(f"failed to find job {reclaimee.job}")
         # Fused Releasing transition (ROADMAP 5a): the session-clone twin
         # of the truth mirror's evict_many fast path — one status-index
@@ -962,6 +974,9 @@ class Session:
         self._fire_deallocate(reclaimee)
         if sink is not None:
             sink.add_evict(reclaimee, reason)
+        log = getattr(self, "_fused_mutlog", None)
+        if log is not None:
+            log.append(("evict", reclaimee.uid, reclaimee.node_name))
 
     def update_job_condition(self, job_info: JobInfo, cond: PodGroupCondition):
         """Upsert a PodGroup condition by type (session.go:348-369)."""

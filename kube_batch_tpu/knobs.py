@@ -255,6 +255,11 @@ FUSED = _knob(
     "KUBE_BATCH_TPU_FUSED", "flag-on", True, "doc/FUSED.md",
     "One-dispatch fused session program (0 falls back to the ladder)",
     parity=True, owner="kube_batch_tpu.ops.fused_solver")
+FUSED_STORM = _knob(
+    "KUBE_BATCH_TPU_FUSED_STORM", "flag-on", True, "doc/FUSED.md",
+    "Post-eviction placements inside the fused program (0 re-dispatches "
+    "per family after evictions)",
+    parity=True, owner="kube_batch_tpu.ops.fused_solver")
 CANDIDATE_SOLVE = _knob(
     "KUBE_BATCH_TPU_CANDIDATE_SOLVE", "flag-on", True, "doc/FUSED.md",
     "Candidate-prefiltered solve (0 scores the full node set)",

@@ -83,6 +83,9 @@ LEDGER_CATALOGUE: Tuple[Tuple[str, str], ...] = (
                    "estimate)"),
     ("snapshot_pool", "pooled job/node clones reused across session "
                       "snapshots (cache/cache.py; per-clone estimate)"),
+    ("fused_storm", "post-eviction storm-leg capture: victim staging "
+                    "columns + proof buffers held until tpu-allocate "
+                    "consumes (ops/fused_solver.py; array nbytes)"),
 )
 
 

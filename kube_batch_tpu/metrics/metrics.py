@@ -1116,8 +1116,11 @@ def take_cycle_dispatches() -> Dict[str, int]:
 
 
 def note_fused_leg(family: str, outcome: str) -> None:
-    """Count one fused-leg outcome (family solve | evict | topo;
-    outcome served | invalidated)."""
+    """Count one fused-leg outcome (family solve | evict | topo |
+    postevict — the storm half's post-eviction placements, served only
+    when the host's committed victim order bit-matches the device's
+    prediction, doc/FUSED.md "Storm half"; outcome served |
+    invalidated)."""
     fused_legs.inc(1.0, family, outcome)
 
 

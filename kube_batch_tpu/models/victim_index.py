@@ -39,7 +39,7 @@ def _rank_victim_columns(node_names: List[str], prio: List[float],
     keep = [i for i, name in enumerate(node_names) if name in node_index]
     m = len(keep)
     if m == 0:
-        return np.zeros(0, np.int32), np.zeros(0, np.int32), []
+        return np.zeros(0, np.int32), np.zeros(0, np.int32), [], keep
     if m != len(node_names):
         prio = [prio[i] for i in keep]
         ts = [ts[i] for i in keep]
@@ -72,7 +72,7 @@ def _rank_victim_columns(node_names: List[str], prio: List[float],
         order = np.asarray(order)
     rank = np.empty(m, np.int32)
     rank[order] = np.arange(m, dtype=np.int32)
-    return node_ix, rank, uids
+    return node_ix, rank, uids, keep
 
 
 class VictimIndex:
@@ -132,6 +132,12 @@ class VictimIndex:
         self._vic_prio: List[float] = []
         self._vic_ts: List[float] = []
         self._vic_uid: List[str] = []
+        # Post-eviction leg detail (ops/fused_solver storm half): the
+        # victim's steady resreq plus its queue/job uids, collected in
+        # the same walk so the slot order is identical by construction.
+        self._vic_res: List = []
+        self._vic_queue: List[str] = []
+        self._vic_job: List[str] = []
         jobs_get = ssn.jobs.get
         running = TaskStatus.Running
         for name, node in ssn.nodes.items():
@@ -150,6 +156,9 @@ class VictimIndex:
                     self._vic_prio.append(t.priority)
                     self._vic_ts.append(t.pod.metadata.creation_timestamp)
                     self._vic_uid.append(t.uid)
+                    self._vic_res.append(t.resreq)
+                    self._vic_queue.append(j.queue)
+                    self._vic_job.append(t.job)
             if nq:
                 self.node_queue[name] = nq
                 self.node_job[name] = nj
@@ -173,11 +182,46 @@ class VictimIndex:
         cached = getattr(self, "_vic_cache", None)
         if cached is not None and cached[0] is node_index:
             return cached[1]
-        out = _rank_victim_columns(self._vic_node, self._vic_prio,
-                                   self._vic_ts, self._vic_uid,
-                                   node_index)
-        self._vic_cache = (node_index, out)
+        node_ix, rank, uids, keep = _rank_victim_columns(
+            self._vic_node, self._vic_prio, self._vic_ts, self._vic_uid,
+            node_index)
+        out = (node_ix, rank, uids)
+        self._vic_cache = (node_index, out, keep)
         return out
+
+    def victim_detail(self, node_index: Dict[str, int], axis: List[str],
+                      queue_index: Dict[str, int], job_index: Dict[str, int]
+                      ) -> Optional[Tuple[np.ndarray, np.ndarray,
+                                          np.ndarray]]:
+        """Post-eviction staging columns for the fused storm leg
+        (ops/fused_solver), slot-aligned with victim_tensors(node_index):
+        quantized [M, R] resreq rows plus snapshot queue/job indices
+        (-1 when the snapshot axis does not carry that uid — the device
+        scatter drops those updates, matching the host, whose absent
+        rows cannot be in the solve universe).  None when a victim's
+        quanta overflow int32 (the tensorize path falls back there too,
+        so the storm leg must not be served)."""
+        self.victim_tensors(node_index)
+        keep = self._vic_cache[2]
+        m = len(keep)
+        r = max(2, len(axis))
+        res = np.zeros((m, r), np.float64)
+        if m:
+            res[:, 0] = [self._vic_res[i].milli_cpu for i in keep]
+            res[:, 1] = [self._vic_res[i].memory for i in keep]
+            for d in range(2, len(axis)):
+                name = axis[d]
+                res[:, d] = [self._vic_res[i].scalar_resources.get(name, 0.0)
+                             for i in keep]
+        from ..ops.resources import quantize_columns
+        res_q = quantize_columns(res)
+        if res_q.size and int(res_q.max()) > np.iinfo(np.int32).max:
+            return None
+        qix = np.asarray([queue_index.get(self._vic_queue[i], -1)
+                          for i in keep], np.int32).reshape(m)
+        jix = np.asarray([job_index.get(self._vic_job[i], -1)
+                          for i in keep], np.int32).reshape(m)
+        return np.ascontiguousarray(res_q, dtype=np.int32), qix, jix
 
     # -- per-node admissibility ---------------------------------------------
 

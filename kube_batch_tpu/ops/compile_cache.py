@@ -505,16 +505,18 @@ def _warm_fused(spec: BucketSpec, cfg, inp_np, inp,
                 resident=None) -> WarmupRecord:
     """Warm the fused one-dispatch session program (ops/fused_solver.py)
     at this bucket: the allocate solve plus the batched eviction scan
-    (plus the topo box scan when topology is enabled) composed inside
-    ONE jit is a DIFFERENT executable from its warmed members, so the
-    first fused session would otherwise pay the composition's XLA
-    compile live.  Routed as the live dispatch would be: mesh-sharded
-    legs when the warm shipper produced a resident image, the pinned
-    single-chip route otherwise.  Other leg subsets compile on first
-    use (each is strictly smaller than this one)."""
+    (plus the storm half's post-eviction adjustment when FUSED_STORM is
+    on, plus the topo box scan when topology is enabled) composed
+    inside ONE jit is a DIFFERENT executable from its warmed members,
+    so the first fused session would otherwise pay the composition's
+    XLA compile live.  Routed as the live dispatch would be:
+    mesh-sharded legs when the warm shipper produced a resident image,
+    the pinned single-chip route otherwise.  Other leg subsets compile
+    on first use (each is strictly smaller than this one)."""
     import numpy as np
     import jax.numpy as jnp
 
+    from .. import knobs
     from ..models.topology import topology_enabled
     from .evict_solver import choose_evict_route
     from .fused_solver import _fused_program, fused_solve_key
@@ -527,8 +529,14 @@ def _warm_fused(spec: BucketSpec, cfg, inp_np, inp,
     n_pad = inp_np.node_idle.shape[0]
     kb = bucket(1)
     mb = bucket(max(spec.tasks, 1))
-    legs = ("evict", "solve", "topo") if topology_enabled() \
-        else ("evict", "solve")
+    legs = ["evict", "solve"]
+    if knobs.FUSED_STORM.enabled():
+        # The eviction-heavy storm variant (doc/FUSED.md "Storm half")
+        # is the executable a reclaim ladder dispatches.
+        legs.append("postevict")
+    if topology_enabled():
+        legs.append("topo")
+    legs = tuple(legs)
     eroute, emesh = choose_evict_route(resident)
     if resident is not None:
         from ..parallel.mesh import default_mesh
@@ -554,6 +562,15 @@ def _warm_fused(spec: BucketSpec, cfg, inp_np, inp,
         trows = np.zeros((kb, 1 + r + np_pad + 4 * ns_pad), np.int32)
         vic_node = np.full((mb,), n_pad, np.int32)
         vic_rank = np.full((mb,), mb, np.int32)
+        pe_res = pe_queue = pe_job = None
+        if "postevict" in legs:
+            # All-sentinel staging (no victims): the adjustment traces
+            # through the same scatter/solve graph as a live storm.
+            qb = int(np.asarray(src.queue_exists).shape[0])
+            jb = int(np.asarray(src.job_start).shape[0])
+            pe_res = np.zeros((mb, r), np.int32)
+            pe_queue = np.full((mb,), qb, np.int32)
+            pe_job = np.full((mb,), jb, np.int32)
         if eroute == "sharded":
             import jax
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -562,6 +579,10 @@ def _warm_fused(spec: BucketSpec, cfg, inp_np, inp,
             node_d = jax.device_put(vic_node, rep)
             rank_d = jax.device_put(vic_rank, rep)
             edyn = None
+            if pe_res is not None:
+                pe_res = jax.device_put(pe_res, rep)
+                pe_queue = jax.device_put(pe_queue, rep)
+                pe_job = jax.device_put(pe_job, rep)
         else:
             trows_d = jnp.asarray(trows)
             node_d = jnp.asarray(vic_node)
@@ -572,6 +593,10 @@ def _warm_fused(spec: BucketSpec, cfg, inp_np, inp,
                  np.asarray(inp_np.node_ports).astype(np.int32),
                  np.asarray(inp_np.node_selcnt)],
                 axis=1).astype(np.int32))
+            if pe_res is not None:
+                pe_res = jnp.asarray(pe_res)
+                pe_queue = jnp.asarray(pe_queue)
+                pe_job = jnp.asarray(pe_job)
         box = None
         troute, tmesh = "xla", None
         if "topo" in legs:
@@ -585,9 +610,12 @@ def _warm_fused(spec: BucketSpec, cfg, inp_np, inp,
         out = _fused_program(
             legs, cfg, aroute, False, amesh, cfg, r, np_pad, ns_pad,
             eroute, emesh, sx, sy, sz, troute, tmesh,
-            src, None, None, statics, edyn, trows_d, node_d, rank_d, box)
+            src, None, None, statics, edyn, trows_d, node_d, rank_d, box,
+            pe_res, pe_queue, pe_job)
         np.asarray(out["alloc"])
         np.asarray(out["evict"][0])
+        if "postevict" in legs:
+            np.asarray(out["postevict"][0])
         if "topo" in legs:
             np.asarray(out["topo"])
     except Exception as exc:  # lint: allow-swallow(warmup must never take down boot; failure is recorded in WarmupRecord.error)

@@ -72,6 +72,13 @@ def fused_enabled() -> bool:
     return knobs.FUSED.enabled()
 
 
+def storm_enabled() -> bool:
+    """The storm half (doc/FUSED.md): the fused program also solves the
+    post-eviction placements against the occupancy its own evict leg
+    adjusts on device, so an eviction-led cycle stays at one dispatch."""
+    return knobs.FUSED.enabled() and knobs.FUSED_STORM.enabled()
+
+
 class _AllocLeg(NamedTuple):
     """The alloc leg's host-side capture: everything tpu-allocate must
     re-derive identically for the precomputed solve to be ITS solve."""
@@ -94,7 +101,7 @@ class FusedState:
 
     __slots__ = ("dispatched", "failed", "legs", "alloc_pending",
                  "alloc_leg", "topo_request", "topo_out", "topo_sig",
-                 "early_scanner")
+                 "early_scanner", "storm")
 
     def __init__(self):
         self.dispatched = False
@@ -106,6 +113,100 @@ class FusedState:
         self.topo_out = None        # device [N, 6] stats
         self.topo_sig = None
         self.early_scanner = False  # scanner seeded before mutations ran
+        self.storm = None           # _StormCapture (postevict leg)
+
+
+def _storm_nbytes(cap) -> int:
+    total = 0
+    for a in (cap.vic_res, cap.vic_qix, cap.vic_jix, cap.vic_node):
+        if a is not None:
+            total += int(a.nbytes)
+    if cap.dinp:
+        for a in cap.dinp.values():
+            total += int(a.nbytes)
+    return total
+
+
+# The SolverInputs fields _prove_storm compares against the fresh
+# staging: the delta-replay targets (P3), the remap-compared task
+# columns and the must-be-bit-equal axes (P4), and the job-block
+# geometry.  Captured as numpy COPIES at dispatch time — the persistent
+# staging layer rewrites the session snapshot and its buffers in place
+# on the next tensorize (models/tensor_snapshot.py "Wire fast path"),
+# so by-reference capture would compare the fresh state to itself.
+_PROOF_FIELDS = (
+    # P4: per-task columns (compared under the uid remap)
+    "task_req", "task_res", "task_sig", "task_ports", "task_aff_req",
+    "task_anti", "task_match", "task_paff_w", "task_panti_w",
+    # P4: axes the predicted iteration cannot touch (bit-equal)
+    "sig_mask", "sig_bonus", "node_idle", "node_alloc", "node_max_tasks",
+    "node_exists", "node_coords", "queue_deserved", "queue_deserved_f",
+    "queue_ts", "queue_uid_rank", "queue_exists", "job_queue",
+    "job_minavail", "job_prio", "job_ts", "job_uid_rank", "total_res",
+    "eps", "scalar_dims", "score_shift",
+    # P4: job-block geometry
+    "job_start", "job_count", "task_sorted",
+    # P3: the mutated axes (fresh == these + modeled deltas)
+    "node_releasing", "node_used", "node_count", "node_ports",
+    "node_selcnt", "queue_init_alloc", "job_init_alloc",
+    "job_init_ready",
+)
+
+
+class _StormCapture:
+    """Host half of the post-eviction storm leg: the dispatch-time
+    staging captured BY VALUE (uid axis, axis name lists, config, numpy
+    copies of the proof-compared input arrays), the victim staging
+    columns the device chose from, the device's prediction readbacks,
+    and the session mutation log the serve proof replays against
+    (doc/FUSED.md "Storm half").  Released at consume or at session
+    close — the ledger audit pins retention.
+
+    # mem-ledger: fused_storm
+    """
+
+    __slots__ = ("duids", "dnode_names", "djob_uids", "dqueue_ids",
+                 "dres_names", "dconfig", "dinp", "route", "vic_res",
+                 "vic_qix", "vic_jix", "vic_node", "uids", "meta", "sel",
+                 "mutlog", "_mem_key", "__weakref__")
+
+    def __init__(self, snap, route, vic_res, vic_qix, vic_jix, vic_node,
+                 uids, meta, sel):
+        self.duids = [t.uid for t in snap.tasks]  # dispatch task axis
+        self.dnode_names = list(snap.node_names)
+        self.djob_uids = list(snap.job_uids)
+        self.dqueue_ids = list(snap.queue_ids)
+        self.dres_names = list(snap.resource_names)
+        self.dconfig = snap.config
+        self.dinp = {name: np.array(np.asarray(getattr(snap.inputs, name)))
+                     for name in _PROOF_FIELDS}
+        self.route = route          # aroute the adjusted solve compiled at
+        self.vic_res = vic_res      # [M, R] i32 victim resreq quanta
+        self.vic_qix = vic_qix      # [M] i32 queue index (Q = absent)
+        self.vic_jix = vic_jix      # [M] i32 job index (J = absent)
+        self.vic_node = vic_node    # [M] i32 node row (evict-leg column)
+        self.uids = list(uids)      # [m] victim uid per slot
+        self.meta = meta            # device [6] i32 did,q*,j*,t*,n*,vcnt
+        self.sel = sel              # device [M] bool chosen-victim mask
+        self.mutlog = []            # (kind, uid, node) from Session hooks
+        from ..metrics import memledger
+        self._mem_key = memledger.ledger("fused_storm").track(
+            self, sizer=_storm_nbytes)
+        memledger.ledger("fused_storm").set(self._mem_key,
+                                            _storm_nbytes(self))
+
+    def release(self) -> None:
+        self.duids = []
+        self.dnode_names = self.djob_uids = self.dqueue_ids = []
+        self.dres_names = []
+        self.dconfig = None
+        self.dinp = {}
+        self.vic_res = self.vic_qix = self.vic_jix = self.vic_node = None
+        self.meta = self.sel = None
+        self.uids = []
+        self.mutlog = []
+        from ..metrics import memledger
+        memledger.ledger("fused_storm").set(self._mem_key, 0)
 
 
 def state_for(ssn) -> FusedState:
@@ -129,6 +230,150 @@ def _conf_names(ssn) -> tuple:
 # are skipped at trace time via the static ``legs`` tuple.
 # ---------------------------------------------------------------------------
 
+def _postevict_adjust(inp, cfg, vic_node, vic_res, vic_queue, vic_job):
+    """Predict reclaim's first committed iteration and adjust the solve
+    inputs by exactly its mutations (doc/FUSED.md "Storm half").
+
+    The prediction mirrors actions/reclaim.py against the OPEN-state
+    arrays the dispatch staged: q* is the first queue surviving the PQ
+    guards (exists, a pending candidate job, not Overused) in (share,
+    ts, uid) order; j* is q*'s front job by the tiered job-order chain;
+    t* is j*'s front task; n* is the first node ascending that passes
+    the static+dynamic predicate chain AND whose other-queue residents'
+    total resreq covers t*'s init request; the victims are the
+    slot-order prefix of n*'s other-queue residents until the running
+    sum covers (the evict loop's inclusive break).  Every delta below
+    is the staged image of the host mutations those commits cause
+    (NodeInfo.release_resident / add_task-Pipelined, the proportion
+    event handlers, ready_task_num, the job block rebuild) — the serve
+    proof in ``_prove_storm`` re-derives the same deltas on the host
+    and refuses the leg on any mismatch, so a wrong prediction can only
+    cost a re-dispatch, never a wrong placement.
+
+    Returns ``(adjusted inputs, meta, chosen)`` with ``meta`` = i32
+    ``[did, q*, j*, t*, n*, v_count]`` and ``chosen`` the [M] victim
+    mask.  When ``did`` is 0 the adjustment is the identity and the
+    solve below equals the plain fused solve bit-for-bit."""
+    from .fairness import queue_shares, safe_share
+    from .resources import less_equal_vec
+    from .solver import _lex_argmin, dynamic_predicate_mask
+    i32 = jnp.int32
+    nb = inp.node_exists.shape[0]
+    qb = inp.queue_exists.shape[0]
+    jb = inp.job_start.shape[0]
+    valid = vic_node < nb
+
+    # q* — reclaim.py:54-61 guards in pop order.
+    has_pending = jnp.zeros((qb,), bool).at[inp.job_queue].max(
+        inp.job_count > 0, mode="drop")
+    if cfg.has_proportion:
+        overused = less_equal_vec(inp.queue_deserved, inp.queue_init_alloc,
+                                  inp.eps, inp.scalar_dims)
+    else:
+        overused = jnp.zeros((qb,), bool)
+    qmask = inp.queue_exists & has_pending & ~overused
+    qkeys = []
+    for name in cfg.queue_key_order:
+        if name == "proportion":
+            qkeys.append(queue_shares(inp.queue_init_alloc,
+                                      inp.queue_deserved_f))
+    qkeys.extend([inp.queue_ts, inp.queue_uid_rank])
+    qstar = _lex_argmin(qmask, qkeys)
+
+    # j* — the tiered chain of _select_job over the open-state arrays
+    # (reclaim pops before anything mutates, so init IS the live state).
+    jmask = (qmask.any() & (inp.job_queue == qstar) & (inp.job_count > 0)
+             & (inp.job_minavail >= 0))
+    jkeys = []
+    for name in cfg.job_key_order:
+        if name == "priority":
+            jkeys.append(-inp.job_prio)
+        elif name == "gang":
+            ready = inp.job_init_ready >= inp.job_minavail
+            jkeys.append(ready.astype(inp.job_ts.dtype))
+        elif name == "drf":
+            jkeys.append(jnp.max(
+                safe_share(inp.job_init_alloc, inp.total_res[None, :]),
+                axis=-1))
+    jkeys.extend([inp.job_ts, inp.job_uid_rank])
+    jstar = _lex_argmin(jmask, jkeys)
+    tstar = inp.task_sorted[inp.job_start[jstar]].astype(i32)
+    treq = inp.task_req[tstar]
+
+    # n* — first node ascending passing the scanner's predicate chain
+    # (models/scanner._scores_numpy feasibility) with an admissible
+    # other-queue resident set whose TOTAL covers (reclaim.py:119-142).
+    other = valid & (vic_queue != qstar)
+    tot = jnp.zeros((nb, treq.shape[0]), i32).at[vic_node].add(
+        jnp.where(other[:, None], vic_res, 0), mode="drop")
+    covers = less_equal_vec(jnp.broadcast_to(treq[None, :], tot.shape),
+                            tot, inp.eps, inp.scalar_dims)
+    feas = (inp.sig_mask[inp.task_sig[tstar]] & inp.node_exists
+            & (inp.node_count < inp.node_max_tasks))
+    dyn = dynamic_predicate_mask(cfg, tstar, inp.task_ports,
+                                 inp.task_aff_req, inp.task_anti,
+                                 inp.node_ports, inp.node_selcnt)
+    if dyn is not None:
+        feas = feas & dyn
+    adm = jnp.zeros((nb,), bool).at[vic_node].max(other, mode="drop")
+    elig = feas & covers & adm
+    did = qmask.any() & jmask.any() & elig.any()
+    nstar = jnp.argmax(elig).astype(i32)
+
+    # Victims: slot-order prefix of n*'s other-queue residents until
+    # the cumulative sum covers, INCLUSIVE of the covering victim (the
+    # evict loop breaks after adding, reclaim.py:144-155).
+    eln = other & (vic_node == nstar)
+    contrib = jnp.where(eln[:, None], vic_res, 0)
+    csum = jnp.cumsum(contrib, axis=0)
+    before = less_equal_vec(jnp.broadcast_to(treq[None, :], csum.shape),
+                            csum - contrib, inp.eps, inp.scalar_dims)
+    chosen = eln & ~before & did
+    vcnt = chosen.sum().astype(i32)
+    d = did.astype(i32)
+
+    # Deltas.  Evict (release_resident): node releasing += resreq, the
+    # victim queue's proportion allocation and the victim job's DRF
+    # allocation / ready count shrink.  Pipeline of t* on n* (add_task
+    # Pipelined + allocate event): releasing -= resreq, used += resreq,
+    # count += 1, ports/selcnt gain t*'s footprint, q*'s proportion
+    # allocation grows; the job block re-sorts with t* consumed.
+    chv = jnp.where(chosen[:, None], vic_res, 0)
+    vq = jnp.where(chosen, vic_queue, qb)   # sentinel rows drop
+    vj = jnp.where(chosen, vic_job, jb)
+    tres = inp.task_res[tstar] * d
+    node_rel = inp.node_releasing.at[vic_node].add(chv, mode="drop")
+    node_rel = node_rel.at[nstar].add(-tres)
+    node_used = inp.node_used.at[nstar].add(tres)
+    node_count = inp.node_count.at[nstar].add(d)
+    node_ports = inp.node_ports.at[nstar].set(
+        inp.node_ports[nstar] | (did & inp.task_ports[tstar]))
+    node_sel = inp.node_selcnt.at[nstar].add(jnp.where(
+        did, inp.task_match[tstar].astype(inp.node_selcnt.dtype), 0))
+    if cfg.has_proportion:
+        q_alloc = inp.queue_init_alloc.at[vq].add(-chv, mode="drop")
+        q_alloc = q_alloc.at[jnp.where(did, qstar, qb)].add(
+            tres, mode="drop")
+    else:
+        q_alloc = inp.queue_init_alloc  # stays zeros host-side too
+    j_alloc = inp.job_init_alloc.at[vj].add(-chv, mode="drop")
+    j_ready = inp.job_init_ready.at[vj].add(
+        -chosen.astype(i32), mode="drop")
+    j_start = inp.job_start.at[jnp.where(did, jstar, jb)].add(
+        1, mode="drop")
+    j_count = inp.job_count.at[jnp.where(did, jstar, jb)].add(
+        -1, mode="drop")
+
+    adj = inp._replace(
+        node_releasing=node_rel, node_used=node_used,
+        node_count=node_count, node_ports=node_ports,
+        node_selcnt=node_sel, queue_init_alloc=q_alloc,
+        job_init_alloc=j_alloc, job_init_ready=j_ready,
+        job_start=j_start, job_count=j_count)
+    meta = jnp.stack([d, qstar, jstar, tstar, nstar, vcnt]).astype(i32)
+    return adj, meta, chosen
+
+
 @functools.partial(jax.jit, static_argnames=(
     "legs", "acfg", "aroute", "has_cand", "amesh",
     "ecfg", "r", "np_pad", "ns_pad", "eroute", "emesh",
@@ -138,11 +383,20 @@ def _fused_program(legs, acfg, aroute, has_cand, amesh,
                    sx, sy, sz, troute, tmesh,
                    ainp, cand_idx, cand_valid,
                    statics, edyn, trows, vic_node, vic_rank,
-                   box):
+                   box, pe_res, pe_queue, pe_job):
     out = {}
     if "solve" in legs:
         from .solver import (_gather_candidate_inputs, _pack_result_ordered,
                              solve_allocate)
+        sinp = ainp
+        if "postevict" in legs:
+            # Storm half: chain the predicted first reclaim iteration's
+            # occupancy update and solve against the ADJUSTED state —
+            # the per-family re-dispatch this leg replaces, inside the
+            # same program.  Never staged with a candidate gather.
+            sinp, pe_meta, pe_sel = _postevict_adjust(
+                ainp, acfg, vic_node, pe_res, pe_queue, pe_job)
+            out["postevict"] = (pe_meta, pe_sel)
         if has_cand:
             if aroute == "sharded":
                 from ..parallel.sharded_solver import (
@@ -155,12 +409,12 @@ def _fused_program(legs, acfg, aroute, has_cand, amesh,
                 res = solve_allocate(sub, acfg)
         elif aroute == "sharded":
             from ..parallel.sharded_solver import solve_allocate_sharded
-            res = solve_allocate_sharded(ainp, acfg, amesh)
+            res = solve_allocate_sharded(sinp, acfg, amesh)
         elif aroute == "pallas":
             from .pallas_solver import solve_allocate_pallas
-            res = solve_allocate_pallas(ainp, acfg)
+            res = solve_allocate_pallas(sinp, acfg)
         else:
-            res = solve_allocate(ainp, acfg)
+            res = solve_allocate(sinp, acfg)
         out["alloc"] = _pack_result_ordered(res.assignment, res.kind,
                                             res.order)
     if "evict" in legs:
@@ -254,6 +508,52 @@ def _stage_alloc(ssn, snap) -> Optional[_AllocLeg]:
                      cand_sig=_cand_sig(candidates), candidates=candidates)
 
 
+def _stage_storm(ssn, scanner, node_p):
+    """Host staging for the postevict leg: the victim detail columns
+    (resreq quanta, queue/job snapshot indices) slot-aligned with the
+    evict leg's staging and padded to its bucket, plus the per-slot
+    uids the serve proof matches the committed victim order against.
+    None (leg not staged; the solve ships unadjusted exactly as before)
+    when the session's ladder has no reclaim walk to predict, or the
+    columns can't be proven (missing snapshot, quanta overflow)."""
+    if "reclaim" not in _conf_names(ssn):
+        # The prediction models actions/reclaim.py specifically; a
+        # preempt/backfill-only ladder would invalidate every clean
+        # session against a reclaim-shaped prediction.
+        return None
+    snap = getattr(scanner, "snap", None)
+    if snap is None or snap.needs_fallback:
+        return None
+    from ..models.victim_index import VictimIndex
+    vindex = VictimIndex.for_session(ssn)
+    qix_map = {q: i for i, q in enumerate(snap.queue_ids)}
+    jix_map = {u: i for i, u in enumerate(snap.job_uids)}
+    detail = vindex.victim_detail(scanner.node_index, snap.resource_names,
+                                  qix_map, jix_map)
+    if detail is None:
+        return None
+    res, qix, jix = detail
+    uids = vindex.victim_tensors(scanner.node_index)[2]
+    mb = int(np.asarray(node_p).shape[0])
+    m = res.shape[0]
+    r = int(np.asarray(snap.inputs.task_req).shape[1])
+    if m > mb or res.shape[1] != r:
+        return None
+    qb = int(np.asarray(snap.inputs.queue_exists).shape[0])
+    jb = int(np.asarray(snap.inputs.job_start).shape[0])
+    res_p = np.zeros((mb, r), np.int32)
+    qix_p = np.full((mb,), qb, np.int32)
+    jix_p = np.full((mb,), jb, np.int32)
+    if m:
+        res_p[:m] = res
+        # Sentinel = axis bucket: the device scatters with mode="drop",
+        # so victims of axis-absent queues/jobs update nothing — their
+        # host twins aren't in the solve universe either.
+        qix_p[:m] = np.where(qix >= 0, qix, qb)
+        jix_p[:m] = np.where(jix >= 0, jix, jb)
+    return res_p, qix_p, jix_p, uids
+
+
 def _chaos_consume(arr: np.ndarray) -> np.ndarray:
     """Readback fault sites for the fused legs (doc/CHAOS.md):
     ``fused.slow`` sleeps before the transfer is consumed and
@@ -285,6 +585,11 @@ def _fail(ssn, st: FusedState, exc: Exception, families) -> None:
     st.alloc_pending = None
     st.alloc_leg = None
     st.topo_out = None
+    storm = getattr(st, "storm", None)
+    if storm is not None:
+        st.storm = None
+        ssn._fused_mutlog = None
+        storm.release()
     device_breaker().failure()
     metrics.note_device_failure("fused")
     for fam in families:
@@ -330,6 +635,16 @@ def take_evict(ssn, scanner, trows, node_p, rank_p):
         alloc = None
     if alloc is not None:
         legs.append("solve")
+    storm = None
+    if (alloc is not None and alloc.candidates is None
+            and knobs.FUSED_STORM.enabled()):
+        try:
+            storm = _stage_storm(ssn, scanner, node_p)
+        except Exception:
+            metrics.note_swallowed("fused_stage_storm")
+            storm = None
+    if storm is not None:
+        legs.append("postevict")
     topo = st.topo_request
     if topo is not None:
         legs.append("topo")
@@ -357,16 +672,25 @@ def take_evict(ssn, scanner, trows, node_p, rank_p):
             cand_valid = jnp.asarray(c.valid)
 
     edyn = None if eroute == "sharded" else jnp.asarray(scanner.dyn)
+    pe_res = pe_queue = pe_job = None
     if eroute == "sharded":
         from jax.sharding import NamedSharding, PartitionSpec as P
         rep = NamedSharding(emesh, P())
         trows_d = jax.device_put(np.asarray(trows), rep)
         node_d = jax.device_put(np.asarray(node_p), rep)
         rank_d = jax.device_put(np.asarray(rank_p), rep)
+        if storm is not None:
+            pe_res = jax.device_put(storm[0], rep)
+            pe_queue = jax.device_put(storm[1], rep)
+            pe_job = jax.device_put(storm[2], rep)
     else:
         trows_d = jnp.asarray(trows)
         node_d = jnp.asarray(node_p)
         rank_d = jnp.asarray(rank_p)
+        if storm is not None:
+            pe_res = jnp.asarray(storm[0])
+            pe_queue = jnp.asarray(storm[1])
+            pe_job = jnp.asarray(storm[2])
 
     sx = sy = sz = 0
     troute, tmesh = "xla", None
@@ -401,7 +725,7 @@ def take_evict(ssn, scanner, trows, node_p, rank_p):
                 has_cand, amesh, scanner.cfg, scanner.r, scanner.np_pad,
                 scanner.ns_pad, eroute, emesh, sx, sy, sz, troute, tmesh,
                 ainp, cand_idx, cand_valid, scanner.statics, edyn,
-                trows_d, node_d, rank_d, box)
+                trows_d, node_d, rank_d, box, pe_res, pe_queue, pe_job)
     except Exception as exc:
         _fail(ssn, st, exc, legs)
         return None
@@ -422,6 +746,17 @@ def take_evict(ssn, scanner, trows, node_p, rank_p):
             remap=(alloc.candidates.remap
                    if alloc.candidates is not None else None))
         _note_dispatch(+1)
+        if storm is not None:
+            cap = _StormCapture(
+                snap=scanner.snap, route=aroute,
+                vic_res=storm[0], vic_qix=storm[1], vic_jix=storm[2],
+                vic_node=np.array(np.asarray(node_p)), uids=storm[3],
+                meta=out["postevict"][0], sel=out["postevict"][1])
+            st.storm = cap
+            # Arm the session mutation log: the serve proof replays the
+            # committed evict/pipeline sequence against the device's
+            # predicted iteration (framework/session.py hooks).
+            ssn._fused_mutlog = cap.mutlog
     if topo is not None:
         st.topo_out = out["topo"]
         st.topo_sig = topo[2]
@@ -441,12 +776,22 @@ def consume_evict(scores, perm, kb: int, n_pad: int):
 
 
 def take_alloc(ssn, shipper, snap, route, candidates):
-    """tpu-allocate's consume point: the precomputed solve is THIS
-    session's solve iff the action's own ship came back CLEAN at the
-    dispatch generation with the same config, route and candidate
-    gather.  Returns the PendingSolve (the action's finish continuation
-    fetches it through the standard path) or None for the per-family
-    dispatch."""
+    """tpu-allocate's consume point.
+
+    Quiet half: the precomputed solve is THIS session's solve iff the
+    action's own ship came back CLEAN at the dispatch generation with
+    the same config, route and candidate gather.
+
+    Storm half (doc/FUSED.md): when the dispatch carried a postevict
+    leg, a DIRTY ship can still serve — iff the committed mutations are
+    bit-identical to the device's predicted reclaim iteration and the
+    fresh staging equals the dispatch staging plus the modeled deltas
+    (``_prove_storm``).  The served packed result is the adjusted solve
+    remapped onto the fresh task axis; any divergence discards the leg
+    and re-dispatches per-family, counted under family="postevict".
+
+    Returns the PendingSolve (the action's finish continuation fetches
+    it through the standard path) or None for the per-family dispatch."""
     st = getattr(ssn, "_fused_state", None)
     if st is None or st.alloc_pending is None:
         return None
@@ -455,17 +800,258 @@ def take_alloc(ssn, shipper, snap, route, candidates):
     pending, leg = st.alloc_pending, st.alloc_leg
     st.alloc_pending = None
     st.alloc_leg = None
+    storm = getattr(st, "storm", None)
+    st.storm = None
+    if storm is not None:
+        ssn._fused_mutlog = None
     ok = (shipper.last_mode == "clean"
           and shipper.generation == leg.generation
           and snap.config == leg.cfg
           and route == leg.route
           and _cand_sig(candidates) == leg.cand_sig)
-    if not ok:
+    if storm is None:
+        if not ok:
+            discard_solve(pending)
+            metrics.note_fused_leg("solve", "invalidated")
+            return None
+        metrics.note_fused_leg("solve", "served")
+        return pending
+
+    from ..chaos import plan as chaos_plan
+    plan = chaos_plan.PLAN
+    poison = plan is not None and plan.fire("fused.postevict_poison")
+    served = None
+    family = "postevict"
+    try:
+        if ok:
+            # Clean ship at the dispatch generation: nothing mutated,
+            # so the leg is valid iff the device ALSO predicted a quiet
+            # session — then the adjustment was the identity and the
+            # packed result IS the plain fused solve (counted under the
+            # plain family; the dispatch count is what the steady gate
+            # pins).  A clean session with a non-identity prediction is
+            # a model divergence: discard.
+            meta = np.asarray(storm.meta)
+            if (int(meta[0]) == 0 and int(meta[5]) == 0
+                    and not storm.mutlog):
+                served, family = pending, "solve"
+        else:
+            served = _prove_storm(storm, snap, route, candidates, pending)
+    except Exception:
+        metrics.note_swallowed("fused_storm_prove")
+        served = None
+    storm.release()
+    if served is None:
         discard_solve(pending)
-        metrics.note_fused_leg("solve", "invalidated")
+        metrics.note_fused_leg("postevict", "invalidated")
         return None
-    metrics.note_fused_leg("solve", "served")
-    return pending
+    if poison:
+        # Chaos site fused.postevict_poison (doc/CHAOS.md): a malformed
+        # served leg must die in tpu-allocate's _validate_result before
+        # any apply — degrade to the per-family re-dispatch, never
+        # double-evict (the victims were committed by the host walk,
+        # not by this leg; the leg only places).
+        from .solver import PendingSolve
+        packed = np.asarray(served.packed)
+        if packed.ndim >= 2 and packed.shape[-1]:
+            served = PendingSolve(packed[..., :-1], remap=served.remap)
+    metrics.note_fused_leg(family, "served")
+    return served
+
+
+def _prove_storm(storm, snap, route, candidates, pending):
+    """The storm serve proof (doc/FUSED.md "Storm half"): serve ONLY
+    when the host's committed mutation log bit-matches the device's
+    predicted iteration (P1: victim uid sequence in slot order; P2: the
+    single pipeline of t* onto n*) AND the fresh staging equals the
+    dispatch staging plus the modeled deltas on every mutated axis (P3)
+    with the fresh task universe exactly the dispatch universe minus t*
+    (P4).  Then the device's adjusted solve IS the solve the per-family
+    re-dispatch would run, and the packed result remapped onto the
+    fresh task axis is served.  Returns the remapped PendingSolve or
+    None (per-family re-dispatch)."""
+    if route != storm.route or candidates is not None:
+        return None
+    dinp = storm.dinp
+    if not dinp or snap.needs_fallback:
+        return None
+    if snap.config != storm.dconfig:
+        return None
+    if (list(snap.node_names) != storm.dnode_names
+            or list(snap.job_uids) != storm.djob_uids
+            or list(snap.queue_ids) != storm.dqueue_ids
+            or list(snap.resource_names) != storm.dres_names):
+        return None
+    meta = np.asarray(storm.meta)
+    sel = np.asarray(storm.sel).astype(bool)
+    did, qstar, jstar, tstar, nstar, vcnt = (int(v) for v in meta[:6])
+    if did != 1 or vcnt < 0:
+        return None
+    slots = np.nonzero(sel)[0]
+    if slots.size != vcnt or (slots.size
+                              and int(slots[-1]) >= len(storm.uids)):
+        return None
+    if tstar >= len(storm.duids) or nstar >= len(storm.dnode_names):
+        return None
+
+    # P1 + P2 — the committed log is EXACTLY the predicted iteration.
+    log = list(storm.mutlog)
+    if len(log) != vcnt + 1:
+        return None
+    for i in range(vcnt):
+        kind, uid, _node = log[i]
+        if kind != "evict" or uid != storm.uids[int(slots[i])]:
+            return None
+    kind, uid, node = log[-1]
+    if (kind != "pipeline" or uid != storm.duids[tstar]
+            or node != storm.dnode_names[nstar]):
+        return None
+
+    finp = snap.inputs
+    npa = np.asarray
+
+    # P4 — fresh task universe == dispatch minus t*, per-job order kept.
+    if len(snap.tasks) != len(storm.duids) - 1:
+        return None
+    drow = {uid: i for i, uid in enumerate(storm.duids)}
+    remap = np.empty(len(snap.tasks), np.int64)
+    for f, t in enumerate(snap.tasks):
+        dr = drow.get(t.uid)
+        if dr is None or dr == tstar:
+            return None
+        remap[f] = dr
+    fstart, fcount = npa(finp.job_start), npa(finp.job_count)
+    dstart, dcount = dinp["job_start"], dinp["job_count"]
+    if fstart.shape != dstart.shape or jstar >= dcount.shape[0]:
+        return None
+    adjc = np.zeros_like(dcount)
+    adjc[jstar] = 1
+    if not np.array_equal(fcount, dcount - adjc):
+        return None
+    fsorted, dsorted = npa(finp.task_sorted), dinp["task_sorted"]
+    if int(dsorted[int(dstart[jstar])]) != tstar:
+        return None
+    jobs = np.nonzero(fcount > 0)[0]
+    reps = fcount[jobs].astype(np.int64)
+    total = int(reps.sum())
+    if total != len(snap.tasks):
+        return None
+    if total:
+        jrep = np.repeat(jobs, reps)
+        within = (np.arange(total, dtype=np.int64)
+                  - np.repeat(np.cumsum(reps) - reps, reps))
+        fpos = fstart[jrep].astype(np.int64) + within
+        dpos = (dstart[jrep].astype(np.int64)
+                + (jrep == jstar).astype(np.int64) + within)
+        frows = fsorted[fpos]
+        if frows.size and int(frows.max()) >= remap.shape[0]:
+            return None
+        if not np.array_equal(remap[frows], dsorted[dpos]):
+            return None
+
+    # P4 — per-task columns equal under the uid remap; sig tables and
+    # every axis the iteration cannot touch bit-equal.
+    rows = np.arange(len(snap.tasks), dtype=np.int64)
+    for name in ("task_req", "task_res", "task_sig", "task_ports",
+                 "task_aff_req", "task_anti", "task_match",
+                 "task_paff_w", "task_panti_w"):
+        fa, da = npa(getattr(finp, name)), dinp[name]
+        if fa.shape[1:] != da.shape[1:] or fa.shape[0] < len(snap.tasks):
+            return None
+        if not np.array_equal(fa[rows], da[remap]):
+            return None
+    for name in ("sig_mask", "sig_bonus", "node_idle", "node_alloc",
+                 "node_max_tasks", "node_exists", "node_coords",
+                 "queue_deserved", "queue_deserved_f", "queue_ts",
+                 "queue_uid_rank", "queue_exists", "job_queue",
+                 "job_minavail", "job_prio", "job_ts", "job_uid_rank",
+                 "total_res", "eps", "scalar_dims", "score_shift"):
+        fa, da = npa(getattr(finp, name)), dinp[name]
+        if fa.shape != da.shape or not np.array_equal(fa, da):
+            return None
+
+    # P3 — fresh mutated axes == dispatch + modeled deltas (int64
+    # intermediates; int32 staging can't overflow them).
+    i64 = np.int64
+    tres = dinp["task_res"][tstar].astype(i64)
+    vres = storm.vic_res[slots].astype(i64)
+    vnode = storm.vic_node[slots].astype(i64)
+    if slots.size and not np.all(vnode == nstar):
+        return None
+    exp = dinp["node_releasing"].astype(i64)
+    np.add.at(exp, vnode, vres)
+    exp[nstar] -= tres
+    if not np.array_equal(npa(finp.node_releasing).astype(i64), exp):
+        return None
+    exp = dinp["node_used"].astype(i64)
+    exp[nstar] += tres
+    if not np.array_equal(npa(finp.node_used).astype(i64), exp):
+        return None
+    exp = dinp["node_count"].astype(i64)
+    exp[nstar] += 1
+    if not np.array_equal(npa(finp.node_count).astype(i64), exp):
+        return None
+    expp = dinp["node_ports"].copy()
+    expp[nstar] = expp[nstar] | dinp["task_ports"][tstar]
+    if not np.array_equal(npa(finp.node_ports), expp):
+        return None
+    exp = dinp["node_selcnt"].astype(i64)
+    exp[nstar] += dinp["task_match"][tstar].astype(i64)
+    if not np.array_equal(npa(finp.node_selcnt).astype(i64), exp):
+        return None
+    qb = dinp["queue_init_alloc"].shape[0]
+    jb = dinp["job_init_alloc"].shape[0]
+    if qstar >= qb:
+        return None
+    if snap.config.has_proportion:
+        exp = dinp["queue_init_alloc"].astype(i64)
+        vq = storm.vic_qix[slots].astype(i64)
+        keep = vq < qb
+        np.subtract.at(exp, vq[keep], vres[keep])
+        exp[qstar] += tres
+        if not np.array_equal(npa(finp.queue_init_alloc).astype(i64),
+                              exp):
+            return None
+    elif not np.array_equal(npa(finp.queue_init_alloc),
+                            dinp["queue_init_alloc"]):
+        return None
+    vj = storm.vic_jix[slots].astype(i64)
+    keepj = vj < jb
+    exp = dinp["job_init_alloc"].astype(i64)
+    np.subtract.at(exp, vj[keepj], vres[keepj])
+    if not np.array_equal(npa(finp.job_init_alloc).astype(i64), exp):
+        return None
+    exp = dinp["job_init_ready"].astype(i64)
+    np.subtract.at(exp, vj[keepj], 1)
+    if not np.array_equal(npa(finp.job_init_ready).astype(i64), exp):
+        return None
+
+    # Serve: remap the packed adjusted solve onto the fresh task axis.
+    # Fresh real row f held dispatch row remap[f]; extras (BestEffort)
+    # and padding rows stay unplaced, exactly as a fresh solve leaves
+    # them.  The perm rebuild is _pack_result_ordered's argsort over
+    # the same (placed, order) keys, so the fetch path decodes the
+    # served leg exactly like a per-family readback.
+    from .solver import PendingSolve
+    packed = np.asarray(pending.packed)
+    if packed.ndim != 2 or packed.shape[0] != 4:
+        return None
+    if remap.size and int(remap.max()) >= packed.shape[1]:
+        return None
+    pf = int(npa(finp.task_req).shape[0])
+    a_f = np.zeros((pf,), np.int32)
+    k_f = np.zeros((pf,), np.int32)
+    o_f = np.zeros((pf,), np.int32)
+    a_f[rows] = packed[0][remap]
+    k_f[rows] = packed[1][remap]
+    o_f[rows] = packed[2][remap]
+    if int((packed[1] > 0).sum()) != int((k_f > 0).sum()):
+        return None  # the device placed a row outside the fresh universe
+    key = np.where(k_f > 0, o_f.astype(np.int64),
+                   np.iinfo(np.int32).max)
+    perm_f = np.argsort(key, kind="stable").astype(np.int32)
+    out = np.ascontiguousarray(np.stack([a_f, k_f, o_f, perm_f]))
+    return PendingSolve(out, remap=None)
 
 
 def take_topo(ssn, inp, shape, n: int):
@@ -522,12 +1108,38 @@ def take_topo(ssn, inp, shape, n: int):
     return stats[:n]
 
 
+def flush_deferred(ssn) -> None:
+    """Flush commit sinks the action-commit scope deferred into the
+    fused dispatch window (framework/commit.py): tpu-allocate's finish
+    calls this FIRST — before fetching the device result — so the
+    cluster egress overlaps the device wait and evict events still
+    precede the session's binds on every path (served, invalidated,
+    fallback).  close_session's finalize is the safety net when the
+    consume never ran."""
+    sinks = getattr(ssn, "_deferred_flush", None)
+    if not sinks:
+        return
+    ssn._deferred_flush = []
+    for sink in sinks:
+        sink.flush()
+
+
 def finalize_session(ssn) -> None:
-    """Ledger hygiene at session close/abandon: an alloc leg nobody
-    consumed (incremental cache answered first, fallback path, stale
-    abort) still holds an in-flight dispatch handle — retire it."""
+    """Ledger hygiene at session close/abandon: flush any commit sinks
+    still deferred into a dispatch window nobody reached, release the
+    storm capture, and retire an unconsumed alloc leg's in-flight
+    dispatch handle (incremental cache answered first, fallback path,
+    stale abort)."""
+    flush_deferred(ssn)
     st = getattr(ssn, "_fused_state", None)
-    if st is None or st.alloc_pending is None:
+    if st is None:
+        return
+    storm = getattr(st, "storm", None)
+    if storm is not None:
+        st.storm = None
+        ssn._fused_mutlog = None
+        storm.release()
+    if st.alloc_pending is None:
         return
     from ..metrics import metrics
     from .solver import discard_solve

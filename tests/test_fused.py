@@ -148,6 +148,59 @@ class TestFusedParity:
         assert results["fused"] == results["control"]
         assert legs_fused.get("solve/served", 0) >= 1
 
+    @pytest.mark.parametrize("force_shard", ["0", "1"])
+    def test_storm_served_parity_vs_storm_off(self, force_shard,
+                                              monkeypatch):
+        """The storm bit-parity control (KUBE_BATCH_TPU_FUSED_STORM=0):
+        on the crafted served-storm cycle the postevict leg SERVES —
+        and victims, victim ORDER, binds and end state are identical to
+        the per-family re-dispatch arm, on the single-chip AND the
+        FORCE_SHARD mesh leg."""
+        from kube_batch_tpu.models.synthetic import make_storm_served_cache
+        from kube_batch_tpu.ops.solver import (FORCE_SHARD_ENV,
+                                               refresh_shard_knobs)
+        actions, tiers = _storm_conf()
+        results = {}
+        try:
+            monkeypatch.setenv(FORCE_SHARD_ENV, force_shard)
+            monkeypatch.setenv("KUBE_BATCH_TPU_FUSED", "1")
+            refresh_shard_knobs()
+            for name, storm in (("storm", "1"), ("control", "0")):
+                monkeypatch.setenv("KUBE_BATCH_TPU_FUSED_STORM", storm)
+                cache, binder = make_storm_served_cache()
+                state, _disp, legs = _dispatch_delta(
+                    lambda: _drive(cache, actions, tiers))
+                results[name] = (state, list(cache.evictor.evicts),
+                                 dict(binder.binds))
+                if name == "storm":
+                    assert legs.get("postevict/served", 0) >= 1, \
+                        "the crafted storm must SERVE the postevict leg"
+        finally:
+            monkeypatch.delenv(FORCE_SHARD_ENV, raising=False)
+            refresh_shard_knobs()
+        assert results["storm"][1], "storm must evict"
+        assert results["storm"][2], "storm must bind"
+        assert results["storm"] == results["control"]
+
+    def test_storm_commit_window_parity_vs_sequential_commit(
+            self, monkeypatch):
+        """Folding the commit flush into the dispatch window must not
+        change a single effect: the KUBE_BATCH_TPU_BATCH_COMMIT=0
+        sequential control (per-task egress at decision time, no sink
+        at all) sees the same victims, order, binds, end state."""
+        from kube_batch_tpu.models.synthetic import make_storm_served_cache
+        monkeypatch.setenv("KUBE_BATCH_TPU_FUSED", "1")
+        actions, tiers = _storm_conf()
+        results = {}
+        for name, batch in (("window", "1"), ("sequential", "0")):
+            monkeypatch.setenv("KUBE_BATCH_TPU_BATCH_COMMIT", batch)
+            cache, binder = make_storm_served_cache()
+            state = _drive(cache, actions, tiers)
+            results[name] = (state, list(cache.evictor.evicts),
+                             dict(binder.binds))
+        assert results["window"][1], "storm must evict"
+        assert results["window"] == results["sequential"]
+
     def test_mesh_leg_parity(self, monkeypatch):
         """FORCE_SHARD: the fused program routed through the sharded
         solvers reproduces the single-chip footprint."""
@@ -213,12 +266,14 @@ class TestOneDispatch:
         assert legs.get("solve/served", 0) == 1
 
     def test_storm_invalidation_falls_back_per_family(self, monkeypatch):
-        """The storm's own evictions land between the fused dispatch
-        and tpu-allocate's ship: the alloc leg is host-invalidated
+        """The FUSED_STORM=0 control arm: without the postevict leg,
+        the storm's own evictions land between the fused dispatch and
+        tpu-allocate's ship, the alloc leg is host-invalidated
         (counted) and the action re-dispatches per-family — decisions
         unchanged (TestFusedParity), dispatches accounted here."""
         from kube_batch_tpu.models.synthetic import make_churn_cache
         monkeypatch.setenv("KUBE_BATCH_TPU_FUSED", "1")
+        monkeypatch.setenv("KUBE_BATCH_TPU_FUSED_STORM", "0")
         actions, tiers = _storm_conf()
         cache, _binder = make_churn_cache(420, 64, 20, 3)
         _state, disp, legs = _dispatch_delta(
@@ -230,6 +285,110 @@ class TestOneDispatch:
         assert legs.get("solve/invalidated", 0) >= 1
         assert disp.get("solve", 0) >= 1, \
             "an invalidated alloc leg must re-dispatch per-family"
+
+    def test_storm_cycle_is_exactly_one_dispatch(self, monkeypatch):
+        """The storm tentpole (doc/FUSED.md "Storm half"): an
+        eviction-heavy cycle whose reclaim iteration the device
+        predicted correctly converges to EXACTLY ONE solve-family
+        dispatch — the victims commit from the evict leg, the
+        post-eviction placements serve from the postevict leg, nothing
+        re-dispatches."""
+        from kube_batch_tpu.models.synthetic import make_storm_served_cache
+        monkeypatch.setenv("KUBE_BATCH_TPU_FUSED", "1")
+        actions, tiers = _storm_conf()
+        cache, binder = make_storm_served_cache()
+        _state, disp, legs = _dispatch_delta(
+            lambda: _drive(cache, actions, tiers))
+        assert cache.evictor.evicts, "storm must evict"
+        assert binder.binds, "the served postevict leg must bind"
+        assert disp == {"fused": 1}, \
+            f"storm cycle must dispatch ONCE, got {disp}"
+        assert legs.get("evict/served", 0) == 1
+        assert legs.get("postevict/served", 0) == 1
+
+    def test_storm_divergence_invalidates_postevict(self, monkeypatch):
+        """Victim-order divergence: the conformance filter drops the
+        first slot-order resident from the host walk, so the committed
+        victim sequence differs from the device's predicted prefix —
+        the order proof refuses the leg (counted) and the action
+        re-dispatches per-family, with the critical pod untouched."""
+        from kube_batch_tpu.models.synthetic import make_storm_served_cache
+        monkeypatch.setenv("KUBE_BATCH_TPU_FUSED", "1")
+        actions, tiers = _storm_conf()
+        cache, _binder = make_storm_served_cache(critical_first=True)
+        _state, disp, legs = _dispatch_delta(
+            lambda: _drive(cache, actions, tiers))
+        assert cache.evictor.evicts, "storm must still evict"
+        assert "storm/low00000" not in cache.evictor.evicts, \
+            "the critical pod must never be evicted"
+        assert legs.get("postevict/invalidated", 0) >= 1
+        assert disp.get("solve", 0) >= 1, \
+            "an invalidated postevict leg must re-dispatch per-family"
+
+    def test_storm_flush_rides_dispatch_window(self, monkeypatch):
+        """Commit-flush-in-the-window: reclaim's CommitSink defers its
+        bulk egress into tpu-allocate's device-wait window (one fused
+        flush per storm cycle) — nothing reaches the evictor at reclaim
+        exit, everything has BEFORE the session's binds egress."""
+        from kube_batch_tpu.models.synthetic import make_storm_served_cache
+        monkeypatch.setenv("KUBE_BATCH_TPU_FUSED", "1")
+        actions, tiers = _storm_conf()
+        cache, binder = make_storm_served_cache()
+        by_name = {a.name(): a for a in actions}
+        ssn = open_session(cache, tiers)
+        ssn._conf_actions = tuple(a.name() for a in actions)
+        try:
+            by_name["reclaim"].execute(ssn)
+            assert len(getattr(ssn, "_deferred_flush", ())) == 1, \
+                "reclaim's sink must defer into the dispatch window"
+            assert not cache.evictor.evicts, \
+                "no cluster egress before the window"
+            assert not binder.binds
+            by_name["tpu-allocate"].execute(ssn)
+            assert len(cache.evictor.evicts) == 3, \
+                "the deferred flush must drain inside the window"
+            assert not ssn._deferred_flush
+            assert binder.binds, "binds egress after the flush"
+        finally:
+            close_session(ssn)
+
+    def test_postevict_poison_degrades_without_double_evict(
+            self, monkeypatch):
+        """Chaos site fused.postevict_poison (doc/CHAOS.md): a
+        malformed served leg dies in tpu-allocate's _validate_result
+        BEFORE any apply, the cycle degrades to the host path, and the
+        degraded cycle's binds bit-match the oracle — and the victims
+        are evicted exactly ONCE (the leg only places; the host walk
+        owns the evictions)."""
+        from kube_batch_tpu.chaos import breaker as breaker_mod
+        from kube_batch_tpu.chaos import plan as chaos_plan
+        from kube_batch_tpu.chaos.breaker import CircuitBreaker
+        from kube_batch_tpu.models.synthetic import make_storm_served_cache
+        monkeypatch.setenv("KUBE_BATCH_TPU_FUSED", "1")
+        monkeypatch.setattr(
+            breaker_mod, "_device_breaker",
+            CircuitBreaker("device_solve", threshold=99, cooldown=1.0))
+        actions, tiers = _storm_conf()
+        # Oracle arm first (no chaos): the per-family control decisions.
+        monkeypatch.setenv("KUBE_BATCH_TPU_FUSED_STORM", "0")
+        cache, binder = make_storm_served_cache()
+        oracle = (_drive(cache, actions, tiers),
+                  list(cache.evictor.evicts), dict(binder.binds))
+        monkeypatch.setenv("KUBE_BATCH_TPU_FUSED_STORM", "1")
+        plan = chaos_plan.install(chaos_plan.FaultPlan(
+            seed=5, rate=1.0, sites=("fused.postevict_poison",)))
+        try:
+            cache, binder = make_storm_served_cache()
+            state = _drive(cache, actions, tiers)
+            poisoned = (state, list(cache.evictor.evicts),
+                        dict(binder.binds))
+        finally:
+            chaos_plan.disable()
+        assert plan.injected().get("fused.postevict_poison", 0) >= 1
+        assert poisoned == oracle, \
+            "the degraded cycle must bit-match the oracle"
+        assert len(poisoned[1]) == len(set(poisoned[1])), \
+            "a poisoned leg must never double-evict"
 
     def test_fused_off_restores_per_family_dispatches(self, monkeypatch):
         """KUBE_BATCH_TPU_FUSED=0 is the bit-parity control: no fused

@@ -135,9 +135,9 @@ def test_knob_registry_coverage_pinned():
     is pinned so a knob added without a declaration (or a declaration
     dropped without removing the flag) fails here, not in review."""
     from kube_batch_tpu import knobs
-    assert len(knobs.REGISTRY) == 44, sorted(knobs.REGISTRY)
+    assert len(knobs.REGISTRY) == 45, sorted(knobs.REGISTRY)
     rows = knobs.inventory_rows()
-    assert len(rows) == 44
+    assert len(rows) == 45
     inventory = (ROOT / "doc" / "INVENTORY.md").read_text(encoding="utf-8")
     for env in knobs.REGISTRY:
         assert env in inventory, f"{env} missing from doc/INVENTORY.md"
@@ -158,13 +158,13 @@ def test_registries_collected_nonempty():
         knob_rule.collect(sf, ctx)
         registry_rule.collect(sf, ctx)
         ledger_rule.collect(sf, ctx)
-    assert len(ctx.knob_decls) == 44
+    assert len(ctx.knob_decls) == 45
     assert len(ctx.metric_decls) >= 80, len(ctx.metric_decls)
     assert len(ctx.chaos_sites) >= 16, sorted(ctx.chaos_sites)
     # ledger-discipline: the catalogue, every marked store, and the
     # registration calls must all be visible to the rule (an anchor-path
     # regression would make it vacuously green).
-    assert len(ctx.ledger_catalogue) == 12, sorted(ctx.ledger_catalogue)
+    assert len(ctx.ledger_catalogue) == 13, sorted(ctx.ledger_catalogue)
     marked = {name for _p, _l, _c, name in ctx.ledger_markers}
     # compile_cache's store is a module-level set (no class to mark);
     # every other catalogued ledger has a marked owning class.

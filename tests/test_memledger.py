@@ -106,7 +106,7 @@ class TestLedgerMechanics:
         assert led.audit() == (300, 900)
 
     def test_catalogue_names_are_the_only_ledgers(self):
-        assert len(memledger.LEDGER_CATALOGUE) == 12
+        assert len(memledger.LEDGER_CATALOGUE) == 13
         with pytest.raises(KeyError):
             memledger.ledger("not-a-ledger")
 

@@ -130,6 +130,27 @@ GATED_KEYS = {
     "steady_dispatches.solve": {
         "path": ("session_dispatches", "solve"), "direction": "down",
         "band": 0.0, "abs_slack": 0.0},
+    # Storm half of the one-dispatch contract (doc/FUSED.md "Storm
+    # half"): solve-family device dispatches for the served-storm cycle
+    # — the eviction-heavy session whose postevict leg serves from the
+    # fused program.  Exactly one at the gate shape; deterministic, so
+    # NO band — a change that makes the storm re-dispatch (prediction
+    # divergence at the crafted scenario, a proof regression, a second
+    # solve) fails as a count, not a latency blur.
+    "storm_dispatches.solve": {
+        "path": ("storm_dispatches", "solve"), "direction": "down",
+        "band": 0.0, "abs_slack": 0.0},
+    # The served-storm session walls at the gate-scaled scenario: the
+    # storm arm's one-dispatch cycle and the FUSED_STORM=0 per-family
+    # control.  Single-sample walls, so latency-class bands — the
+    # deterministic win lives in storm_dispatches.solve above; these
+    # track the trajectory of the wall it buys.
+    "storm_ms": {
+        "path": ("storm_ms",), "direction": "down",
+        "band": 1.0, "abs_slack": 5.0},
+    "storm_seq_ms": {
+        "path": ("storm_seq_ms",), "direction": "down",
+        "band": 1.0, "abs_slack": 5.0},
     # Shard-scoped ingest probe (doc/INGEST.md): deterministic watch
     # bytes and retained baseline bytes for a half-scoped replica at
     # the fixed probe shape.  Both are directional DOWN — the whole

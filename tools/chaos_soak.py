@@ -73,6 +73,11 @@ tiers:
 # would have served (a storm mostly falls back to full rebuilds on its
 # own), so the soak exercises it opportunistically while the dedicated
 # degradation test lives in tests/test_incremental_sessions.py.
+# ``fused.postevict_poison`` is likewise not required: it only
+# activates when a reclaim storm's postevict leg is consumed, and this
+# soak's conf ladder has no reclaim action — the dedicated degradation
+# test (poisoned leg dies in tpu-allocate's _validate_result, degrade
+# without double-evict) lives in tests/test_fused.py.
 FAKE_SITES = ("session.snapshot", "session.tensorize", "solve.device_error",
               "solve.slow", "solve.poison", "evict_solve.device_error",
               "fused.device_error", "fused.slow", "fused.poison",
@@ -456,6 +461,11 @@ def run_soak(seeds, *, nodes: int = 8, cycles: int = 10,
                   ("fused.device_error", min(1.0, rate * 1.2)),
                   ("fused.slow", min(1.0, rate * 3.0)),
                   ("fused.poison", min(1.0, rate * 2.4)),
+                  # Draws only when a reclaim storm's postevict leg is
+                  # consumed (see FAKE_SITES note: this soak's conf has
+                  # no reclaim, so activation is opportunistic — a
+                  # reclaim-enabled soak inherits the boost).
+                  ("fused.postevict_poison", min(1.0, rate * 2.4)),
                   # Fires only on micro-eligible cycles (see FAKE_SITES
                   # note): boost it so those cycles do get hit.
                   ("incremental.stale_generation", min(1.0, rate * 1.6)),

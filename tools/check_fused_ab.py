@@ -10,11 +10,21 @@ line from stdin and assert the one-dispatch subsystem's contracts
    sharded solvers) reproduces the single-chip footprint.
 3. TOPO PARITY — the three-family (evict+solve+topo) dispatch on the
    fragmentation-pressure torus matches the FUSED=0 control.
-4. NON-VACUOUS — at least one fused dispatch actually happened, each
+4. STORM PARITY — the served-storm leg (doc/FUSED.md "Storm half"):
+   the crafted reclaim scenario's footprint is bit-identical to the
+   KUBE_BATCH_TPU_FUSED_STORM=0 per-family control and the FORCE_SHARD
+   mesh leg.
+5. ONE DISPATCH — the served-storm cycle converges to EXACTLY one
+   solve-family device dispatch (storm_dispatches.solve == 1): the
+   postevict leg served, nothing re-dispatched.
+6. NON-VACUOUS — at least one fused dispatch actually happened, each
    of the three families was SERVED from a fused dispatch somewhere in
    the run (a dispatched-but-never-consumed leg measures nothing), the
-   three-family route was taken, and the storm really stormed
-   (evictions >= 1) while the quiet leg really placed.
+   postevict leg was SERVED on the storm leg (zero served postevict
+   legs means the one-dispatch count measured a quiet cycle — the
+   gate fails vacuously), the three-family route was taken, and the
+   storm really stormed (evictions >= 1) while the quiet leg really
+   placed.
 
 bench.py deliberately always exits 0 (the artifact-always-emits
 contract), so pass/fail lives here — the check_evict_ab discipline.
@@ -43,7 +53,8 @@ def main() -> int:
     for key, what in (
             ("fused_parity", "storm/quiet footprint"),
             ("fused_shard_parity", "FORCE_SHARD mesh leg"),
-            ("fused_topo_parity", "three-family topology leg")):
+            ("fused_topo_parity", "three-family topology leg"),
+            ("fused_storm_parity", "served-storm one-dispatch leg")):
         if out.get(key) is not True:
             print(f"check_fused_ab: PARITY FAILURE — {what} diverged "
                   f"from the KUBE_BATCH_TPU_FUSED=0 control "
@@ -63,6 +74,30 @@ def main() -> int:
                   "never SERVED from a fused dispatch "
                   f"(legs={legs})", file=sys.stderr)
             return 1
+    # Storm half (doc/FUSED.md): the served-storm cycle must converge
+    # to EXACTLY one solve-family dispatch, and that count is only
+    # meaningful if the postevict leg actually SERVED — zero served
+    # postevict legs fails vacuously (the cycle measured was quiet).
+    storm_legs = ab.get("storm_legs") or {}
+    if storm_legs.get("postevict/served", 0) < 1:
+        print("check_fused_ab: VACUOUS — the postevict family was "
+              "never SERVED on the served-storm leg "
+              f"(storm_legs={storm_legs})", file=sys.stderr)
+        return 1
+    storm_dispatches = ab.get("storm_dispatches") or {}
+    if storm_dispatches.get("solve", 0) != 1:
+        print("check_fused_ab: ONE-DISPATCH FAILURE — the served-storm "
+              "cycle took "
+              f"{storm_dispatches.get('solve', 0)} solve-family "
+              "dispatches (must be exactly 1: evict + postevict legs "
+              "served from ONE fused program)", file=sys.stderr)
+        return 1
+    if ab.get("storm_evictions", 0) < 1 or ab.get("storm_binds", 0) < 1:
+        print("check_fused_ab: VACUOUS — the served-storm leg did not "
+              f"both evict and bind (evictions="
+              f"{ab.get('storm_evictions')}, binds="
+              f"{ab.get('storm_binds')})", file=sys.stderr)
+        return 1
     routes = ab.get("topo_routes") or {}
     if routes.get("fused/evict+solve+topo", 0) < 1:
         print("check_fused_ab: VACUOUS — no three-family "
@@ -79,11 +114,16 @@ def main() -> int:
               f"(binds={ab.get('binds')}, quiet={ab.get('quiet_binds')}, "
               f"slice={ab.get('topo_slice_binds')})", file=sys.stderr)
         return 1
-    print("fused session A/B: parity OK (storm + quiet + mesh + topo)")
+    print("fused session A/B: parity OK "
+          "(storm + quiet + mesh + topo + served-storm)")
     print(f"  fused dispatches {dispatches.get('fused'):3d}   "
           f"storm evictions {ab.get('evictions')}   "
           f"binds {ab.get('binds')}+{ab.get('quiet_binds')} quiet")
     print(f"  legs {legs}")
+    print(f"  served-storm: {storm_dispatches.get('solve')} dispatch, "
+          f"legs {storm_legs}, "
+          f"on {ab.get('storm_on_ms')} ms / off {ab.get('storm_off_ms')}"
+          " ms")
     print(f"  on {ab.get('on_ms')} ms / off {ab.get('off_ms')} ms "
           f"(per-session median, same-box counterbalanced)")
     return 0

@@ -14,8 +14,12 @@ Two legs, one process:
   then deletes everything.  After the drain the mirror / pending /
   baseline ledgers must return exactly to their pre-burst totals
   (deletes give the bytes back), and the audit must still reconcile.
+* **Storm leg** — one served-storm session (doc/FUSED.md "Storm
+  half"): the by-value proof capture must fill the ``fused_storm``
+  ledger while the fused dispatch is in flight and release every byte
+  when the leg is consumed.
 
-A vacuity guard requires at least 8 of the 12 catalogued ledgers to
+A vacuity guard requires at least 8 of the 13 catalogued ledgers to
 have held non-zero bytes at some point during the run — a refactor
 that silently unregisters the hooks cannot green-light this gate.
 
@@ -153,6 +157,27 @@ def run_scheduler_leg(out: dict, failures: list) -> None:
     out["last_half_growth"] = growth
 
 
+def run_storm_leg(out: dict, failures: list) -> None:
+    """Storm-capture books (doc/FUSED.md "Storm half"): one served-storm
+    session fills the fused_storm ledger with the by-value proof capture
+    between dispatch and consume, then releases every byte."""
+    import bench
+    bench._fused_served_storm_arm(True)
+    peak = memledger.watermarks().get("fused_storm", 0)
+    final = memledger.totals().get("fused_storm", 0)
+    if peak <= 0:
+        failures.append("storm: VACUOUS — the served-storm session never "
+                        "tracked a fused_storm capture")
+    if final != 0:
+        failures.append(f"storm: LEAK — fused_storm holds {final} bytes "
+                        "after the capture was consumed")
+    report = memledger.audit_mem_ledgers(raise_on_drift=False)
+    drift = report.get("_drift")
+    if drift:
+        failures.append("storm: AUDIT — " + "; ".join(drift["failures"]))
+    out["storm"] = {"fused_storm_peak": peak, "fused_storm_final": final}
+
+
 def run_edge_leg(out: dict, failures: list) -> None:
     cluster = Cluster()
     cluster.create_queue(v1alpha1.Queue(
@@ -208,6 +233,9 @@ def main() -> int:
     try:
         run_scheduler_leg(out, failures)
         live.update(n for n, v in memledger.totals().items() if v > 0)
+        run_storm_leg(out, failures)
+        if out.get("storm", {}).get("fused_storm_peak", 0) > 0:
+            live.add("fused_storm")
         run_edge_leg(out, failures)
         live.update(n for n, v in out["edge"]["burst"].items() if v > 0)
     except Exception as exc:  # noqa: BLE001 — artifact stays honest

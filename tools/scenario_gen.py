@@ -81,6 +81,7 @@ SEQUENTIAL_CONTROLS = {
     "KUBE_BATCH_TPU_WIRE_FAST": "0",
     "KUBE_BATCH_TPU_BATCH_COMMIT": "0",
     "KUBE_BATCH_TPU_FUSED": "0",
+    "KUBE_BATCH_TPU_FUSED_STORM": "0",
     "KUBE_BATCH_TPU_LAZY_TASKS": "0",
 }
 
